@@ -1,0 +1,17 @@
+"""esr_tpu — a TPU-native event-stream super-resolution framework.
+
+A from-scratch JAX/Flax/Pallas rebuild of the capabilities of WarranWeng/ESR
+(ECCV 2022, "Boosting Event Stream Super-Resolution with A Recurrent Neural
+Network"), designed TPU-first:
+
+- event rasterization as jit'd scatter-add ops (``esr_tpu.ops.encodings``)
+- deformable convolution as a gather-and-MAC formulation with a Pallas path
+  (``esr_tpu.ops.dcn``)
+- the recurrent SR network as functional Flax modules with explicit state
+  (``esr_tpu.models``)
+- BPTT over event windows via ``jax.lax.scan`` (``esr_tpu.training``)
+- data parallelism via ``jax.sharding`` meshes + XLA collectives
+  (``esr_tpu.parallel``)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from . import encodings
+from . import resize
+from . import dcn

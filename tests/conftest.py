@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device CPU mesh before JAX initializes.
+
+Multi-device sharding tests run on virtual CPU devices
+(``--xla_force_host_platform_device_count=8``), the JAX equivalent of the
+fake-backend distributed tests the reference lacks (SURVEY.md §4).
+
+Note: the env var ``JAX_PLATFORMS=cpu`` alone is not honored when a TPU
+plugin is installed; ``jax.config.update`` is authoritative.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,135 @@
+"""DCNv2 correctness tests.
+
+Mirrors the reference's test strategy (``models/DCNv2/testcuda.py``):
+zero-offset DCN == regular conv identity, gradient sanity, plus a numerical
+parity check against torchvision's deform_conv2d (same DCNv2 semantics as the
+reference's CUDA extension).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.ops.dcn import deform_conv2d, dcn_offsets_from_conv
+
+
+def _zero_offset_case(b=2, h=8, w=8, cin=4, cout=6, dg=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((b, h, w, cin)).astype(np.float32)
+    weight = rng.standard_normal((3, 3, cin, cout)).astype(np.float32) * 0.1
+    bias = rng.standard_normal((cout,)).astype(np.float32)
+    offsets = np.zeros((b, h, w, dg, 9, 2), np.float32)
+    mask = np.ones((b, h, w, dg, 9), np.float32)
+    return x, offsets, mask, weight, bias
+
+
+def test_zero_offset_equals_regular_conv():
+    x, offsets, mask, weight, bias = _zero_offset_case()
+    out = deform_conv2d(
+        jnp.array(x), jnp.array(offsets), jnp.array(mask), jnp.array(weight), jnp.array(bias)
+    )
+    ref = jax.lax.conv_general_dilated(
+        jnp.array(x), jnp.array(weight),
+        window_strides=(1, 1), padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + bias
+    np.testing.assert_allclose(np.array(out), np.array(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_integer_offset_shifts_sampling():
+    # A uniform (dy=0, dx=1) offset samples one pixel to the right: equivalent
+    # to deform-conv over the left-shifted image (with zero fill on the right).
+    b, h, w, cin, cout = 1, 6, 6, 2, 3
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((b, h, w, cin)).astype(np.float32)
+    weight = rng.standard_normal((3, 3, cin, cout)).astype(np.float32)
+    offsets = np.zeros((b, h, w, 1, 9, 2), np.float32)
+    offsets[..., 1] = 1.0
+    mask = np.ones((b, h, w, 1, 9), np.float32)
+    out = deform_conv2d(jnp.array(x), jnp.array(offsets), jnp.array(mask), jnp.array(weight))
+    x_shift = np.concatenate([x[:, :, 1:], np.zeros((b, h, 1, cin), np.float32)], axis=2)
+    ref = deform_conv2d(
+        jnp.array(x_shift), jnp.zeros_like(jnp.array(offsets)), jnp.array(mask), jnp.array(weight)
+    )
+    # Interior columns agree; both borders differ (zero fill vs gather).
+    np.testing.assert_allclose(
+        np.array(out)[:, :, 1 : w - 2], np.array(ref)[:, :, 1 : w - 2], atol=1e-4
+    )
+
+
+def test_mask_scales_output():
+    x, offsets, mask, weight, _ = _zero_offset_case()
+    out1 = deform_conv2d(jnp.array(x), jnp.array(offsets), jnp.array(mask), jnp.array(weight))
+    out2 = deform_conv2d(jnp.array(x), jnp.array(offsets), jnp.array(mask * 0.5), jnp.array(weight))
+    np.testing.assert_allclose(np.array(out2), np.array(out1) * 0.5, atol=1e-4)
+
+
+def test_stride_2_output_shape():
+    x, _, _, weight, _ = _zero_offset_case(h=9, w=9)
+    ho = wo = (9 + 2 * 1 - 3) // 2 + 1
+    offsets = jnp.zeros((2, ho, wo, 2, 9, 2))
+    mask = jnp.ones((2, ho, wo, 2, 9))
+    out = deform_conv2d(jnp.array(x), offsets, mask, jnp.array(weight), stride=2)
+    assert out.shape == (2, ho, wo, 6)
+
+
+def test_gradients_finite_and_nonzero():
+    x, offsets, mask, weight, bias = _zero_offset_case(b=1, h=5, w=5, cin=2, cout=2, dg=1)
+    offsets = offsets + 0.3  # fractional so offset grads are nonzero
+
+    def loss(x, off, m, wgt):
+        return jnp.sum(
+            deform_conv2d(jnp.array(x), off, m, wgt, jnp.array(bias)) ** 2
+        )
+
+    grads = jax.grad(loss, argnums=(0, 1, 2, 3))(
+        jnp.array(x), jnp.array(offsets), jnp.array(mask), jnp.array(weight)
+    )
+    for g in grads:
+        assert np.isfinite(np.array(g)).all()
+        assert np.abs(np.array(g)).max() > 0
+
+
+def test_matches_torchvision_deform_conv():
+    torchvision = pytest.importorskip("torchvision")
+    import torch
+
+    b, h, w, cin, cout, dg = 2, 7, 9, 4, 5, 2
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((b, h, w, cin)).astype(np.float32)
+    weight = rng.standard_normal((3, 3, cin, cout)).astype(np.float32) * 0.2
+    bias = rng.standard_normal((cout,)).astype(np.float32)
+    offsets = (rng.standard_normal((b, h, w, dg, 9, 2)) * 1.5).astype(np.float32)
+    mask = rng.random((b, h, w, dg, 9)).astype(np.float32)
+
+    out = deform_conv2d(
+        jnp.array(x), jnp.array(offsets), jnp.array(mask), jnp.array(weight), jnp.array(bias)
+    )
+
+    # torchvision layout: offset [B, dg*2*K, H, W] with (y, x) interleaved per
+    # tap; mask [B, dg*K, H, W]; weight [Cout, Cin, kh, kw].
+    off_t = np.transpose(offsets, (0, 3, 4, 5, 1, 2)).reshape(b, dg * 9 * 2, h, w)
+    mask_t = np.transpose(mask, (0, 3, 4, 1, 2)).reshape(b, dg * 9, h, w)
+    ref = torchvision.ops.deform_conv2d(
+        torch.from_numpy(x).permute(0, 3, 1, 2),
+        torch.from_numpy(off_t),
+        torch.from_numpy(weight).permute(3, 2, 0, 1),
+        torch.from_numpy(bias),
+        padding=1,
+        mask=torch.from_numpy(mask_t),
+    )
+    np.testing.assert_allclose(
+        np.array(out), ref.permute(0, 2, 3, 1).numpy(), atol=1e-4, rtol=1e-3
+    )
+
+
+def test_offsets_from_conv_layout():
+    b, ho, wo, dg, k = 1, 4, 4, 2, 9
+    raw = np.zeros((b, ho, wo, dg * 3 * k), np.float32)
+    offsets, mask = dcn_offsets_from_conv(jnp.array(raw), dg, k)
+    assert offsets.shape == (b, ho, wo, dg, k, 2)
+    assert mask.shape == (b, ho, wo, dg, k)
+    # zero-init conv -> zero offsets, mask = sigmoid(0) = 0.5
+    np.testing.assert_allclose(np.array(offsets), 0.0)
+    np.testing.assert_allclose(np.array(mask), 0.5)

@@ -1,0 +1,238 @@
+"""Golden tests for esr_tpu.ops.encodings against numpy references.
+
+Mirrors the reference's embedded property test (``encodings.py:673-696``):
+stack -> redistribute -> re-rasterize round trips, plus scatter-add parity.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from esr_tpu.ops import encodings as E
+
+
+def _rand_events(n, h, w, seed=0, frac_valid=1.0):
+    rng = np.random.default_rng(seed)
+    xs = rng.integers(0, w, n).astype(np.float32)
+    ys = rng.integers(0, h, n).astype(np.float32)
+    ts = np.sort(rng.random(n)).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    valid = (np.arange(n) < int(n * frac_valid)).astype(np.float32)
+    return xs, ys, ts, ps, valid
+
+
+def test_events_to_image_matches_numpy_scatter():
+    h, w, n = 13, 17, 500
+    xs, ys, ts, ps, _ = _rand_events(n, h, w)
+    img = np.array(E.events_to_image(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w)))
+    ref = np.zeros((h, w), np.float32)
+    np.add.at(ref, (ys.astype(int), xs.astype(int)), ps)
+    np.testing.assert_allclose(img, ref, atol=1e-5)
+
+
+def test_events_to_image_drops_out_of_range():
+    h, w = 8, 8
+    xs = np.array([0.0, 7.0, 8.0, -1.0, 100.0])
+    ys = np.array([0.0, 7.0, 3.0, 3.0, 100.0])
+    ps = np.ones(5, np.float32)
+    img = np.array(E.events_to_image(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w)))
+    assert img.sum() == 2.0
+    assert img[0, 0] == 1.0 and img[7, 7] == 1.0
+
+
+def test_events_to_image_drops_fractional_negative_coords():
+    # xs in (-1, 0) must be dropped, not truncated onto column 0 (the
+    # reference masks on the float coords before .long()).
+    h, w = 4, 4
+    xs = np.array([-0.4, 0.2], np.float32)
+    ys = np.array([1.0, 1.0], np.float32)
+    ps = np.ones(2, np.float32)
+    img = np.array(E.events_to_image(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w)))
+    assert img.sum() == 1.0 and img[1, 0] == 1.0
+
+
+def test_cnt2event_clamps_negative_counts():
+    # A model-predicted count image can contain negative values; they must
+    # not corrupt the cumsum-based cell assignment.
+    cnt = np.zeros((3, 3, 2), np.float32)
+    cnt[0, 0, 0] = -0.9
+    cnt[1, 1, 0] = 2.0
+    ev, valid = E.cnt2event(jnp.array(cnt), 8)
+    assert np.array(valid).sum() == 2
+    back = np.array(
+        E.events_to_channels(ev[:, 0], ev[:, 1], ev[:, 3], (3, 3), valid)
+    )
+    assert back[1, 1, 0] == 2.0 and back.sum() == 2.0
+
+
+def test_events_to_image_respects_valid_mask():
+    h, w, n = 10, 10, 200
+    xs, ys, ts, ps, valid = _rand_events(n, h, w, frac_valid=0.5)
+    img = np.array(
+        E.events_to_image(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w), jnp.array(valid))
+    )
+    k = int(valid.sum())
+    ref = np.zeros((h, w), np.float32)
+    np.add.at(ref, (ys[:k].astype(int), xs[:k].astype(int)), ps[:k])
+    np.testing.assert_allclose(img, ref, atol=1e-5)
+
+
+def test_events_to_image_bilinear_conserves_mass():
+    h, w, n = 16, 16, 300
+    rng = np.random.default_rng(1)
+    xs = rng.random(n).astype(np.float32) * (w - 2) + 0.3
+    ys = rng.random(n).astype(np.float32) * (h - 2) + 0.3
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    img = np.array(
+        E.events_to_image(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w), interpolation="bilinear")
+    )
+    np.testing.assert_allclose(img.sum(), ps.sum(), atol=1e-3)
+
+
+def test_events_to_channels_counts():
+    h, w, n = 12, 12, 400
+    xs, ys, ts, ps, _ = _rand_events(n, h, w, seed=2)
+    cnt = np.array(E.events_to_channels(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w)))
+    assert cnt.shape == (h, w, 2)
+    ref_pos = np.zeros((h, w), np.float32)
+    ref_neg = np.zeros((h, w), np.float32)
+    np.add.at(ref_pos, (ys[ps > 0].astype(int), xs[ps > 0].astype(int)), 1.0)
+    np.add.at(ref_neg, (ys[ps < 0].astype(int), xs[ps < 0].astype(int)), 1.0)
+    np.testing.assert_allclose(cnt[..., 0], ref_pos, atol=1e-5)
+    np.testing.assert_allclose(cnt[..., 1], ref_neg, atol=1e-5)
+    assert (cnt >= 0).all()
+
+
+def test_events_to_voxel_temporal_bilinear():
+    h, w, n, B = 9, 11, 250, 5
+    xs, ys, ts, ps, _ = _rand_events(n, h, w, seed=3)
+    vox = np.array(
+        E.events_to_voxel(jnp.array(xs), jnp.array(ys), jnp.array(ts), jnp.array(ps), B, (h, w))
+    )
+    assert vox.shape == (h, w, B)
+    ref = np.zeros((h, w, B), np.float32)
+    tn = ts * (B - 1)
+    for b in range(B):
+        wgt = np.maximum(0.0, 1.0 - np.abs(tn - b))
+        np.add.at(ref[..., b], (ys.astype(int), xs.astype(int)), ps * wgt)
+    np.testing.assert_allclose(vox, ref, atol=1e-4)
+    # total mass conserved (bilinear weights sum to 1 for ts in [0,1])
+    np.testing.assert_allclose(vox.sum(), ps.sum(), atol=1e-3)
+
+
+def test_events_to_stack_sums_to_count_image():
+    h, w, n, B = 14, 10, 300, 4
+    xs, ys, ts, ps, _ = _rand_events(n, h, w, seed=4)
+    stack = np.array(
+        E.events_to_stack(jnp.array(xs), jnp.array(ys), jnp.array(ts), jnp.array(ps), B, (h, w))
+    )
+    img = np.array(E.events_to_image(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w)))
+    np.testing.assert_allclose(stack.sum(-1), img, atol=1e-4)
+
+
+def test_events_to_stack_polarity_matches_channels():
+    h, w, n = 14, 10, 300
+    xs, ys, ts, ps, _ = _rand_events(n, h, w, seed=5)
+    stack = np.array(
+        E.events_to_stack(
+            jnp.array(xs), jnp.array(ys), jnp.array(ts), jnp.array(ps), 3, (h, w), polarity=True
+        )
+    )
+    assert stack.shape == (h, w, 3, 2)
+    cnt = np.array(E.events_to_channels(jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w)))
+    np.testing.assert_allclose(stack.sum(2), cnt, atol=1e-4)
+
+
+def test_polarity_mask():
+    ps = jnp.array([1.0, -1.0, 1.0, -1.0])
+    m = np.array(E.events_polarity_mask(ps))
+    np.testing.assert_allclose(m, [[1, 0], [0, 1], [1, 0], [0, 1]])
+
+
+def test_hot_event_mask():
+    rate = np.zeros((6, 6), np.float32)
+    rate[2, 3] = 0.95
+    rate[4, 4] = 0.85
+    rate[1, 1] = 0.5
+    mask = np.array(E.get_hot_event_mask(jnp.array(rate), idx=10, max_px=10, max_rate=0.8))
+    assert mask[2, 3] == 0 and mask[4, 4] == 0
+    assert mask[1, 1] == 1 and mask.sum() == 34
+    # before min_obvs: all ones
+    mask2 = np.array(E.get_hot_event_mask(jnp.array(rate), idx=2, max_px=10, max_rate=0.8))
+    assert mask2.sum() == 36
+
+
+def test_cnt2event_round_trip():
+    h, w = 7, 9
+    rng = np.random.default_rng(6)
+    cnt = rng.integers(0, 4, (h, w, 2)).astype(np.float32)
+    cap = int(cnt.sum()) + 10
+    ev, valid = E.cnt2event(jnp.array(cnt), cap)
+    ev, valid = np.array(ev), np.array(valid)
+    assert valid.sum() == cnt.sum()
+    # timestamps sorted
+    tv = ev[valid.astype(bool), 2]
+    assert (np.diff(tv) >= 0).all()
+    # re-rasterize == original counts
+    back = np.array(
+        E.events_to_channels(
+            jnp.array(ev[:, 0]), jnp.array(ev[:, 1]), jnp.array(ev[:, 3]), (h, w), jnp.array(valid)
+        )
+    )
+    np.testing.assert_allclose(back, cnt, atol=1e-5)
+
+
+def test_event_redistribute_round_trip():
+    # Reference's own property test (encodings.py:673-696): stack -> events ->
+    # re-binned stack reproduces the original.
+    h, w, B = 6, 8, 4
+    rng = np.random.default_rng(7)
+    stack = rng.integers(-3, 4, (h, w, B)).astype(np.float32)
+    cap = int(np.abs(stack).sum()) + 8
+    ev, valid = E.event_redistribute(jnp.array(stack), cap)
+    ev, valid = np.array(ev), np.array(valid)
+    assert valid.sum() == np.abs(stack).sum()
+    back = np.array(
+        E.events_to_stack(
+            jnp.array(ev[:, 0]), jnp.array(ev[:, 1]), jnp.array(ev[:, 2]), jnp.array(ev[:, 3]),
+            B, (h, w), jnp.array(valid),
+        )
+    )
+    np.testing.assert_allclose(back, stack, atol=1e-4)
+
+
+def test_event_redistribute_polarity_round_trip():
+    h, w, B = 5, 7, 3
+    rng = np.random.default_rng(8)
+    stack = rng.integers(0, 3, (h, w, B, 2)).astype(np.float32)
+    cap = int(stack.sum()) + 8
+    ev, valid = E.event_redistribute_polarity(jnp.array(stack), cap)
+    ev, valid = np.array(ev), np.array(valid)
+    assert valid.sum() == stack.sum()
+    back = np.array(
+        E.events_to_stack(
+            jnp.array(ev[:, 0]), jnp.array(ev[:, 1]), jnp.array(ev[:, 2]), jnp.array(ev[:, 3]),
+            B, (h, w), jnp.array(valid), polarity=True,
+        )
+    )
+    np.testing.assert_allclose(back, stack, atol=1e-4)
+
+
+def test_batched_cnt2event():
+    rng = np.random.default_rng(9)
+    cnt = rng.integers(0, 3, (2, 5, 5, 2)).astype(np.float32)
+    cap = 64
+    ev, valid = E.cnt2event_batch(jnp.array(cnt), cap)
+    assert ev.shape == (2, cap, 4)
+    # capacity clamps: valid count = min(cap, total events)
+    expect = np.minimum(cnt.sum((1, 2, 3)), cap)
+    assert np.array(valid).sum(1).tolist() == expect.tolist()
+
+
+def test_scaled_coords():
+    # LR coords on an HR grid: the SR input transform (h5dataset.py:520-537).
+    xs = jnp.array([0.0, 1.0, 2.0, 3.0])
+    ys = jnp.array([0.0, 1.0, 2.0, 3.0])
+    xn, yn = E.normalize_events(xs, ys, (4, 4))
+    sx, sy = E.scale_event_coords(xn, yn, (8, 8))
+    np.testing.assert_array_equal(np.array(sx), [0, 2, 4, 6])
+    np.testing.assert_array_equal(np.array(sy), [0, 2, 4, 6])

@@ -1,0 +1,45 @@
+"""Parity tests: esr_tpu.ops.resize vs torch.nn.functional.interpolate.
+
+The reference's metrics depend on torch's exact bicubic (a=-0.75,
+align_corners=False); these tests pin that parity (SURVEY.md §7.3 item 4).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F
+
+from esr_tpu.ops import resize as R
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "bicubic", "nearest"])
+@pytest.mark.parametrize(
+    "in_hw,out_hw",
+    [((8, 8), (16, 16)), ((15, 9), (30, 18)), ((16, 16), (8, 8)), ((7, 11), (20, 5))],
+)
+def test_matches_torch(mode, in_hw, out_hw):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, *in_hw, 3)).astype(np.float32)
+    ours = np.array(R.interpolate(jnp.array(x), out_hw, mode=mode))
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    kwargs = {} if mode == "nearest" else {"align_corners": False}
+    ref = F.interpolate(xt, size=out_hw, mode=mode, **kwargs)
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=1e-4)
+
+
+def test_scale_factor_form():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 6, 2)).astype(np.float32)
+    up = R.interpolate_scale(jnp.array(x), 2, mode="bilinear")
+    assert up.shape == (8, 12, 2)
+    xt = torch.from_numpy(x).permute(2, 0, 1)[None]
+    ref = F.interpolate(xt, scale_factor=2, mode="bilinear", align_corners=False)
+    np.testing.assert_allclose(np.array(up), ref[0].permute(1, 2, 0).numpy(), atol=2e-5)
+
+
+def test_identity():
+    x = jnp.ones((3, 5, 5, 2))
+    assert R.interpolate(x, (5, 5)) is x
