@@ -1,0 +1,67 @@
+"""Benchmark: train steps/sec on the flagship config, one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors the reference recipe (BASELINE.md): DeepRecurrNet inch=2
+basech=8, seqn=3, batch=2 per chip, seq_len=8 BPTT windows (L=10 frames),
+2x SR from the down16 NFS ladder (LR 45x80 -> HR 90x160), Adam + the gated
+exponential schedule. The reference publishes no numbers (BASELINE.json
+"published": {}), so vs_baseline is null until a measured GPU baseline
+exists.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.training.optim import make_reference_optimizer
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    # seq_len=8 BPTT: L - seqn + 1 = 8 windows
+    b, L, seqn = 2, 10, 3
+    h, w = 90, 160  # HR grid (2x SR of the down16 45x80 ladder)
+
+    model = DeepRecurrNet(inch=2, basech=8, num_frame=seqn)
+    rng = np.random.default_rng(0)
+    batch = {
+        "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+        "gt": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+    }
+    states = model.init_states(b, h, w)
+    params = model.init(jax.random.PRNGKey(0), batch["inp"][:, :seqn], states)
+    opt = make_reference_optimizer()
+    step = jax.jit(make_train_step(model, opt, seqn=seqn), donate_argnums=(0,))
+
+    state = TrainState.create(params, opt)
+    # warmup / compile
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_steps_per_sec_per_chip_seqlen8",
+                "value": round(steps_per_sec, 4),
+                "unit": "steps/s",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+from .layers import (
+    ConvLayer,
+    TransposedConvLayer,
+    UpsampleConvLayer,
+    RecurrentConvLayer,
+    ResidualBlock,
+    ConvLSTMCell,
+    ConvGRUCell,
+    MLP,
+)
+from .esr import DeepRecurrNet, FeatsExtract, TimePropagation, STFusion
+from .registry import get_model, register_model, MODEL_REGISTRY

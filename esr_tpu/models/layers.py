@@ -1,0 +1,363 @@
+"""Core NN building blocks (Flax linen), channel-last.
+
+Functional re-design of the reference's ``models/submodules.py``: same layer
+semantics (conv + optional norm + activation, bilinear-upsample conv, residual
+blocks, conv recurrences with orthogonal GRU init), but:
+
+- NHWC / HWIO layouts (TPU-native) instead of NCHW;
+- recurrent cells are pure functions of ``(input, state) -> (output, state)``
+  so the sequence dimension can ride ``jax.lax.scan`` and states shard
+  cleanly under ``pjit`` (the reference stores states on module attributes,
+  ``submodules.py:412-514``);
+- initializers mirror torch defaults (kaiming-uniform with a=sqrt(5), i.e.
+  U(±1/sqrt(fan_in)), ``torch.nn.Conv2d``/``Linear`` reset_parameters) so
+  training dynamics start from the same distribution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+Array = jax.Array
+
+
+def torch_uniform_init(fan_in_axes: str = "conv") -> Callable:
+    """U(-1/sqrt(fan_in), 1/sqrt(fan_in)) — torch's default conv/linear init."""
+
+    def init(key, shape, dtype=jnp.float32):
+        if fan_in_axes == "conv":  # HWIO
+            fan_in = int(np.prod(shape[:-1]))
+        else:  # dense: (in, out)
+            fan_in = shape[0]
+        bound = 1.0 / np.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+def torch_conv_bias_init(fan_in: int) -> Callable:
+    def init(key, shape, dtype=jnp.float32):
+        bound = 1.0 / np.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+_ACTIVATIONS = {
+    None: None,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "leaky_relu": jax.nn.leaky_relu,
+}
+
+
+def get_activation(name: Optional[str]) -> Optional[Callable]:
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unsupported activation: {name}")
+    return _ACTIVATIONS[name]
+
+
+class _NormWrapper(nn.Module):
+    """Optional norm following a conv (reference ConvLayer norm handling).
+
+    Only stateless norms are supported: ``'IN'`` (instance norm; the
+    reference's ``track_running_stats=True`` variant is approximated by the
+    batch statistics, which is what torch uses in training mode) and ``None``.
+    ``'BN'`` is rejected explicitly: batch statistics would need a train flag
+    threaded through every module and a mutable ``batch_stats`` collection in
+    the train step — none of the reference's shipped configs use BN (the
+    headline config sets ``norm: null`` and the reference's SyncBN conversion
+    is a no-op in practice, SURVEY.md §5), so until a config needs it we fail
+    loudly rather than silently running inference-mode BN.
+    """
+
+    norm: Optional[str] = None
+    bn_momentum: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        if self.norm == "IN":
+            # InstanceNorm == GroupNorm with one group per channel.
+            x = nn.GroupNorm(num_groups=None, group_size=1)(x)
+        elif self.norm is not None:
+            raise NotImplementedError(
+                f"norm={self.norm!r} is not supported (only 'IN' or None); "
+                "BN needs train-flag threading + batch_stats handling"
+            )
+        return x
+
+
+class ConvLayer(nn.Module):
+    """Conv2d + optional norm + activation (reference ``submodules.py:158-199``)."""
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+    bn_momentum: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        k = self.kernel_size
+        cin = x.shape[-1]
+        use_bias = self.norm != "BN"
+        x = nn.Conv(
+            self.features,
+            (k, k),
+            strides=(self.stride, self.stride),
+            padding=((self.padding, self.padding), (self.padding, self.padding)),
+            use_bias=use_bias,
+            kernel_init=torch_uniform_init(),
+            bias_init=torch_conv_bias_init(cin * k * k),
+        )(x)
+        x = _NormWrapper(self.norm, self.bn_momentum)(x, train)
+        act = get_activation(self.activation)
+        return act(x) if act is not None else x
+
+
+class TransposedConvLayer(nn.Module):
+    """Stride-2 transposed conv, x2 upsampling (reference ``submodules.py:202-251``).
+
+    Matches ``torch.nn.ConvTranspose2d(stride=2, output_padding=1)`` output
+    shape (exactly 2x the input).
+    """
+
+    features: int
+    kernel_size: int = 3
+    padding: int = 0
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        k = self.kernel_size
+        p = self.padding
+        use_bias = self.norm != "BN"
+        # torch: out = (H-1)*2 - 2p + k + output_padding(=1).
+        # lax.conv_transpose with explicit padding (k-1-p, k-1-p+1) realizes it.
+        # torch ConvTranspose2d weight is (in, out, kh, kw), so its default
+        # init fan_in is out*k*k — NOT in*k*k like Conv2d.
+        fan_in = self.features * k * k
+
+        def kernel_init(key, shape, dtype=jnp.float32):
+            bound = 1.0 / np.sqrt(fan_in)
+            return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+        x = nn.ConvTranspose(
+            self.features,
+            (k, k),
+            strides=(2, 2),
+            padding=((k - 1 - p, k - p), (k - 1 - p, k - p)),
+            use_bias=use_bias,
+            kernel_init=kernel_init,
+            bias_init=torch_conv_bias_init(fan_in),
+        )(x)
+        x = _NormWrapper(self.norm)(x, train)
+        act = get_activation(self.activation)
+        return act(x) if act is not None else x
+
+
+class UpsampleConvLayer(nn.Module):
+    """Bilinear x-scale upsample + conv (reference ``submodules.py:254-299``).
+
+    The resize matches torch ``align_corners=False`` exactly (see
+    ``esr_tpu.ops.resize``).
+    """
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+    scale: int = 2
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        from esr_tpu.ops.resize import interpolate_scale
+
+        x = interpolate_scale(x, self.scale, mode="bilinear")
+        return ConvLayer(
+            self.features,
+            self.kernel_size,
+            self.stride,
+            self.padding,
+            self.activation,
+            self.norm,
+        )(x, train)
+
+
+class ResidualBlock(nn.Module):
+    """conv-relu-conv + identity (reference ``submodules.py:347-409``)."""
+
+    features: int
+    stride: int = 1
+    norm: Optional[str] = None
+    final_activation: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        residual = x
+        cin = x.shape[-1]
+        use_bias = self.norm != "BN"
+        out = nn.Conv(
+            self.features,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)),
+            use_bias=use_bias,
+            kernel_init=torch_uniform_init(),
+            bias_init=torch_conv_bias_init(cin * 9),
+        )(x)
+        out = _NormWrapper(self.norm)(out, train)
+        out = jax.nn.relu(out)
+        out = nn.Conv(
+            self.features,
+            (3, 3),
+            padding=((1, 1), (1, 1)),
+            use_bias=use_bias,
+            kernel_init=torch_uniform_init(),
+            bias_init=torch_conv_bias_init(self.features * 9),
+        )(out)
+        out = _NormWrapper(self.norm)(out, train)
+        out = out + residual
+        if self.final_activation:
+            out = jax.nn.relu(out)
+        return out
+
+
+class ConvGRUCell(nn.Module):
+    """Convolutional GRU with orthogonal kernel init, zero bias
+    (reference ``submodules.py:474-514``).
+
+    Pure cell: ``(x [B,H,W,Cin], state [B,H,W,Ch]) -> new state``. Callers
+    create the zero initial state via :func:`zeros_state`.
+    """
+
+    hidden: int
+    kernel_size: int = 3
+
+    @staticmethod
+    def zeros_state(batch: int, h: int, w: int, hidden: int) -> Array:
+        return jnp.zeros((batch, h, w, hidden), dtype=jnp.float32)
+
+    @nn.compact
+    def __call__(self, x: Array, state: Array) -> Array:
+        k = self.kernel_size
+        pad = k // 2
+        conv = lambda name: nn.Conv(
+            self.hidden,
+            (k, k),
+            padding=((pad, pad), (pad, pad)),
+            kernel_init=nn.initializers.orthogonal(),
+            bias_init=nn.initializers.zeros,
+            name=name,
+        )
+        stacked = jnp.concatenate([x, state], axis=-1)
+        update = jax.nn.sigmoid(conv("update_gate")(stacked))
+        reset = jax.nn.sigmoid(conv("reset_gate")(stacked))
+        out = jnp.tanh(conv("out_gate")(jnp.concatenate([x, state * reset], axis=-1)))
+        return state * (1.0 - update) + out * update
+
+
+class ConvLSTMCell(nn.Module):
+    """Convolutional LSTM (reference ``submodules.py:412-471``).
+
+    State is ``(hidden, cell)``; returns ``(hidden, (hidden, cell))``.
+    """
+
+    hidden: int
+    kernel_size: int = 3
+
+    @staticmethod
+    def zeros_state(batch: int, h: int, w: int, hidden: int) -> Tuple[Array, Array]:
+        z = jnp.zeros((batch, h, w, hidden), dtype=jnp.float32)
+        return (z, z)
+
+    @nn.compact
+    def __call__(
+        self, x: Array, state: Tuple[Array, Array]
+    ) -> Tuple[Array, Tuple[Array, Array]]:
+        prev_hidden, prev_cell = state
+        k = self.kernel_size
+        pad = k // 2
+        cin = x.shape[-1] + self.hidden
+        gates = nn.Conv(
+            4 * self.hidden,
+            (k, k),
+            padding=((pad, pad), (pad, pad)),
+            kernel_init=torch_uniform_init(),
+            bias_init=torch_conv_bias_init(cin * k * k),
+        )(jnp.concatenate([x, prev_hidden], axis=-1))
+        in_gate, remember_gate, out_gate, cell_gate = jnp.split(gates, 4, axis=-1)
+        in_gate = jax.nn.sigmoid(in_gate)
+        remember_gate = jax.nn.sigmoid(remember_gate)
+        out_gate = jax.nn.sigmoid(out_gate)
+        cell_gate = jnp.tanh(cell_gate)
+        cell = remember_gate * prev_cell + in_gate * cell_gate
+        hidden = out_gate * jnp.tanh(cell)
+        return hidden, (hidden, cell)
+
+
+class RecurrentConvLayer(nn.Module):
+    """Conv + recurrent block (reference ``submodules.py:302-344``).
+
+    ``(x, state) -> (output, new_state)``. For ``convgru`` the output IS the
+    new state (matching the reference, where ``forward`` returns
+    ``state, state``).
+    """
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    recurrent_block_type: str = "convgru"
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: Array, state: Any) -> Tuple[Array, Any]:
+        x = ConvLayer(
+            self.features,
+            self.kernel_size,
+            self.stride,
+            self.padding,
+            self.activation,
+            self.norm,
+        )(x)
+        if self.recurrent_block_type == "convgru":
+            new_state = ConvGRUCell(self.features, kernel_size=3)(x, state)
+            return new_state, new_state
+        elif self.recurrent_block_type == "convlstm":
+            out, new_state = ConvLSTMCell(self.features, kernel_size=3)(x, state)
+            return out, new_state
+        raise ValueError(f"unsupported recurrent block: {self.recurrent_block_type}")
+
+
+class MLP(nn.Module):
+    """Dense stack with ReLU between layers (reference ``submodules.py:67-77``)."""
+
+    hidden_dim: int
+    output_dim: int
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        dims = [self.hidden_dim] * (self.num_layers - 1) + [self.output_dim]
+        for i, d in enumerate(dims):
+            x = nn.Dense(
+                d,
+                kernel_init=torch_uniform_init("dense"),
+                bias_init=torch_conv_bias_init(x.shape[-1]),
+            )(x)
+            if i < self.num_layers - 1:
+                x = jax.nn.relu(x)
+        return x

@@ -1,0 +1,84 @@
+"""Pad/crop helpers and skip connections (reference ``models/model_util.py``).
+
+Channel-last equivalents of ``CropSize``/``OptimalCropSize``
+(``model_util.py:41-48,133-164``): pad an image so H and W divide a factor
+(top/left get the ceil half, matching ``ZeroPad2d(l, r, t, b)`` with
+``ceil``/``floor`` splits), and crop a (possibly upscaled) output back.
+Implemented as pure functions returning static pad specs — everything stays
+jit-compatible because shapes are Python ints at trace time.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def optimal_crop_size(size: int, factor: int, safety_margin: int = 0) -> int:
+    """Smallest multiple of ``factor`` >= ``size`` (reference ``:41-48``)."""
+    return factor * math.ceil(size / factor) + safety_margin * factor
+
+
+class PadSpec(NamedTuple):
+    height: int
+    width: int
+    padded_height: int
+    padded_width: int
+    top: int
+    bottom: int
+    left: int
+    right: int
+
+
+def compute_pad(height: int, width: int, factor_h: int, factor_w: int) -> PadSpec:
+    """Pad amounts to make (H, W) divisible by (factor_h, factor_w).
+
+    Matches ``CropSize.__init__`` (reference ``model_util.py:133-154``):
+    top/left take the ceil half of the slack.
+    """
+    ph = optimal_crop_size(height, factor_h)
+    pw = optimal_crop_size(width, factor_w)
+    top = math.ceil(0.5 * (ph - height))
+    bottom = math.floor(0.5 * (ph - height))
+    left = math.ceil(0.5 * (pw - width))
+    right = math.floor(0.5 * (pw - width))
+    return PadSpec(height, width, ph, pw, top, bottom, left, right)
+
+
+def pad_image(x: Array, spec: PadSpec) -> Array:
+    """Zero-pad ``[..., H, W, C]`` per ``spec``."""
+    pad_width = [(0, 0)] * (x.ndim - 3) + [
+        (spec.top, spec.bottom),
+        (spec.left, spec.right),
+        (0, 0),
+    ]
+    return jnp.pad(x, pad_width)
+
+
+def crop_image(x: Array, spec: PadSpec, scale: int = 1) -> Array:
+    """Crop ``[..., H*, W*, C]`` back to ``scale`` x the original size.
+
+    Center-crop math mirrors ``CropSize.crop`` (reference ``:155-164``).
+    """
+    cx = math.floor(spec.padded_width * scale / 2)
+    cy = math.floor(spec.padded_height * scale / 2)
+    ix0 = cx - math.floor(spec.width * scale / 2)
+    ix1 = cx + math.ceil(spec.width * scale / 2)
+    iy0 = cy - math.floor(spec.height * scale / 2)
+    iy1 = cy + math.ceil(spec.height * scale / 2)
+    return x[..., iy0:iy1, ix0:ix1, :]
+
+
+def skip_concat(x1: Array, x2: Array) -> Array:
+    """Channel concat skip (reference ``model_util.py:14-20``)."""
+    return jnp.concatenate([x1, x2], axis=-1)
+
+
+def skip_sum(x1: Array, x2: Array) -> Array:
+    """Additive skip (reference ``model_util.py:23-27``)."""
+    return x1 + x2

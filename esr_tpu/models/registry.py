@@ -1,0 +1,41 @@
+"""Model registry — explicit name -> class mapping.
+
+Replaces the reference's ``eval(config['model']['name'])(**args)``
+instantiation (``train_ours_cnt_seq.py:762``) with a registry, per
+SURVEY.md §5 ("the rebuild should replace ``eval`` with an explicit
+registry").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Type
+
+from flax import linen as nn
+
+MODEL_REGISTRY: Dict[str, Type[nn.Module]] = {}
+
+
+def register_model(name: str) -> Callable:
+    def wrap(cls):
+        MODEL_REGISTRY[name] = cls
+        return cls
+
+    return wrap
+
+
+def get_model(name: str, **kwargs) -> nn.Module:
+    """Instantiate a registered model by config name."""
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model '{name}'; registered: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name](**kwargs)
+
+
+def _register_builtins():
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    MODEL_REGISTRY.setdefault("DeepRecurrNet", DeepRecurrNet)
+
+
+_register_builtins()

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, shard_batch, replicate, make_parallel_train_step

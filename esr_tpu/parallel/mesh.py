@@ -1,0 +1,66 @@
+"""Device mesh + sharding: the distributed runtime, TPU-native.
+
+Replaces the reference's DDP/NCCL stack (``train_ours_cnt_seq.py:64-85``
+rendezvous, DDP gradient allreduce, ``DistributedSampler``) with JAX SPMD:
+
+- a ``Mesh`` over all devices with a ``'data'`` axis (the model is a small
+  CNN; DP is the parallelism that matters — SURVEY.md §2.3);
+- batch sharded over ``'data'`` with ``NamedSharding``, params replicated;
+- ``jit`` compiles ONE SPMD program; XLA inserts the gradient all-reduce
+  over ICI automatically (no explicit collectives, no barriers — program
+  structure is the synchronization);
+- multi-host: the same code runs under ``jax.distributed.initialize`` where
+  the mesh spans hosts and collectives ride ICI within a slice / DCN across
+  slices. No rendezvous code needed here.
+
+The explicit-logging allreduce (``reduce_tensor``, ``myutils/utils.py:43-54``)
+has no equivalent: metrics computed inside the jit'd step are already
+globally reduced.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None, axis_name: str = "data"
+) -> Mesh:
+    """1-D data-parallel mesh over all (or given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis_name: str = "data") -> Any:
+    """Place a host batch with the leading axis sharded over the mesh."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree (params/opt state) over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def make_parallel_train_step(
+    train_step, mesh: Mesh, axis_name: str = "data", donate: bool = True
+):
+    """jit the train step with DP shardings pinned.
+
+    ``state`` replicated, ``batch`` sharded on the leading (batch) axis,
+    outputs replicated. XLA turns the gradient sum into an ICI all-reduce.
+    """
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(axis_name))
+    return jax.jit(
+        train_step,
+        in_shardings=(repl, data),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -1,0 +1,3 @@
+from .schedule import exponential_with_floor
+from .optim import make_optimizer
+from .train_step import make_train_step, TrainState, make_eval_step

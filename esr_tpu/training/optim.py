@@ -1,0 +1,94 @@
+"""Optimizer construction (registry-based, mirrors the reference recipe).
+
+The reference uses ``torch.optim.Adam(lr=1e-3, weight_decay=1e-4,
+amsgrad=True)`` (``config/train_ours_enfssyn.yml:28-34``). torch's Adam
+weight decay is L2-added-to-gradient (not decoupled AdamW), so the optax
+equivalent is ``add_decayed_weights`` *before* the Adam transform.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from esr_tpu.training.schedule import exponential_with_floor
+
+
+class _AmsgradState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+    nu: optax.Updates
+    nu_max: optax.Updates
+
+
+def scale_by_amsgrad_torch(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    """torch-exact AMSGrad: the running max is taken over the *uncorrected*
+    second moment (``torch.optim.Adam`` with ``amsgrad=True``), whereas
+    ``optax.scale_by_amsgrad`` maxes the bias-corrected one — a small but
+    compounding divergence.
+    """
+
+    def init(params):
+        z = lambda: jax.tree.map(jnp.zeros_like, params)
+        return _AmsgradState(jnp.zeros((), jnp.int32), z(), z(), z())
+
+    def update(updates, state, params=None):
+        count = state.count + 1
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
+        nu_max = jax.tree.map(jnp.maximum, state.nu_max, nu)
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        out = jax.tree.map(
+            lambda m, vm: (m / bc1) / (jnp.sqrt(vm) / jnp.sqrt(bc2) + eps),
+            mu,
+            nu_max,
+        )
+        return out, _AmsgradState(count, mu, nu, nu_max)
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_optimizer(
+    name: str = "Adam",
+    lr: Union[float, Callable] = 1e-3,
+    weight_decay: float = 0.0,
+    amsgrad: bool = True,
+    betas=(0.9, 0.999),
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    if name not in ("Adam", "AdamW", "SGD"):
+        raise KeyError(f"unknown optimizer '{name}'")
+    if name == "SGD":
+        # torch SGD applies weight decay as L2-on-gradient too.
+        parts = []
+        if weight_decay:
+            parts.append(optax.add_decayed_weights(weight_decay))
+        parts.append(optax.sgd(lr))
+        return optax.chain(*parts)
+    parts = []
+    if name == "Adam" and weight_decay:
+        # torch Adam: grad += wd * param, then moments.
+        parts.append(optax.add_decayed_weights(weight_decay))
+    if amsgrad:
+        parts.append(scale_by_amsgrad_torch(b1=betas[0], b2=betas[1], eps=eps))
+    else:
+        parts.append(optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps))
+    if name == "AdamW" and weight_decay:
+        # decoupled: decay applied after moment normalization.
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(
+        optax.scale_by_learning_rate(lr)
+    )
+    return optax.chain(*parts)
+
+
+def make_reference_optimizer(iteration_schedule: bool = True):
+    """The exact headline training recipe from the reference config."""
+    sched = exponential_with_floor(1e-3, gamma=0.95, change_rate=4000, floor=1e-4)
+    return make_optimizer("Adam", lr=sched, weight_decay=1e-4, amsgrad=True)

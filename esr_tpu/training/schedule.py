@@ -1,0 +1,34 @@
+"""LR schedules (reference recipe: ExponentialLR with a floor gate).
+
+The reference steps ``ExponentialLR(gamma)`` every ``lr_change_rate``
+iterations but only while the current lr is >= ``floor``
+(``train_ours_cnt_seq.py:322-325``: the gate reads the lr *before* stepping,
+so the final value may land just below the floor and then stays fixed).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def exponential_with_floor(
+    base_lr: float,
+    gamma: float = 0.95,
+    change_rate: int = 4000,
+    floor: float = 1e-4,
+):
+    """optax-style schedule fn reproducing the reference's gated decay."""
+    if base_lr < floor:
+        max_decays = 0
+    else:
+        # decay #m happens iff lr after m-1 decays is still >= floor
+        max_decays = math.floor(math.log(floor / base_lr) / math.log(gamma)) + 1
+        max_decays = max(max_decays, 0)
+
+    def schedule(step):
+        decays = jnp.minimum(step // change_rate, max_decays)
+        return base_lr * (gamma ** decays.astype(jnp.float32))
+
+    return schedule

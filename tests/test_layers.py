@@ -1,0 +1,147 @@
+"""Tests for esr_tpu.models.layers — shape/semantics parity with the
+reference's submodules (torch wiring validated via torch functional convs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.models.layers import (
+    ConvLayer,
+    ConvGRUCell,
+    ConvLSTMCell,
+    MLP,
+    RecurrentConvLayer,
+    ResidualBlock,
+    TransposedConvLayer,
+    UpsampleConvLayer,
+)
+
+
+def test_conv_layer_shapes_and_activation():
+    m = ConvLayer(8, 3, stride=1, padding=1)
+    x = jnp.array(np.random.default_rng(0).standard_normal((2, 10, 12, 4)), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    assert y.shape == (2, 10, 12, 8)
+    assert (np.array(y) >= 0).all()  # relu
+
+
+@pytest.mark.parametrize("hw", [(10, 12), (11, 13)])
+def test_conv_stride2_matches_torch_shape(hw):
+    torch = pytest.importorskip("torch")
+    h, w = hw
+    m = ConvLayer(8, 3, stride=2, padding=1, activation=None)
+    x = jnp.zeros((1, h, w, 4))
+    y = m.apply(m.init(jax.random.PRNGKey(0), x), x)
+    ref = torch.nn.Conv2d(4, 8, 3, stride=2, padding=1)(torch.zeros(1, 4, h, w))
+    assert y.shape[1:3] == tuple(ref.shape[2:])
+
+
+def test_conv_layer_matches_torch_numerics():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 9, 9, 3)).astype(np.float32)
+    m = ConvLayer(5, 3, stride=2, padding=1, activation="relu")
+    params = m.init(jax.random.PRNGKey(1), jnp.array(x))
+    kernel = np.array(params["params"]["Conv_0"]["kernel"])  # HWIO
+    bias = np.array(params["params"]["Conv_0"]["bias"])
+    y = np.array(m.apply(params, jnp.array(x)))
+
+    conv = torch.nn.Conv2d(3, 5, 3, stride=2, padding=1)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(kernel).permute(3, 2, 0, 1))
+        conv.bias.copy_(torch.from_numpy(bias))
+    ref = torch.relu(conv(torch.from_numpy(x).permute(0, 3, 1, 2)))
+    np.testing.assert_allclose(
+        y, ref.detach().permute(0, 2, 3, 1).numpy(), atol=1e-4, rtol=1e-3
+    )
+
+
+def test_transposed_conv_doubles_spatial():
+    m = TransposedConvLayer(6, kernel_size=3, padding=1)
+    x = jnp.zeros((2, 7, 9, 4))
+    y = m.apply(m.init(jax.random.PRNGKey(0), x), x)
+    assert y.shape == (2, 14, 18, 6)
+
+
+def test_upsample_conv_layer():
+    m = UpsampleConvLayer(4, 3, padding=1)
+    x = jnp.array(np.random.default_rng(2).standard_normal((1, 6, 8, 8)), jnp.float32)
+    y = m.apply(m.init(jax.random.PRNGKey(0), x), x)
+    assert y.shape == (1, 12, 16, 4)
+
+
+def test_residual_block_identity_path():
+    m = ResidualBlock(4)
+    x = jnp.array(np.random.default_rng(3).standard_normal((2, 8, 8, 4)), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    # zero both convs -> output = relu(residual)
+    z = jax.tree.map(jnp.zeros_like, params)
+    y = m.apply(z, x)
+    np.testing.assert_allclose(np.array(y), np.maximum(np.array(x), 0), atol=1e-6)
+
+
+def test_convgru_matches_reference_formula():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 6, 6, 3)).astype(np.float32)
+    h0 = rng.standard_normal((2, 6, 6, 5)).astype(np.float32)
+    cell = ConvGRUCell(hidden=5)
+    params = cell.init(jax.random.PRNGKey(0), jnp.array(x), jnp.array(h0))
+    new = np.array(cell.apply(params, jnp.array(x), jnp.array(h0)))
+
+    def tconv(name, inp):
+        k = np.array(params["params"][name]["kernel"])  # HWIO
+        b = np.array(params["params"][name]["bias"])
+        return F.conv2d(
+            torch.from_numpy(inp).permute(0, 3, 1, 2),
+            torch.from_numpy(k).permute(3, 2, 0, 1),
+            torch.from_numpy(b),
+            padding=1,
+        ).permute(0, 2, 3, 1).numpy()
+
+    stacked = np.concatenate([x, h0], axis=-1)
+    update = 1 / (1 + np.exp(-tconv("update_gate", stacked)))
+    reset = 1 / (1 + np.exp(-tconv("reset_gate", stacked)))
+    out = np.tanh(tconv("out_gate", np.concatenate([x, h0 * reset], axis=-1)))
+    expect = h0 * (1 - update) + out * update
+    np.testing.assert_allclose(new, expect, atol=1e-4, rtol=1e-3)
+
+
+def test_convgru_orthogonal_init():
+    cell = ConvGRUCell(hidden=4)
+    x = jnp.zeros((1, 5, 5, 4))
+    params = cell.init(jax.random.PRNGKey(0), x, x)
+    k = np.array(params["params"]["update_gate"]["kernel"])  # [3,3,8,4]
+    flat = k.reshape(-1, k.shape[-1])  # orthogonal columns
+    np.testing.assert_allclose(flat.T @ flat, np.eye(4), atol=1e-4)
+    assert np.array(params["params"]["update_gate"]["bias"]).sum() == 0
+
+
+def test_convlstm_shapes_and_state():
+    cell = ConvLSTMCell(hidden=6)
+    x = jnp.array(np.random.default_rng(5).standard_normal((2, 7, 7, 3)), jnp.float32)
+    state = ConvLSTMCell.zeros_state(2, 7, 7, 6)
+    params = cell.init(jax.random.PRNGKey(0), x, state)
+    out, (h, c) = cell.apply(params, x, state)
+    assert out.shape == h.shape == c.shape == (2, 7, 7, 6)
+    assert np.abs(np.array(out)).max() <= 1.0  # tanh-bounded
+
+
+def test_recurrent_conv_layer_gru_output_is_state():
+    m = RecurrentConvLayer(8, 3, stride=1, padding=1, recurrent_block_type="convgru")
+    x = jnp.array(np.random.default_rng(6).standard_normal((1, 6, 6, 4)), jnp.float32)
+    state = ConvGRUCell.zeros_state(1, 6, 6, 8)
+    params = m.init(jax.random.PRNGKey(0), x, state)
+    out, new_state = m.apply(params, x, state)
+    np.testing.assert_array_equal(np.array(out), np.array(new_state))
+
+
+def test_mlp_layer_sizes():
+    m = MLP(hidden_dim=8, output_dim=32, num_layers=2)
+    x = jnp.zeros((4, 16))
+    y = m.apply(m.init(jax.random.PRNGKey(0), x), x)
+    assert y.shape == (4, 32)

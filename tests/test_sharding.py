@@ -1,0 +1,78 @@
+"""Data-parallel sharding tests on the virtual 8-device CPU mesh.
+
+The JAX-native replacement for DDP multi-process tests (SURVEY.md §4:
+"the rebuild should do better"): DP training on 8 devices must match
+single-device training bit-for-bit (up to reduction order)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.parallel.mesh import (
+    make_mesh,
+    make_parallel_train_step,
+    replicate,
+    shard_batch,
+)
+from esr_tpu.training.optim import make_optimizer
+from esr_tpu.training.train_step import TrainState, make_train_step
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def _setup(b, L=4, h=16, w=16):
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    rng = np.random.default_rng(0)
+    batch = {
+        "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+        "gt": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+    }
+    states = model.init_states(b, h, w)
+    params = model.init(jax.random.PRNGKey(0), batch["inp"][:, :3], states)
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    return model, params, opt, batch
+
+
+def test_dp_matches_single_device():
+    model, params, opt, batch = _setup(b=8)
+    step_fn = make_train_step(model, opt, seqn=3)
+
+    # single device
+    s_single = TrainState.create(params, opt)
+    s_single, m_single = jax.jit(step_fn)(s_single, batch)
+
+    # 8-way DP
+    mesh = make_mesh()
+    pstep = make_parallel_train_step(step_fn, mesh, donate=False)
+    s_dp = replicate(TrainState.create(params, opt), mesh)
+    sharded = shard_batch(batch, mesh)
+    s_dp, m_dp = pstep(s_dp, sharded)
+
+    np.testing.assert_allclose(
+        float(m_single["loss"]), float(m_dp["loss"]), rtol=1e-5
+    )
+    for a, b in zip(jax.tree.leaves(s_single.params), jax.tree.leaves(s_dp.params)):
+        np.testing.assert_allclose(np.array(a), np.array(b), atol=1e-5)
+
+
+def test_batch_actually_sharded():
+    mesh = make_mesh()
+    x = jnp.zeros((8, 4, 16, 16, 2))
+    xs = shard_batch(x, mesh)
+    assert len(xs.sharding.device_set) == 8
+    shard_shapes = {s.data.shape for s in xs.addressable_shards}
+    assert shard_shapes == {(1, 4, 16, 16, 2)}
+
+
+def test_dp_step_runs_with_uneven_model_sizes():
+    # padding path (odd H/W) under sharding
+    model, params, opt, batch = _setup(b=8, h=15, w=17)
+    mesh = make_mesh()
+    step_fn = make_train_step(model, opt, seqn=3)
+    pstep = make_parallel_train_step(step_fn, mesh, donate=False)
+    s = replicate(TrainState.create(params, opt), mesh)
+    s, m = pstep(s, shard_batch(batch, mesh))
+    assert np.isfinite(float(m["loss"]))

@@ -1,0 +1,113 @@
+"""Tests for the training layer: schedule gating, optimizer parity with
+torch Adam, and the scanned BPTT train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.training.schedule import exponential_with_floor
+from esr_tpu.training.optim import make_optimizer
+from esr_tpu.training.train_step import (
+    TrainState,
+    _make_windows,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def test_schedule_decays_then_floors():
+    sched = exponential_with_floor(1e-3, gamma=0.95, change_rate=4000, floor=1e-4)
+    assert float(sched(0)) == pytest.approx(1e-3)
+    assert float(sched(3999)) == pytest.approx(1e-3)
+    assert float(sched(4000)) == pytest.approx(1e-3 * 0.95)
+    assert float(sched(8000)) == pytest.approx(1e-3 * 0.95**2)
+    # decay stops once lr drops below the floor; final value is the first
+    # one below 1e-4 (the reference gates on the pre-step lr)
+    late = float(sched(10_000_000))
+    assert late < 1e-4
+    assert late == pytest.approx(1e-3 * 0.95**45)
+    assert 1e-3 * 0.95**44 >= 1e-4  # last gated step was still >= floor
+
+
+def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal(16).astype(np.float32)
+    target = rng.standard_normal(16).astype(np.float32)
+
+    # torch: Adam with L2 weight decay + amsgrad
+    wt = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    opt_t = torch.optim.Adam([wt], lr=1e-2, weight_decay=1e-2, amsgrad=True)
+    for _ in range(20):
+        opt_t.zero_grad()
+        loss = ((wt - torch.from_numpy(target)) ** 2).sum()
+        loss.backward()
+        opt_t.step()
+
+    opt_j = make_optimizer("Adam", lr=1e-2, weight_decay=1e-2, amsgrad=True)
+    wj = jnp.array(w0)
+    os_ = opt_j.init(wj)
+    grad_fn = jax.grad(lambda w: ((w - jnp.array(target)) ** 2).sum())
+    for _ in range(20):
+        upd, os_ = opt_j.update(grad_fn(wj), os_, wj)
+        wj = jax.tree.map(lambda p, u: p + u, wj, upd)
+    np.testing.assert_allclose(np.array(wj), wt.detach().numpy(), atol=1e-5)
+
+
+def test_make_windows():
+    seq = jnp.arange(2 * 5).reshape(2, 5, 1, 1, 1).astype(jnp.float32)
+    win = _make_windows(seq, 3)
+    assert win.shape == (3, 2, 3, 1, 1, 1)
+    np.testing.assert_array_equal(
+        np.array(win[:, 0, :, 0, 0, 0]), [[0, 1, 2], [1, 2, 3], [2, 3, 4]]
+    )
+
+
+def _tiny_setup(b=2, L=4, h=16, w=16, seqn=3):
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=seqn)
+    rng = np.random.default_rng(1)
+    batch = {
+        "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+        "gt": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+    }
+    x0 = batch["inp"][:, :seqn]
+    states = model.init_states(b, h, w)
+    params = model.init(jax.random.PRNGKey(0), x0, states)
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    return model, params, opt, batch
+
+
+def test_train_step_learns():
+    model, params, opt, batch = _tiny_setup()
+    step = jax.jit(make_train_step(model, opt, seqn=3))
+    state = TrainState.create(params, opt)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]  # overfits a fixed batch
+    assert int(state.step) == 8
+    assert np.isfinite(losses).all()
+    assert metrics["loss_per_window"].shape == (2,)  # L - seqn + 1
+
+
+def test_train_step_remat_matches():
+    model, params, opt, batch = _tiny_setup()
+    s1 = TrainState.create(params, opt)
+    s2 = TrainState.create(params, opt)
+    step = jax.jit(make_train_step(model, opt, seqn=3))
+    step_r = jax.jit(make_train_step(model, opt, seqn=3, remat=True))
+    s1, m1 = step(s1, batch)
+    s2, m2 = step_r(s2, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.array(a), np.array(b), atol=1e-5)
+
+
+def test_eval_step():
+    model, params, opt, batch = _tiny_setup()
+    ev = jax.jit(make_eval_step(model, seqn=3))
+    out = ev(params, batch)
+    assert np.isfinite(float(out["valid_loss"]))
