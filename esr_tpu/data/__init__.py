@@ -1,0 +1,13 @@
+"""Host-side data pipeline (reference ``dataloader/``).
+
+The reference rasterizes events on CPU DataLoader workers and ships dense
+tensors to the GPU (SURVEY.md §3.3). The TPU-native equivalent keeps the same
+split: HDF5 windowing + scatter-add rasterization happen host-side in numpy
+(``np_encodings``), sequences are collated into static-shape ``[B, L, ...]``
+arrays, and per-host sharding replaces ``DistributedSampler``. The jit'd
+train step does the BPTT windowing on device.
+"""
+
+from esr_tpu.data import np_encodings
+
+__all__ = ["np_encodings"]

@@ -1,0 +1,103 @@
+"""Host-side (numpy) event rasterization — the data-pipeline mirror of
+``esr_tpu.ops.encodings``.
+
+Same semantics as the jit-able jnp ops (channel-last layouts, half-open time
+binning — see ``ops/encodings.py`` module docstring for the deliberate
+boundary-handling deviation from the reference) so host-prepared batches and
+device-side re-encodings agree bit-for-bit. Parity is pinned by
+``tests/test_data_pipeline.py::test_np_vs_jnp_encoding_parity``.
+
+Replaces the reference's torch/Cython CPU encodings
+(``/root/reference/dataloader/encodings.py:243-363``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from esr_tpu.ops.resize import _interp_matrix
+
+
+def events_to_image_np(
+    xs: np.ndarray, ys: np.ndarray, ps: np.ndarray, sensor_size: Tuple[int, int]
+) -> np.ndarray:
+    """Scatter-add events into ``[H, W]``; out-of-range events dropped."""
+    h, w = sensor_size
+    img = np.zeros((h, w), np.float32)
+    inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
+    np.add.at(
+        img,
+        (ys[inb].astype(np.int64), xs[inb].astype(np.int64)),
+        ps[inb].astype(np.float32),
+    )
+    return img
+
+
+def events_to_channels_np(
+    xs: np.ndarray, ys: np.ndarray, ps: np.ndarray, sensor_size: Tuple[int, int]
+) -> np.ndarray:
+    """Two-channel count image ``[H, W, 2]`` (pos, neg)."""
+    pos = events_to_image_np(xs, ys, (ps > 0).astype(np.float32), sensor_size)
+    neg = events_to_image_np(xs, ys, (ps < 0).astype(np.float32), sensor_size)
+    return np.stack([pos, neg], axis=-1)
+
+
+def events_to_stack_np(
+    xs: np.ndarray,
+    ys: np.ndarray,
+    ts: np.ndarray,
+    ps: np.ndarray,
+    num_bins: int,
+    sensor_size: Tuple[int, int],
+) -> np.ndarray:
+    """Signed time-binned stack ``[H, W, B]`` (half-open binning)."""
+    h, w = sensor_size
+    out = np.zeros((h, w, num_bins), np.float32)
+    if xs.size == 0:
+        return out
+    t0 = ts.min()
+    dt = ts.max() - t0 + 1e-6
+    rel = (ts - t0) / dt
+    b = np.clip(np.floor(rel * num_bins).astype(np.int64), 0, num_bins - 1)
+    inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
+    np.add.at(
+        out,
+        (ys[inb].astype(np.int64), xs[inb].astype(np.int64), b[inb]),
+        ps[inb].astype(np.float32),
+    )
+    return out
+
+
+def events_to_voxel_np(
+    xs: np.ndarray,
+    ys: np.ndarray,
+    ts: np.ndarray,
+    ps: np.ndarray,
+    num_bins: int,
+    sensor_size: Tuple[int, int],
+) -> np.ndarray:
+    """Voxel grid ``[H, W, B]`` with temporal bilinear weights; ``ts`` must be
+    normalized to [0, 1]."""
+    tnorm = ts.astype(np.float32) * (num_bins - 1)
+    bins = []
+    for b in range(num_bins):
+        wgt = np.maximum(0.0, 1.0 - np.abs(tnorm - b))
+        bins.append(
+            events_to_image_np(xs, ys, ps.astype(np.float32) * wgt, sensor_size)
+        )
+    return np.stack(bins, axis=-1)
+
+
+def interpolate_np(x: np.ndarray, size: Tuple[int, int], mode: str) -> np.ndarray:
+    """Host resize of ``[H, W, C]`` with torch ``align_corners=False``
+    semantics — reuses the same interpolation matrices as the device op
+    (``esr_tpu.ops.resize``), so host and device resizes agree exactly."""
+    h_in, w_in = x.shape[0], x.shape[1]
+    if (h_in, w_in) == tuple(size):
+        return x.astype(np.float32)
+    mh = _interp_matrix(h_in, size[0], mode)
+    mw = _interp_matrix(w_in, size[1], mode)
+    out = np.einsum("oh,hwc->owc", mh, x.astype(np.float32))
+    return np.einsum("ow,hwc->hoc", mw, out)

@@ -1,0 +1,33 @@
+"""Loss / metric layer (reference ``loss/``, ``myutils/iwe.py``).
+
+Training uses plain MSE (reference ``train_ours_cnt_seq.py:226-231``); the
+rest of this package is the inference-metric and self-supervised loss suite:
+PSNR/SSIM (``restore``), LPIPS (``lpips``), contrast-maximization flow loss
+(``flow``), and brightness-constancy reconstruction loss (``reconstruction``).
+"""
+
+from esr_tpu.losses.restore import (
+    l1_metric,
+    mse_metric,
+    psnr,
+    psnr_metric,
+    ssim,
+    ssim_metric,
+)
+from esr_tpu.losses.lpips import LPIPS, load_lpips_params
+from esr_tpu.losses.flow import event_warping_loss, averaged_iwe
+from esr_tpu.losses.reconstruction import BrightnessConstancy
+
+__all__ = [
+    "l1_metric",
+    "mse_metric",
+    "psnr",
+    "psnr_metric",
+    "ssim",
+    "ssim_metric",
+    "LPIPS",
+    "load_lpips_params",
+    "event_warping_loss",
+    "averaged_iwe",
+    "BrightnessConstancy",
+]

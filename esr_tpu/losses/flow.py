@@ -1,0 +1,165 @@
+"""Contrast-maximization flow losses, TPU-native.
+
+Rebuilds ``/root/reference/loss/flow.py`` as jit-able static-shape jnp:
+
+- :func:`event_warping_loss` — ``EventWarping`` (``flow.py:15-113``): squared
+  sums of forward/backward per-polarity average-timestamp images plus a
+  Charbonnier flow-smoothness term.
+- :func:`averaged_iwe` — ``AveragedIWE`` (``flow.py:116-232``): per-pixel,
+  per-polarity *average* number of warped events. The reference computes the
+  per-destination unique-source count with a data-dependent ``torch.unique``
+  per batch element; here it is a static-shape sort + first-occurrence
+  scatter, so it jits and batches.
+
+Events are ``[B, N, 4]`` rows ``(ts, y, x, p)`` with a ``valid`` lane mask
+(see ``esr_tpu.ops.iwe``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from esr_tpu.ops.iwe import gather_event_flow, get_interpolation, interpolate
+
+Array = jax.Array
+
+
+def _masked_pol(pol_mask: Array, valid: Optional[Array]) -> Array:
+    if valid is None:
+        return pol_mask
+    return pol_mask * valid.astype(pol_mask.dtype)[:, :, None]
+
+
+def event_warping_loss(
+    flow_list,
+    event_list: Array,
+    pol_mask: Array,
+    resolution: Tuple[int, int],
+    valid: Optional[Array] = None,
+    regul_weight: float = 1.0,
+) -> Array:
+    """Forward+backward averaged-timestamp contrast loss
+    (reference ``EventWarping.forward``, ``flow.py:31-113``).
+
+    ``flow_list``: list of ``[B, H, W, 2]`` flow maps (x, y channels);
+    ``event_list``: ``[B, N, 4]`` (ts, y, x, p); ``pol_mask``: ``[B, N, 2]``.
+    """
+    if not isinstance(flow_list, (list, tuple)):
+        flow_list = [flow_list]
+    flow_scaling = max(resolution)
+    pol_mask = _masked_pol(pol_mask, valid)
+    pol4 = jnp.concatenate([pol_mask] * 4, axis=1)
+    ts4 = jnp.concatenate([event_list[:, :, 0:1]] * 4, axis=1)
+
+    total = 0.0
+    for flow_map in flow_list:
+        event_flow = gather_event_flow(flow_map, event_list)
+
+        def avg_ts_images(tref: float, ts_w: Array) -> Array:
+            idx, w = get_interpolation(
+                event_list, event_flow, tref, resolution, flow_scaling
+            )
+            acc = 0.0
+            for pc in range(2):
+                pm = pol4[:, :, pc : pc + 1]
+                iwe = interpolate(idx, w, resolution, polarity_mask=pm)
+                iwe_ts = interpolate(idx, w * ts_w, resolution, polarity_mask=pm)
+                acc = acc + jnp.sum((iwe_ts / (iwe + 1e-9)) ** 2)
+            return acc
+
+        total = total + avg_ts_images(1.0, ts4) + avg_ts_images(0.0, 1.0 - ts4)
+
+        # Charbonnier flow smoothness (flow.py:99-104).
+        dx = flow_map[:, :-1, :, :] - flow_map[:, 1:, :, :]
+        dy = flow_map[:, :, :-1, :] - flow_map[:, :, 1:, :]
+        smooth = jnp.sqrt(dx**2 + 1e-6).sum() + jnp.sqrt(dy**2 + 1e-6).sum()
+        total = total + regul_weight * smooth
+
+    return total
+
+
+def averaged_iwe(
+    flow_map: Array,
+    event_list: Array,
+    pol_mask: Array,
+    resolution: Tuple[int, int],
+    valid: Optional[Array] = None,
+) -> Array:
+    """Per-pixel per-polarity average warped-event count ``[B, H, W, 2]``
+    (reference ``AveragedIWE.forward``, ``flow.py:127-232``).
+
+    For each destination pixel, the raw warped count is divided by the number
+    of *distinct source pixels* mapping there (per polarity). Uniqueness is
+    computed with a sort over encoded (pol, src, dst) keys and
+    first-occurrence flags — static shapes, no host round-trip.
+    """
+    h, w = resolution
+    r = h * w
+    flow_scaling = max(resolution)
+    pol_mask = _masked_pol(pol_mask, valid)
+
+    event_flow = gather_event_flow(flow_map, event_list)
+    fw_idx, fw_weights = get_interpolation(
+        event_list, event_flow, 1, resolution, flow_scaling, round_idx=True
+    )
+    if valid is not None:
+        fw_weights = fw_weights * valid.astype(fw_weights.dtype)[:, :, None]
+
+    iwe_pos = interpolate(fw_idx, fw_weights, resolution, pol_mask[:, :, 0:1])
+    iwe_neg = interpolate(fw_idx, fw_weights, resolution, pol_mask[:, :, 1:2])
+
+    # Source pixel of each event.
+    src = (
+        event_list[:, :, 1].astype(jnp.int32) * w
+        + event_list[:, :, 2].astype(jnp.int32)
+    )
+    src = jnp.clip(src, 0, r - 1)
+    dst = jnp.clip(fw_idx[:, :, 0].astype(jnp.int32), 0, r - 1)
+
+    # Polarity code: 1 = positive, 0 = negative, 2 = unfeasible/invalid
+    # (reference flow.py:166-169: zero-weight or padded lanes get a fake
+    # polarity so they never count).
+    pol = jnp.where(event_list[:, :, 3] >= 1, 1, 0)
+    dead = (fw_weights[:, :, 0] == 0) | (
+        (pol_mask[:, :, 0] + pol_mask[:, :, 1]) == 0
+    )
+    pol = jnp.where(dead, 2, pol)
+
+    def contrib_one(pol_b, src_b, dst_b):
+        # Lexicographic sort by (pol, src, dst) via cascaded stable sorts
+        # (least-significant key first) — no composite integer key, so no
+        # int32 overflow at real sensor resolutions (H*W can exceed 2^15.5
+        # where (3*(H*W)^2) would wrap). First occurrence of each triple is
+        # a distinct (source -> destination) mapping for that polarity.
+        order = jnp.argsort(dst_b, stable=True)
+        pol_s, src_s, dst_s = pol_b[order], src_b[order], dst_b[order]
+        order = jnp.argsort(src_s, stable=True)
+        pol_s, src_s, dst_s = pol_s[order], src_s[order], dst_s[order]
+        order = jnp.argsort(pol_s, stable=True)
+        pol_sorted, src_s, dst_sorted = pol_s[order], src_s[order], dst_s[order]
+        first = jnp.concatenate(
+            [
+                jnp.ones((1,), bool),
+                (pol_sorted[1:] != pol_sorted[:-1])
+                | (src_s[1:] != src_s[:-1])
+                | (dst_sorted[1:] != dst_sorted[:-1]),
+            ]
+        )
+        img_pos = jnp.zeros((r,), jnp.float32)
+        img_neg = jnp.zeros((r,), jnp.float32)
+        fp = jnp.where(first & (pol_sorted == 1), 1.0, 0.0)
+        fn = jnp.where(first & (pol_sorted == 0), 1.0, 0.0)
+        img_pos = img_pos.at[dst_sorted].add(fp)
+        img_neg = img_neg.at[dst_sorted].add(fn)
+        return img_pos, img_neg
+
+    pos_contrib, neg_contrib = jax.vmap(contrib_one)(pol, src, dst)
+    pos_contrib = pos_contrib.reshape(-1, h, w, 1)
+    neg_contrib = neg_contrib.reshape(-1, h, w, 1)
+
+    iwe_pos = jnp.where(pos_contrib > 0, iwe_pos / jnp.maximum(pos_contrib, 1), iwe_pos)
+    iwe_neg = jnp.where(neg_contrib > 0, iwe_neg / jnp.maximum(neg_contrib, 1), iwe_neg)
+    return jnp.concatenate([iwe_pos, iwe_neg], axis=-1)

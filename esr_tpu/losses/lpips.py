@@ -1,0 +1,177 @@
+"""LPIPS perceptual distance, Flax-native.
+
+Rebuilds the vendored PerceptualSimilarity stack
+(``/root/reference/loss/PerceptualSimilarity/models/networks_basic.py:32-110``):
+input scaling layer -> AlexNet feature taps (relu1..relu5) -> per-layer
+channel normalization -> squared diff -> learned 1x1 linear calibration ->
+spatial average -> sum over layers.
+
+Weights: the linear-calibration weights ship with this repo
+(``esr_tpu/losses/lpips_lin_alex.npz``, converted from the public
+richzhang/PerceptualSimilarity v0.1 release — ~1.2k floats). The AlexNet
+backbone weights come from torchvision's pretrained model, which is not
+redistributable here; :func:`load_lpips_params` converts a torch state dict
+when one is supplied and otherwise falls back to a fixed-seed random
+backbone (a deterministic but *uncalibrated* perceptual distance — fine for
+relative comparisons, documented for absolute ones).
+
+The reference's multi-channel handling (``loss/restore.py:28-38``: each
+channel replicated to RGB, distances averaged) is reproduced by
+:meth:`LPIPS.multi_channel`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+Array = jax.Array
+
+# (channels, kernel, stride, pool_before) for the 5 AlexNet feature stages;
+# taps are taken after each stage's ReLU (pretrained_networks.py:66-96).
+_ALEX_STAGES = (
+    (64, 11, 4, False),
+    (192, 5, 1, True),
+    (384, 3, 1, True),
+    (256, 3, 1, False),
+    (256, 3, 1, False),
+)
+_ALEX_CHNS = tuple(s[0] for s in _ALEX_STAGES)
+
+# ScalingLayer constants (networks_basic.py:103-110).
+_SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], np.float32)
+
+_LIN_WEIGHTS_FILE = os.path.join(os.path.dirname(__file__), "lpips_lin_alex.npz")
+
+
+class _AlexFeatures(nn.Module):
+    """AlexNet ``features`` trunk returning the 5 post-ReLU taps."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Sequence[Array]:
+        taps = []
+        for i, (ch, k, s, pool_before) in enumerate(_ALEX_STAGES):
+            if pool_before:
+                x = nn.max_pool(x, (3, 3), strides=(2, 2))
+            pad = 2 if k in (11, 5) else 1
+            x = nn.Conv(
+                ch, (k, k), strides=(s, s),
+                padding=((pad, pad), (pad, pad)), name=f"conv{i}",
+            )(x)
+            x = jax.nn.relu(x)
+            taps.append(x)
+        return taps
+
+
+class LPIPS(nn.Module):
+    """Learned perceptual distance ``forward(x, y) -> [B]``.
+
+    Inputs ``[B, H, W, 3]``. ``normalize=True`` maps [0, 1] -> [-1, 1]
+    first (reference ``perceptual_loss.__call__``, ``loss/restore.py:18-23``).
+    """
+
+    use_lins: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array, y: Array, normalize: bool = True) -> Array:
+        if normalize:
+            x = 2.0 * x - 1.0
+            y = 2.0 * y - 1.0
+        shift = jnp.asarray(_SHIFT)
+        scale = jnp.asarray(_SCALE)
+        x = (x - shift) / scale
+        y = (y - shift) / scale
+
+        net = _AlexFeatures(name="alex")
+        fx = net(x)
+        fy = net(y)
+
+        total = 0.0
+        for i, (a, b) in enumerate(zip(fx, fy)):
+            a = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-10)
+            b = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-10)
+            diff = (a - b) ** 2
+            if self.use_lins:
+                # 1x1 conv with non-negative learned weights, no bias.
+                w = self.param(
+                    f"lin{i}",
+                    nn.initializers.constant(1.0 / _ALEX_CHNS[i]),
+                    (_ALEX_CHNS[i],),
+                )
+                val = (diff * jnp.abs(w)).sum(axis=-1)
+            else:
+                val = diff.sum(axis=-1)
+            total = total + val.mean(axis=(1, 2))
+        return total
+
+    def multi_channel(self, params, pred: Array, tgt: Array) -> Array:
+        """Grayscale/2-channel images: replicate each channel to RGB and
+        average distances (reference ``loss/restore.py:26-38``)."""
+        c = pred.shape[-1]
+        if c == 3:
+            return self.apply(params, pred, tgt).mean()
+        dists = []
+        for i in range(c):
+            p3 = jnp.repeat(pred[..., i : i + 1], 3, axis=-1)
+            t3 = jnp.repeat(tgt[..., i : i + 1], 3, axis=-1)
+            dists.append(self.apply(params, p3, t3).mean())
+        return jnp.stack(dists).mean()
+
+
+def _torch_conv_to_flax(w: np.ndarray) -> np.ndarray:
+    # torch OIHW -> flax HWIO
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def load_lpips_params(
+    alexnet_state: Optional[Dict[str, Any]] = None,
+    lin_npz_path: Optional[str] = None,
+    rng_seed: int = 0,
+) -> Dict[str, Any]:
+    """Build the LPIPS param pytree.
+
+    ``alexnet_state``: a torchvision ``alexnet().state_dict()``-style mapping
+    (numpy or torch tensors) with keys ``features.{0,3,6,8,10}.{weight,bias}``.
+    When absent, the backbone is random-initialized from ``rng_seed``
+    (deterministic, uncalibrated — see module docstring). The lin calibration
+    weights load from the bundled npz.
+    """
+    model = LPIPS()
+    dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(rng_seed), dummy, dummy)
+    params = jax.tree.map(np.asarray, params)
+    p = params["params"]
+
+    torch_layer_idx = (0, 3, 6, 8, 10)
+    if alexnet_state is not None:
+        for i, li in enumerate(torch_layer_idx):
+            w = np.asarray(alexnet_state[f"features.{li}.weight"], np.float32)
+            b = np.asarray(alexnet_state[f"features.{li}.bias"], np.float32)
+            p["alex"][f"conv{i}"]["kernel"] = _torch_conv_to_flax(w)
+            p["alex"][f"conv{i}"]["bias"] = b
+
+    path = lin_npz_path or _LIN_WEIGHTS_FILE
+    if os.path.exists(path):
+        lins = np.load(path)
+        for i in range(5):
+            p[f"lin{i}"] = np.asarray(lins[f"lin{i}"], np.float32)
+    return params
+
+
+def convert_lpips_lin_pth(pth_path: str, out_npz_path: str) -> None:
+    """One-shot converter: richzhang LPIPS v0.1 ``alex.pth`` (keys
+    ``lin{i}.model.1.weight`` of shape ``[1, C, 1, 1]``) -> flat npz."""
+    import torch
+
+    sd = torch.load(pth_path, map_location="cpu")
+    out = {
+        f"lin{i}": sd[f"lin{i}.model.1.weight"].numpy().reshape(-1)
+        for i in range(5)
+    }
+    np.savez(out_npz_path, **out)

@@ -1,0 +1,117 @@
+"""Restoration metrics: L1/MSE/PSNR/SSIM, pure jnp.
+
+The reference computes SSIM/PSNR with scikit-image **on CPU** per image
+(``loss/restore.py:43-90``) — a host round-trip per validation sample. Here
+they are jit-able jnp reproducing scikit-image's exact algorithm (uniform
+7x7 window, sample covariance, border crop), so the whole eval path stays on
+device and batches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def mse_metric(pred: Array, tgt: Array) -> Array:
+    return jnp.mean((pred - tgt) ** 2)
+
+
+def l1_metric(pred: Array, tgt: Array) -> Array:
+    return jnp.mean(jnp.abs(pred - tgt))
+
+
+def psnr(pred: Array, tgt: Array, data_range: float | Array = 1.0) -> Array:
+    """``10 log10(R^2 / MSE)`` (scikit-image ``peak_signal_noise_ratio``)."""
+    err = jnp.mean((pred - tgt) ** 2)
+    return 10.0 * jnp.log10(jnp.asarray(data_range) ** 2 / jnp.maximum(err, 1e-20))
+
+
+def _uniform_filter_valid(img: Array, win: int) -> Array:
+    """Mean filter, VALID region only — equals scipy ``uniform_filter``
+    followed by the (win-1)//2 border crop scikit-image applies."""
+    k = jnp.ones((win, win, 1, 1), img.dtype) / (win * win)
+    return jax.lax.conv_general_dilated(
+        img[None, :, :, None],
+        k,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )[0, :, :, 0]
+
+
+def ssim(
+    pred: Array,
+    tgt: Array,
+    data_range: float | Array = 1.0,
+    win_size: int = 7,
+    k1: float = 0.01,
+    k2: float = 0.03,
+) -> Array:
+    """Structural similarity of two ``[H, W]`` images.
+
+    Exact re-derivation of scikit-image ``structural_similarity`` defaults
+    (uniform window, ``use_sample_covariance=True`` so the covariance is
+    normalized by ``NP/(NP-1)``, mean taken over the border-cropped map) —
+    the configuration the reference relies on (``loss/restore.py:43-63``).
+    """
+    x = pred.astype(jnp.float64 if pred.dtype == jnp.float64 else jnp.float32)
+    y = tgt.astype(x.dtype)
+    np_ = win_size * win_size
+    cov_norm = np_ / (np_ - 1.0)
+
+    ux = _uniform_filter_valid(x, win_size)
+    uy = _uniform_filter_valid(y, win_size)
+    uxx = _uniform_filter_valid(x * x, win_size)
+    uyy = _uniform_filter_valid(y * y, win_size)
+    uxy = _uniform_filter_valid(x * y, win_size)
+
+    vx = cov_norm * (uxx - ux * ux)
+    vy = cov_norm * (uyy - uy * uy)
+    vxy = cov_norm * (uxy - ux * uy)
+
+    r = jnp.asarray(data_range)
+    c1 = (k1 * r) ** 2
+    c2 = (k2 * r) ** 2
+    s = ((2 * ux * uy + c1) * (2 * vxy + c2)) / (
+        (ux**2 + uy**2 + c1) * (vx + vy + c2)
+    )
+    return jnp.mean(s)
+
+
+def ssim_metric(pred: Array, tgt: Array, data_range: float = 2.0) -> Array:
+    """Reference ``ssim_loss.__call__`` semantics: ``[H, W]`` or ``[H, W, C]``
+    inputs, channel-averaged (``loss/restore.py:52-63``).
+
+    ``data_range`` defaults to 2.0 because the reference passes none to
+    scikit-image, which derives it from the float dtype range (-1, 1) —
+    matching that quirk keeps our numbers comparable to baseline ones.
+    """
+    if pred.ndim == 2:
+        return ssim(pred, tgt, data_range)
+    vals = [
+        ssim(pred[..., c], tgt[..., c], data_range) for c in range(pred.shape[-1])
+    ]
+    return jnp.stack(vals).mean()
+
+
+def psnr_metric(pred: Array, tgt: Array) -> Array:
+    """Reference ``psnr_loss.__call__`` semantics (``loss/restore.py:66-90``).
+
+    Multi-channel: per-channel ``data_range = tgt[c].max() - tgt.min()``
+    (the reference's per-channel-max-minus-global-min quirk, ``:83``),
+    averaged over channels. Single-channel: images clipped to [0, 1],
+    ``data_range = 1``.
+    """
+    if pred.ndim == 2:
+        return psnr(jnp.clip(pred, 0, 1), jnp.clip(tgt, 0, 1), 1.0)
+    tmin = tgt.min()
+    vals = []
+    for c in range(pred.shape[-1]):
+        dr = tgt[..., c].max() - tmin
+        vals.append(psnr(pred[..., c], tgt[..., c], dr))
+    return jnp.stack(vals).mean()

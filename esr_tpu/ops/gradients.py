@@ -1,0 +1,46 @@
+"""Spatial gradient ops (reference ``myutils/gradients.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_SOBEL_X = jnp.array(
+    [[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]]
+)
+_SOBEL_Y = jnp.array(
+    [[-1.0, -2.0, -1.0], [0.0, 0.0, 0.0], [1.0, 2.0, 1.0]]
+)
+
+
+def sobel(x: Array) -> Tuple[Array, Array]:
+    """Normalized Sobel gradients with replication padding.
+
+    Equivalent of the reference ``Sobel`` module (``gradients.py:7-33``):
+    channels are folded into the batch, the input is replication-padded by 1,
+    and the 3x3 Sobel responses are divided by 8.
+
+    ``x``: ``[B, H, W, C]`` -> ``(gradx, grady)`` each ``[B, H, W, C]``.
+    """
+    b, h, w, c = x.shape
+    flat = jnp.moveaxis(x, -1, 1).reshape(b * c, h, w)
+    padded = jnp.pad(flat, ((0, 0), (1, 1), (1, 1)), mode="edge")
+
+    def conv(img, k):
+        return jax.lax.conv_general_dilated(
+            img[:, :, :, None],
+            k[:, :, None, None],
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[..., 0]
+
+    gx = conv(padded, _SOBEL_X) / 8.0
+    gy = conv(padded, _SOBEL_Y) / 8.0
+    gx = jnp.moveaxis(gx.reshape(b, c, h, w), 1, -1)
+    gy = jnp.moveaxis(gy.reshape(b, c, h, w), 1, -1)
+    return gx, gy

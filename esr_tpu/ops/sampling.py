@@ -1,0 +1,42 @@
+"""Bilinear grid sampling (torch ``F.grid_sample`` semantics), jnp."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def grid_sample(
+    img: Array, grid: Array, align_corners: bool = False
+) -> Array:
+    """Bilinear sample with zero padding.
+
+    ``img``: ``[B, H, W, C]``; ``grid``: ``[B, Ho, Wo, 2]`` as (x, y) in
+    [-1, 1]. Matches ``torch.nn.functional.grid_sample(mode='bilinear',
+    padding_mode='zeros')``; ``align_corners=False`` (torch's default) maps
+    -1/+1 to the outer pixel *edges*, ``True`` to the outer pixel centers.
+    """
+    b, h, w, c = img.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        x = (gx + 1.0) * (w - 1) / 2.0
+        y = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        x = ((gx + 1.0) * w - 1.0) / 2.0
+        y = ((gy + 1.0) * h - 1.0) / 2.0
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    out = 0.0
+    for ox, oy in ((0, 0), (1, 0), (0, 1), (1, 1)):
+        xi = x0 + ox
+        yi = y0 + oy
+        wgt = (1.0 - jnp.abs(x - xi)) * (1.0 - jnp.abs(y - yi))
+        inb = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        vals = jax.vmap(lambda im, yy, xx: im[yy, xx])(img, yc, xc)
+        out = out + jnp.where((inb & jnp.isfinite(wgt))[..., None], wgt[..., None] * vals, 0.0)
+    return out

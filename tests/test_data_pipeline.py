@@ -1,0 +1,58 @@
+"""Data pipeline: host (numpy) vs device (jnp) encoding parity, datasets."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.data import np_encodings as NE
+from esr_tpu.ops import encodings as E
+from esr_tpu.ops.resize import interpolate
+
+
+def _rand_events(n, h, w, rng, frac=True):
+    xs = rng.random(n).astype(np.float32) * w if frac else rng.integers(0, w, n)
+    ys = rng.random(n).astype(np.float32) * h if frac else rng.integers(0, h, n)
+    ts = np.sort(rng.random(n)).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    return xs.astype(np.float32), ys.astype(np.float32), ts, ps
+
+
+def test_np_vs_jnp_encoding_parity():
+    """Bit-for-bit agreement between host rasterization and device ops."""
+    rng = np.random.default_rng(0)
+    h, w, n = 13, 17, 256
+    xs, ys, ts, ps = _rand_events(n, h, w, rng)
+
+    np.testing.assert_array_equal(
+        NE.events_to_image_np(xs, ys, ps, (h, w)),
+        np.asarray(E.events_to_image(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ps), (h, w))),
+    )
+    np.testing.assert_array_equal(
+        NE.events_to_channels_np(xs, ys, ps, (h, w)),
+        np.asarray(E.events_to_channels(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ps), (h, w))),
+    )
+    for nb in (1, 4):
+        np.testing.assert_allclose(
+            NE.events_to_stack_np(xs, ys, ts, ps, nb, (h, w)),
+            np.asarray(E.events_to_stack(
+                jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ts), jnp.asarray(ps), nb, (h, w)
+            )),
+            atol=1e-5,
+        )
+    tsn = (ts - ts.min()) / (ts.max() - ts.min())
+    np.testing.assert_allclose(
+        NE.events_to_voxel_np(xs, ys, tsn, ps, 5, (h, w)),
+        np.asarray(E.events_to_voxel(
+            jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(tsn), jnp.asarray(ps), 5, (h, w)
+        )),
+        atol=1e-5,
+    )
+
+
+def test_interpolate_np_matches_device_resize():
+    rng = np.random.default_rng(1)
+    img = rng.random((9, 12, 2)).astype(np.float32)
+    for mode in ("bilinear", "bicubic", "nearest"):
+        host = NE.interpolate_np(img, (18, 24), mode)
+        dev = np.asarray(interpolate(jnp.asarray(img), (18, 24), mode))
+        np.testing.assert_allclose(host, dev, atol=1e-4)

@@ -1,0 +1,127 @@
+"""IWE warping utilities: round-trips, parity with hand cases and torch."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.ops.iwe import (
+    compute_pol_iwe,
+    deblur_events,
+    gather_event_flow,
+    get_interpolation,
+    interpolate,
+    purge_unfeasible,
+)
+from esr_tpu.ops.encodings import events_to_channels
+from esr_tpu.ops.sampling import grid_sample
+
+
+def _rand_events(n, h, w, rng):
+    ts = rng.random(n).astype(np.float32)
+    ys = rng.integers(0, h, n).astype(np.float32)
+    xs = rng.integers(0, w, n).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    return np.stack([ts, ys, xs, ps], axis=-1)
+
+
+def test_purge_unfeasible():
+    coords = jnp.array([[[0.0, 0.0], [-1.0, 2.0], [3.0, 5.0], [2.0, 4.0]]])
+    out, mask = purge_unfeasible(coords, (4, 5))
+    np.testing.assert_array_equal(
+        np.asarray(mask)[0, :, 0], [1.0, 0.0, 0.0, 1.0]
+    )
+    assert np.all(np.asarray(out)[0, 1] == 0)
+
+
+def test_zero_flow_roundtrip_matches_count_image():
+    """With zero flow and rounding, the IWE is the plain count image."""
+    rng = np.random.default_rng(0)
+    h, w, n = 8, 10, 64
+    ev = _rand_events(n, h, w, rng)
+    events = jnp.asarray(ev)[None]
+    flow = jnp.zeros((1, h, w, 2))
+    pos = jnp.asarray((ev[:, 3] > 0).astype(np.float32))[None, :, None]
+    neg = jnp.asarray((ev[:, 3] < 0).astype(np.float32))[None, :, None]
+    iwe = compute_pol_iwe(flow, events, (h, w), pos, neg, round_idx=True)
+    cnt = events_to_channels(
+        jnp.asarray(ev[:, 2]), jnp.asarray(ev[:, 1]), jnp.asarray(ev[:, 3]), (h, w)
+    )
+    np.testing.assert_allclose(np.asarray(iwe)[0], np.asarray(cnt), atol=1e-5)
+
+
+def test_valid_mask_drops_padded_lanes():
+    rng = np.random.default_rng(1)
+    h, w = 6, 6
+    ev = _rand_events(32, h, w, rng)
+    events = jnp.asarray(ev)[None]
+    valid = jnp.asarray((np.arange(32) < 16).astype(np.float32))[None]
+    flow = jnp.zeros((1, h, w, 2))
+    full = deblur_events(flow, events, (h, w), round_idx=True)
+    half = deblur_events(flow, events, (h, w), round_idx=True, valid=valid)
+    cnt_half = events_to_channels(
+        jnp.asarray(ev[:16, 2]), jnp.asarray(ev[:16, 1]),
+        jnp.abs(jnp.asarray(ev[:16, 3])), (h, w),
+    ).sum(-1)
+    assert np.asarray(half).sum() == 16
+    assert np.asarray(full).sum() == 32
+    np.testing.assert_allclose(np.asarray(half)[0, :, :, 0], np.asarray(cnt_half))
+
+
+def test_bilinear_weights_sum_to_one_inbounds():
+    """4-tap weights of an interior event sum to 1."""
+    events = jnp.array([[[0.5, 2.3, 3.7, 1.0]]])
+    flow = jnp.zeros((1, 1, 2))
+    idx, w = get_interpolation(events, flow, tref=0.5, res=(8, 8), flow_scaling=8)
+    np.testing.assert_allclose(np.asarray(w).sum(), 1.0, atol=1e-6)
+
+
+def test_gather_event_flow():
+    h, w = 4, 5
+    fmap = np.zeros((1, h, w, 2), np.float32)
+    fmap[0, 2, 3, 0] = 7.0  # x-component
+    fmap[0, 2, 3, 1] = -3.0  # y-component
+    events = jnp.array([[[0.0, 2.0, 3.0, 1.0]]])
+    out = np.asarray(gather_event_flow(jnp.asarray(fmap), events))
+    np.testing.assert_allclose(out[0, 0], [-3.0, 7.0])  # (y, x) order
+
+
+def test_grid_sample_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(2)
+    img = rng.random((2, 7, 9, 3)).astype(np.float32)
+    grid = (rng.random((2, 5, 6, 2)).astype(np.float32) * 2.4) - 1.2
+    ours = np.asarray(grid_sample(jnp.asarray(img), jnp.asarray(grid)))
+    theirs = (
+        torch.nn.functional.grid_sample(
+            torch.from_numpy(img).permute(0, 3, 1, 2),
+            torch.from_numpy(grid),
+            mode="bilinear",
+            padding_mode="zeros",
+            align_corners=False,
+        )
+        .permute(0, 2, 3, 1)
+        .numpy()
+    )
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_sobel_matches_torch_conv():
+    torch = pytest.importorskip("torch")
+    from esr_tpu.ops.gradients import sobel
+
+    rng = np.random.default_rng(3)
+    img = rng.random((2, 6, 8, 1)).astype(np.float32)
+    gx, gy = sobel(jnp.asarray(img))
+
+    t = torch.from_numpy(img).permute(0, 3, 1, 2)
+    pad = torch.nn.ReplicationPad2d(1)(t)
+    ka = torch.tensor([[[[-1.0, 0, 1], [-2, 0, 2], [-1, 0, 1]]]])
+    kb = torch.tensor([[[[-1.0, -2, -1], [0, 0, 0], [1, 2, 1]]]])
+    tx = torch.nn.functional.conv2d(pad, ka) / 8
+    ty = torch.nn.functional.conv2d(pad, kb) / 8
+    np.testing.assert_allclose(
+        np.asarray(gx), tx.permute(0, 2, 3, 1).numpy(), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(gy), ty.permute(0, 2, 3, 1).numpy(), atol=1e-5
+    )
