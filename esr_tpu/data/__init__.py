@@ -9,5 +9,41 @@ train step does the BPTT windowing on device.
 """
 
 from esr_tpu.data import np_encodings
+from esr_tpu.data.dataset import EventWindowDataset, SequenceDataset
+from esr_tpu.data.loader import (
+    ConcatSequenceDataset,
+    SequenceLoader,
+    ShardedSampler,
+    collate_sequences,
+    overlapping_windows,
+    read_datalist,
+)
+from esr_tpu.data.records import (
+    H5Recording,
+    MemoryRecording,
+    Recording,
+    ScaleLadder,
+    open_recording,
+    resolve_scale_ladder,
+)
+from esr_tpu.data.synthetic import make_synthetic_recording, write_synthetic_h5
 
-__all__ = ["np_encodings"]
+__all__ = [
+    "np_encodings",
+    "EventWindowDataset",
+    "SequenceDataset",
+    "ConcatSequenceDataset",
+    "SequenceLoader",
+    "ShardedSampler",
+    "collate_sequences",
+    "overlapping_windows",
+    "read_datalist",
+    "H5Recording",
+    "MemoryRecording",
+    "Recording",
+    "ScaleLadder",
+    "open_recording",
+    "resolve_scale_ladder",
+    "make_synthetic_recording",
+    "write_synthetic_h5",
+]

@@ -1,0 +1,234 @@
+"""Batching + sharding: datalist → static-shape device-ready batches.
+
+Replaces the reference's torch ``DataLoader``/``DistributedSampler`` stack
+(``/root/reference/dataloader/h5dataloader.py:180-268``). Differences by
+design:
+
+- **Collate shape.** The reference collates a length-L sequence into
+  ``(L − seqn + 1)`` overlapping seqn-windows on the CPU
+  (``h5dataloader.py:210-233``) and python-loops over them for BPTT. Here the
+  loader emits ONE ``{key: (B, L, …)}`` batch; the jit'd train step slices the
+  overlapping windows on device (``esr_tpu.training.train_step._make_windows``)
+  and scans over them — no host-side duplication of (seqn−1)/seqn of the data.
+  :func:`overlapping_windows` provides the reference-shaped view when needed
+  (inference streaming).
+- **Sharding.** ``DistributedSampler`` becomes :class:`ShardedSampler`: each
+  host takes a deterministic, padded, epoch-shuffled slice of the index space
+  — the JAX data-parallel analogue (per-host input feeding a ``('data',)``
+  mesh axis).
+- **Prefetch.** A background thread overlaps host rasterization with device
+  steps (the torch num_workers analogue; HDF5/numpy release little GIL so a
+  single prefetch thread is usually enough — heavier lifting belongs to the
+  native host kernels in ``esr_tpu/native``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from esr_tpu.data.dataset import SequenceDataset
+
+
+def read_datalist(path: str) -> List[str]:
+    """Datalist txt → list of recording paths (one per line, '#' comments ok)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line)
+    return out
+
+
+class ConcatSequenceDataset:
+    """Concatenation of per-recording :class:`SequenceDataset`s
+    (``h5dataloader.py:20-34``)."""
+
+    def __init__(self, recordings: Sequence, config: Dict):
+        self.datasets = [SequenceDataset(r, config) for r in recordings]
+        if not self.datasets:
+            raise ValueError("empty datalist")
+        # a recording with fewer windows than sequence_length clamps its L
+        # (dataset.py) — mixing it with full-length recordings would produce
+        # ragged sequences that cannot be collated into one (B, L, …) batch
+        lengths = {d.L for d in self.datasets}
+        if len(lengths) > 1:
+            bad = [
+                (r, d.L) for r, d in zip(recordings, self.datasets)
+                if d.L != config["sequence"]["sequence_length"]
+            ]
+            raise ValueError(
+                f"inconsistent sequence lengths {sorted(lengths)}: recordings "
+                f"{bad} are too short for sequence_length="
+                f"{config['sequence']['sequence_length']}"
+            )
+        self.cumlen = np.cumsum([len(d) for d in self.datasets])
+        self.inp_resolution = self.datasets[0].inp_resolution
+        self.gt_resolution = self.datasets[0].gt_resolution
+
+    @classmethod
+    def from_datalist(cls, datalist_path: str, config: Dict) -> "ConcatSequenceDataset":
+        return cls(read_datalist(datalist_path), config)
+
+    def __len__(self) -> int:
+        return int(self.cumlen[-1])
+
+    def get_item(self, index: int, seed: Optional[int] = None):
+        d = int(np.searchsorted(self.cumlen, index, side="right"))
+        local = index - (self.cumlen[d - 1] if d else 0)
+        return self.datasets[d].get_item(int(local), seed=seed)
+
+
+class ShardedSampler:
+    """Deterministic per-host index sharding with epoch shuffling.
+
+    Pads the (optionally shuffled) index list to a multiple of
+    ``num_shards × batch_size`` by wrapping, then deals indices round-robin so
+    every host sees the same number of batches — the SPMD replacement for
+    torch's ``DistributedSampler`` (``h5dataloader.py:189``; epoch reshuffle
+    ``train_ours_cnt_seq.py:204``).
+    """
+
+    def __init__(
+        self,
+        num_items: int,
+        batch_size: int,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        assert 0 <= shard_id < num_shards
+        self.num_items = num_items
+        self.batch_size = batch_size
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        idx = np.arange(self.num_items)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            rng.shuffle(idx)
+        chunk = self.batch_size * self.num_shards
+        if self.drop_last:
+            idx = idx[: (len(idx) // chunk) * chunk]
+        elif len(idx) % chunk:
+            # wrap-pad to a multiple of chunk (np.resize tiles, so this also
+            # covers num_items < chunk)
+            idx = np.resize(idx, -(-len(idx) // chunk) * chunk)
+        if len(idx) == 0:
+            return
+        mine = idx.reshape(-1, self.num_shards, self.batch_size)[:, self.shard_id]
+        for batch in mine:
+            yield batch
+
+    def __len__(self) -> int:
+        chunk = self.batch_size * self.num_shards
+        if self.drop_last:
+            return self.num_items // chunk
+        return -(-self.num_items // chunk)
+
+
+def collate_sequences(
+    sequences: List[List[Dict[str, np.ndarray]]],
+) -> Dict[str, np.ndarray]:
+    """[B sequences of L item-dicts] → {key: (B, L, …)} float32 batch."""
+    keys = sequences[0][0].keys()
+    return {
+        k: np.stack([np.stack([item[k] for item in seq]) for seq in sequences])
+        for k in keys
+    }
+
+
+def overlapping_windows(batch: Dict[str, np.ndarray], seqn: int) -> List[Dict[str, np.ndarray]]:
+    """Reference-shaped view: (B, L, …) → list of (L−seqn+1) dicts of
+    (B, seqn, …) overlapping windows (``h5dataloader.py:229-233``)."""
+    L = next(iter(batch.values())).shape[1]
+    assert L >= seqn
+    return [{k: v[:, i : i + seqn] for k, v in batch.items()} for i in range(L - seqn + 1)]
+
+
+class SequenceLoader:
+    """Iterable over collated ``(B, L, …)`` batches with epoch semantics.
+
+    The training analogue of ``HDF5DataLoaderSequence``; construct one per
+    host with its ``shard_id``/``num_shards``.
+    """
+
+    def __init__(
+        self,
+        dataset: ConcatSequenceDataset,
+        batch_size: int,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+        prefetch: int = 2,
+    ):
+        self.dataset = dataset
+        self.sampler = ShardedSampler(
+            len(dataset), batch_size, shard_id, num_shards, shuffle, drop_last, seed
+        )
+        self.prefetch = prefetch
+        self.seed = seed
+        self.inp_resolution = dataset.inp_resolution
+        self.gt_resolution = dataset.gt_resolution
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.sampler)
+
+    def _build(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
+        # one shared derived seed per sequence keeps augmentation consistent
+        # across its windows (reference: h5dataset.py:761-766)
+        epoch = self.sampler.epoch
+        seqs = [
+            self.dataset.get_item(
+                int(i), seed=int(np.random.default_rng((self.seed, epoch, int(i))).integers(2**31))
+            )
+            for i in indices
+        ]
+        return collate_sequences(seqs)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        batches = iter(self.sampler)
+        if self.prefetch <= 0:
+            for idx in batches:
+                yield self._build(idx)
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+
+        def worker():
+            try:
+                for idx in batches:
+                    q.put(self._build(idx))
+                q.put(stop)
+            except BaseException as e:  # propagate into the consumer thread
+                q.put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item

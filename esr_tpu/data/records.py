@@ -1,0 +1,240 @@
+"""Event-recording storage layer: HDF5 readers + the resolution ladder.
+
+The reference pairs an input event stream with a ground-truth stream via a
+per-file "resolution ladder": each HDF5 recording stores the same scene at
+``ori, down2, down4, down8, down16`` resolutions, and ``(scale, ori_scale)``
+select which rung feeds the model and which rung supervises it
+(``/root/reference/dataloader/h5dataset.py:31-145``). The reference spells the
+ladder as a five-way if-chain; here it is one arithmetic rule (see
+:func:`resolve_scale_ladder`).
+
+Unlike the reference — which re-reads the full ``ts[:]`` dataset from HDF5 on
+every window-index query (``h5dataset.py:264-269,438-463``) — recordings cache
+the timestamp arrays once; all searches are ``np.searchsorted`` on the cached
+array (replacing the Cython ``binary_search`` ext,
+``dataloader/binary_search/binary_search.pyx:17-38``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # h5py is optional at import time so pure-array tests need no HDF5.
+    import h5py
+except ImportError:  # pragma: no cover
+    h5py = None
+
+_LADDER = {"ori": 1, "down2": 2, "down4": 4, "down8": 8, "down16": 16}
+
+
+def _scaled(resolution: Sequence[int], factor: float) -> List[int]:
+    return [round(i / factor) for i in resolution]
+
+
+@dataclass(frozen=True)
+class ScaleLadder:
+    """Resolved resolutions + HDF5 group prefixes for one (scale, ori_scale)."""
+
+    inp_resolution: Tuple[int, int]
+    gt_resolution: Tuple[int, int]
+    inp_down_resolution: Tuple[int, int]
+    inp_prefix: str
+    gt_prefix: Optional[str]  # None when no GT event stream is needed
+
+
+def resolve_scale_ladder(
+    sensor_resolution: Sequence[int],
+    scale: int,
+    ori_scale: str,
+    need_gt_events: bool = False,
+    real_world_test: bool = False,
+) -> ScaleLadder:
+    """Pick input/GT rungs of the resolution ladder.
+
+    Mirrors ``h5dataset.py:31-145``: with input at ``sensor/f`` (``f`` from
+    ``ori_scale``), the GT rung for ``scale``× SR is ``sensor/(f/scale)`` —
+    i.e. ``scale`` must divide ``f`` when real GT events are requested.
+    Without GT events the GT resolution is simply ``scale``× the input (same
+    prefix; GT tensors are synthesized from the input stream).
+    """
+    if ori_scale not in _LADDER:
+        raise ValueError(f"unknown ori_scale {ori_scale!r}")
+    f = _LADDER[ori_scale]
+    inp_resolution = tuple(_scaled(sensor_resolution, f))
+    inp_down = tuple(round(i / scale) for i in inp_resolution)
+
+    if real_world_test:
+        # Real-sensor capture: only the down8 rung exists (recorded, not
+        # simulated), under the 'down8_real' group (h5dataset.py:44-59).
+        if ori_scale != "down8" or need_gt_events:
+            raise ValueError("real_world_test requires ori_scale=down8 and no GT events")
+        g = 8 // scale if scale in (2, 4, 8) else 1
+        return ScaleLadder(
+            inp_resolution=inp_resolution,
+            gt_resolution=tuple(_scaled(sensor_resolution, g)),
+            inp_down_resolution=inp_down,
+            inp_prefix="down8_real",
+            gt_prefix="down8_real",
+        )
+
+    if not need_gt_events:
+        return ScaleLadder(
+            inp_resolution=inp_resolution,
+            gt_resolution=tuple(i * scale for i in inp_resolution),
+            inp_down_resolution=inp_down,
+            inp_prefix=ori_scale,
+            gt_prefix=ori_scale,
+        )
+
+    if f % scale != 0:
+        raise ValueError(f"scale {scale} incompatible with ori_scale {ori_scale}")
+    g = f // scale
+    gt_prefix = "ori" if g == 1 else f"down{g}"
+    return ScaleLadder(
+        inp_resolution=inp_resolution,
+        gt_resolution=tuple(_scaled(sensor_resolution, g)),
+        inp_down_resolution=inp_down,
+        inp_prefix=ori_scale,
+        gt_prefix=gt_prefix,
+    )
+
+
+class EventStream:
+    """One resolution rung: coordinate/timestamp/polarity arrays.
+
+    ``ts`` is cached in host memory; ``xs/ys/ps`` are sliced lazily from the
+    backing store (HDF5 dataset or numpy array).
+    """
+
+    def __init__(self, xs, ys, ts: np.ndarray, ps):
+        self._xs, self._ys, self._ps = xs, ys, ps
+        self.ts = np.asarray(ts, np.float64)
+        self.num_events = len(self.ts)
+
+    def window(self, idx0: int, idx1: int) -> np.ndarray:
+        """Events in ``[idx0, idx1)`` as a ``[4, N]`` float64 array (x,y,t,p)."""
+        return np.stack(
+            [
+                np.asarray(self._xs[idx0:idx1], np.float64),
+                np.asarray(self._ys[idx0:idx1], np.float64),
+                self.ts[idx0:idx1],
+                np.asarray(self._ps[idx0:idx1], np.float64),
+            ]
+        )
+
+    def search(self, t: float) -> int:
+        """Index of the first event with timestamp >= ``t``."""
+        return int(np.searchsorted(self.ts, t, side="left"))
+
+
+class Recording:
+    """A recording: event streams per ladder rung + optional frame images.
+
+    Abstract storage: :class:`H5Recording` reads the reference HDF5 layout
+    (``{prefix}_events/{xs,ys,ts,ps}`` groups + ``ori_images/image%09d`` with
+    ``timestamp`` attrs, written by
+    ``/root/reference/generate_dataset/tools/event_packagers.py:119+``);
+    :class:`MemoryRecording` holds in-memory arrays for tests/synthetics.
+    """
+
+    sensor_resolution: Tuple[int, int]
+
+    def stream(self, prefix: str) -> EventStream:
+        raise NotImplementedError
+
+    @property
+    def num_frames(self) -> int:
+        return len(self.frame_ts)
+
+    @property
+    def frame_ts(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def frame(self, index: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class H5Recording(Recording):
+    def __init__(self, path: str):
+        if h5py is None:  # pragma: no cover
+            raise ImportError("h5py is required to read HDF5 recordings")
+        self.path = path
+        self._file = h5py.File(path, "r")
+        self.sensor_resolution = tuple(
+            int(i) for i in np.asarray(self._file.attrs["sensor_resolution"]).tolist()
+        )
+        self._streams: Dict[str, EventStream] = {}
+        self._frame_ts: Optional[np.ndarray] = None
+        self._frame_names: Optional[List[str]] = None
+
+    def stream(self, prefix: str) -> EventStream:
+        if prefix not in self._streams:
+            grp = self._file[f"{prefix}_events"]
+            self._streams[prefix] = EventStream(
+                grp["xs"], grp["ys"], grp["ts"][:], grp["ps"]
+            )
+        return self._streams[prefix]
+
+    def _load_frames(self) -> None:
+        if self._frame_ts is None:
+            names = sorted(self._file["ori_images"]) if "ori_images" in self._file else []
+            self._frame_names = names
+            self._frame_ts = np.asarray(
+                [self._file[f"ori_images/{n}"].attrs["timestamp"] for n in names],
+                np.float64,
+            )
+
+    @property
+    def frame_ts(self) -> np.ndarray:
+        self._load_frames()
+        return self._frame_ts
+
+    def frame(self, index: int) -> np.ndarray:
+        self._load_frames()
+        return self._file[f"ori_images/{self._frame_names[index]}"][:]
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class MemoryRecording(Recording):
+    """In-memory recording (tests, synthetic benchmarks — no HDF5 round trip)."""
+
+    def __init__(
+        self,
+        sensor_resolution: Sequence[int],
+        streams: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+        frames: Optional[Sequence[np.ndarray]] = None,
+        frame_ts: Optional[Sequence[float]] = None,
+    ):
+        self.sensor_resolution = tuple(int(i) for i in sensor_resolution)
+        self._streams = {
+            k: EventStream(xs, ys, ts, ps) for k, (xs, ys, ts, ps) in streams.items()
+        }
+        self._frames = list(frames) if frames is not None else []
+        self._frame_ts = np.asarray(frame_ts if frame_ts is not None else [], np.float64)
+
+    def stream(self, prefix: str) -> EventStream:
+        return self._streams[prefix]
+
+    @property
+    def frame_ts(self) -> np.ndarray:
+        return self._frame_ts
+
+    def frame(self, index: int) -> np.ndarray:
+        return self._frames[index]
+
+
+def open_recording(path_or_recording) -> Recording:
+    if isinstance(path_or_recording, Recording):
+        return path_or_recording
+    if isinstance(path_or_recording, (str, os.PathLike)):
+        return H5Recording(os.fspath(path_or_recording))
+    raise TypeError(f"cannot open recording from {type(path_or_recording)!r}")

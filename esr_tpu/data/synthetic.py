@@ -1,0 +1,108 @@
+"""Synthetic recordings in the reference HDF5 layout — tests + benchmarks.
+
+Generates a correlated multi-resolution event "scene": a set of moving
+point sources emit events; each ladder rung (``ori, down2, …``) sees the same
+events quantized to its grid, with the event count scaled by the area ratio
+(the reference datasets are built this way offline by ESIM simulation at each
+resolution, ``/root/reference/generate_dataset/syn_nfs_rgb.py:80-127``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from esr_tpu.data.records import _LADDER as _LADDER_FACTORS
+from esr_tpu.data.records import MemoryRecording
+
+
+def synthesize_streams(
+    sensor_resolution: Tuple[int, int],
+    base_events: int,
+    duration: float = 1.0,
+    rungs: Sequence[str] = ("ori", "down2", "down4", "down8", "down16"),
+    num_sources: int = 6,
+    rng: Optional[np.random.Generator] = None,
+) -> Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Event streams per rung; ``base_events`` events at the coarsest rung,
+    scaled by factor² at finer rungs so scale²·N GT windowing holds."""
+    rng = rng or np.random.default_rng(0)
+    H, W = sensor_resolution
+    fmax = max(_LADDER_FACTORS[r] for r in rungs)
+
+    # shared latent trajectory: sources moving with constant velocity
+    src_xy = rng.random((num_sources, 2))
+    src_v = rng.normal(0, 0.3, (num_sources, 2))
+
+    streams = {}
+    for rung in rungs:
+        f = _LADDER_FACTORS[rung]
+        h, w = round(H / f), round(W / f)
+        n = int(base_events * (fmax / f) ** 2)
+        ts = np.sort(rng.random(n)) * duration
+        which = rng.integers(0, num_sources, n)
+        pos = src_xy[which] + src_v[which] * (ts / duration)[:, None]
+        pos += rng.normal(0, 0.02, (n, 2))  # sensor jitter
+        pos %= 1.0
+        xs = np.floor(pos[:, 0] * w).astype(np.int32).clip(0, w - 1)
+        ys = np.floor(pos[:, 1] * h).astype(np.int32).clip(0, h - 1)
+        ps = rng.choice(np.array([-1, 1], np.int8), n)
+        streams[rung] = (xs, ys, ts, ps)
+    return streams
+
+
+def make_synthetic_recording(
+    sensor_resolution: Tuple[int, int] = (64, 64),
+    base_events: int = 4096,
+    num_frames: int = 8,
+    duration: float = 1.0,
+    rungs: Sequence[str] = ("ori", "down2", "down4", "down8", "down16"),
+    seed: int = 0,
+) -> MemoryRecording:
+    rng = np.random.default_rng(seed)
+    streams = synthesize_streams(
+        sensor_resolution, base_events, duration, rungs, rng=rng
+    )
+    H, W = sensor_resolution
+    frames = [
+        (rng.random((H, W)) * 255).astype(np.uint8) for _ in range(num_frames)
+    ]
+    frame_ts = np.linspace(0, duration, num_frames)
+    return MemoryRecording(sensor_resolution, streams, frames, frame_ts)
+
+
+def write_synthetic_h5(
+    path: str,
+    sensor_resolution: Tuple[int, int] = (64, 64),
+    base_events: int = 4096,
+    num_frames: int = 8,
+    duration: float = 1.0,
+    rungs: Sequence[str] = ("ori", "down2", "down4", "down8", "down16"),
+    seed: int = 0,
+) -> str:
+    """Write a recording in the reference layout
+    (``generate_dataset/tools/event_packagers.py:119+``): per-rung
+    ``{prefix}_events/{xs,ys,ts,ps}`` groups, ``ori_images/image%09d`` frames
+    with ``timestamp`` attrs, ``sensor_resolution`` file attr."""
+    import h5py
+
+    rng = np.random.default_rng(seed)
+    streams = synthesize_streams(
+        sensor_resolution, base_events, duration, rungs, rng=rng
+    )
+    H, W = sensor_resolution
+    with h5py.File(path, "w") as f:
+        f.attrs["sensor_resolution"] = np.asarray(sensor_resolution, np.int32)
+        for rung, (xs, ys, ts, ps) in streams.items():
+            g = f.create_group(f"{rung}_events")
+            g.create_dataset("xs", data=xs)
+            g.create_dataset("ys", data=ys)
+            g.create_dataset("ts", data=ts)
+            g.create_dataset("ps", data=ps)
+        frame_ts = np.linspace(0, duration, num_frames)
+        for i in range(num_frames):
+            img = (rng.random((H, W)) * 255).astype(np.uint8)
+            d = f.create_dataset(f"ori_images/image{i:09d}", data=img)
+            d.attrs["timestamp"] = frame_ts[i]
+    return path

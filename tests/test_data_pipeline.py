@@ -56,3 +56,255 @@ def test_interpolate_np_matches_device_resize():
         host = NE.interpolate_np(img, (18, 24), mode)
         dev = np.asarray(interpolate(jnp.asarray(img), (18, 24), mode))
         np.testing.assert_allclose(host, dev, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Dataset / loader layer (records, windowing, sequences, sharding, collate)
+# ---------------------------------------------------------------------------
+
+from esr_tpu.data import (
+    ConcatSequenceDataset,
+    EventWindowDataset,
+    H5Recording,
+    SequenceDataset,
+    SequenceLoader,
+    ShardedSampler,
+    collate_sequences,
+    make_synthetic_recording,
+    overlapping_windows,
+    resolve_scale_ladder,
+    write_synthetic_h5,
+)
+
+BASE_CFG = {
+    "scale": 2,
+    "ori_scale": "down4",
+    "time_bins": 1,
+    "mode": "events",
+    "window": 128,
+    "sliding_window": 64,
+    "need_gt_events": True,
+    "need_gt_frame": True,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "step_size": 2,
+        "seqn": 3,
+        "pause": {
+            "enabled": False,
+            "proba_pause_when_running": 0.0,
+            "proba_pause_when_paused": 0.0,
+        },
+    },
+}
+
+
+def test_resolve_scale_ladder_matches_reference_table():
+    """The arithmetic ladder reproduces the reference if-chain
+    (/root/reference/dataloader/h5dataset.py:31-145)."""
+    sr = (128, 256)
+    # no GT events: gt = inp * scale, same prefix
+    lad = resolve_scale_ladder(sr, 2, "down4", need_gt_events=False)
+    assert lad.inp_resolution == (32, 64)
+    assert lad.gt_resolution == (64, 128)
+    assert lad.inp_prefix == lad.gt_prefix == "down4"
+    # GT events: climb the ladder
+    for ori, scale, gt_prefix, gt_res in [
+        ("down2", 2, "ori", (128, 256)),
+        ("down4", 2, "down2", (64, 128)),
+        ("down4", 4, "ori", (128, 256)),
+        ("down8", 2, "down4", (32, 64)),
+        ("down16", 4, "down4", (32, 64)),
+        ("down16", 16, "ori", (128, 256)),
+        ("ori", 1, "ori", (128, 256)),
+    ]:
+        lad = resolve_scale_ladder(sr, scale, ori, need_gt_events=True)
+        assert lad.gt_prefix == gt_prefix, (ori, scale)
+        assert lad.gt_resolution == gt_res, (ori, scale)
+    with pytest.raises(ValueError):
+        resolve_scale_ladder(sr, 4, "down2", need_gt_events=True)
+
+
+def test_event_window_dataset_item_schema():
+    rec = make_synthetic_recording((64, 64), base_events=2048, seed=1)
+    ds = EventWindowDataset(rec, BASE_CFG)
+    assert len(ds) > 0
+    item = ds.get_item(0, seed=7)
+    h, w = ds.inp_resolution
+    kh, kw = ds.gt_resolution
+    assert (h, w) == (16, 16) and (kh, kw) == (32, 32)
+    assert item["inp_cnt"].shape == (h, w, 2)
+    assert item["inp_stack"].shape == (h, w, 1)
+    assert item["inp_scaled_cnt"].shape == (kh, kw, 2)
+    assert item["gt_cnt"].shape == (kh, kw, 2)
+    assert item["gt_img"].shape == (kh, kw, 1)
+    assert item["inp_down_cnt"].shape == (8, 8, 2)
+    assert item["inp_down_scaled_cnt"].shape == (h, w, 2)
+    # count conservation: window events all land in-bounds on the inp grid
+    assert item["inp_cnt"].sum() == BASE_CFG["window"]
+    # scaled cnt re-scatters the same events onto the HR grid
+    assert item["inp_scaled_cnt"].sum() == BASE_CFG["window"]
+    # determinism given a seed
+    item2 = ds.get_item(0, seed=7)
+    np.testing.assert_array_equal(item["inp_cnt"], item2["inp_cnt"])
+
+
+def test_gt_window_is_scale_squared_events():
+    rec = make_synthetic_recording((64, 64), base_events=2048, seed=2)
+    ds = EventWindowDataset(rec, BASE_CFG)
+    item = ds.get_item(1, seed=3)
+    # GT window = scale² * window events (h5dataset.py:451-475)
+    assert item["gt_cnt"].sum() == BASE_CFG["scale"] ** 2 * BASE_CFG["window"]
+
+
+def test_augmentation_flips_are_seed_consistent():
+    cfg = dict(BASE_CFG)
+    cfg["data_augment"] = {
+        "enabled": True,
+        "augment": ["Horizontal", "Vertical", "Polarity"],
+        "augment_prob": [1.0, 1.0, 1.0],
+    }
+    rec = make_synthetic_recording((64, 64), base_events=2048, seed=3)
+    plain = EventWindowDataset(rec, BASE_CFG).get_item(0, seed=11)
+    aug = EventWindowDataset(rec, cfg).get_item(0, seed=11)
+    # H+V flip with polarity swap: cnt channels swapped and double-flipped
+    np.testing.assert_allclose(
+        aug["inp_cnt"], plain["inp_cnt"][::-1, ::-1, ::-1], atol=0
+    )
+
+
+def test_pause_yields_zero_events():
+    rec = make_synthetic_recording((64, 64), base_events=2048, seed=4)
+    ds = EventWindowDataset(rec, BASE_CFG)
+    item = ds.get_item(0, pause=True, seed=5)
+    assert item["inp_cnt"].sum() == 0
+    assert item["inp_scaled_cnt"].sum() == 0
+    # GT side unaffected by an input pause
+    assert item["gt_cnt"].sum() > 0
+
+
+def test_sequence_dataset_lengths_and_pause():
+    rec = make_synthetic_recording((64, 64), base_events=2048, seed=5)
+    ds = SequenceDataset(rec, BASE_CFG)
+    n_windows = len(ds.dataset)
+    L, step = 4, 2
+    assert len(ds) == (n_windows - L) // step + 1
+    seq = ds.get_item(0, seed=9)
+    assert len(seq) == L
+    # pause enabled: always paused after first window
+    cfg = dict(BASE_CFG)
+    cfg["sequence"] = dict(BASE_CFG["sequence"])
+    cfg["sequence"]["pause"] = {
+        "enabled": True,
+        "proba_pause_when_running": 1.0,
+        "proba_pause_when_paused": 1.0,
+    }
+    seq_p = SequenceDataset(rec, cfg).get_item(0, seed=9)
+    assert seq_p[0]["inp_cnt"].sum() > 0
+    for it in seq_p[1:]:
+        assert it["inp_cnt"].sum() == 0
+
+
+def test_sharded_sampler_partitions_and_pads():
+    n, bs = 103, 4
+    shards = [
+        list(ShardedSampler(n, bs, shard_id=s, num_shards=3, shuffle=True, seed=1))
+        for s in range(3)
+    ]
+    # same number of batches per shard
+    assert len({len(s) for s in shards}) == 1
+    seen = np.concatenate([np.concatenate(s) for s in shards])
+    # covers every index at least once (padding wraps)
+    assert set(seen.tolist()) == set(range(n))
+    # deterministic given (seed, epoch)
+    again = list(ShardedSampler(n, bs, 0, 3, True, seed=1))
+    np.testing.assert_array_equal(np.concatenate(shards[0]), np.concatenate(again))
+    # different epoch reshuffles
+    s2 = ShardedSampler(n, bs, 0, 3, True, seed=1)
+    s2.set_epoch(1)
+    assert not np.array_equal(np.concatenate(shards[0]), np.concatenate(list(s2)))
+
+
+def test_loader_collates_and_windows(tmp_path):
+    path = write_synthetic_h5(
+        str(tmp_path / "rec.h5"), (64, 64), base_events=2048, seed=6
+    )
+    ds = ConcatSequenceDataset([path, path], BASE_CFG)
+    loader = SequenceLoader(ds, batch_size=2, shuffle=True, seed=0, prefetch=2)
+    batch = next(iter(loader))
+    L = BASE_CFG["sequence"]["sequence_length"]
+    assert batch["inp_scaled_cnt"].shape == (2, L, 32, 32, 2)
+    assert batch["gt_cnt"].shape == (2, L, 32, 32, 2)
+    wins = overlapping_windows(batch, seqn=3)
+    assert len(wins) == L - 3 + 1
+    assert wins[0]["inp_cnt"].shape == (2, 3, 16, 16, 2)
+    np.testing.assert_array_equal(
+        wins[1]["inp_cnt"][:, 0], batch["inp_cnt"][:, 1]
+    )
+
+
+def test_h5_recording_roundtrip(tmp_path):
+    path = write_synthetic_h5(
+        str(tmp_path / "rt.h5"), (32, 32), base_events=512, num_frames=4, seed=7
+    )
+    rec = H5Recording(path)
+    assert rec.sensor_resolution == (32, 32)
+    s = rec.stream("down4")
+    ev = s.window(0, 16)
+    assert ev.shape == (4, 16)
+    assert (np.diff(s.ts) >= 0).all()
+    assert rec.num_frames == 4
+    assert rec.frame(0).shape == (32, 32)
+    rec.close()
+
+
+def test_loader_feeds_train_step(tmp_path):
+    """End-to-end: synthetic h5 → loader → jit'd scanned BPTT train step."""
+    import jax
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.training.optim import make_optimizer
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    path = write_synthetic_h5(
+        str(tmp_path / "e2e.h5"), (64, 64), base_events=2048, seed=8
+    )
+    loader = SequenceLoader(
+        ConcatSequenceDataset([path], BASE_CFG), batch_size=2, shuffle=False, prefetch=0
+    )
+    batch = next(iter(loader))
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    inp = jnp.asarray(batch["inp_scaled_cnt"])
+    gt = jnp.asarray(batch["gt_cnt"])
+    states = model.init_states(inp.shape[0], inp.shape[2], inp.shape[3])
+    params = model.init(jax.random.PRNGKey(0), inp[:, :3], states)
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    step = jax.jit(make_train_step(model, opt, seqn=3))
+    state = TrainState.create(params, opt)
+    state, metrics = step(state, {"inp": inp, "gt": gt})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_sharded_sampler_tiny_dataset():
+    """Fewer items than one global chunk: wrap-padding still yields full batches."""
+    shards = [list(ShardedSampler(3, 4, s, 2, shuffle=False)) for s in range(2)]
+    assert len(shards[0]) == len(shards[1]) == 1
+    seen = np.concatenate([np.concatenate(s) for s in shards])
+    assert set(seen.tolist()) == {0, 1, 2}
+
+
+def test_prefetch_propagates_worker_errors(tmp_path):
+    path = write_synthetic_h5(str(tmp_path / "x.h5"), (64, 64), base_events=2048)
+    ds = ConcatSequenceDataset([path], BASE_CFG)
+    loader = SequenceLoader(ds, batch_size=1, prefetch=2)
+    loader._build = lambda idx: (_ for _ in ()).throw(RuntimeError("corrupt file"))
+    with pytest.raises(RuntimeError, match="corrupt file"):
+        next(iter(loader))
+
+
+def test_concat_rejects_ragged_sequence_lengths():
+    long_rec = make_synthetic_recording((64, 64), base_events=4096, seed=1)
+    # base_events is at the coarsest rung; down4 sees 16x that, so 12 base
+    # events -> 192 window-rung events -> 3 windows < sequence_length=4
+    short_rec = make_synthetic_recording((64, 64), base_events=12, seed=2)
+    with pytest.raises(ValueError, match="sequence length"):
+        ConcatSequenceDataset([long_rec, short_rec], BASE_CFG)
