@@ -1,0 +1,25 @@
+"""Config system: YAML parsing, CLI overrides, run dirs, component builders."""
+
+from esr_tpu.config.parser import (
+    RunConfig,
+    apply_overrides,
+    load_config,
+    set_by_path,
+)
+from esr_tpu.config.build import (
+    build_lr_schedule,
+    build_model,
+    build_optimizer,
+    build_train_loader,
+)
+
+__all__ = [
+    "RunConfig",
+    "apply_overrides",
+    "load_config",
+    "set_by_path",
+    "build_lr_schedule",
+    "build_model",
+    "build_optimizer",
+    "build_train_loader",
+]

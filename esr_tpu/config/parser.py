@@ -1,0 +1,129 @@
+"""YAML config parsing, semicolon key-path CLI overrides, run directories.
+
+Rebuilds the reference's ``YAMLParser`` (``config/parser.py:14-128``):
+
+- YAML (with anchors) loaded via ``yaml.safe_load``;
+- CLI overrides addressed by semicolon key paths
+  (``trainer;iteration_based_train;iterations``), reference ``:103-107``;
+- run dirs ``<output>/models/<experiment>/<runid>`` and
+  ``<output>/logs/<experiment>/<runid>`` with the *effective* config dumped to
+  the model dir (``:22-36``); run id defaults to a timestamp (``:26-27``);
+- logging configured into the log dir.
+
+Component instantiation lives in :mod:`esr_tpu.config.build` — an explicit
+registry, never ``eval`` (the reference instantiates via
+``eval(config['model']['name'])``, ``train_ours_cnt_seq.py:762``; SURVEY.md §5
+calls for a registry instead).
+"""
+
+from __future__ import annotations
+
+import os
+from datetime import datetime
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+from esr_tpu.utils.logging import setup_logging
+
+
+def load_config(path: str) -> Dict:
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def dump_config(config: Dict, path: str) -> None:
+    with open(path, "w") as f:
+        yaml.safe_dump(config, f, sort_keys=False)
+
+
+def set_by_path(tree: Dict, keypath: str, value) -> None:
+    """``set_by_path(cfg, 'a;b;c', v)`` → ``cfg['a']['b']['c'] = v``
+    (reference ``config/parser.py:103-107``). Intermediate dicts are created
+    when absent so overrides can introduce optional blocks."""
+    keys = keypath.split(";")
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = _parse_scalar(value)
+
+
+def _parse_scalar(value):
+    """CLI strings → YAML scalars ('1e-3' → float, 'true' → bool, ...).
+
+    YAML 1.1 only floats exponents written with a dot ('1.0e-3'); fall back to
+    python float parsing so bare '1e-3' works from the CLI.
+    """
+    if not isinstance(value, str):
+        return value
+    parsed = yaml.safe_load(value)
+    if isinstance(parsed, str):
+        try:
+            return float(parsed)
+        except ValueError:
+            return parsed
+    return parsed
+
+
+def apply_overrides(config: Dict, overrides: Sequence[str]) -> Dict:
+    """Apply ``key;path=value`` strings in order (later wins)."""
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} is not of the form key;path=value")
+        keypath, value = ov.split("=", 1)
+        set_by_path(config, keypath, value)
+    return config
+
+
+class RunConfig:
+    """Effective config + run directories + logging for one training run.
+
+    dict-style item access proxies the config (reference ``parser.py:82-84``).
+    """
+
+    def __init__(
+        self,
+        config: Dict,
+        runid: Optional[str] = None,
+        resume: Optional[str] = None,
+        reset: bool = False,
+        seed: int = 123,
+        make_dirs: bool = True,
+        is_main: bool = True,
+    ):
+        self.config = config
+        self.resume = resume
+        self.reset = reset
+        self.seed = seed
+        self.runid = runid or datetime.now().strftime(r"%m%d_%H%M%S")
+
+        out = config["trainer"]["output_path"]
+        exp = config["experiment"]
+        self.save_dir = os.path.join(out, "models", exp, self.runid)
+        self.log_dir = os.path.join(out, "logs", exp, self.runid)
+        if make_dirs:
+            os.makedirs(self.save_dir, exist_ok=True)
+            os.makedirs(self.log_dir, exist_ok=True)
+            dump_config(config, os.path.join(self.save_dir, "config.yml"))
+            setup_logging(self.log_dir, is_main=is_main)
+
+    @classmethod
+    def from_args(
+        cls,
+        config_path: str,
+        overrides: Sequence[str] = (),
+        runid: Optional[str] = None,
+        resume: Optional[str] = None,
+        reset: bool = False,
+        seed: int = 123,
+        make_dirs: bool = True,
+        is_main: bool = True,
+    ) -> "RunConfig":
+        config = apply_overrides(load_config(config_path), overrides)
+        return cls(config, runid, resume, reset, seed, make_dirs, is_main)
+
+    def __getitem__(self, name: str):
+        return self.config[name]
+
+    def get(self, name: str, default=None):
+        return self.config.get(name, default)

@@ -48,6 +48,56 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host glue: ``jax.distributed.initialize``.
+
+    The reference's NCCL rendezvous reads ``RANK``/``WORLD_SIZE``/``MASTER_*``
+    env vars (``train_ours_cnt_seq.py:64-85``); JAX reads the same class of
+    launcher-provided env (or TPU metadata) inside ``initialize`` — call with
+    no args on TPU pods / SLURM, or pass the triple explicitly. No-op when
+    already initialized or when running single-process with no launcher env.
+    """
+    import jax.distributed
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # already initialized — keep going (idempotent launcher semantics)
+        if "already initialized" not in str(e).lower():
+            raise
+
+
+def process_shard_info() -> tuple:
+    """``(shard_id, num_shards)`` for the per-host data loader — the
+    ``jax.process_index()`` replacement for torch's rank/world_size."""
+    return jax.process_index(), jax.process_count()
+
+
+def stage_batch(batch: Any, mesh: Mesh, axis_name: str = "data") -> Any:
+    """Host-local numpy batch → global device array sharded over ``axis_name``.
+
+    Single-process: a plain sharded ``device_put``. Multi-process: each host
+    contributes its local shard of the global batch via
+    ``jax.make_array_from_process_local_data`` (the per-host loader feeds
+    ``global_batch / num_hosts`` rows; together they form the global array).
+    """
+    sharding = NamedSharding(mesh, P(axis_name))
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
 def make_parallel_train_step(
     train_step, mesh: Mesh, axis_name: str = "data", donate: bool = True
 ):

@@ -1,0 +1,197 @@
+"""Checkpoint save/restore: Orbax pytrees + embedded config.
+
+Rebuilds the reference's checkpointing (``train_ours_cnt_seq.py:635-725``,
+``myutils/utils.py:140-177``) per SURVEY.md §5 ("Orbax checkpointing with the
+same embedded-config convention"):
+
+- a checkpoint is a directory ``checkpoint-iteration{N}/`` holding the
+  ``state/`` pytree (params + optimizer state + step) and ``meta.yml`` with
+  the FULL effective config plus trainer progress
+  ``{training_mode, iteration, monitor_best}`` — self-describing, so
+  inference rebuilds the model from the checkpoint alone
+  (reference ``infer_ours_cnt.py:123-127``);
+- new-best saves ``model_best_until_iteration{N}/`` (reference ``:682-685``);
+- resume is name-checked per component (model/optimizer names recorded in
+  ``meta.yml`` must match the live config, reference ``Resumer``,
+  ``myutils/utils.py:147-171``): a model-name mismatch skips the whole
+  restore; an optimizer-name mismatch restores params but re-initializes
+  optimizer state;
+- ``--reset`` restores weights but zeroes trainer progress
+  (reference ``:697-722``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import yaml
+
+from esr_tpu.training.train_step import TrainState
+
+logger = logging.getLogger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    state: TrainState,
+    config: Dict,
+    iteration: int,
+    monitor_best: float,
+    training_mode: str = "iteration_based_train",
+    save_best: bool = False,
+) -> str:
+    """Write ``checkpoint-iteration{N}`` (and the best-alias when asked)."""
+    meta = {
+        "model": {"name": config["model"]["name"]},
+        "optimizer": {"name": config["optimizer"]["name"]},
+        "lr_scheduler": {
+            "name": (config.get("lr_scheduler") or {}).get("name")
+        },
+        "config": config,
+        "trainer": {
+            "training_mode": training_mode,
+            "iteration": int(iteration),
+            "monitor_best": float(monitor_best),
+        },
+    }
+    ckptr = _checkpointer()
+    names = [f"checkpoint-iteration{iteration}"]
+    if save_best:
+        names.append(f"model_best_until_iteration{iteration}")
+    path = ""
+    for name in names:
+        path = os.path.join(os.path.abspath(ckpt_dir), name)
+        ckptr.save(os.path.join(path, "state"), _to_host(state))
+        with open(os.path.join(path, "meta.yml"), "w") as f:
+            yaml.safe_dump(meta, f, sort_keys=False)
+        logger.info("Saved checkpoint: %s", path)
+    ckptr.wait_until_finished()
+    return path
+
+
+def _to_host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def read_meta(path: str) -> Dict:
+    with open(os.path.join(path, "meta.yml")) as f:
+        return yaml.safe_load(f)
+
+
+def restore_state(path: str, template: TrainState) -> TrainState:
+    """Restore the raw state pytree into ``template``'s structure."""
+    ckptr = _checkpointer()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        _to_host(template),
+    )
+    restored = ckptr.restore(os.path.join(os.path.abspath(path), "state"), abstract)
+    return jax.tree.map(lambda t, r: np.asarray(r), template, restored)
+
+
+def resume_checkpoint(
+    path: str,
+    state: TrainState,
+    config: Dict,
+    reset: bool = False,
+    training_mode: str = "iteration_based_train",
+) -> Tuple[TrainState, int, float]:
+    """Name-checked resume. Returns ``(state, start_iteration, monitor_best)``.
+
+    Mirrors the reference's semantics: same training mode and no ``--reset``
+    → trainer progress restored (``start = iteration + 1``); otherwise weights
+    only (``train_ours_cnt_seq.py:697-722``).
+    """
+    meta = read_meta(path)
+
+    if meta["model"]["name"] != config["model"]["name"]:
+        logger.warning(
+            "Checkpoint model %r != configured %r — not resuming.",
+            meta["model"]["name"],
+            config["model"]["name"],
+        )
+        return state, 0, float("inf")
+
+    restored = restore_state(path, state)
+
+    if meta["optimizer"]["name"] != config["optimizer"]["name"]:
+        logger.warning(
+            "Checkpoint optimizer %r != configured %r — restoring params only.",
+            meta["optimizer"]["name"],
+            config["optimizer"]["name"],
+        )
+        restored = TrainState(
+            params=restored.params, opt_state=state.opt_state, step=state.step
+        )
+
+    trainer_meta = meta.get("trainer", {})
+    same_mode = trainer_meta.get("training_mode") == training_mode
+    if reset or not same_mode:
+        logger.info("Checkpoint loaded; trainer progress reset.")
+        restored = TrainState(
+            params=restored.params,
+            opt_state=restored.opt_state,
+            step=np.zeros((), np.int32),
+        )
+        return restored, 0, float("inf")
+
+    start = int(trainer_meta.get("iteration", 0)) + 1
+    best = float(trainer_meta.get("monitor_best", float("inf")))
+    logger.info(
+        "Checkpoint loaded; resuming from iteration %d (best=%g).", start, best
+    )
+    return restored, start, best
+
+
+def load_for_inference(path: str) -> Tuple[Any, Any, Dict]:
+    """Rebuild ``(model, params, config)`` from a checkpoint directory alone.
+
+    The reference equivalent builds the model from the config embedded in the
+    ``.pth`` (``infer_ours_cnt.py:118-132``). Only ``params`` is materialized;
+    the optimizer state in the checkpoint is ignored.
+    """
+    import jax.numpy as jnp
+
+    from esr_tpu.config.build import build_model, build_optimizer
+
+    meta = read_meta(path)
+    config = meta["config"]
+    model = build_model(config["model"])
+
+    # Shape-only init to learn the full state structure (conv params are
+    # independent of spatial size; any /8-friendly dummy works). The optimizer
+    # is rebuilt from the embedded config purely to shape its state slot.
+    n = config["model"].get("args", {}).get("num_frame", 3)
+    inch = config["model"].get("args", {}).get("inch", 2)
+    x = jnp.zeros((1, n, 16, 16, inch), jnp.float32)
+    states = model.init_states(1, 16, 16)
+    it_cfg = config.get("trainer", {}).get("iteration_based_train", {})
+    optimizer, _ = build_optimizer(
+        config["optimizer"],
+        config.get("lr_scheduler"),
+        it_cfg.get("lr_change_rate"),
+    )
+
+    def shape_state():
+        params = model.init(jax.random.PRNGKey(0), x, states)
+        return TrainState.create(params, optimizer)
+
+    template = jax.eval_shape(shape_state)
+    abstract = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), template
+    )
+    ckptr = _checkpointer()
+    restored = ckptr.restore(
+        os.path.join(os.path.abspath(path), "state"), abstract
+    )
+    return model, restored.params, config

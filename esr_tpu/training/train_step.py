@@ -87,16 +87,16 @@ def make_train_step(
         def body(states, xs):
             window, gtw = xs
             pred, states = apply_fn(params, window, states)
-            return states, ((pred - gtw) ** 2).mean()
+            return states, (((pred - gtw) ** 2).mean(), pred)
 
-        _, losses = jax.lax.scan(body, states0, (windows, gt_mid))
+        _, (losses, preds) = jax.lax.scan(body, states0, (windows, gt_mid))
         # reference accumulates the SUM of per-window MSEs before backward
-        return losses.sum(), losses
+        return losses.sum(), (losses, preds[-1])
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
-        (loss, losses), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
-        )
+        (loss, (losses, last_pred)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -106,6 +106,7 @@ def make_train_step(
             "loss": loss,
             "loss_per_window": losses,
             "grad_norm": optax.global_norm(grads),
+            "last_pred": last_pred,
         }
         return new_state, metrics
 
@@ -132,6 +133,9 @@ def make_eval_step(model, seqn: int = 3) -> Callable:
             return states, ((pred - gtw) ** 2).mean()
 
         _, losses = jax.lax.scan(body, states0, (windows, gt_mid))
-        return {"valid_loss": losses.sum()}
+        # valid_loss = window-summed MSE, valid_mse_loss = last window's MSE —
+        # the reference logs both (train_ours_cnt_seq.py:571-589: `loss`
+        # accumulates, `mse_loss` holds the loop's final value).
+        return {"valid_loss": losses.sum(), "valid_mse_loss": losses[-1]}
 
     return eval_step

@@ -1,0 +1,364 @@
+"""The training driver: iteration-based loop, validation, early stop, ckpts.
+
+Rebuilds the reference ``Trainer`` (``train_ours_cnt_seq.py:88-341``) around
+the jit'd BPTT step, the TPU way:
+
+- ONE compiled SPMD step per sequence (scan over windows, grads all-reduced
+  over the mesh by XLA) replaces the python BPTT loop + DDP backward
+  (``:206-235``); per-host loaders feed the global batch
+  (``stage_batch``, the ``DistributedSampler`` analogue);
+- validation every ``valid_step`` iterations (``:296-314``) via the jit'd
+  eval step; metrics from inside jit are already globally reduced, so the
+  reference's explicit logging all-reduce (``reduce_tensor``) has no
+  equivalent;
+- ``min valid_loss`` monitoring with early stop
+  (``eval_model_performance``, ``:383-424``);
+- checkpoint every ``save_period`` and on new-best, main-process only
+  (``:316-319``), resume honored in ``__init__`` (``:172-173``);
+- the LR gate lives inside the optimizer's schedule
+  (``exponential_with_floor``) rather than an imperative
+  ``scheduler.step()`` (``:322-325``) — same trajectory;
+- epoch-based mode is deliberately NOT ported: in the reference it is legacy
+  and broken (uses MinkowskiEngine with the import commented out,
+  SURVEY.md §2.1 Trainer row); configs enabling it get a clear error.
+
+Seeding policy (reference ``init_seeds`` ``:30-46``): one base seed; numpy is
+seeded ``seed + process_index`` per host, the loaders derive per-sequence
+generators from the base seed so augmentation is reproducible, and model init
+uses ``PRNGKey(seed)`` (identical across hosts — params must agree).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from esr_tpu.config.build import (
+    build_model,
+    build_optimizer,
+    build_train_loader,
+)
+from esr_tpu.config.parser import RunConfig
+from esr_tpu.parallel.mesh import (
+    make_mesh,
+    make_parallel_train_step,
+    process_shard_info,
+    replicate,
+    stage_batch,
+)
+from esr_tpu.training.checkpoint import resume_checkpoint, save_checkpoint
+from esr_tpu.training.train_step import (
+    TrainState,
+    make_eval_step,
+    make_train_step,
+)
+from esr_tpu.utils.trackers import MetricTracker
+from esr_tpu.utils.vis_events import render_event_cnt, render_frame
+from esr_tpu.utils.writer import MetricWriter
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+class Trainer:
+    def __init__(self, run: RunConfig, mesh=None):
+        self.run = run
+        config = run.config
+        trainer_cfg = config["trainer"]
+
+        if trainer_cfg.get("epoch_based_train", {}).get("enabled", False):
+            raise ValueError(
+                "epoch_based_train is not supported (legacy/broken in the "
+                "reference — SURVEY.md §2.1); use iteration_based_train"
+            )
+        it_cfg = trainer_cfg["iteration_based_train"]
+        if not it_cfg.get("enabled", True):
+            raise ValueError("iteration_based_train must be enabled")
+
+        self.iterations = int(float(it_cfg["iterations"]))
+        self.save_period = int(it_cfg.get("save_period", 10**9))
+        self.train_log_step = int(it_cfg.get("train_log_step", 50))
+        self.valid_step = int(it_cfg.get("valid_step", 1000))
+        lr_change_rate = it_cfg.get("lr_change_rate")
+
+        # seeding policy
+        self.shard_id, self.num_shards = process_shard_info()
+        self.is_main = self.shard_id == 0
+        np.random.seed(run.seed + self.shard_id)
+
+        # data
+        self.train_loader = build_train_loader(
+            config["train_dataloader"],
+            self.shard_id,
+            self.num_shards,
+            seed=run.seed,
+        )
+        self.valid_loader = None
+        if config.get("valid_dataloader") is not None:
+            self.valid_loader = build_train_loader(
+                config["valid_dataloader"],
+                self.shard_id,
+                self.num_shards,
+                seed=run.seed,
+            )
+
+        # model + optimizer
+        self.model = build_model(config["model"])
+        self.optimizer, self.schedule = build_optimizer(
+            config["optimizer"], config.get("lr_scheduler"), lr_change_rate
+        )
+        self.seqn = int(
+            config["train_dataloader"]["dataset"]["sequence"].get("seqn", 3)
+        )
+        self.mid_idx = (self.seqn - 1) // 2
+
+        # mesh + compiled steps
+        self.mesh = mesh if mesh is not None else make_mesh()
+        remat = bool(trainer_cfg.get("remat", False))
+        self.train_step = make_parallel_train_step(
+            make_train_step(self.model, self.optimizer, self.seqn, remat=remat),
+            self.mesh,
+        )
+        repl = NamedSharding(self.mesh, P())
+        data = NamedSharding(self.mesh, P("data"))
+        self.eval_step = jax.jit(
+            make_eval_step(self.model, self.seqn),
+            in_shardings=(repl, data),
+            out_shardings=repl,
+        )
+
+        # params init — identical on every host
+        kh, kw = self.train_loader.gt_resolution
+        b = int(config["train_dataloader"]["batch_size"])
+        dummy = np.zeros((1, self.seqn, kh, kw, self.model.inch), np.float32)
+        states = self.model.init_states(1, kh, kw)
+        params = self.model.init(jax.random.PRNGKey(run.seed), dummy, states)
+        state = TrainState.create(params, self.optimizer)
+
+        # monitor config (reference :149-157)
+        self.monitor = trainer_cfg.get("monitor", "off")
+        if self.monitor == "off":
+            self.mnt_mode, self.mnt_metric = "off", None
+            self.mnt_best = 0.0
+        else:
+            self.mnt_mode, self.mnt_metric = self.monitor.split()
+            assert self.mnt_mode in ("min", "max")
+            self.mnt_best = math.inf if self.mnt_mode == "min" else -math.inf
+        self.early_stop = int(float(trainer_cfg.get("early_stop", 10**9)))
+        self.not_improved_count = 0
+
+        # observability (main process only, reference :160-169)
+        self.writer = None
+        if self.is_main:
+            self.writer = MetricWriter(
+                run.log_dir,
+                logger,
+                enable_tensorboard=bool(trainer_cfg.get("tensorboard", True)),
+            )
+        self.train_metrics = MetricTracker(
+            ["train_mse_loss", "train_loss"], writer=self.writer
+        )
+        self.valid_metrics = MetricTracker(["valid_mse_loss", "valid_loss"])
+        vis_cfg = trainer_cfg.get("vis", {}) or {}
+        self.vis_enabled = bool(vis_cfg.get("enabled", False))
+        self.train_vis_step = int(vis_cfg.get("train_img_writer_num", 20))
+
+        self.profile_cfg = trainer_cfg.get("profile", {}) or {}
+        self.start_iteration = 0
+
+        # resume (reference :172-173, :687-725)
+        if run.resume is not None:
+            state, self.start_iteration, self.mnt_best = resume_checkpoint(
+                run.resume, state, config, reset=run.reset
+            )
+
+        self.state = replicate(state, self.mesh)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _stage(self, batch: Dict[str, np.ndarray]) -> Dict:
+        """Select the two streams the step consumes and shard them."""
+        return stage_batch(
+            {"inp": batch["inp_scaled_cnt"], "gt": batch["gt_cnt"]}, self.mesh
+        )
+
+    def _log_images(self, batch: Dict[str, np.ndarray], pred: np.ndarray) -> None:
+        """TensorBoard qualitative dump (reference :258-293)."""
+        mid = self.mid_idx
+        # first sequence, middle window of the L frames for the input views
+        self.writer.add_image(
+            "train_inp_events_cnt",
+            render_event_cnt(batch["inp_cnt"][0, mid]),
+        )
+        self.writer.add_image(
+            "train_inp_scaled_events_cnt",
+            render_event_cnt(batch["inp_scaled_cnt"][0, mid]),
+        )
+        self.writer.add_image(
+            "train_esr_events_cnt", render_event_cnt(np.round(pred))
+        )
+        self.writer.add_image(
+            "train_gt_events_cnt", render_event_cnt(batch["gt_cnt"][0, mid])
+        )
+        if "gt_img" in batch:
+            self.writer.add_image(
+                "train_gt_frame", render_frame(batch["gt_img"][0, mid])
+            )
+
+    def _valid(self, stamp: int) -> Dict[str, float]:
+        """Full pass over the validation loader (reference ``_valid``,
+        ``:541-633``). Metrics from jit are global; averaged over batches."""
+        assert self.valid_loader is not None
+        self.valid_metrics.reset()
+        for batch in self.valid_loader:
+            out = self.eval_step(self.state.params, self._stage(batch))
+            self.valid_metrics.update("valid_loss", float(out["valid_loss"]))
+            self.valid_metrics.update(
+                "valid_mse_loss", float(out["valid_mse_loss"])
+            )
+        result = self.valid_metrics.result()
+        if self.writer is not None:
+            for k, v in result.items():
+                self.writer.add_scalar(f"stamp_{k}", v, step=stamp)
+        return result
+
+    def eval_model_performance(self, log: Dict[str, float]):
+        """Early-stop / best bookkeeping (reference ``:383-424``)."""
+        best = False
+        stop_training = False
+        if self.mnt_mode != "off":
+            if self.mnt_metric not in log:
+                logger.warning(
+                    "Metric %r not found; ignoring this stamp.", self.mnt_metric
+                )
+            else:
+                value = log[self.mnt_metric]
+                improved = (
+                    value <= self.mnt_best
+                    if self.mnt_mode == "min"
+                    else value >= self.mnt_best
+                )
+                if improved:
+                    self.mnt_best = value
+                    self.not_improved_count = 0
+                    best = True
+                else:
+                    self.not_improved_count += 1
+            if self.not_improved_count > self.early_stop:
+                logger.info(
+                    "Validation did not improve for %d stamps; stopping.",
+                    self.early_stop,
+                )
+                stop_training = True
+        return stop_training, best
+
+    def _save(self, iteration: int, best: bool) -> None:
+        if not self.is_main:
+            return
+        save_checkpoint(
+            self.run.save_dir,
+            jax.device_get(self.state),
+            self.run.config,
+            iteration,
+            self.mnt_best,
+            save_best=best,
+        )
+
+    # -- the loop ----------------------------------------------------------
+
+    def train(self) -> Dict[str, float]:
+        """Run to ``iterations`` (or early stop). Returns final train log."""
+        epoch = 0
+        iter_idx = self.start_iteration
+        valid_stamp = 1
+        stop = False
+        profiling = False
+        self.train_metrics.reset()
+
+        prof = self.profile_cfg
+        if prof.get("enabled", False) and self.is_main:
+            jax.profiler.start_trace(
+                prof.get("trace_dir", self.run.log_dir + "/profile")
+            )
+            profiling = True
+
+        logger.info(
+            "Training: %d iterations, %d sequences/epoch/host, mesh=%s",
+            self.iterations,
+            len(self.train_loader),
+            tuple(self.mesh.shape.items()),
+        )
+
+        while not stop:
+            self.train_loader.set_epoch(epoch)
+            for batch in self.train_loader:
+                best = False
+                self.state, metrics = self.train_step(
+                    self.state, self._stage(batch)
+                )
+
+                loss = float(metrics["loss"])
+                mse_loss = float(metrics["loss_per_window"][-1])
+                if self.writer is not None:
+                    self.writer.set_step(iter_idx)
+                self.train_metrics.update("train_mse_loss", mse_loss)
+                self.train_metrics.update("train_loss", loss)
+                if self.writer is not None:
+                    self.writer.add_scalar(
+                        "learning_rate", float(self.schedule(iter_idx))
+                    )
+                    if iter_idx % self.train_log_step == 0:
+                        logger.info(
+                            "Train Epoch: %d Iteration: %d/%d "
+                            "train_mse_loss: %.4e train_loss: %.4e lr: %.4e",
+                            epoch + 1,
+                            iter_idx,
+                            self.iterations,
+                            mse_loss,
+                            loss,
+                            float(self.schedule(iter_idx)),
+                        )
+                    if self.vis_enabled and iter_idx % self.train_vis_step == 0:
+                        pred = np.asarray(
+                            jax.device_get(metrics["last_pred"])[0]
+                        )
+                        self._log_images(batch, pred)
+
+                if (
+                    self.valid_loader is not None
+                    and iter_idx % self.valid_step == 0
+                    and iter_idx != 0
+                ):
+                    val_log = self._valid(valid_stamp)
+                    logger.info(
+                        "Valid stamp %d: %s",
+                        valid_stamp,
+                        {k: round(v, 6) for k, v in val_log.items()},
+                    )
+                    stop, best = self.eval_model_performance(val_log)
+                    valid_stamp += 1
+                    if stop:
+                        break
+
+                if (
+                    iter_idx % self.save_period == 0 and iter_idx != 0
+                ) or best:
+                    self._save(iter_idx, best)
+
+                if iter_idx + 1 >= self.iterations:
+                    logger.info("Training completes!")
+                    stop = True
+                    break
+                iter_idx += 1
+            epoch += 1
+
+        if profiling:
+            jax.profiler.stop_trace()
+        if self.writer is not None:
+            self.writer.close()
+        return self.train_metrics.result()

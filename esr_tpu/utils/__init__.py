@@ -1,0 +1,20 @@
+"""Observability: metric tracking, timers, logging, writers.
+
+TPU-native rebuild of the reference's L0 layer (``myutils/utils.py``,
+``myutils/timers.py``, ``logger/``).
+"""
+
+from esr_tpu.utils.trackers import MetricTracker, YamlLogger
+from esr_tpu.utils.timers import Timer, timing_stats, print_timing_info
+from esr_tpu.utils.logging import setup_logging
+from esr_tpu.utils.writer import MetricWriter
+
+__all__ = [
+    "MetricTracker",
+    "YamlLogger",
+    "Timer",
+    "timing_stats",
+    "print_timing_info",
+    "setup_logging",
+    "MetricWriter",
+]

@@ -1,0 +1,68 @@
+"""Logging setup: console + rotating file handler.
+
+Rebuilds ``logger/logger.py:8-24`` + ``logger/logger_config.json`` without the
+JSON indirection: one call configures a console handler (message-only, like
+the reference console format) and a rotating ``info.txt`` in the log dir with
+timestamps.
+
+The reference silences non-rank-0 processes by monkey-patching
+``builtins.print`` (``train_ours_cnt_seq.py:49-61``); here
+:func:`setup_logging` takes ``is_main`` and raises the console level on
+non-main hosts instead — stdlib-only, no patching.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+from typing import Optional
+
+
+def setup_logging(
+    log_dir: Optional[str] = None,
+    level: int = logging.INFO,
+    is_main: bool = True,
+) -> logging.Logger:
+    """Configure the root logger; returns the ``esr_tpu`` logger.
+
+    Safe to call repeatedly (handlers are replaced, not duplicated).
+    """
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    # INFO at the root keeps third-party DEBUG spam (jax tracing internals)
+    # out of the file handler; our own loggers opt into DEBUG per-name.
+    root.setLevel(logging.INFO)
+
+    console = logging.StreamHandler()
+    console.setFormatter(logging.Formatter("%(message)s"))
+    console.setLevel(level if is_main else logging.WARNING)
+    root.addHandler(console)
+
+    if log_dir is not None:
+        os.makedirs(log_dir, exist_ok=True)
+        fileh = logging.handlers.RotatingFileHandler(
+            os.path.join(log_dir, "info.txt"),
+            maxBytes=10 * 1024 * 1024,
+            backupCount=5,
+        )
+        fileh.setFormatter(
+            logging.Formatter(
+                "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+            )
+        )
+        fileh.setLevel(logging.DEBUG if is_main else logging.WARNING)
+        root.addHandler(fileh)
+
+    return logging.getLogger("esr_tpu")
+
+
+def get_logger(name: str, verbosity: int = 2) -> logging.Logger:
+    """Named logger with the reference's verbosity mapping
+    (``config/parser.py:40-44,63-68``)."""
+    levels = {0: logging.WARNING, 1: logging.INFO, 2: logging.DEBUG}
+    assert verbosity in levels, f"verbosity {verbosity} not in {list(levels)}"
+    logger = logging.getLogger(name)
+    logger.setLevel(levels[verbosity])
+    return logger

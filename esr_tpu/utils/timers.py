@@ -1,0 +1,56 @@
+"""Wall-clock timers with a process-wide summary.
+
+Rebuilds ``myutils/timers.py:29-77``: ``Timer`` context managers append
+durations to a global registry; :func:`print_timing_info` reports means and is
+registered via ``atexit`` the first time a timer fires. The reference's
+``CudaTimer`` (cuda-event based) has no TPU analogue — device work is async
+under JAX, so callers time around ``jax.block_until_ready`` instead; the
+:class:`Timer` here is sufficient for both roles.
+"""
+
+from __future__ import annotations
+
+import atexit
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+timing_stats: Dict[str, List[float]] = defaultdict(list)
+_atexit_registered = False
+
+
+class Timer:
+    """``with Timer('name'): ...`` — seconds appended to ``timing_stats``.
+
+    Pass a ``logger`` to also log the single measurement at exit
+    (reference ``myutils/timers.py:43-63``).
+    """
+
+    def __init__(self, name: str, logger=None):
+        self.name = name
+        self.logger = logger
+
+    def __enter__(self) -> "Timer":
+        global _atexit_registered
+        if not _atexit_registered:
+            atexit.register(print_timing_info)
+            _atexit_registered = True
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.interval = time.perf_counter() - self._t0
+        timing_stats[self.name].append(self.interval)
+        if self.logger is not None:
+            self.logger.info(f"{self.name}: {self.interval:.4f} s")
+
+
+def print_timing_info(logger=None) -> None:
+    """Mean wall-clock per timer name (reference ``timers.py:66-77``)."""
+    emit = logger.info if logger is not None else print
+    if not timing_stats:
+        return
+    emit("== Timing statistics ==")
+    for name, samples in timing_stats.items():
+        mean = sum(samples) / len(samples)
+        emit(f"{name}: {mean:.4f} s ({len(samples)} samples)")

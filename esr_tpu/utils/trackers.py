@@ -1,0 +1,105 @@
+"""Running-average metric tracking + YAML result logging.
+
+Rebuilds the reference's ``MetricTracker`` (``myutils/utils.py:85-106``,
+pandas-backed) as a plain-dict accumulator, and ``Logger_yaml``
+(``myutils/utils.py:180-192``) with explicit ``close()``/context-manager
+semantics instead of the reference's fragile ``__del__``-time dump
+(SURVEY.md §7.3-7 lists the ``__del__``-based YAML logger as a quirk NOT to
+port).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, Optional
+
+
+class MetricTracker:
+    """Totals / counts / running averages per key.
+
+    ``writer`` (optional) receives ``add_scalar(key, value)`` on every update,
+    matching the reference's writer hook (``myutils/utils.py:95-97``).
+    Unknown keys are created on first update (the reference requires
+    pre-declared keys; auto-creation removes a foot-gun without changing any
+    observable averages).
+    """
+
+    def __init__(self, keys: Iterable[str] = (), writer=None):
+        self.writer = writer
+        self._total: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+        for k in keys:
+            self._total[k] = 0.0
+            self._count[k] = 0
+
+    def reset(self) -> None:
+        for k in self._total:
+            self._total[k] = 0.0
+            self._count[k] = 0
+
+    def update(self, key: str, value: float, n: int = 1) -> None:
+        if self.writer is not None:
+            self.writer.add_scalar(key, value)
+        self._total[key] = self._total.get(key, 0.0) + float(value) * n
+        self._count[key] = self._count.get(key, 0) + n
+
+    def avg(self, key: str) -> float:
+        c = self._count.get(key, 0)
+        return self._total.get(key, 0.0) / c if c else 0.0
+
+    def result(self) -> Dict[str, float]:
+        """{key: running average} — keys with no updates report 0.0, matching
+        the reference's zero-initialized dataframe."""
+        return {k: self.avg(k) for k in self._total}
+
+
+class YamlLogger:
+    """Structured YAML result file (inference reports, eval summaries).
+
+    API-compatible with the reference's ``Logger_yaml``: ``log_info`` appends
+    to an ``info`` list, ``log_dict`` stores a named mapping. The file is
+    written on ``close()`` (or context exit) — never from ``__del__``.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._info = defaultdict(list)
+        self._closed = False
+
+    def log_info(self, info: str) -> None:
+        self._info["info"].append(info)
+
+    def log_dict(self, payload: Dict, name: str) -> None:
+        self._info[name] = _plain(payload)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        import yaml
+
+        with open(self.path, "w") as f:
+            yaml.safe_dump(dict(self._info), f, sort_keys=False)
+        self._closed = True
+
+    def __enter__(self) -> "YamlLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _plain(obj):
+    """Recursively convert numpy/jax scalars and arrays to YAML-safe python."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_plain(v) for v in obj]
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return obj

@@ -1,0 +1,100 @@
+"""Metric writer: JSONL always, TensorBoard when available.
+
+Rebuilds the reference's ``TensorboardWriter`` facade
+(``logger/visualization.py:5-73``): step/mode tagging via :meth:`set_step`,
+``steps_per_sec`` emitted on every step advance, scalar + image logging.
+
+Two sinks:
+- **JSONL** (``metrics.jsonl`` in the log dir): one line per scalar —
+  machine-readable, zero dependencies, survives any environment;
+- **TensorBoard** via ``torch.utils.tensorboard`` when importable and
+  ``tensorboard=True`` (the torch CPU wheel is baked into this image).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class MetricWriter:
+    def __init__(
+        self,
+        log_dir: str,
+        logger=None,
+        enable_tensorboard: bool = True,
+    ):
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        self.step = 0
+        self.mode = ""
+        self._timer = time.perf_counter()
+        self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+
+        self.tb = None
+        if enable_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self.tb = SummaryWriter(log_dir)
+            except Exception as e:  # pragma: no cover - env-dependent
+                if logger is not None:
+                    logger.warning(
+                        "TensorBoard unavailable (%s); JSONL metrics only", e
+                    )
+
+    def set_step(self, step: int, mode: str = "train") -> None:
+        """Advance the global step; emits ``steps_per_sec`` like the reference
+        (``logger/visualization.py:43-49``)."""
+        self.mode = mode
+        if step == 0:
+            self._timer = time.perf_counter()
+        else:
+            now = time.perf_counter()
+            dt = now - self._timer
+            if dt > 0 and step > self.step:
+                self.add_scalar(
+                    "steps_per_sec", (step - self.step) / dt
+                )
+            self._timer = now
+        self.step = step
+
+    def _tag(self, key: str) -> str:
+        return f"{key}/{self.mode}" if self.mode else key
+
+    def add_scalar(self, key: str, value: float, step: Optional[int] = None) -> None:
+        step = self.step if step is None else step
+        self._jsonl.write(
+            json.dumps(
+                {"step": step, "tag": self._tag(key), "value": float(value)}
+            )
+            + "\n"
+        )
+        self._jsonl.flush()
+        if self.tb is not None:
+            self.tb.add_scalar(self._tag(key), float(value), global_step=step)
+
+    def add_image(self, key: str, image, step: Optional[int] = None) -> None:
+        """``image``: HWC or HW uint8/float numpy array. TensorBoard-only
+        (JSONL records that an image was logged, not the pixels)."""
+        step = self.step if step is None else step
+        self._jsonl.write(
+            json.dumps({"step": step, "tag": self._tag(key), "image": True})
+            + "\n"
+        )
+        if self.tb is not None:
+            fmt = "HWC" if getattr(image, "ndim", 2) == 3 else "HW"
+            self.tb.add_image(self._tag(key), image, global_step=step, dataformats=fmt)
+
+    def close(self) -> None:
+        self._jsonl.close()
+        if self.tb is not None:
+            self.tb.close()
+
+    def __enter__(self) -> "MetricWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,270 @@
+"""Integration: config system -> Trainer -> checkpoints -> resume -> infer-load.
+
+The VERDICT round-1 acceptance criteria:
+- a YAML config + datalist of synthetic HDF5 recordings trains for N
+  iterations on the virtual 8-device mesh and the loss decreases;
+- save -> restore round-trips bitwise (continued training stays identical);
+- inference rebuilds the model from the checkpoint alone.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from esr_tpu.config.build import build_optimizer
+from esr_tpu.config.parser import RunConfig, apply_overrides, load_config, set_by_path
+from esr_tpu.data.synthetic import write_synthetic_h5
+from esr_tpu.training import checkpoint as ckpt_lib
+from esr_tpu.training.trainer import Trainer
+
+
+def _write_corpus(tmp_path, n_rec=2):
+    paths = []
+    for i in range(n_rec):
+        p = str(tmp_path / f"rec{i}.h5")
+        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6, seed=i)
+        paths.append(p)
+    datalist = str(tmp_path / "datalist.txt")
+    with open(datalist, "w") as f:
+        f.write("\n".join(paths) + "\n")
+    return datalist
+
+
+def _make_config(tmp_path, datalist, iterations=8, valid_step=4, save_period=100):
+    dataset = {
+        "scale": 2,
+        "ori_scale": "down4",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 128,
+        "sliding_window": 64,
+        "need_gt_events": True,
+        "need_gt_frame": True,
+        "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+        "sequence": {
+            "sequence_length": 4,
+            "seqn": 3,
+            "step_size": 2,
+            "pause": {"enabled": False},
+        },
+    }
+    loader = {
+        "path_to_datalist_txt": datalist,
+        "batch_size": 8,
+        "shuffle": True,
+        "drop_last": True,
+        "prefetch": 0,
+        "dataset": dataset,
+    }
+    valid_loader = dict(loader, shuffle=False, drop_last=False)
+    return {
+        "experiment": "test_exp",
+        "model": {
+            "name": "DeepRecurrNet",
+            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+        },
+        "optimizer": {
+            "name": "Adam",
+            "args": {"lr": 1e-3, "weight_decay": 1e-4, "amsgrad": True},
+        },
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": str(tmp_path / "out"),
+            "iteration_based_train": {
+                "enabled": True,
+                "iterations": iterations,
+                "save_period": save_period,
+                "train_log_step": 4,
+                "valid_step": valid_step,
+                "lr_change_rate": 4000,
+            },
+            "monitor": "min valid_loss",
+            "early_stop": 100,
+            "tensorboard": False,
+            "vis": {"enabled": False},
+        },
+        "train_dataloader": loader,
+        "valid_dataloader": valid_loader,
+    }
+
+
+# ---------------------------------------------------------------------------
+# config system
+# ---------------------------------------------------------------------------
+
+
+def test_set_by_path_and_overrides():
+    cfg = {"a": {"b": {"c": 1}}, "top": "x"}
+    set_by_path(cfg, "a;b;c", "2")
+    assert cfg["a"]["b"]["c"] == 2  # scalar-parsed
+    set_by_path(cfg, "a;b;lr", "1e-3")
+    assert cfg["a"]["b"]["lr"] == pytest.approx(1e-3)
+    set_by_path(cfg, "a;new;flag", "true")
+    assert cfg["a"]["new"]["flag"] is True
+    apply_overrides(cfg, ["top=hello"])
+    assert cfg["top"] == "hello"
+    with pytest.raises(ValueError):
+        apply_overrides(cfg, ["no_equals_sign"])
+
+
+def test_run_config_dirs_and_dump(tmp_path):
+    cfg_path = str(tmp_path / "c.yml")
+    config = _make_config(tmp_path, "unused.txt")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(config, f)
+
+    run = RunConfig.from_args(
+        cfg_path,
+        overrides=["train_dataloader;batch_size=4"],
+        runid="r1",
+    )
+    assert run["train_dataloader"]["batch_size"] == 4
+    assert os.path.isdir(run.save_dir) and run.save_dir.endswith("test_exp/r1")
+    assert os.path.isdir(run.log_dir)
+    dumped = load_config(os.path.join(run.save_dir, "config.yml"))
+    assert dumped["train_dataloader"]["batch_size"] == 4  # effective config
+
+
+def test_reference_yaml_schema_parses():
+    """The shipped translated config drives the builders."""
+    config = load_config("configs/train_esr_2x.yml")
+    from esr_tpu.config.build import build_lr_schedule, build_model
+
+    model = build_model(config["model"])
+    assert model.basech == 8 and model.num_frame == 3
+    sched = build_lr_schedule(
+        config["optimizer"],
+        config["lr_scheduler"],
+        config["trainer"]["iteration_based_train"]["lr_change_rate"],
+    )
+    assert float(sched(0)) == pytest.approx(1e-3)
+    assert float(sched(4000)) == pytest.approx(1e-3 * 0.95)
+    # the floor gate: the last decay fires while lr is still >= 1e-4
+    # (45 decays: 1e-3*0.95^44 = 1.047e-4 >= 1e-4 -> one more step)
+    assert float(sched(10**9)) == pytest.approx(1e-3 * 0.95**45, rel=1e-6)
+    assert float(sched(10**9)) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# trainer end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("corpus")
+    return tmp, _write_corpus(tmp)
+
+
+@pytest.mark.slow
+def test_trainer_end_to_end(corpus, tmp_path):
+    tmp, datalist = corpus
+    config = _make_config(tmp_path, datalist, iterations=30, valid_step=10)
+    run = RunConfig(config, runid="e2e", seed=0)
+    trainer = Trainer(run)
+    assert len(jax.devices()) == 8  # virtual CPU mesh from conftest
+
+    losses = []
+    orig_update = trainer.train_metrics.update
+
+    def spy(key, value, n=1):
+        if key == "train_loss":
+            losses.append(value)
+        orig_update(key, value, n)
+
+    trainer.train_metrics.update = spy
+    result = trainer.train()
+
+    assert len(losses) == 30
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+    assert result["train_loss"] > 0
+    # validation ran and the monitor saw it
+    assert trainer.mnt_best != float("inf")
+    # metrics jsonl written
+    assert os.path.getsize(os.path.join(run.log_dir, "metrics.jsonl")) > 0
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_bitwise(corpus, tmp_path):
+    tmp, datalist = corpus
+    config = _make_config(tmp_path, datalist, iterations=3, valid_step=100)
+    run = RunConfig(config, runid="ck", seed=1)
+    trainer = Trainer(run)
+    trainer.train()  # 3 iterations
+    path = ckpt_lib.save_checkpoint(
+        run.save_dir,
+        jax.device_get(trainer.state),
+        config,
+        2,
+        trainer.mnt_best,
+        save_best=True,
+    )
+    assert os.path.basename(path) == "model_best_until_iteration2"
+
+    # fresh trainer resumed from the checkpoint: state must match bitwise
+    run2 = RunConfig(config, runid="ck2", seed=99, resume=path)
+    trainer2 = Trainer(run2)
+    assert trainer2.start_iteration == 3
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(trainer.state)),
+        jax.tree.leaves(jax.device_get(trainer2.state)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # and continued training diverges identically: one step on one batch
+    batch = next(iter(trainer.train_loader))
+    staged = trainer._stage(batch)
+    s1, m1 = trainer.train_step(trainer.state, staged)
+    s2, m2 = trainer2.train_step(trainer2.state, trainer2._stage(batch))
+    assert float(m1["loss"]) == float(m2["loss"])
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(s1.params)),
+        jax.tree.leaves(jax.device_get(s2.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_resume_reset_and_name_check(corpus, tmp_path):
+    tmp, datalist = corpus
+    config = _make_config(tmp_path, datalist, iterations=2, valid_step=100)
+    run = RunConfig(config, runid="rs", seed=2)
+    trainer = Trainer(run)
+    trainer.train()
+    state = jax.device_get(trainer.state)
+    path = ckpt_lib.save_checkpoint(run.save_dir, state, config, 5, 0.25)
+
+    # --reset: weights restored, progress zeroed
+    st, start, best = ckpt_lib.resume_checkpoint(path, state, config, reset=True)
+    assert start == 0 and best == float("inf")
+    np.testing.assert_array_equal(
+        jax.tree.leaves(st.params)[0], jax.tree.leaves(state.params)[0]
+    )
+
+    # model-name mismatch: nothing restored
+    bad = {**config, "model": {"name": "SomethingElse", "args": {}}}
+    _, start, best = ckpt_lib.resume_checkpoint(path, state, bad)
+    assert start == 0 and best == float("inf")
+
+
+@pytest.mark.slow
+def test_load_for_inference_matches(corpus, tmp_path):
+    tmp, datalist = corpus
+    config = _make_config(tmp_path, datalist, iterations=1, valid_step=100)
+    run = RunConfig(config, runid="inf", seed=3)
+    trainer = Trainer(run)
+    trainer.train()
+    state = jax.device_get(trainer.state)
+    path = ckpt_lib.save_checkpoint(run.save_dir, state, config, 1, 0.0)
+
+    model, params, cfg = ckpt_lib.load_for_inference(path)
+    assert cfg["model"]["name"] == "DeepRecurrNet"
+    x = np.random.default_rng(0).random((1, 3, 32, 32, 2)).astype(np.float32)
+    states = model.init_states(1, 32, 32)
+    out1, _ = model.apply(state.params, x, states)
+    out2, _ = model.apply(params, x, states)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))

@@ -1,0 +1,146 @@
+"""Observability layer: trackers, timers, writer, vis_events."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from esr_tpu.utils.timers import Timer, timing_stats
+from esr_tpu.utils.trackers import MetricTracker, YamlLogger
+from esr_tpu.utils.vis_events import (
+    EventVisualizer,
+    render_event_cnt,
+    render_event_list,
+    render_event_stack,
+    render_frame,
+)
+from esr_tpu.utils.writer import MetricWriter
+
+
+def test_metric_tracker_running_average():
+    mt = MetricTracker(["a", "b"])
+    mt.update("a", 1.0)
+    mt.update("a", 3.0)
+    mt.update("b", 10.0, n=4)
+    assert mt.avg("a") == 2.0
+    assert mt.avg("b") == 10.0
+    assert mt.result() == {"a": 2.0, "b": 10.0}
+    mt.reset()
+    assert mt.result() == {"a": 0.0, "b": 0.0}
+    mt.update("new_key", 5.0)  # auto-created
+    assert mt.avg("new_key") == 5.0
+
+
+def test_metric_tracker_writer_hook():
+    calls = []
+
+    class W:
+        def add_scalar(self, k, v):
+            calls.append((k, v))
+
+    mt = MetricTracker(["x"], writer=W())
+    mt.update("x", 2.5)
+    assert calls == [("x", 2.5)]
+
+
+def test_yaml_logger_roundtrip(tmp_path):
+    import yaml
+
+    p = str(tmp_path / "report.yml")
+    with YamlLogger(p) as yl:
+        yl.log_info("hello")
+        yl.log_dict({"esr_mse": np.float32(0.5), "arr": np.arange(3)}, "results")
+    data = yaml.safe_load(open(p))
+    assert data["info"] == ["hello"]
+    assert data["results"]["esr_mse"] == 0.5
+    assert data["results"]["arr"] == [0, 1, 2]
+
+
+def test_timer_records():
+    with Timer("unit_test_timer"):
+        pass
+    assert timing_stats["unit_test_timer"]
+
+
+def test_metric_writer_jsonl(tmp_path):
+    w = MetricWriter(str(tmp_path), enable_tensorboard=False)
+    w.add_scalar("loss", 9.0)  # before any set_step: untagged
+    w.set_step(0)
+    w.add_scalar("loss", 1.5)
+    w.set_step(10, "valid")  # emits steps_per_sec
+    w.add_scalar("loss", 0.5)
+    w.close()
+    lines = [
+        json.loads(l)
+        for l in open(os.path.join(str(tmp_path), "metrics.jsonl"))
+    ]
+    tags = {l["tag"] for l in lines}
+    assert "loss" in tags and "loss/train" in tags and "loss/valid" in tags
+    assert any(t.startswith("steps_per_sec") for t in tags)
+
+
+# ---------------------------------------------------------------------------
+# vis_events — semantics of the reference colorizer
+# ---------------------------------------------------------------------------
+
+
+def test_render_event_cnt_black_green_red():
+    cnt = np.zeros((4, 4, 2), np.float32)
+    cnt[0, 0, 0] = 4.0  # positive only
+    cnt[1, 1, 1] = 4.0  # negative only
+    img = render_event_cnt(cnt, "green_red", black_background=True)
+    assert img.shape == (4, 4, 3) and img.dtype == np.uint8
+    r, g, b = img[0, 0]
+    assert g > 0 and r == 0 and b == 0  # positive -> green
+    r, g, b = img[1, 1]
+    assert r > 0 and g == 0 and b == 0  # negative -> red
+    assert (img[3, 3] == 0).all()  # background black
+
+
+def test_render_event_cnt_white_background():
+    cnt = np.zeros((4, 4, 2), np.float32)
+    cnt[0, 0, 0] = 4.0
+    cnt[1, 1, 1] = 4.0
+    img = render_event_cnt(cnt, "green_red", black_background=False)
+    assert (img[3, 3] == 255).all()  # background white
+    r, g, b = img[0, 0]
+    assert g == 255 and r < 255 and b < 255  # green-tinted positive
+    r, g, b = img[1, 1]
+    assert r == 255 and g < 255 and b < 255  # red-tinted negative
+
+
+def test_render_event_cnt_gray_and_nonorm():
+    cnt = np.zeros((3, 3, 2), np.float32)
+    cnt[0, 0, 0] = 2.0
+    cnt[1, 1, 1] = 2.0
+    img = render_event_cnt(cnt, "gray")
+    assert img.ndim == 2
+    assert img[0, 0] > img[2, 2] > img[1, 1]  # pos > bg > neg
+    imgb = render_event_cnt(cnt, "green_red", norm=False)
+    assert imgb[0, 0, 1] == 255  # binary intensities
+
+
+def test_render_event_list_and_stack_and_frame(tmp_path):
+    ev = np.array([[0, 0, 0.0, 1], [2, 1, 0.5, -1], [9, 9, 0.6, 1]], np.float32)
+    img = render_event_list(ev, (3, 4))  # out-of-bounds event dropped
+    assert tuple(img[0, 0]) == (0, 0, 255)  # blue positive
+    assert tuple(img[1, 2]) == (255, 0, 0)  # red negative
+    assert tuple(img[2, 3]) == (255, 255, 255)
+
+    stack = np.zeros((5, 6, 4), np.float32)
+    tiled = render_event_stack(stack)
+    assert tiled.shape == (10, 12, 3)
+    assert (tiled == 255).all()  # zero stack -> all white (diverging midpoint)
+
+    fr = render_frame(np.full((4, 4, 1), 0.5, np.float32))
+    assert fr.shape == (4, 4) and fr[0, 0] == 127
+
+    vis = EventVisualizer()
+    path = str(tmp_path / "cnt.png")
+    out = vis.plot_event_cnt(
+        np.random.default_rng(0).random((8, 8, 2)).astype(np.float32),
+        is_save=True,
+        path=path,
+    )
+    assert os.path.exists(path) and out.shape == (8, 8, 3)

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Training entry point.
+
+TPU-native rebuild of ``train_ours_cnt_seq.py`` (reference ``:742-832``):
+
+    python train.py -c configs/train_esr_2x.yml -id run0
+    python train.py -c cfg.yml -o "train_dataloader;batch_size=8" \\
+                    -o "trainer;iteration_based_train;iterations=10000"
+    python train.py -c cfg.yml -r <ckpt-dir> [--reset]
+
+Multi-host: launch once per host (e.g. on each TPU-pod worker); JAX
+rendezvous replaces ``torch.distributed.launch``. On a single host this runs
+SPMD over all local devices — no launcher needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from esr_tpu.config.parser import RunConfig
+from esr_tpu.parallel.mesh import initialize_multihost
+
+
+def get_args():
+    p = argparse.ArgumentParser(description="ESR-TPU training")
+    p.add_argument("-c", "--config", required=True, help="YAML config path")
+    p.add_argument("-id", "--runid", default=None, help="run id (default: timestamp)")
+    p.add_argument("-seed", "--seed", default=123, type=int)
+    p.add_argument("-r", "--resume", default=None, help="checkpoint dir to resume")
+    p.add_argument(
+        "--reset",
+        action="store_true",
+        help="on resume, restore weights but reset trainer progress",
+    )
+    p.add_argument(
+        "-o",
+        "--override",
+        action="append",
+        default=[],
+        metavar="key;path=value",
+        help="config override by semicolon key path (repeatable)",
+    )
+    p.add_argument(
+        "--multihost",
+        action="store_true",
+        help="call jax.distributed.initialize() before building the mesh",
+    )
+    return p.parse_args()
+
+
+def main():
+    args = get_args()
+    if args.multihost:
+        initialize_multihost()
+
+    import jax
+
+    run = RunConfig.from_args(
+        args.config,
+        overrides=args.override,
+        runid=args.runid,
+        resume=args.resume,
+        reset=args.reset,
+        seed=args.seed,
+        is_main=jax.process_index() == 0,
+    )
+
+    from esr_tpu.training.trainer import Trainer
+
+    trainer = Trainer(run)
+    result = trainer.train()
+    print({k: round(v, 6) for k, v in result.items()})
+
+
+if __name__ == "__main__":
+    main()
