@@ -1,0 +1,9 @@
+"""Inference / evaluation harness."""
+
+from esr_tpu.inference.harness import (
+    InferenceRunner,
+    aggregate_results,
+    run_inference,
+)
+
+__all__ = ["InferenceRunner", "aggregate_results", "run_inference"]

@@ -1,0 +1,262 @@
+"""Streaming inference over recordings: ESR vs bicubic metrics + reports.
+
+Rebuilds ``infer_ours_cnt.py`` (reference ``:22-115`` per-recording body,
+``:160-350`` driver):
+
+- one :class:`InferenceRunner` per trained model: the forward is jit'd once
+  and reused across recordings;
+- recurrent state is reset ONCE per recording and persists across the whole
+  stream (reference ``:54`` — train resets per batch, inference per
+  recording);
+- each length-L sequence contributes its FIRST seqn-window
+  (``inputs_seq[0]``, reference ``:55-56``), sequences are non-overlapping
+  (step_size = L by default), batch 1, in order;
+- metrics per window: esr_{l1,mse,ssim,psnr[,lpips]} against the GT count
+  image of the middle frame, and the same for the bicubic-upsampled LR input
+  (the classical baseline, reference ``:78,86-100``); per-recording means via
+  :class:`MetricTracker`; datalist-level breakdown + means
+  (reference ``:336-347``);
+- LPIPS only runs when calibrated params are supplied — the random-backbone
+  fallback must be requested explicitly upstream
+  (``load_lpips_params(allow_uncalibrated=True)``);
+- optional PNG dumps in the reference's directory layout (``:44-49,104-109``);
+- per-forward latency (timed around ``block_until_ready``) and params count
+  (reference ``:65-67,71-74``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esr_tpu.data.loader import ConcatSequenceDataset, SequenceLoader
+from esr_tpu.losses.restore import (
+    l1_metric,
+    mse_metric,
+    psnr_metric,
+    ssim_metric,
+)
+from esr_tpu.ops.resize import interpolate
+from esr_tpu.utils.trackers import MetricTracker, YamlLogger
+from esr_tpu.utils.vis_events import render_event_cnt, render_frame, save_image
+
+logger = logging.getLogger(__name__)
+
+_IMG_DIRS = (
+    "lr_event_img",
+    "hr_scaled_event_img",
+    "hr_esr_event_img",
+    "hr_bicubic_event_img",
+    "hr_gt_event_img",
+)
+
+
+def _num_params(params) -> float:
+    return sum(np.asarray(p).size for p in jax.tree.leaves(params)) / 1e6
+
+
+class InferenceRunner:
+    def __init__(
+        self,
+        model,
+        params,
+        seqn: int = 3,
+        lpips_model=None,
+        lpips_params=None,
+    ):
+        self.model = model
+        self.params = params
+        self.seqn = seqn
+        self.mid_idx = (seqn - 1) // 2
+
+        self._fwd = jax.jit(model.apply)
+
+        self.lpips = None
+        if lpips_model is not None and lpips_params is not None:
+            self.lpips = jax.jit(
+                lambda a, b: lpips_model.multi_channel(lpips_params, a, b)
+            )
+
+        @jax.jit
+        def _metrics(pred, base, gt):
+            return {
+                "esr_l1": l1_metric(pred, gt),
+                "esr_mse": mse_metric(pred, gt),
+                "esr_ssim": ssim_metric(pred, gt),
+                "esr_psnr": psnr_metric(pred, gt),
+                "bicubic_l1": l1_metric(base, gt),
+                "bicubic_mse": mse_metric(base, gt),
+                "bicubic_ssim": ssim_metric(base, gt),
+                "bicubic_psnr": psnr_metric(base, gt),
+            }
+
+        self._metrics = _metrics
+
+    def run_recording(
+        self,
+        data_path: str,
+        dataset_config: Dict,
+        out_dir: Optional[str] = None,
+        save_images: bool = False,
+        report: bool = True,
+    ) -> Dict[str, float]:
+        """Stream one recording; returns the per-recording metric means."""
+        dataset = ConcatSequenceDataset([data_path], dataset_config)
+        loader = SequenceLoader(
+            dataset, batch_size=1, shuffle=False, drop_last=False, prefetch=1
+        )
+        kh, kw = dataset.gt_resolution
+
+        keys = ["esr_l1", "esr_mse", "esr_ssim", "esr_psnr",
+                "bicubic_l1", "bicubic_mse", "bicubic_ssim", "bicubic_psnr",
+                "time", "params"]
+        if self.lpips is not None:
+            keys += ["esr_lpips", "bicubic_lpips"]
+        track = MetricTracker(keys)
+        track.update("params", _num_params(self.params))
+
+        img_root = None
+        if save_images and out_dir is not None:
+            img_root = os.path.join(out_dir, "event_img")
+            for d in _IMG_DIRS:
+                os.makedirs(os.path.join(img_root, d), exist_ok=True)
+            os.makedirs(os.path.join(out_dir, "img", "gt_img"), exist_ok=True)
+
+        # state persists across the WHOLE recording (reference :54)
+        states = self.model.init_states(1, kh, kw)
+
+        for i, batch in enumerate(loader):
+            window = {
+                k: v[:, : self.seqn] for k, v in batch.items()
+            }  # inputs_seq[0]
+            inp_scaled = jnp.asarray(window["inp_scaled_cnt"])
+
+            t0 = time.perf_counter()
+            pred, states = self._fwd(self.params, inp_scaled, states)
+            pred = jax.block_until_ready(pred)
+            track.update("time", time.perf_counter() - t0)
+
+            gt = jnp.asarray(window["gt_cnt"][0, self.mid_idx])  # [kH,kW,2]
+            inp_cnt = jnp.asarray(window["inp_cnt"][0, self.mid_idx])
+            pred0 = pred[0]
+            if pred0.shape[:2] != (kh, kw):
+                pred0 = interpolate(pred0, (kh, kw), "bicubic")
+            bicubic = interpolate(inp_cnt, (kh, kw), "bicubic")
+
+            for k, v in self._metrics(pred0, bicubic, gt).items():
+                track.update(k, float(v))
+            if self.lpips is not None:
+                track.update("esr_lpips", float(self.lpips(pred0, gt)))
+                track.update("bicubic_lpips", float(self.lpips(bicubic, gt)))
+
+            if img_root is not None:
+                pred_np = np.asarray(pred0)
+                views = {
+                    "lr_event_img": np.asarray(inp_cnt),
+                    "hr_scaled_event_img": window["inp_scaled_cnt"][0, self.mid_idx],
+                    "hr_esr_event_img": np.round(pred_np),
+                    "hr_bicubic_event_img": np.asarray(bicubic),
+                    "hr_gt_event_img": np.asarray(gt),
+                }
+                for d, img in views.items():
+                    save_image(
+                        os.path.join(img_root, d, f"{i:09d}.png"),
+                        render_event_cnt(img),
+                    )
+                if "gt_img" in window:
+                    save_image(
+                        os.path.join(out_dir, "img", "gt_img", f"{i:09d}.png"),
+                        render_frame(window["gt_img"][0, self.mid_idx]),
+                    )
+
+        result = track.result()
+        if report and out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            with YamlLogger(os.path.join(out_dir, "inference.yml")) as yl:
+                yl.log_info(f"inference on {data_path}")
+                yl.log_dict(dataset_config, "eval_dataset_config")
+                yl.log_dict(result, "evaluation results")
+        return result
+
+
+def aggregate_results(results: List[Dict[str, float]], names: List[str]):
+    """Per-recording breakdown + datalist means (reference ``:336-347``)."""
+    breakdown: Dict[str, Dict[str, float]] = defaultdict(dict)
+    means: Dict[str, List[float]] = defaultdict(list)
+    for name, entry in zip(names, results):
+        for k, v in entry.items():
+            breakdown[k][name] = v
+            means[k].append(v)
+    return dict(breakdown), {k: float(np.mean(v)) for k, v in means.items()}
+
+
+def run_inference(
+    checkpoint_path: str,
+    data_list: Sequence[str],
+    output_path: str,
+    dataset_config: Optional[Dict] = None,
+    save_images: bool = True,
+    lpips_backbone_npz: Optional[str] = None,
+    allow_uncalibrated_lpips: bool = False,
+) -> Dict[str, float]:
+    """Full driver: checkpoint -> model, datalist -> per-recording + mean
+    reports under ``output_path`` (reference ``main`` mode 1, ``:295-347``).
+    Returns the datalist-mean metrics."""
+    from esr_tpu.training.checkpoint import load_for_inference
+
+    model, params, config = load_for_inference(checkpoint_path)
+    if dataset_config is None:
+        dataset_config = config["valid_dataloader"]["dataset"]
+    seqn = int(dataset_config["sequence"].get("seqn", 3))
+    ck_seqn = config["model"].get("args", {}).get("num_frame", 3)
+    assert ck_seqn == seqn, (
+        f"checkpoint num_frame={ck_seqn} != dataloader seqn={seqn}"
+    )  # reference infer_ours_cnt.py:125
+
+    lpips_model = lpips_params = None
+    if lpips_backbone_npz is not None or allow_uncalibrated_lpips:
+        from esr_tpu.losses.lpips import (
+            LPIPS,
+            load_alexnet_npz,
+            load_lpips_params,
+        )
+
+        backbone = (
+            load_alexnet_npz(lpips_backbone_npz)
+            if lpips_backbone_npz
+            else None
+        )
+        lpips_model = LPIPS()
+        lpips_params = load_lpips_params(
+            backbone, allow_uncalibrated=allow_uncalibrated_lpips
+        )
+
+    runner = InferenceRunner(
+        model, params, seqn, lpips_model=lpips_model, lpips_params=lpips_params
+    )
+
+    os.makedirs(output_path, exist_ok=True)
+    results, names = [], []
+    for data_path in data_list:
+        name = os.path.basename(data_path)
+        logger.info("processing %s", data_path)
+        out_dir = os.path.join(output_path, name)
+        result = runner.run_recording(
+            data_path, dataset_config, out_dir, save_images=save_images
+        )
+        results.append(result)
+        names.append(name)
+
+    breakdown, mean = aggregate_results(results, names)
+    with YamlLogger(os.path.join(output_path, "inference_all.yml")) as yl:
+        yl.log_info(f"inference {checkpoint_path} on {list(data_list)}")
+        yl.log_dict(breakdown, "breakdown results for each data")
+        yl.log_dict(mean, "mean results for the whole data")
+    return mean

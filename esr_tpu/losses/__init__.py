@@ -14,7 +14,12 @@ from esr_tpu.losses.restore import (
     ssim,
     ssim_metric,
 )
-from esr_tpu.losses.lpips import LPIPS, load_lpips_params
+from esr_tpu.losses.lpips import (
+    LPIPS,
+    convert_alexnet_backbone_pth,
+    load_alexnet_npz,
+    load_lpips_params,
+)
 from esr_tpu.losses.flow import event_warping_loss, averaged_iwe
 from esr_tpu.losses.reconstruction import BrightnessConstancy
 
@@ -27,6 +32,8 @@ __all__ = [
     "ssim_metric",
     "LPIPS",
     "load_lpips_params",
+    "convert_alexnet_backbone_pth",
+    "load_alexnet_npz",
     "event_warping_loss",
     "averaged_iwe",
     "BrightnessConstancy",

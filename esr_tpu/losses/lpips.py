@@ -133,15 +133,29 @@ def load_lpips_params(
     alexnet_state: Optional[Dict[str, Any]] = None,
     lin_npz_path: Optional[str] = None,
     rng_seed: int = 0,
+    allow_uncalibrated: bool = False,
 ) -> Dict[str, Any]:
     """Build the LPIPS param pytree.
 
     ``alexnet_state``: a torchvision ``alexnet().state_dict()``-style mapping
-    (numpy or torch tensors) with keys ``features.{0,3,6,8,10}.{weight,bias}``.
-    When absent, the backbone is random-initialized from ``rng_seed``
-    (deterministic, uncalibrated — see module docstring). The lin calibration
-    weights load from the bundled npz.
+    (numpy or torch tensors) with keys ``features.{0,3,6,8,10}.{weight,bias}``
+    — the pretrained backbone the reference loads
+    (``loss/PerceptualSimilarity/models/dist_model.py:66-74``). Convert one
+    offline with :func:`convert_alexnet_backbone_pth`.
+
+    Without it the backbone is random-initialized from ``rng_seed`` and the
+    resulting "lpips" numbers are MEANINGLESS as perceptual distances (only
+    usable as a smoke-test statistic). That fallback must be requested
+    explicitly with ``allow_uncalibrated=True``; otherwise this raises.
     """
+    if alexnet_state is None and not allow_uncalibrated:
+        raise ValueError(
+            "No AlexNet backbone weights supplied. LPIPS with a random "
+            "backbone does not measure perceptual similarity. Pass "
+            "alexnet_state=<converted torchvision state dict> (see "
+            "convert_alexnet_backbone_pth), or opt in to the uncalibrated "
+            "fallback explicitly with allow_uncalibrated=True."
+        )
     model = LPIPS()
     dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
     params = model.init(jax.random.PRNGKey(rng_seed), dummy, dummy)
@@ -175,3 +189,25 @@ def convert_lpips_lin_pth(pth_path: str, out_npz_path: str) -> None:
         for i in range(5)
     }
     np.savez(out_npz_path, **out)
+
+
+def convert_alexnet_backbone_pth(pth_path: str, out_npz_path: str) -> None:
+    """One-shot converter for the backbone: a torchvision
+    ``alexnet-owt-*.pth`` state dict -> npz of the five feature convs.
+    Run wherever the torchvision weights are available; the npz is what
+    :func:`load_alexnet_npz` consumes at eval time."""
+    import torch
+
+    sd = torch.load(pth_path, map_location="cpu")
+    out = {}
+    for li in (0, 3, 6, 8, 10):
+        out[f"features.{li}.weight"] = sd[f"features.{li}.weight"].numpy()
+        out[f"features.{li}.bias"] = sd[f"features.{li}.bias"].numpy()
+    np.savez(out_npz_path, **out)
+
+
+def load_alexnet_npz(npz_path: str) -> Dict[str, np.ndarray]:
+    """Load a converted backbone npz into the mapping
+    :func:`load_lpips_params` expects."""
+    data = np.load(npz_path)
+    return {k: data[k] for k in data.files}

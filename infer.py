@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Inference entry point.
+
+TPU-native rebuild of ``infer_ours_cnt.py`` (reference ``:135-350``, working
+mode 1):
+
+    python infer.py --model_path <ckpt-dir> --data_list test.txt \\
+                    --output_path /tmp/out --scale 2 --ori_scale down16
+
+The checkpoint directory is an Orbax checkpoint written by training; the model
+is rebuilt from the config embedded in it. LPIPS runs only when a converted
+AlexNet backbone npz is supplied (--lpips_backbone) or the uncalibrated
+fallback is explicitly requested (--allow_uncalibrated_lpips, smoke tests
+only).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def get_flags():
+    p = argparse.ArgumentParser(description="ESR-TPU inference")
+    p.add_argument("--model_path", type=str, required=True, help="checkpoint dir")
+    p.add_argument("--data_path", type=str, default=None, help="single recording")
+    p.add_argument("--data_list", type=str, default=None, help="datalist txt")
+    p.add_argument("--output_path", type=str, required=True)
+    p.add_argument("--save_images", dest="save_images", action="store_true", default=True)
+    p.add_argument("--no_save_images", dest="save_images", action="store_false")
+    p.add_argument("--lpips_backbone", type=str, default=None)
+    p.add_argument("--allow_uncalibrated_lpips", action="store_true")
+
+    # dataset overrides (reference get_flags, infer_ours_cnt.py:135-157)
+    p.add_argument("--scale", type=int, default=4)
+    p.add_argument("--seqn", type=int, default=3)
+    p.add_argument("--seql", type=int, default=9)
+    p.add_argument("--step_size", type=int, default=None)
+    p.add_argument("--time_bins", type=int, default=1)
+    p.add_argument("--ori_scale", type=str, default="down4")
+    p.add_argument("--mode", type=str, default="events")
+    p.add_argument("--window", type=int, default=2048)
+    p.add_argument("--sliding_window", type=int, default=1024)
+    p.add_argument("--need_gt_frame", default=True, action="store_true")
+    p.add_argument("--need_gt_events", default=True, action="store_true")
+    return p.parse_args()
+
+
+def main():
+    flags = get_flags()
+    assert (flags.data_path is None) != (flags.data_list is None), (
+        "pass exactly one of --data_path / --data_list"
+    )
+
+    dataset_config = {
+        "scale": flags.scale,
+        "ori_scale": flags.ori_scale,
+        "time_bins": flags.time_bins,
+        "need_gt_frame": flags.need_gt_frame,
+        "need_gt_events": flags.need_gt_events,
+        "mode": flags.mode,
+        "window": flags.window,
+        "sliding_window": flags.sliding_window,
+        "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+        "sequence": {
+            "sequence_length": flags.seql,
+            "seqn": flags.seqn,
+            "step_size": flags.step_size,
+            "pause": {"enabled": False},
+        },
+    }
+
+    if flags.data_list is not None:
+        from esr_tpu.data.loader import read_datalist
+
+        data_list = read_datalist(flags.data_list)
+    else:
+        data_list = [flags.data_path]
+
+    from esr_tpu.inference.harness import run_inference
+    from esr_tpu.utils.logging import setup_logging
+
+    setup_logging(flags.output_path)
+    mean = run_inference(
+        flags.model_path,
+        data_list,
+        flags.output_path,
+        dataset_config,
+        save_images=flags.save_images,
+        lpips_backbone_npz=flags.lpips_backbone,
+        allow_uncalibrated_lpips=flags.allow_uncalibrated_lpips,
+    )
+    print({k: round(v, 6) for k, v in mean.items()})
+
+
+if __name__ == "__main__":
+    main()

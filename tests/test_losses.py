@@ -95,7 +95,7 @@ def test_psnr_metric_reference_quirk():
 
 def test_lpips_zero_on_identical_and_positive_otherwise():
     model = LPIPS()
-    params = load_lpips_params()
+    params = load_lpips_params(allow_uncalibrated=True)
     rng = np.random.default_rng(4)
     x = jnp.asarray(rng.random((1, 64, 64, 3)).astype(np.float32))
     y = jnp.asarray(rng.random((1, 64, 64, 3)).astype(np.float32))
@@ -106,7 +106,7 @@ def test_lpips_zero_on_identical_and_positive_otherwise():
 
 
 def test_lpips_bundled_lin_weights_load():
-    params = load_lpips_params()
+    params = load_lpips_params(allow_uncalibrated=True)
     lin0 = np.asarray(params["params"]["lin0"])
     assert lin0.shape == (64,)
     # converted calibration weights are not the constant-init fallback
@@ -115,7 +115,7 @@ def test_lpips_bundled_lin_weights_load():
 
 def test_lpips_multi_channel_replication():
     model = LPIPS()
-    params = load_lpips_params()
+    params = load_lpips_params(allow_uncalibrated=True)
     rng = np.random.default_rng(5)
     x = jnp.asarray(rng.random((1, 32, 32, 2)).astype(np.float32))
     d = float(model.multi_channel(params, x, x))
