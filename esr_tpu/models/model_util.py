@@ -74,11 +74,46 @@ def crop_image(x: Array, spec: PadSpec, scale: int = 1) -> Array:
     return x[..., iy0:iy1, ix0:ix1, :]
 
 
+def _align_to(x1: Array, x2: Array) -> Array:
+    """Zero-pad or center-crop ``x1``'s spatial dims to match ``x2``.
+
+    Reference ``skip_sum``/``skip_concat`` apply ``ZeroPad2d`` with
+    ``diff // 2`` / ``diff - diff // 2`` splits (``model_util.py:14-27``);
+    torch accepts NEGATIVE pads there, which crop — SRUNetRecurrent's decoder
+    relies on both directions (``unet.py:491-495``). Floor division on
+    negative diffs reproduces torch's split exactly.
+    """
+    dy = x2.shape[-3] - x1.shape[-3]
+    dx = x2.shape[-2] - x1.shape[-2]
+    if dy == 0 and dx == 0:
+        return x1
+    top, bottom = dy // 2, dy - dy // 2
+    left, right = dx // 2, dx - dx // 2
+
+    def pad_amount(v):
+        return max(v, 0)
+
+    pads = [(0, 0)] * (x1.ndim - 3) + [
+        (pad_amount(top), pad_amount(bottom)),
+        (pad_amount(left), pad_amount(right)),
+        (0, 0),
+    ]
+    if any(p != (0, 0) for p in pads):
+        x1 = jnp.pad(x1, pads)
+    # negative side -> crop that many elements from that edge
+    y0 = -top if top < 0 else 0
+    y1 = x1.shape[-3] + (bottom if bottom < 0 else 0)
+    x0 = -left if left < 0 else 0
+    x1_ = x1.shape[-2] + (right if right < 0 else 0)
+    return x1[..., y0:y1, x0:x1_, :]
+
+
 def skip_concat(x1: Array, x2: Array) -> Array:
-    """Channel concat skip (reference ``model_util.py:14-20``)."""
-    return jnp.concatenate([x1, x2], axis=-1)
+    """Channel concat skip with spatial alignment
+    (reference ``model_util.py:14-20``)."""
+    return jnp.concatenate([_align_to(x1, x2), x2], axis=-1)
 
 
 def skip_sum(x1: Array, x2: Array) -> Array:
-    """Additive skip (reference ``model_util.py:23-27``)."""
-    return x1 + x2
+    """Additive skip with spatial alignment (reference ``model_util.py:23-27``)."""
+    return _align_to(x1, x2) + x2

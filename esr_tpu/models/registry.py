@@ -34,8 +34,18 @@ def get_model(name: str, **kwargs) -> nn.Module:
 
 def _register_builtins():
     from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.models.unet import (
+        MultiResUNet,
+        SRUNetRecurrent,
+        UNetFlow,
+        UNetRecurrent,
+    )
 
     MODEL_REGISTRY.setdefault("DeepRecurrNet", DeepRecurrNet)
+    MODEL_REGISTRY.setdefault("UNetFlow", UNetFlow)
+    MODEL_REGISTRY.setdefault("UNetRecurrent", UNetRecurrent)
+    MODEL_REGISTRY.setdefault("MultiResUNet", MultiResUNet)
+    MODEL_REGISTRY.setdefault("SRUNetRecurrent", SRUNetRecurrent)
 
 
 _register_builtins()

@@ -1,0 +1,340 @@
+"""UNet model family (e2vid lineage) — the reference's alternative models.
+
+Functional Flax re-design of ``/root/reference/models/unet.py:19-498``:
+
+- :class:`UNetFlow` (``:170-227``): recurrent encoders, image+flow heads;
+- :class:`UNetRecurrent` (``:230-301``): recurrent encoders, single image out;
+- :class:`MultiResUNet` (``:304-390``): stateless, a prediction at every
+  decoder scale, each fed forward into the next decoder (concat skips);
+- :class:`SRUNetRecurrent` (``:393-498``): the SR variant — x4-then-x2
+  decoders plus per-skip x2 upsamplers give an output at 2x the input
+  resolution.
+
+Shared semantics kept from the reference:
+
+- channel ladder ``base * multiplier^i`` (``:58-64``);
+- stride-2 k=5 encoders, skip on every encoder + the head;
+- ``skip_sum``/``skip_concat`` zero-pad-or-crop alignment — SRUNetRecurrent's
+  decoder depends on both directions (``model_util.py:14-27``, see
+  :func:`esr_tpu.models.model_util._align_to`);
+- ``use_upsample_conv`` selects bilinear-upsample-conv vs transposed conv
+  (``:52-55``); the SR variant requires upsample-conv (its non-default
+  scales don't exist for transposed convs — same crash in the reference).
+
+Differences by design: recurrent states are threaded explicitly
+(``(x, states) -> (out, states)``, reset by constructing fresh zeros via
+:meth:`init_states`) instead of stored on module attributes, so sequences ride
+``lax.scan`` and states shard under ``pjit``. Layouts are NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from esr_tpu.models.layers import (
+    ConvLayer,
+    ConvGRUCell,
+    ConvLSTMCell,
+    RecurrentConvLayer,
+    ResidualBlock,
+    TransposedConvLayer,
+    UpsampleConvLayer,
+    get_activation,
+)
+from esr_tpu.models.model_util import skip_concat, skip_sum
+
+Array = jax.Array
+
+
+class _UNetBase(nn.Module):
+    """Shared config + channel-ladder arithmetic (reference ``:25-64``)."""
+
+    base_num_channels: int = 32
+    num_encoders: int = 4
+    num_residual_blocks: int = 2
+    num_output_channels: int = 1
+    skip_type: str = "sum"
+    norm: Optional[str] = None
+    use_upsample_conv: bool = True
+    num_bins: int = 5
+    recurrent_block_type: Optional[str] = "convlstm"
+    kernel_size: int = 5
+    channel_multiplier: int = 2
+    final_activation: Optional[str] = None
+
+    @property
+    def encoder_input_sizes(self) -> List[int]:
+        return [
+            int(self.base_num_channels * self.channel_multiplier**i)
+            for i in range(self.num_encoders)
+        ]
+
+    @property
+    def encoder_output_sizes(self) -> List[int]:
+        return [
+            int(self.base_num_channels * self.channel_multiplier ** (i + 1))
+            for i in range(self.num_encoders)
+        ]
+
+    @property
+    def max_num_channels(self) -> int:
+        return self.encoder_output_sizes[-1]
+
+    def _skip(self, x1: Array, x2: Array) -> Array:
+        assert self.skip_type in ("sum", "concat"), self.skip_type
+        return (skip_sum if self.skip_type == "sum" else skip_concat)(x1, x2)
+
+    def _upsample_layer(self, features: int, scale: int = 2, name=None):
+        if self.use_upsample_conv:
+            return UpsampleConvLayer(
+                features,
+                self.kernel_size,
+                padding=self.kernel_size // 2,
+                norm=self.norm,
+                scale=scale,
+                name=name,
+            )
+        assert scale == 2, "TransposedConvLayer only realizes x2 (reference parity)"
+        return TransposedConvLayer(
+            features,
+            self.kernel_size,
+            padding=self.kernel_size // 2,
+            norm=self.norm,
+            name=name,
+        )
+
+    def _final_act(self, x: Array) -> Array:
+        # reference: getattr(torch, final_activation, None) — 'none' -> None
+        name = self.final_activation
+        if name in (None, "none"):
+            return x
+        act = get_activation(name)
+        return act(x)
+
+    # recurrent state plumbing ------------------------------------------------
+
+    def init_states(self, batch: int, height: int, width: int) -> Tuple:
+        """Zero recurrent states for every encoder (resolution halves per
+        stage; stride-2 k=5 p=2 conv gives ceil(H/2))."""
+        states = []
+        h, w = height, width
+        for c in self.encoder_output_sizes:
+            h, w = -(-h // 2), -(-w // 2)
+            if self.recurrent_block_type == "convlstm":
+                states.append(ConvLSTMCell.zeros_state(batch, h, w, c))
+            else:
+                states.append(ConvGRUCell.zeros_state(batch, h, w, c))
+        return tuple(states)
+
+
+class _RecurrentEncoderStack(nn.Module):
+    sizes: Sequence[int]
+    kernel_size: int
+    recurrent_block_type: str
+    norm: Optional[str]
+
+    @nn.compact
+    def __call__(self, x: Array, states: Tuple) -> Tuple[Array, List[Array], Tuple]:
+        blocks, new_states = [], []
+        for i, c in enumerate(self.sizes):
+            x, s = RecurrentConvLayer(
+                c,
+                self.kernel_size,
+                stride=2,
+                padding=self.kernel_size // 2,
+                recurrent_block_type=self.recurrent_block_type,
+                norm=self.norm,
+                name=f"encoder_{i}",
+            )(x, states[i])
+            blocks.append(x)
+            new_states.append(s)
+        return x, blocks, tuple(new_states)
+
+
+class UNetRecurrent(_UNetBase):
+    """Recurrent UNet, single-image head (reference ``unet.py:230-301``)."""
+
+    def setup(self):
+        k = self.kernel_size
+        self.head = ConvLayer(
+            self.base_num_channels, k, stride=1, padding=k // 2
+        )
+        self.encoders = _RecurrentEncoderStack(
+            self.encoder_output_sizes, k, self.recurrent_block_type, self.norm
+        )
+        self.resblocks = [
+            ResidualBlock(self.max_num_channels, norm=self.norm, name=f"res_{i}")
+            for i in range(self.num_residual_blocks)
+        ]
+        self.decoders = [
+            self._upsample_layer(c, name=f"decoder_{i}")
+            for i, c in enumerate(reversed(self.encoder_input_sizes))
+        ]
+        self.pred = ConvLayer(
+            self.num_output_channels, 1, activation=None, norm=self.norm
+        )
+
+    def __call__(self, x: Array, states: Tuple) -> Tuple[Array, Tuple]:
+        x = self.head(x)
+        head = x
+        x, blocks, states = self.encoders(x, states)
+        for res in self.resblocks:
+            x = res(x)
+        for i, dec in enumerate(self.decoders):
+            x = dec(self._skip(x, blocks[self.num_encoders - i - 1]))
+        img = self.pred(self._skip(x, head))
+        return self._final_act(img), states
+
+
+class UNetFlow(_UNetBase):
+    """Recurrent UNet with combined image+flow prediction
+    (reference ``unet.py:170-227``): 3 output channels, split into
+    ``{'image': [..., :1], 'flow': [..., 1:3]}``."""
+
+    def setup(self):
+        k = self.kernel_size
+        self.head = ConvLayer(
+            self.base_num_channels, k, stride=1, padding=k // 2
+        )
+        self.encoders = _RecurrentEncoderStack(
+            self.encoder_output_sizes, k, self.recurrent_block_type, self.norm
+        )
+        self.resblocks = [
+            ResidualBlock(self.max_num_channels, norm=self.norm, name=f"res_{i}")
+            for i in range(self.num_residual_blocks)
+        ]
+        self.decoders = [
+            self._upsample_layer(c, name=f"decoder_{i}")
+            for i, c in enumerate(reversed(self.encoder_input_sizes))
+        ]
+        self.pred = ConvLayer(3, 1, activation=None, norm=None)
+
+    def __call__(self, x: Array, states: Tuple):
+        x = self.head(x)
+        head = x
+        x, blocks, states = self.encoders(x, states)
+        for res in self.resblocks:
+            x = res(x)
+        for i, dec in enumerate(self.decoders):
+            x = dec(self._skip(x, blocks[self.num_encoders - i - 1]))
+        img_flow = self.pred(self._skip(x, head))
+        return (
+            {"image": img_flow[..., 0:1], "flow": img_flow[..., 1:3]},
+            states,
+        )
+
+
+class MultiResUNet(_UNetBase):
+    """Stateless UNet with a prediction at every decoder scale
+    (reference ``unet.py:304-390``). ``skip_type`` is forced to concat, the
+    first encoder consumes the raw input (no head), and each prediction is
+    concatenated into the next decoder's input."""
+
+    def setup(self):
+        k = self.kernel_size
+        self.enc = [
+            ConvLayer(
+                c,
+                k,
+                stride=2,
+                padding=k // 2,
+                norm=self.norm,
+                name=f"encoder_{i}",
+            )
+            for i, c in enumerate(self.encoder_output_sizes)
+        ]
+        self.resblocks = [
+            ResidualBlock(self.max_num_channels, norm=self.norm, name=f"res_{i}")
+            for i in range(self.num_residual_blocks)
+        ]
+        self.decoders = [
+            self._upsample_layer(c, name=f"decoder_{i}")
+            for i, c in enumerate(reversed(self.encoder_input_sizes))
+        ]
+        self.preds = [
+            ConvLayer(
+                self.num_output_channels,
+                1,
+                activation=self.final_activation
+                if self.final_activation not in (None, "none")
+                else None,
+                norm=self.norm,
+                name=f"pred_{i}",
+            )
+            for i, _ in enumerate(reversed(self.encoder_input_sizes))
+        ]
+
+    def __call__(self, x: Array) -> List[Array]:
+        blocks = []
+        for enc in self.enc:
+            x = enc(x)
+            blocks.append(x)
+        for res in self.resblocks:
+            x = res(x)
+        predictions: List[Array] = []
+        for i, (dec, pred) in enumerate(zip(self.decoders, self.preds)):
+            x = skip_concat(x, blocks[self.num_encoders - i - 1])
+            if i > 0:
+                x = skip_concat(predictions[-1], x)
+            x = dec(x)
+            predictions.append(pred(x))
+        return predictions
+
+
+class SRUNetRecurrent(_UNetBase):
+    """SR recurrent UNet: output at 2x the input resolution
+    (reference ``unet.py:393-498``).
+
+    Decoder ``i=0`` upsamples x4, the rest x2; every skip path (including the
+    head) goes through its own x2 upsampler, and the zero-pad/crop alignment
+    inside ``skip_*`` reconciles the staggered resolutions exactly as the
+    reference's ``ZeroPad2d`` calls do."""
+
+    def setup(self):
+        assert self.use_upsample_conv, (
+            "SRUNetRecurrent needs use_upsample_conv=True (x4 decoders)"
+        )
+        k = self.kernel_size
+        self.head = ConvLayer(
+            self.base_num_channels, k, stride=1, padding=k // 2
+        )
+        self.encoders = _RecurrentEncoderStack(
+            self.encoder_output_sizes, k, self.recurrent_block_type, self.norm
+        )
+        self.resblocks = [
+            ResidualBlock(self.max_num_channels, norm=self.norm, name=f"res_{i}")
+            for i in range(self.num_residual_blocks)
+        ]
+        self.decoders = [
+            self._upsample_layer(c, scale=4 if i == 0 else 2, name=f"decoder_{i}")
+            for i, c in enumerate(reversed(self.encoder_input_sizes))
+        ]
+        skip_sizes = list(reversed(self.encoder_output_sizes)) + [
+            self.base_num_channels
+        ]
+        self.skip_upsampler = [
+            self._upsample_layer(c, scale=2, name=f"skip_up_{i}")
+            for i, c in enumerate(skip_sizes)
+        ]
+        self.pred = ConvLayer(
+            self.num_output_channels, 1, activation=None, norm=self.norm
+        )
+
+    def __call__(self, x: Array, states: Tuple) -> Tuple[Array, Tuple]:
+        x = self.head(x)
+        head = x
+        x, blocks, states = self.encoders(x, states)
+        for res in self.resblocks:
+            x = res(x)
+        for i, dec in enumerate(self.decoders):
+            x = dec(
+                self._skip(
+                    x, self.skip_upsampler[i](blocks[self.num_encoders - i - 1])
+                )
+            )
+        img = self.pred(self._skip(x, self.skip_upsampler[-1](head)))
+        return self._final_act(img), states

@@ -1,0 +1,105 @@
+"""UNet family: shapes, recurrent state threading, registry.
+
+Shape oracle: the reference's ``__main__`` smoke test
+(``/root/reference/models/unet.py:501-521``) runs SRUNetRecurrent on
+``[2, 5, 8, 8]`` with 3 encoders/convlstm and doubles the resolution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.models.registry import get_model
+from esr_tpu.models.unet import (
+    MultiResUNet,
+    SRUNetRecurrent,
+    UNetFlow,
+    UNetRecurrent,
+)
+
+KW = dict(
+    base_num_channels=8,
+    num_encoders=3,
+    num_residual_blocks=2,
+    num_output_channels=5,
+    skip_type="sum",
+    norm=None,
+    use_upsample_conv=True,
+    num_bins=5,
+    recurrent_block_type="convlstm",
+    kernel_size=5,
+)
+
+
+def _init(model, shape, with_states=True):
+    x = jnp.zeros(shape, jnp.float32)
+    if with_states:
+        states = model.init_states(shape[0], shape[1], shape[2])
+        params = model.init(jax.random.PRNGKey(0), x, states)
+        return x, states, params
+    params = model.init(jax.random.PRNGKey(0), x)
+    return x, None, params
+
+
+@pytest.mark.slow
+def test_srunet_recurrent_doubles_resolution():
+    """Reference smoke test: 8x8 in -> 16x16 out (unet.py:501-521)."""
+    model = SRUNetRecurrent(**KW)
+    x, states, params = _init(model, (2, 8, 8, 5))
+    out, new_states = model.apply(params, x, states)
+    assert out.shape == (2, 16, 16, 5)
+    assert len(new_states) == 3
+    # convlstm states: (hidden, cell) per encoder at halved resolutions
+    assert new_states[0][0].shape == (2, 4, 4, 16)
+    assert new_states[2][1].shape == (2, 1, 1, 64)
+
+
+@pytest.mark.slow
+def test_srunet_concat_skip_and_bigger_input():
+    model = SRUNetRecurrent(**{**KW, "skip_type": "concat",
+                               "recurrent_block_type": "convgru",
+                               "num_output_channels": 2})
+    x, states, params = _init(model, (1, 16, 16, 5))
+    out, _ = model.apply(params, x, states)
+    assert out.shape == (1, 32, 32, 2)
+
+
+@pytest.mark.slow
+def test_unet_recurrent_same_resolution_and_state_evolution():
+    model = UNetRecurrent(**{**KW, "num_output_channels": 1})
+    x, states, params = _init(model, (2, 16, 16, 5))
+    out, s1 = model.apply(params, x, states)
+    assert out.shape == (2, 16, 16, 1)
+    # states actually evolve and feed back
+    ones = jnp.ones_like(x)
+    out_a, s2 = model.apply(params, ones, s1)
+    out_b, _ = model.apply(params, ones, model.init_states(2, 16, 16))
+    assert not np.allclose(np.asarray(out_a), np.asarray(out_b))
+
+
+@pytest.mark.slow
+def test_unet_flow_heads():
+    model = UNetFlow(**{**KW, "num_output_channels": 3})
+    x, states, params = _init(model, (1, 16, 16, 5))
+    out, _ = model.apply(params, x, states)
+    assert out["image"].shape == (1, 16, 16, 1)
+    assert out["flow"].shape == (1, 16, 16, 2)
+
+
+@pytest.mark.slow
+def test_multires_unet_prediction_pyramid():
+    model = MultiResUNet(**{**KW, "skip_type": "concat",
+                            "recurrent_block_type": None,
+                            "num_output_channels": 1})
+    x, _, params = _init(model, (1, 16, 16, 5), with_states=False)
+    preds = model.apply(params, x)
+    assert [p.shape for p in preds] == [
+        (1, 4, 4, 1), (1, 8, 8, 1), (1, 16, 16, 1)
+    ]
+
+
+def test_unets_registered():
+    for name in ("UNetFlow", "UNetRecurrent", "MultiResUNet", "SRUNetRecurrent"):
+        m = get_model(name, base_num_channels=4, num_encoders=2, num_bins=5)
+        assert m.base_num_channels == 4
