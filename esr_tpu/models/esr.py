@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from esr_tpu.ops.dcn import dcn_offsets_from_conv, deform_conv2d
+from esr_tpu.ops.dcn import dcn_offsets_from_conv, deform_conv2d_auto
 from esr_tpu.models.layers import (
     ConvLayer,
     ConvGRUCell,
@@ -159,6 +159,7 @@ class STFusion(nn.Module):
     has_dcnatten: bool = True
     has_scaleaggre: bool = True
     deformable_groups: int = 8
+    dcn_impl: str = "auto"  # 'auto' -> Pallas kernel on TPU, jnp elsewhere
 
     def setup(self):
         assert self.has_dcnatten or self.has_scaleaggre
@@ -225,7 +226,10 @@ class STFusion(nn.Module):
         )
         offsets, mask = dcn_offsets_from_conv(raw, self.deformable_groups, 9)
         aligned = jax.nn.relu(
-            deform_conv2d(feat0, offsets, mask, self.dcn_weight, self.dcn_bias)
+            deform_conv2d_auto(
+                feat0, offsets, mask, self.dcn_weight, self.dcn_bias,
+                impl=self.dcn_impl,
+            )
         )
         feat = self.post_dcn(jnp.concatenate([aligned, feat1], axis=-1))
         sk = self.spatial_kernel(feat)  # [B, H, W, 2]
@@ -298,6 +302,7 @@ class DeepRecurrNet(nn.Module):
     gtc_frozen: bool = False
     has_dcnatten: bool = True
     has_scaleaggre: bool = True
+    dcn_impl: str = "auto"
 
     down_scale: int = 8
 
@@ -316,7 +321,7 @@ class DeepRecurrNet(nn.Module):
         self.spacetime_fuse = STFusion(
             channels=c, num_frame=self.num_frame, norm=self.norm,
             activation=self.activation, has_dcnatten=self.has_dcnatten,
-            has_scaleaggre=self.has_scaleaggre,
+            has_scaleaggre=self.has_scaleaggre, dcn_impl=self.dcn_impl,
         )
         self.tail = ConvLayer(
             self.inch, 3, padding=1, activation="relu", norm=self.norm

@@ -1,0 +1,248 @@
+"""Pallas TPU kernel for modulated deformable convolution (DCNv2).
+
+The fused fast path promised by SURVEY.md §7.1-3 phase B, replacing the
+reference's CUDA im2col + GEMM pair
+(``/root/reference/models/DCNv2/src/cuda/dcn_v2_im2col_cuda.cu:125+``,
+``dcn_v2_cuda.cu:78-92``) — and the HBM round-trip of the jnp fallback
+(``esr_tpu.ops.dcn.deform_conv2d`` materializes the ``[B, dg, Ho, Wo, K, Cg]``
+column tensor in HBM; this kernel never does).
+
+TPU-native formulation
+----------------------
+A CUDA-style per-thread scalar gather does not map to the TPU's vector units,
+so the bilinear gather is recast as **one-hot matrix multiplication** on the
+MXU, operating entirely in VMEM:
+
+- host-side (XLA-fused elementwise): sampling positions = base grid + learned
+  offsets; decomposed into 4 integer corner indices (flattened, clipped) and
+  4 bilinear corner weights, pre-multiplied by the sigmoid modulation mask and
+  zeroed outside the image (the ``dmcn_im2col_bilinear_cuda`` boundary rule);
+- kernel, per batch image: for each deformable group ``g`` and kernel tap
+  ``k``, build the weighted selection matrix
+  ``S[hw, o] = Σ_corners (hw == idx_c[o]) · w_c[o]`` with vector compares
+  against an iota (no scatter), then two MXU contractions
+  ``colsᵀ = imgᵀ_g · S`` and ``acc += Wᵀ_{g,k} · colsᵀ``;
+- matmuls run at ``Precision.HIGHEST``: the MXU's default bf16 rounding is a
+  *gather corruption* here (values, not just precision, change) — verified
+  exact against ``jnp.take`` at f32.
+
+Everything lives in VMEM for one batch image (feature maps at the ESR
+bottleneck are tiny: ``H/8 × W/8 × 8·basech``), so the only HBM traffic is
+the input read and output write.
+
+The backward pass is the jnp formulation's VJP via ``jax.custom_vjp`` — the
+transpose of the gather is exactly the reference's atomicAdd col2im scatter
+(``dcn_v2_im2col_cuda.cu:56-123``), and XLA autodiff of the gather emits it.
+Gradients are therefore bit-identical to the jnp path the tests pin.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from esr_tpu.ops import dcn as _dcn_jnp
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _corner_decomposition(
+    offsets: jax.Array,
+    mask: jax.Array,
+    h: int,
+    w: int,
+    stride: int,
+    padding: int,
+    dilation: int,
+    kh: int,
+    kw: int,
+    hw_pad: int,
+    no_pad: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sampling positions -> 4 (index, weight) corner pairs per tap.
+
+    Returns ``idx [B, dg, 4, K, No_pad] int32`` and
+    ``wgt [B, dg, 4, K, No_pad] f32`` (mask-premultiplied, zero when the
+    corner falls outside the image or in the No padding).
+    """
+    b, ho, wo, dg, k, _ = offsets.shape
+    no = ho * wo
+
+    oy = jnp.arange(ho) * stride - padding
+    ox = jnp.arange(wo) * stride - padding
+    ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+    tap_y = (ky * dilation).reshape(-1).astype(jnp.float32)
+    tap_x = (kx * dilation).reshape(-1).astype(jnp.float32)
+
+    base_y = oy[:, None, None, None].astype(jnp.float32) + tap_y[None, None, None, :]
+    base_x = ox[None, :, None, None].astype(jnp.float32) + tap_x[None, None, None, :]
+    ys = base_y[None] + offsets[..., 0]  # [B, Ho, Wo, dg, K]
+    xs = base_x[None] + offsets[..., 1]
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    dy = ys - y0
+    dx = xs - x0
+
+    idxs, wgts = [], []
+    for cy, cx, cw in (
+        (0, 0, (1 - dy) * (1 - dx)),
+        (0, 1, (1 - dy) * dx),
+        (1, 0, dy * (1 - dx)),
+        (1, 1, dy * dx),
+    ):
+        yi = y0.astype(jnp.int32) + cy
+        xi = x0.astype(jnp.int32) + cx
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        flat = jnp.clip(yi, 0, h - 1) * w + jnp.clip(xi, 0, w - 1)
+        idxs.append(jnp.where(inb, flat, 0))
+        wgts.append(jnp.where(inb, cw, 0.0) * mask)
+
+    # [B, Ho, Wo, dg, K, 4] -> [B, dg, 4, K, No]
+    idx = jnp.stack(idxs, axis=-1)
+    wgt = jnp.stack(wgts, axis=-1)
+    idx = idx.reshape(b, no, dg, k, 4).transpose(0, 2, 4, 3, 1)
+    wgt = wgt.reshape(b, no, dg, k, 4).transpose(0, 2, 4, 3, 1)
+
+    idx = jnp.pad(idx, ((0, 0), (0, 0), (0, 0), (0, 0), (0, no_pad - no)))
+    wgt = jnp.pad(wgt, ((0, 0), (0, 0), (0, 0), (0, 0), (0, no_pad - no)))
+    return idx.astype(jnp.int32), wgt.astype(jnp.float32)
+
+
+def _dcn_kernel(xt_ref, idx_ref, wgt_ref, wt_ref, out_ref, *, dg, cg, k, hw_pad, no_pad, cout):
+    from jax.experimental import pallas as pl  # noqa: F401  (kept for clarity)
+
+    HIGH = jax.lax.Precision.HIGHEST
+    iota = jax.lax.broadcasted_iota(jnp.int32, (hw_pad, no_pad), 0)
+
+    acc = jnp.zeros((cout, no_pad), jnp.float32)
+    for g in range(dg):
+        img_g = xt_ref[0, g * cg : (g + 1) * cg, :]  # [Cg, HWp]
+        for kk in range(k):
+            s = jnp.zeros((hw_pad, no_pad), jnp.float32)
+            for c in range(4):
+                iv = idx_ref[0, g, c, kk, :]  # [Nop] lane vector
+                wv = wgt_ref[0, g, c, kk, :]
+                s = s + jnp.where(iota == iv[None, :], wv[None, :], 0.0)
+            # colsT [Cg, Nop] = imgT_g [Cg, HWp] @ S [HWp, Nop]
+            cols = jax.lax.dot_general(
+                img_g, s, (((1,), (0,)), ((), ())),
+                precision=HIGH, preferred_element_type=jnp.float32,
+            )
+            # acc [Cout, Nop] += Wt[g, kk] [Cout, Cg] @ colsT
+            acc = acc + jax.lax.dot_general(
+                wt_ref[g, kk], cols, (((1,), (0,)), ((), ())),
+                precision=HIGH, preferred_element_type=jnp.float32,
+            )
+    out_ref[0] = acc
+
+
+def _pallas_forward(
+    x: jax.Array,
+    offsets: jax.Array,
+    mask: jax.Array,
+    weight: jax.Array,
+    stride: int,
+    padding: int,
+    dilation: int,
+    interpret: bool,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, w, cin = x.shape
+    kh, kw, wcin, cout = weight.shape
+    _, ho, wo, dg, k, _ = offsets.shape
+    assert wcin == cin and k == kh * kw and cin % dg == 0
+    cg = cin // dg
+    no = ho * wo
+    hw_pad = _round_up(h * w, 128)
+    no_pad = _round_up(no, 128)
+
+    idx, wgt = _corner_decomposition(
+        offsets, mask, h, w, stride, padding, dilation, kh, kw, hw_pad, no_pad
+    )
+
+    # x [B, H, W, C] -> xT [B, C, HWp]
+    xt = x.reshape(b, h * w, cin).transpose(0, 2, 1)
+    xt = jnp.pad(xt, ((0, 0), (0, 0), (0, hw_pad - h * w)))
+    # weight HWIO -> [dg, K, Cout, Cg]
+    wt = weight.reshape(k, dg, cg, cout).transpose(1, 0, 3, 2)
+
+    kernel = functools.partial(
+        _dcn_kernel, dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_pad=no_pad, cout=cout
+    )
+    out_t = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, cin, hw_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_pad), lambda i: (i, 0, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_pad), lambda i: (i, 0, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((dg, k, cout, cg), lambda i: (0, 0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, cout, no_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, cout, no_pad), jnp.float32),
+        interpret=interpret,
+    )(xt, idx, wgt, wt)
+
+    # [B, Cout, Nop] -> [B, Ho, Wo, Cout]
+    return out_t[:, :, :no].transpose(0, 2, 1).reshape(b, ho, wo, cout)
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def deform_conv2d_pallas(
+    x: jax.Array,
+    offsets: jax.Array,
+    mask: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array] = None,
+    stride: int = 1,
+    padding: int = 1,
+    dilation: int = 1,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in replacement for :func:`esr_tpu.ops.dcn.deform_conv2d` with the
+    fused Pallas forward. ``interpret=None`` auto-selects interpreter mode on
+    CPU backends (tests) and compiled Mosaic on TPU."""
+    interp = _auto_interpret() if interpret is None else interpret
+    out = _pallas_forward(x, offsets, mask, weight, stride, padding, dilation, interp)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _fwd(x, offsets, mask, weight, bias, stride, padding, dilation, interpret):
+    out = deform_conv2d_pallas(
+        x, offsets, mask, weight, bias, stride, padding, dilation, interpret
+    )
+    return out, (x, offsets, mask, weight, bias)
+
+
+def _bwd(stride, padding, dilation, interpret, res, g):
+    x, offsets, mask, weight, bias = res
+
+    def ref_fn(x_, offsets_, mask_, weight_, bias_):
+        return _dcn_jnp.deform_conv2d(
+            x_, offsets_, mask_, weight_,
+            bias_ if bias is not None else None,
+            stride=stride, padding=padding, dilation=dilation,
+        )
+
+    _, vjp = jax.vjp(ref_fn, x, offsets, mask, weight, bias)
+    gx, goff, gmask, gw, gb = vjp(g)
+    return gx, goff, gmask, gw, (gb if bias is not None else None)
+
+
+deform_conv2d_pallas.defvjp(_fwd, _bwd)
