@@ -231,14 +231,33 @@ class EventWindowDataset:
         down_scaled_cnt = np.floor_divide(self._scaled(down_norm, self.inp_resolution, "cnt"), k2)
         return down_cnt, down_scaled_cnt
 
+    #: every key :meth:`get_item` can produce (reference item schema,
+    #: ``h5dataset.py:374-408``)
+    ALL_KEYS = (
+        "inp_stack", "inp_cnt",
+        "inp_bicubic_cnt", "inp_bicubic_stack",
+        "inp_near_cnt", "inp_near_stack",
+        "inp_scaled_cnt", "inp_scaled_stack",
+        "inp_down_cnt", "inp_down_scaled_cnt",
+        "gt_stack", "gt_cnt", "gt_img", "gt_inp_size_img", "frame",
+    )
+
     def get_item(self, index: int, pause: bool = False, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
-        """Build the ~17-key tensor dict for one window (``h5dataset.py:271-408``).
+        """Build the tensor dict for one window (``h5dataset.py:271-408``).
 
         All arrays are channel-last float32: counts ``[H, W, 2]``, stacks
         ``[H, W, TB]``, frames ``[H, W, 1]``.
+
+        Which keys are built is controlled by ``config['item_keys']``
+        (default: all of :attr:`ALL_KEYS`, reference parity). The reference
+        unconditionally rasterizes every encoding on the CPU workers; per-key
+        laziness is the main host-pipeline throughput lever — training needs
+        only 2-4 of the ~17 streams, and each unused stream costs a
+        scatter-add or a resize per item.
         """
         if seed is None:
             seed = int(np.random.randint(0, 2**31 - 1))
+        keys = self.config.get("item_keys") or self.ALL_KEYS
         idx0, idx1 = (int(i) for i in self.event_indices[index])
 
         if pause:
@@ -257,66 +276,111 @@ class EventWindowDataset:
                 )
                 inp_ev = np.concatenate([inp_ev, noise], axis=1)
 
-        if self.need_gt_events:
-            gt_idx0, gt_idx1 = (int(i) for i in self.gt_event_indices[index])
-            gt_ev = self.gt_stream.window(gt_idx0, gt_idx1)
-            if self.augment_cfg["enabled"]:
-                gt_ev = self._augment_events(gt_ev, self.gt_resolution, seed)
-            gt_ev = self._format(gt_ev)
-        else:
-            gt_ev = np.zeros((4, 0), np.float32)
-
         h, w = self.inp_resolution
         kh, kw = self.gt_resolution
 
-        inp_stack = self._stack(inp_ev, self.inp_resolution)
-        inp_cnt = self._cnt(inp_ev, self.inp_resolution)
-        norm_ev = self._normalized(inp_ev, self.inp_resolution)
-        item = {
+        # lazily-shared intermediates
+        cache: Dict[str, np.ndarray] = {}
+
+        def gt_ev():
+            if "gt_ev" not in cache:
+                if self.need_gt_events:
+                    g0, g1 = (int(i) for i in self.gt_event_indices[index])
+                    ev = self.gt_stream.window(g0, g1)
+                    if self.augment_cfg["enabled"]:
+                        ev = self._augment_events(ev, self.gt_resolution, seed)
+                    cache["gt_ev"] = self._format(ev)
+                else:
+                    cache["gt_ev"] = np.zeros((4, 0), np.float32)
+            return cache["gt_ev"]
+
+        def inp_cnt():
+            if "inp_cnt" not in cache:
+                cache["inp_cnt"] = self._cnt(inp_ev, self.inp_resolution)
+            return cache["inp_cnt"]
+
+        def inp_stack():
+            if "inp_stack" not in cache:
+                cache["inp_stack"] = self._stack(inp_ev, self.inp_resolution)
+            return cache["inp_stack"]
+
+        def norm_ev():
+            if "norm_ev" not in cache:
+                cache["norm_ev"] = self._normalized(inp_ev, self.inp_resolution)
+            return cache["norm_ev"]
+
+        def gt_frame_pair():
+            if "gt_img" not in cache:
+                gt_img = np.zeros((kh, kw, 1), np.float32)
+                gt_img_inp = np.zeros((h, w, 1), np.float32)
+                if self.need_gt_frame:
+                    # GT frame at the mid-window ts (h5dataset.py:477-487)
+                    ref_idx = (idx0 + idx1) // 2
+                    t = self.inp_stream.ts[ref_idx]
+                    fi = int(np.clip(
+                        np.searchsorted(self.recording.frame_ts, t, side="left"),
+                        0,
+                        self.recording.num_frames - 1,
+                    ))
+                    raw = self.recording.frame(fi)
+                    if self.augment_cfg["enabled"]:
+                        raw = self._augment_frame(raw, seed)
+                    raw = raw.astype(np.float32)[..., None] / 255.0
+                    gt_img = _resize(raw, (kh, kw), "bicubic")
+                    gt_img_inp = _resize(raw, (h, w), "bicubic")
+                cache["gt_img"] = gt_img
+                cache["gt_inp_size_img"] = gt_img_inp
+            return cache["gt_img"], cache["gt_inp_size_img"]
+
+        def unsupervised():
+            if "inp_down_cnt" not in cache:
+                down_cnt, down_scaled = self._unsupervised(norm_ev())
+                cache["inp_down_cnt"] = down_cnt
+                cache["inp_down_scaled_cnt"] = down_scaled
+            return cache["inp_down_cnt"], cache["inp_down_scaled_cnt"]
+
+        builders = {
             "inp_stack": inp_stack,
             "inp_cnt": inp_cnt,
-            "inp_bicubic_cnt": _resize(inp_cnt, (kh, kw), "bicubic"),
-            "inp_bicubic_stack": _resize(inp_stack, (kh, kw), "bicubic"),
-            "inp_near_cnt": _resize(inp_cnt, (kh, kw), "nearest"),
-            "inp_near_stack": _resize(inp_stack, (kh, kw), "nearest"),
-            "inp_scaled_cnt": self._scaled(norm_ev, self.gt_resolution, "cnt"),
-            "inp_scaled_stack": self._scaled(norm_ev, self.gt_resolution, "stack"),
+            "inp_bicubic_cnt": lambda: _resize(inp_cnt(), (kh, kw), "bicubic"),
+            "inp_bicubic_stack": lambda: _resize(inp_stack(), (kh, kw), "bicubic"),
+            "inp_near_cnt": lambda: _resize(inp_cnt(), (kh, kw), "nearest"),
+            "inp_near_stack": lambda: _resize(inp_stack(), (kh, kw), "nearest"),
+            "inp_scaled_cnt": lambda: self._scaled(norm_ev(), self.gt_resolution, "cnt"),
+            "inp_scaled_stack": lambda: self._scaled(norm_ev(), self.gt_resolution, "stack"),
+            "inp_down_cnt": lambda: unsupervised()[0],
+            "inp_down_scaled_cnt": lambda: unsupervised()[1],
+            "gt_stack": lambda: self._stack(gt_ev(), self.gt_resolution),
+            "gt_cnt": lambda: self._cnt(gt_ev(), self.gt_resolution),
+            "gt_img": lambda: gt_frame_pair()[0],
+            "gt_inp_size_img": lambda: gt_frame_pair()[1],
+            "frame": lambda: self._mode_frame(index, seed),
         }
-        item["inp_down_cnt"], item["inp_down_scaled_cnt"] = self._unsupervised(norm_ev)
-        item["gt_stack"] = self._stack(gt_ev, self.gt_resolution)
-        item["gt_cnt"] = self._cnt(gt_ev, self.gt_resolution)
+        item = {k: builders[k]() for k in keys}
 
-        # GT frame at the mid-window timestamp (``h5dataset.py:477-487``)
-        gt_img = np.zeros((kh, kw, 1), np.float32)
-        gt_img_inp = np.zeros((h, w, 1), np.float32)
-        if self.need_gt_frame:
-            ref_idx = (idx0 + idx1) // 2
-            t = self.inp_stream.ts[ref_idx]
-            fi = int(np.clip(
-                np.searchsorted(self.recording.frame_ts, t, side="left"),
-                0,
-                self.recording.num_frames - 1,
-            ))
-            raw = self.recording.frame(fi)
-            if self.augment_cfg["enabled"]:
-                raw = self._augment_frame(raw, seed)
-            raw = raw.astype(np.float32)[..., None] / 255.0
-            gt_img = _resize(raw, (kh, kw), "bicubic")
-            gt_img_inp = _resize(raw, (h, w), "bicubic")
-        item["gt_img"] = gt_img
-        item["gt_inp_size_img"] = gt_img_inp
+        if self.custom_resolution is not None:
+            missing = [
+                k
+                for k in ("inp_cnt", "inp_scaled_cnt", "inp_down_cnt",
+                          "inp_down_scaled_cnt", "gt_cnt")
+                if k not in item
+            ]
+            if missing:
+                raise ValueError(
+                    f"custom_resolution needs item_keys to include {missing}"
+                )
+            item.update(self._custom_items(item))
+        return {k: np.ascontiguousarray(v, np.float32) for k, v in item.items()}
 
+    def _mode_frame(self, index: int, seed: int) -> np.ndarray:
+        kh, kw = self.gt_resolution
         frame = np.zeros((kh, kw, 1), np.float32)
         if self.config["mode"] == "frame":
             raw = self.recording.frame(index).astype(np.float32)[..., None] / 255.0
             if self.augment_cfg["enabled"]:
                 raw = self._augment_frame(raw, seed)
             frame = _resize(raw, (kh, kw), "bicubic")
-        item["frame"] = frame
-
-        if self.custom_resolution is not None:
-            item.update(self._custom_items(item))
-        return {k: np.ascontiguousarray(v, np.float32) for k, v in item.items()}
+        return frame
 
     __getitem__ = get_item
 

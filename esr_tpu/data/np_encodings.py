@@ -25,14 +25,13 @@ def events_to_image_np(
 ) -> np.ndarray:
     """Scatter-add events into ``[H, W]``; out-of-range events dropped."""
     h, w = sensor_size
-    img = np.zeros((h, w), np.float32)
     inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
-    np.add.at(
-        img,
-        (ys[inb].astype(np.int64), xs[inb].astype(np.int64)),
-        ps[inb].astype(np.float32),
-    )
-    return img
+    flat = ys[inb].astype(np.int64) * w + xs[inb].astype(np.int64)
+    # bincount >> np.add.at (unbuffered ufunc) on the host hot path; weights
+    # here are counts / ±1 polarities, so the f64 accumulate is exact and the
+    # f32 cast preserves bit-parity with the device scatter-add.
+    img = np.bincount(flat, weights=ps[inb], minlength=h * w)
+    return img.astype(np.float32).reshape(h, w)
 
 
 def events_to_channels_np(
@@ -62,12 +61,11 @@ def events_to_stack_np(
     rel = (ts - t0) / dt
     b = np.clip(np.floor(rel * num_bins).astype(np.int64), 0, num_bins - 1)
     inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
-    np.add.at(
-        out,
-        (ys[inb].astype(np.int64), xs[inb].astype(np.int64), b[inb]),
-        ps[inb].astype(np.float32),
-    )
-    return out
+    flat = (
+        ys[inb].astype(np.int64) * w + xs[inb].astype(np.int64)
+    ) * num_bins + b[inb]
+    binned = np.bincount(flat, weights=ps[inb], minlength=h * w * num_bins)
+    return binned.astype(np.float32).reshape(h, w, num_bins)
 
 
 def events_to_voxel_np(

@@ -114,32 +114,38 @@ def _corner_decomposition(
     return idx.astype(jnp.int32), wgt.astype(jnp.float32)
 
 
-def _dcn_kernel(xt_ref, idx_ref, wgt_ref, wt_ref, out_ref, *, dg, cg, k, hw_pad, no_pad, cout):
-    from jax.experimental import pallas as pl  # noqa: F401  (kept for clarity)
+def _dcn_kernel(xt_ref, idx_ref, wgt_ref, wt_ref, out_ref, *, dg, cg, k, hw_pad, no_tile, cout):
+    """One (batch image, output tile) per program; ``fori_loop`` over the
+    flattened (group, tap) pairs keeps VMEM to one S matrix at a time and
+    writes the f32 accumulator exactly once."""
+    from jax.experimental import pallas as pl
 
     HIGH = jax.lax.Precision.HIGHEST
-    iota = jax.lax.broadcasted_iota(jnp.int32, (hw_pad, no_pad), 0)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (hw_pad, no_tile), 0)
 
-    acc = jnp.zeros((cout, no_pad), jnp.float32)
-    for g in range(dg):
-        img_g = xt_ref[0, g * cg : (g + 1) * cg, :]  # [Cg, HWp]
-        for kk in range(k):
-            s = jnp.zeros((hw_pad, no_pad), jnp.float32)
-            for c in range(4):
-                iv = idx_ref[0, g, c, kk, :]  # [Nop] lane vector
-                wv = wgt_ref[0, g, c, kk, :]
-                s = s + jnp.where(iota == iv[None, :], wv[None, :], 0.0)
-            # colsT [Cg, Nop] = imgT_g [Cg, HWp] @ S [HWp, Nop]
-            cols = jax.lax.dot_general(
-                img_g, s, (((1,), (0,)), ((), ())),
-                precision=HIGH, preferred_element_type=jnp.float32,
-            )
-            # acc [Cout, Nop] += Wt[g, kk] [Cout, Cg] @ colsT
-            acc = acc + jax.lax.dot_general(
-                wt_ref[g, kk], cols, (((1,), (0,)), ((), ())),
-                precision=HIGH, preferred_element_type=jnp.float32,
-            )
-    out_ref[0] = acc
+    def body(i, acc):
+        g = i // k
+        kk = i % k
+        img_g = xt_ref[0, pl.ds(g * cg, cg), :]  # [Cg, HWp]
+        s = jnp.zeros((hw_pad, no_tile), jnp.float32)
+        for c in range(4):
+            iv = idx_ref[0, g, c, kk, :]  # [no_tile] lane vector
+            wv = wgt_ref[0, g, c, kk, :]
+            s = s + jnp.where(iota == iv[None, :], wv[None, :], 0.0)
+        # colsT [Cg, no_tile] = imgT_g [Cg, HWp] @ S [HWp, no_tile]
+        cols = jax.lax.dot_general(
+            img_g, s, (((1,), (0,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+        # acc [Cout, no_tile] += Wt[g, kk] [Cout, Cg] @ colsT
+        return acc + jax.lax.dot_general(
+            wt_ref[g, kk], cols, (((1,), (0,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+
+    out_ref[0] = jax.lax.fori_loop(
+        0, dg * k, body, jnp.zeros((cout, no_tile), jnp.float32)
+    )
 
 
 def _pallas_forward(
@@ -162,7 +168,17 @@ def _pallas_forward(
     cg = cin // dg
     no = ho * wo
     hw_pad = _round_up(h * w, 128)
-    no_pad = _round_up(no, 128)
+    # Output-pixel tiling bounds the S matrix (and iota) to
+    # [hw_pad, no_tile] f32 in VMEM; shrink the tile as the image grows.
+    if hw_pad <= 1024:
+        cap = 512
+    elif hw_pad <= 4096:
+        cap = 256
+    else:
+        cap = 128
+    no_tile = min(cap, _round_up(no, 128))
+    no_pad = _round_up(no, no_tile)
+    n_tiles = no_pad // no_tile
 
     idx, wgt = _corner_decomposition(
         offsets, mask, h, w, stride, padding, dilation, kh, kw, hw_pad, no_pad
@@ -175,19 +191,19 @@ def _pallas_forward(
     wt = weight.reshape(k, dg, cg, cout).transpose(1, 0, 3, 2)
 
     kernel = functools.partial(
-        _dcn_kernel, dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_pad=no_pad, cout=cout
+        _dcn_kernel, dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout
     )
     out_t = pl.pallas_call(
         kernel,
-        grid=(b,),
+        grid=(b, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, cin, hw_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, dg, 4, k, no_pad), lambda i: (i, 0, 0, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, dg, 4, k, no_pad), lambda i: (i, 0, 0, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((dg, k, cout, cg), lambda i: (0, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cin, hw_pad), lambda i, t: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+            pl.BlockSpec((dg, k, cout, cg), lambda i, t: (0, 0, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, cout, no_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            (1, cout, no_tile), lambda i, t: (i, 0, t), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((b, cout, no_pad), jnp.float32),
         interpret=interpret,

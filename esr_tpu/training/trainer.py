@@ -91,9 +91,23 @@ class Trainer:
         self.is_main = self.shard_id == 0
         np.random.seed(run.seed + self.shard_id)
 
-        # data
+        # data — build only the streams the loop consumes (per-key laziness
+        # in EventWindowDataset is the host-throughput lever; the reference
+        # rasterizes all ~17 unconditionally). A user-set item_keys wins.
+        vis_cfg0 = trainer_cfg.get("vis", {}) or {}
+        train_keys = ["inp_scaled_cnt", "gt_cnt"]
+        if vis_cfg0.get("enabled", False):
+            train_keys += ["inp_cnt", "gt_img"]
+
+        def _loader_cfg(block, keys):
+            import copy
+
+            cfg = copy.deepcopy(block)
+            cfg["dataset"].setdefault("item_keys", keys)
+            return cfg
+
         self.train_loader = build_train_loader(
-            config["train_dataloader"],
+            _loader_cfg(config["train_dataloader"], train_keys),
             self.shard_id,
             self.num_shards,
             seed=run.seed,
@@ -101,7 +115,9 @@ class Trainer:
         self.valid_loader = None
         if config.get("valid_dataloader") is not None:
             self.valid_loader = build_train_loader(
-                config["valid_dataloader"],
+                _loader_cfg(
+                    config["valid_dataloader"], ["inp_scaled_cnt", "gt_cnt"]
+                ),
                 self.shard_id,
                 self.num_shards,
                 seed=run.seed,
