@@ -9,6 +9,10 @@ from esr_tpu.ops import encodings as E
 from esr_tpu.ops.resize import interpolate
 
 
+
+# heavy parity/integration module -> excluded from the fast tier
+pytestmark = pytest.mark.slow
+
 def _rand_events(n, h, w, rng, frac=True):
     xs = rng.random(n).astype(np.float32) * w if frac else rng.integers(0, w, n)
     ys = rng.random(n).astype(np.float32) * h if frac else rng.integers(0, h, n)
@@ -63,6 +67,7 @@ def test_interpolate_np_matches_device_resize():
 # ---------------------------------------------------------------------------
 
 from esr_tpu.data import (
+
     ConcatSequenceDataset,
     EventWindowDataset,
     H5Recording,

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from esr_tpu.losses import (
+
     BrightnessConstancy,
     LPIPS,
     averaged_iwe,
@@ -20,6 +21,10 @@ from esr_tpu.losses import (
 
 # --- SSIM: independent numpy re-derivation of scikit-image's algorithm ----
 
+
+
+# heavy parity/integration module -> excluded from the fast tier
+pytestmark = pytest.mark.slow
 
 def _ssim_numpy(x, y, data_range=1.0, win=7, k1=0.01, k2=0.03):
     from numpy.lib.stride_tricks import sliding_window_view

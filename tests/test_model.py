@@ -11,6 +11,9 @@ from esr_tpu.models import model_util
 from esr_tpu.models.esr import DeepRecurrNet
 from esr_tpu.models.registry import get_model
 
+# heavy parity/integration module -> excluded from the fast tier
+pytestmark = pytest.mark.slow
+
 
 def _make(b=1, n=3, h=32, w=32, basech=8, **kw):
     model = DeepRecurrNet(inch=2, basech=basech, num_frame=n, **kw)

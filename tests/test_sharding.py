@@ -7,6 +7,7 @@ single-device training bit-for-bit (up to reduction order)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from esr_tpu.models.esr import DeepRecurrNet
 from esr_tpu.parallel.mesh import (
@@ -17,6 +18,9 @@ from esr_tpu.parallel.mesh import (
 )
 from esr_tpu.training.optim import make_optimizer
 from esr_tpu.training.train_step import TrainState, make_train_step
+
+# heavy parity/integration module -> excluded from the fast tier
+pytestmark = pytest.mark.slow
 
 
 def test_eight_devices_available():

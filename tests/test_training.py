@@ -79,6 +79,7 @@ def _tiny_setup(b=2, L=4, h=16, w=16, seqn=3):
     return model, params, opt, batch
 
 
+@pytest.mark.slow
 def test_train_step_learns():
     model, params, opt, batch = _tiny_setup()
     step = jax.jit(make_train_step(model, opt, seqn=3))
@@ -93,6 +94,7 @@ def test_train_step_learns():
     assert metrics["loss_per_window"].shape == (2,)  # L - seqn + 1
 
 
+@pytest.mark.slow
 def test_train_step_remat_matches():
     model, params, opt, batch = _tiny_setup()
     s1 = TrainState.create(params, opt)
@@ -106,6 +108,7 @@ def test_train_step_remat_matches():
         np.testing.assert_allclose(np.array(a), np.array(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_eval_step():
     model, params, opt, batch = _tiny_setup()
     ev = jax.jit(make_eval_step(model, seqn=3))
