@@ -1,0 +1,11 @@
+"""Offline dataset tools: datalist generation, HDF5 packagers, converters."""
+
+from esr_tpu.tools.datalist import generate_datalist, write_txt
+from esr_tpu.tools.packagers import H5LadderPackager, H5Packager
+
+__all__ = [
+    "generate_datalist",
+    "write_txt",
+    "H5Packager",
+    "H5LadderPackager",
+]

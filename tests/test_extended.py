@@ -1,0 +1,93 @@
+"""Extended submodules: shapes + semantics of attention/knn/edge-conv."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.models.extended import (
+    Conv3DBlock,
+    Deconv3DBlock,
+    DenseEdgeConv,
+    DilatedBlock,
+    InceptionBlock,
+    MeanShift,
+    SelfAttention,
+    batch_distance_matrix,
+    group_knn,
+)
+
+
+def test_inception_and_dilated_block_shapes():
+    x = jnp.ones((2, 12, 14, 8))
+    m = InceptionBlock(features=16, kernel_size=3, dilation=2)
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(params, x).shape == (2, 12, 14, 16)
+
+    d = DilatedBlock(features=16, cardinality=2)
+    params = d.init(jax.random.PRNGKey(0), x)
+    assert d.apply(params, x).shape == (2, 12, 14, 16)
+
+
+def test_self_attention_shape_and_tied_qk():
+    x = jnp.asarray(np.random.default_rng(0).random((2, 17, 8)), jnp.float32)
+    m = SelfAttention(channels=8)
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape == x.shape
+    # only ONE qk projection exists (tied weights, reference :84-86)
+    names = set(params["params"].keys())
+    assert "qk" in names and "q_conv" not in names
+
+
+def test_conv3d_blocks():
+    x = jnp.ones((1, 4, 8, 8, 3))
+    m = Conv3DBlock(features=6)
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(params, x).shape == (1, 4, 8, 8, 6)
+
+    d = Deconv3DBlock(features=6)
+    params = d.init(jax.random.PRNGKey(0), x)
+    assert d.apply(params, x).shape == (1, 8, 16, 16, 6)
+
+
+def test_batch_distance_matrix():
+    rng = np.random.default_rng(1)
+    a = rng.random((2, 5, 3)).astype(np.float32)
+    b = rng.random((2, 7, 3)).astype(np.float32)
+    d = np.asarray(batch_distance_matrix(jnp.asarray(a), jnp.asarray(b)))
+    want = ((a[:, :, None] - b[:, None]) ** 2).sum(-1)
+    np.testing.assert_allclose(d, want, atol=1e-5)
+
+
+def test_group_knn_finds_nearest_and_dedups():
+    pts = jnp.asarray(
+        [[[0.0, 0.0], [1.0, 0.0], [1.0, 0.0], [5.0, 5.0]]], jnp.float32
+    )  # point 2 duplicates point 1
+    q = jnp.asarray([[[0.9, 0.0]]], jnp.float32)
+    nbr, idx, dist = group_knn(2, q, pts, unique=True)
+    assert idx.shape == (1, 1, 2)
+    # nearest is point 1; its duplicate (2) must NOT be second — point 0 is
+    assert int(idx[0, 0, 0]) == 1
+    assert int(idx[0, 0, 1]) == 0
+    np.testing.assert_allclose(np.asarray(dist[0, 0, 0]), 0.01, atol=1e-5)
+
+    nbr2, idx2, _ = group_knn(2, q, pts, unique=False)
+    assert set(np.asarray(idx2[0, 0]).tolist()) == {1, 2}
+
+
+def test_dense_edge_conv_shapes():
+    x = jnp.asarray(np.random.default_rng(2).random((2, 16, 6)), jnp.float32)
+    m = DenseEdgeConv(growth_rate=8, n=3, k=4)
+    params = m.init(jax.random.PRNGKey(0), x)
+    y, idx = m.apply(params, x)
+    # channels: (growth + C) + growth + growth = 6 + 3*8 = 30
+    assert y.shape == (2, 16, 30)
+    assert idx.shape == (2, 16, 4)
+
+
+def test_mean_shift():
+    x = jnp.full((1, 2, 2, 3), 255.0)
+    m = MeanShift(rgb_mean=(1.0, 1.0, 1.0), rgb_std=(1.0, 1.0, 1.0), sign=-1)
+    out = m(x)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)

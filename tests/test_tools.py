@@ -1,0 +1,112 @@
+"""Offline tools: datalist generation + HDF5 packagers round-trip."""
+
+import os
+
+import numpy as np
+import pytest
+
+from esr_tpu.tools.datalist import generate_datalist, write_txt
+from esr_tpu.tools.packagers import H5LadderPackager, H5Packager
+
+
+@pytest.fixture
+def h5_dir(tmp_path):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    for i in range(10):
+        (d / f"rec{i}.h5").write_bytes(b"")
+    return str(d)
+
+
+def test_datalist_modes(h5_dir, tmp_path):
+    train, valid = generate_datalist(h5_dir, mode=0, num=4, seed=1)
+    assert len(train) == 4 and valid == []
+    assert train == sorted(train)
+
+    train, valid = generate_datalist(h5_dir, mode=1, num=6, valid_num=3, seed=1)
+    assert len(train) == 6 and len(valid) == 3
+    assert not set(train) & set(valid)  # disjoint
+
+    train, valid = generate_datalist(h5_dir, mode=2, portion=0.7, seed=2)
+    assert len(train) == 7 and len(valid) == 3
+    assert sorted(train + valid) == sorted(set(train) | set(valid))
+
+    train, valid = generate_datalist(
+        h5_dir, mode=3, num=5, valid_num=2, valid_data_path=h5_dir, seed=3
+    )
+    assert len(train) == 5 and len(valid) == 2
+
+    # determinism
+    again, _ = generate_datalist(h5_dir, mode=0, num=4, seed=1)
+    assert again == sorted(generate_datalist(h5_dir, mode=0, num=4, seed=1)[0])
+
+    out = str(tmp_path / "train.txt")
+    write_txt(out, train)
+    assert open(out).read().splitlines() == train
+
+
+def test_ladder_packager_roundtrips_through_reader(tmp_path):
+    """Packager output must be readable by the training pipeline's
+    H5Recording (the reference format contract)."""
+    from esr_tpu.data.records import H5Recording
+
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "rec.h5")
+    rungs = ("down8", "down16")
+    with H5LadderPackager(path, rungs=rungs) as pk:
+        for rung, n in (("down8", 256), ("down16", 64)):
+            ts = np.sort(rng.random(n))
+            # two appends exercise the resizable datasets
+            half = n // 2
+            xs = rng.integers(0, 80, n).astype(np.int16)
+            ys = rng.integers(0, 45, n).astype(np.int16)
+            ps = rng.choice([-1.0, 1.0], n)
+            pk.package_events(rung, xs[:half], ys[:half], ts[:half], ps[:half])
+            pk.package_events(rung, xs[half:], ys[half:], ts[half:], ps[half:])
+        for i in range(3):
+            pk.package_image(
+                "down8", (rng.random((45, 80)) * 255).astype(np.uint8), i / 2.0
+            )
+        pk.add_metadata((720, 1280))
+
+    rec = H5Recording(path)
+    assert rec.sensor_resolution == (720, 1280)
+    s = rec.stream("down16")
+    assert s.num_events == 64
+    ev = s.window(0, 10)
+    assert ev.shape == (4, 10)
+    assert np.all(np.diff(rec.stream("down8").ts) >= 0)
+    rec.close()
+
+    import h5py
+
+    with h5py.File(path) as f:
+        img = f["down8_images/image000000001"]
+        assert img.attrs["timestamp"] == 0.5
+        assert "event_idx" in img.attrs
+
+
+def test_single_stream_packager(tmp_path):
+    import h5py
+
+    rng = np.random.default_rng(1)
+    path = str(tmp_path / "single.h5")
+    n = 100
+    ts = np.sort(rng.random(n))
+    ps = rng.choice([-1.0, 1.0], n)
+    with H5Packager(path) as pk:
+        pk.package_events(
+            rng.integers(0, 32, n), rng.integers(0, 24, n), ts, ps
+        )
+        pk.package_image((rng.random((24, 32)) * 255).astype(np.uint8), 0.25)
+        pk.package_flow(rng.random((24, 32, 2)).astype(np.float32), 0.25)
+        pk.add_metadata(
+            int((ps > 0).sum()), int((ps < 0).sum()), float(ts[0]), float(ts[-1]),
+            (24, 32),
+        )
+    with h5py.File(path) as f:
+        assert f.attrs["num_events"] == n
+        assert f.attrs["num_pos"] + f.attrs["num_neg"] == n
+        assert f["events/ts"].shape == (n,)
+        assert "event_idx" in f["images/image000000000"].attrs
+        assert f["flow/flow000000000"].shape == (24, 32, 2)
