@@ -37,7 +37,17 @@ def events_to_image_np(
 def events_to_channels_np(
     xs: np.ndarray, ys: np.ndarray, ps: np.ndarray, sensor_size: Tuple[int, int]
 ) -> np.ndarray:
-    """Two-channel count image ``[H, W, 2]`` (pos, neg)."""
+    """Two-channel count image ``[H, W, 2]`` (pos, neg).
+
+    Uses the native C++ kernel (``esr_tpu.native``) when available — the
+    loader hot path — with this numpy implementation as the always-correct
+    fallback (``ESR_TPU_NATIVE=0`` forces it).
+    """
+    from esr_tpu import native
+
+    out = native.rasterize_counts(xs, ys, ps, sensor_size)
+    if out is not None:
+        return out
     pos = events_to_image_np(xs, ys, (ps > 0).astype(np.float32), sensor_size)
     neg = events_to_image_np(xs, ys, (ps < 0).astype(np.float32), sensor_size)
     return np.stack([pos, neg], axis=-1)
@@ -51,11 +61,19 @@ def events_to_stack_np(
     num_bins: int,
     sensor_size: Tuple[int, int],
 ) -> np.ndarray:
-    """Signed time-binned stack ``[H, W, B]`` (half-open binning)."""
+    """Signed time-binned stack ``[H, W, B]`` (half-open binning).
+
+    Native C++ kernel when available; numpy fallback below.
+    """
     h, w = sensor_size
     out = np.zeros((h, w, num_bins), np.float32)
     if xs.size == 0:
         return out
+    from esr_tpu import native
+
+    nout = native.rasterize_stack(xs, ys, ts, ps, num_bins, sensor_size)
+    if nout is not None:
+        return nout
     t0 = ts.min()
     dt = ts.max() - t0 + 1e-6
     rel = (ts - t0) / dt

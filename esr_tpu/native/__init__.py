@@ -1,0 +1,145 @@
+"""Native host kernels: lazy g++ build + ctypes bindings.
+
+The TPU-native replacement for the reference's Cython data-path extensions
+(``/root/reference/dataloader/cython_cnt2event``, ``cython_event_redistribute``,
+``binary_search`` — built by its ``install.sh``): the hot host loops live in
+``host_kernels.cpp``, compiled on first use into a per-machine cache and bound
+via ctypes (no pybind11 in this image). Everything degrades gracefully — if no
+compiler is available the numpy mirrors keep working and :func:`available`
+returns False.
+
+Set ``ESR_TPU_NATIVE=0`` to force the numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "host_kernels.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_F32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_I64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+
+def _build() -> Optional[str]:
+    cache_dir = os.path.join(
+        os.path.expanduser("~"), ".cache", "esr_tpu_native"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    import hashlib
+
+    tag = hashlib.sha1(open(_SRC, "rb").read()).hexdigest()[:16]
+    so_path = os.path.join(cache_dir, f"host_kernels_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        _SRC, "-o", so_path + ".tmp",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so_path + ".tmp", so_path)
+        return so_path
+    except Exception:
+        # no OpenMP? retry without it
+        try:
+            cmd = [c for c in cmd if c != "-fopenmp"]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(so_path + ".tmp", so_path)
+            return so_path
+        except Exception:
+            return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("ESR_TPU_NATIVE", "1") == "0":
+        return None
+    so = _build()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    lib.rasterize_counts.argtypes = [
+        _F32, _F32, _F32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _F32
+    ]
+    lib.rasterize_stack.argtypes = [
+        _F32, _F32, _F32, _F32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, _F32,
+    ]
+    lib.rescatter_counts.argtypes = [
+        _F32, _F32, _F32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _F32
+    ]
+    lib.rasterize_counts_batch.argtypes = [
+        _F32, _F32, _F32, _I64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, _F32,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _c32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, np.float32)
+
+
+def rasterize_counts(xs, ys, ps, sensor_size) -> Optional[np.ndarray]:
+    """[H, W, 2] count image, or None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    h, w = sensor_size
+    xs, ys, ps = _c32(xs), _c32(ys), _c32(ps)
+    out = np.zeros((h, w, 2), np.float32)
+    lib.rasterize_counts(xs, ys, ps, len(xs), h, w, out)
+    return out
+
+
+def rasterize_stack(xs, ys, ts, ps, num_bins, sensor_size) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    h, w = sensor_size
+    xs, ys, ts, ps = _c32(xs), _c32(ys), _c32(ts), _c32(ps)
+    out = np.zeros((h, w, num_bins), np.float32)
+    lib.rasterize_stack(xs, ys, ts, ps, len(xs), num_bins, h, w, out)
+    return out
+
+
+def rescatter_counts(xs_norm, ys_norm, ps, sensor_size) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    h, w = sensor_size
+    xs, ys, ps = _c32(xs_norm), _c32(ys_norm), _c32(ps)
+    out = np.zeros((h, w, 2), np.float32)
+    lib.rescatter_counts(xs, ys, ps, len(xs), h, w, out)
+    return out
+
+
+def rasterize_counts_batch(xs, ys, ps, offsets, sensor_size) -> Optional[np.ndarray]:
+    """Concatenated events + ``offsets [items+1]`` -> [items, H, W, 2],
+    OpenMP-parallel over items."""
+    lib = _load()
+    if lib is None:
+        return None
+    h, w = sensor_size
+    xs, ys, ps = _c32(xs), _c32(ys), _c32(ps)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    items = len(offsets) - 1
+    out = np.zeros((items, h, w, 2), np.float32)
+    lib.rasterize_counts_batch(xs, ys, ps, offsets, items, h, w, out)
+    return out

@@ -1,0 +1,118 @@
+"""Native C++ host kernels: parity vs the numpy mirrors."""
+
+import numpy as np
+import pytest
+
+from esr_tpu import native
+
+
+def _events(n, h, w, seed, fringe=True):
+    rng = np.random.default_rng(seed)
+    xs = (rng.random(n) * (w + 2) - 1).astype(np.float32)  # incl. out-of-range
+    ys = (rng.random(n) * (h + 2) - 1).astype(np.float32)
+    if not fringe:
+        xs = np.clip(xs, 0, w - 1)
+        ys = np.clip(ys, 0, h - 1)
+    ts = np.sort(rng.random(n)).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    return xs, ys, ts, ps
+
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain / native lib"
+)
+
+
+def _np_counts(xs, ys, ps, size):
+    """Numpy fallback, bypassing the native dispatch."""
+    from esr_tpu.data.np_encodings import events_to_image_np
+
+    pos = events_to_image_np(xs, ys, (ps > 0).astype(np.float32), size)
+    neg = events_to_image_np(xs, ys, (ps < 0).astype(np.float32), size)
+    return np.stack([pos, neg], axis=-1)
+
+
+@requires_native
+def test_rasterize_counts_parity():
+    h, w = 13, 17
+    xs, ys, ts, ps = _events(2048, h, w, 0)
+    out = native.rasterize_counts(xs, ys, ps, (h, w))
+    np.testing.assert_array_equal(out, _np_counts(xs, ys, ps, (h, w)))
+    # empty input
+    e = np.zeros(0, np.float32)
+    assert native.rasterize_counts(e, e, e, (h, w)).sum() == 0
+
+
+@requires_native
+def test_rasterize_stack_parity():
+    from esr_tpu.data import np_encodings as NE
+
+    h, w = 9, 11
+    xs, ys, ts, ps = _events(1024, h, w, 1)
+    for tb in (1, 4):
+        out = native.rasterize_stack(xs, ys, ts, ps, tb, (h, w))
+        # force the numpy fallback path for the oracle
+        import os
+
+        os.environ["ESR_TPU_NATIVE"] = "0"
+        try:
+            import esr_tpu.native as nat
+
+            saved_lib, saved_tried = nat._lib, nat._tried
+            nat._lib, nat._tried = None, True
+            want = NE.events_to_stack_np(xs, ys, ts, ps, tb, (h, w))
+        finally:
+            nat._lib, nat._tried = saved_lib, saved_tried
+            os.environ.pop("ESR_TPU_NATIVE")
+        np.testing.assert_array_equal(out, want)
+
+
+@requires_native
+def test_rescatter_counts_matches_scaled_path():
+    h, w = 20, 24
+    rng = np.random.default_rng(2)
+    n = 512
+    xn = rng.random(n).astype(np.float32)
+    yn = rng.random(n).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    out = native.rescatter_counts(xn, yn, ps, (h, w))
+    want = _np_counts(xn * w, yn * h, ps, (h, w))
+    np.testing.assert_array_equal(out, want)
+
+
+@requires_native
+def test_rasterize_counts_batch():
+    h, w = 8, 10
+    rng = np.random.default_rng(3)
+    lens = [100, 0, 257, 31]
+    offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    n = int(offsets[-1])
+    xs = (rng.random(n) * w).astype(np.float32)
+    ys = (rng.random(n) * h).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], n).astype(np.float32)
+    out = native.rasterize_counts_batch(xs, ys, ps, offsets, (h, w))
+    assert out.shape == (4, h, w, 2)
+    for i in range(4):
+        a, b = offsets[i], offsets[i + 1]
+        np.testing.assert_array_equal(
+            out[i], _np_counts(xs[a:b], ys[a:b], ps[a:b], (h, w))
+        )
+    assert out[1].sum() == 0  # empty item
+
+
+def test_numpy_fallback_when_disabled(monkeypatch):
+    import esr_tpu.native as nat
+
+    monkeypatch.setattr(nat, "_lib", None)
+    monkeypatch.setattr(nat, "_tried", True)
+    assert nat.rasterize_counts(
+        np.zeros(1, np.float32), np.zeros(1, np.float32),
+        np.ones(1, np.float32), (4, 4)
+    ) is None  # caller falls back to numpy
+    from esr_tpu.data.np_encodings import events_to_channels_np
+
+    out = events_to_channels_np(
+        np.zeros(1, np.float32), np.zeros(1, np.float32),
+        np.ones(1, np.float32), (4, 4)
+    )
+    assert out[0, 0, 0] == 1.0
