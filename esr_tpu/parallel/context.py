@@ -1,0 +1,188 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence parallelism at all — its sequence dimension is
+a python loop on one device (SURVEY.md §2.3: "SP/CP: No") — but this
+framework treats long-context as first-class: when sequences outgrow one
+chip's HBM, shard the sequence axis over a mesh axis and compute attention
+with XLA collectives over ICI.
+
+Two standard strategies, both built on ``shard_map``:
+
+- :func:`ring_attention` — blockwise attention with the K/V shards rotated
+  around the ring via ``jax.lax.ppermute`` while a numerically-stable online
+  softmax accumulates partial outputs (the Ring Attention construction:
+  each device only ever holds ``seq/num_devices`` of K/V, memory is O(N/p)
+  per device, and communication overlaps the ``seq²/p`` compute).
+  Supports causal masking via global block offsets.
+- :func:`ulysses_attention` — the all-to-all alternative: transpose the
+  sharding from the sequence axis to the heads axis
+  (``jax.lax.all_to_all``), run ordinary full attention on each device's
+  head slice, transpose back. Cheaper comm at moderate lengths; requires
+  ``num_heads % axis_size == 0``.
+
+Both are exact: parity with single-device full attention is pinned by
+``tests/test_context_parallel.py`` on the virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+Array = jax.Array
+
+
+def _attention_block(
+    q: Array,
+    k: Array,
+    v: Array,
+    m: Array,
+    l: Array,
+    o: Array,
+    mask: Optional[Array],
+    scale: float,
+):
+    """One online-softmax update step.
+
+    ``q [B, nq, H, D]``, ``k/v [B, nk, H, D]``; carries ``m`` (running max,
+    [B, nq, H]), ``l`` (running denominator), ``o`` (unnormalized output).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # guard fully-masked rows: keep m finite so exp() stays 0, not nan
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    p = jnp.exp(scores - m_safe[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum("bqhk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = False,
+) -> Array:
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+
+    ``q, k, v``: ``[B, N, H, D]`` global arrays (sharded or not — the
+    ``shard_map`` in/out specs pin sequence sharding). Returns ``[B, N, H, D]``
+    sharded the same way.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    axis_size = mesh.shape[axis_name]
+
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    def inner(q_blk: Array, k_blk: Array, v_blk: Array) -> Array:
+        b, nq, h, d = q_blk.shape
+        nk = k_blk.shape[1]
+        my_idx = jax.lax.axis_index(axis_name)
+
+        m0 = jnp.full((b, nq, h), -jnp.inf, q_blk.dtype)
+        l0 = jnp.zeros((b, nq, h), q_blk.dtype)
+        o0 = jnp.zeros_like(q_blk)
+
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+        def body(step, carry):
+            k_cur, v_cur, m, l, o = carry
+            # the K/V block currently held came from device (my_idx - step)
+            src = (my_idx - step) % axis_size
+            mask = None
+            if causal:
+                q_pos = my_idx * nq + jnp.arange(nq)
+                k_pos = src * nk + jnp.arange(nk)
+                mask = (
+                    q_pos[None, :, None, None] >= k_pos[None, None, None, :]
+                )
+            m, l, o = _attention_block(q_blk, k_cur, v_cur, m, l, o, mask, scale)
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            return k_nxt, v_nxt, m, l, o
+
+        _, _, m, l, o = jax.lax.fori_loop(
+            0, axis_size, body, (k_blk, v_blk, m0, l0, o0)
+        )
+        return o / jnp.maximum(l, 1e-38)[..., None]
+
+    return inner(q, k, v)
+
+
+def ulysses_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = False,
+) -> Array:
+    """All-to-all (Ulysses) context parallelism: re-shard seq -> heads, run
+    full attention per head shard, re-shard back."""
+    axis_size = mesh.shape[axis_name]
+    assert q.shape[2] % axis_size == 0, (
+        f"num_heads {q.shape[2]} must divide by axis size {axis_size}"
+    )
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    def inner(q_blk: Array, k_blk: Array, v_blk: Array) -> Array:
+        # [B, N/p, H, D] -> all_to_all -> [B, N, H/p, D]
+        def to_heads(x):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def to_seq(x):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qh, kh, vh = to_heads(q_blk), to_heads(k_blk), to_heads(v_blk)
+        scores = jnp.einsum("bqhd,bkhd->bqhk", qh, kh) * scale
+        if causal:
+            n = qh.shape[1]
+            mask = jnp.arange(n)[:, None] >= jnp.arange(n)[None, :]
+            scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bqhk,bkhd->bqhd", p, vh)
+        return to_seq(out)
+
+    return inner(q, k, v)
+
+
+def full_attention(q: Array, k: Array, v: Array, causal: bool = False) -> Array:
+    """Single-device reference: plain softmax attention ``[B, N, H, D]``."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if causal:
+        n = q.shape[1]
+        mask = jnp.arange(n)[:, None] >= jnp.arange(n)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p, v)
