@@ -89,7 +89,7 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     def inner(q_blk: Array, k_blk: Array, v_blk: Array) -> Array:
         b, nq, h, d = q_blk.shape
@@ -149,7 +149,7 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     def inner(q_blk: Array, k_blk: Array, v_blk: Array) -> Array:
         # [B, N/p, H, D] -> all_to_all -> [B, N, H/p, D]
