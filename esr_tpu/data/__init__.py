@@ -12,6 +12,7 @@ from esr_tpu.data import np_encodings
 from esr_tpu.data.dataset import EventWindowDataset, SequenceDataset
 from esr_tpu.data.loader import (
     ConcatSequenceDataset,
+    InferenceSequenceLoader,
     SequenceLoader,
     ShardedSampler,
     collate_sequences,
@@ -33,6 +34,7 @@ __all__ = [
     "EventWindowDataset",
     "SequenceDataset",
     "ConcatSequenceDataset",
+    "InferenceSequenceLoader",
     "SequenceLoader",
     "ShardedSampler",
     "collate_sequences",

@@ -160,6 +160,40 @@ def overlapping_windows(batch: Dict[str, np.ndarray], seqn: int) -> List[Dict[st
     return [{k: v[:, i : i + seqn] for k, v in batch.items()} for i in range(L - seqn + 1)]
 
 
+class InferenceSequenceLoader:
+    """Streaming loader over ONE recording for evaluation — the analogue of
+    ``InferenceHDF5DataLoaderSequence`` (``h5dataloader.py:271-347``): batch 1,
+    in order, no shuffling, no sharding; sequences are non-overlapping
+    (``step_size = L``) and recurrent state is carried across them by the
+    caller (``esr_tpu.inference.harness``).
+
+    Yields reference-shaped window lists when ``as_windows=True`` (the
+    collate's ``(L−seqn+1)`` overlapping seqn-windows), else raw ``(1, L, …)``
+    batches.
+    """
+
+    def __init__(self, recording, config: Dict, as_windows: bool = False):
+        self.dataset = ConcatSequenceDataset([recording], config)
+        self.seqn = int(config["sequence"].get("seqn", 3))
+        self.as_windows = as_windows
+        self.inp_resolution = self.dataset.inp_resolution
+        self.gt_resolution = self.dataset.gt_resolution
+        self._loader = SequenceLoader(
+            self.dataset, batch_size=1, shuffle=False, drop_last=False,
+            prefetch=1,
+        )
+
+    def __len__(self) -> int:
+        return len(self._loader)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if self.as_windows:
+                yield overlapping_windows(batch, self.seqn)
+            else:
+                yield batch
+
+
 class SequenceLoader:
     """Iterable over collated ``(B, L, …)`` batches with epoch semantics.
 

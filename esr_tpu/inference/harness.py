@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from esr_tpu.data.loader import ConcatSequenceDataset, SequenceLoader
+from esr_tpu.data.loader import InferenceSequenceLoader
 from esr_tpu.losses.restore import (
     l1_metric,
     mse_metric,
@@ -108,11 +108,8 @@ class InferenceRunner:
         report: bool = True,
     ) -> Dict[str, float]:
         """Stream one recording; returns the per-recording metric means."""
-        dataset = ConcatSequenceDataset([data_path], dataset_config)
-        loader = SequenceLoader(
-            dataset, batch_size=1, shuffle=False, drop_last=False, prefetch=1
-        )
-        kh, kw = dataset.gt_resolution
+        loader = InferenceSequenceLoader(data_path, dataset_config)
+        kh, kw = loader.gt_resolution
 
         keys = ["esr_l1", "esr_mse", "esr_ssim", "esr_psnr",
                 "bicubic_l1", "bicubic_mse", "bicubic_ssim", "bicubic_psnr",

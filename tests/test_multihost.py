@@ -1,0 +1,120 @@
+"""Multi-host wiring: a REAL two-process jax.distributed run on CPU.
+
+The reference can only be tested under a live DDP launch (SURVEY.md §4:
+"Multi-node/distributed testing: none"); here two actual processes rendezvous
+through ``jax.distributed.initialize`` (Gloo collectives), build the global
+('data',) mesh spanning both, shard per-host loader output with
+``stage_batch`` / ``make_array_from_process_local_data``, and take one
+all-reduced training step — asserting both processes observe the identical
+global loss and updated params.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from esr_tpu.parallel.mesh import initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+    )
+
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from esr_tpu.data.loader import ShardedSampler
+    from esr_tpu.parallel.mesh import (
+        make_mesh, make_parallel_train_step, process_shard_info, replicate,
+        stage_batch,
+    )
+
+    shard_id, num_shards = process_shard_info()
+    assert (shard_id, num_shards) == (pid, 2), (shard_id, num_shards)
+
+    # per-host loader shard: disjoint halves of the index space
+    sampler = ShardedSampler(8, batch_size=2, shard_id=shard_id,
+                             num_shards=num_shards, shuffle=False)
+    my_indices = np.concatenate(list(sampler))
+    print("INDICES", pid, my_indices.tolist())
+
+    mesh = make_mesh()   # spans BOTH processes' cpu devices
+    n_global = len(jax.devices())
+    assert n_global == 2 * len(jax.local_devices())
+
+    # tiny linear train step through the real DP machinery
+    w0 = jnp.zeros((4,), jnp.float32)
+    opt = optax.sgd(0.1)
+
+    def train_step(state, batch):
+        params, opt_state = state
+        def loss_fn(p):
+            return ((batch["x"] @ p - batch["y"]) ** 2).mean()
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, opt_state = opt.update(g, opt_state, params)
+        return (optax.apply_updates(params, up), opt_state), {"loss": loss}
+
+    step = make_parallel_train_step(train_step, mesh, donate=False)
+    state = replicate((w0, opt.init(w0)), mesh)
+
+    # each host contributes its half of the global batch
+    rng = np.random.default_rng(0)          # same data on both, split by row
+    X = rng.standard_normal((2 * n_global, 4)).astype(np.float32)
+    Y = rng.standard_normal(2 * n_global).astype(np.float32)
+    rows = X.shape[0] // 2
+    local = {"x": X[pid * rows:(pid + 1) * rows],
+             "y": Y[pid * rows:(pid + 1) * rows]}
+    batch = stage_batch(local, mesh)
+
+    state, metrics = step(state, batch)
+    print("LOSS", pid, float(metrics["loss"]))
+    print("W", pid, np.asarray(state[0]).round(6).tolist())
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_step(tmp_path):
+    port = "29731"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(i), port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+
+    def grab(out, key):
+        return [l for l in out.splitlines() if l.startswith(key)]
+
+    # loader shards are disjoint and cover the index space
+    idx0 = eval(grab(outs[0], "INDICES")[0].split(" ", 2)[2])
+    idx1 = eval(grab(outs[1], "INDICES")[0].split(" ", 2)[2])
+    assert not set(idx0) & set(idx1)
+    assert sorted(idx0 + idx1) == list(range(8))
+
+    # both processes agree on the GLOBAL loss and updated params
+    loss0 = float(grab(outs[0], "LOSS")[0].split()[2])
+    loss1 = float(grab(outs[1], "LOSS")[0].split()[2])
+    assert loss0 == pytest.approx(loss1, rel=1e-6)
+    w0 = grab(outs[0], "W")[0].split(" ", 2)[2]
+    w1 = grab(outs[1], "W")[0].split(" ", 2)[2]
+    assert w0 == w1
