@@ -24,8 +24,7 @@ design:
 
 from __future__ import annotations
 
-import queue
-import threading
+from collections import deque
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -240,29 +239,24 @@ class SequenceLoader:
         return collate_sequences(seqs)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        batches = iter(self.sampler)
+        batches = list(self.sampler)
         if self.prefetch <= 0:
             for idx in batches:
                 yield self._build(idx)
             return
 
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = object()
+        # Thread-pool prefetch, order-preserving: ``prefetch`` batches are
+        # built concurrently while the consumer drains in order. HDF5 reads
+        # and the native ctypes rasterization kernels release the GIL, so
+        # threads scale where the reference needed forked DataLoader workers.
+        from concurrent.futures import ThreadPoolExecutor
 
-        def worker():
-            try:
-                for idx in batches:
-                    q.put(self._build(idx))
-                q.put(stop)
-            except BaseException as e:  # propagate into the consumer thread
-                q.put(e)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        with ThreadPoolExecutor(max_workers=self.prefetch) as pool:
+            pending = deque()
+            it = iter(batches)
+            for idx in it:
+                pending.append(pool.submit(self._build, idx))
+                if len(pending) >= self.prefetch:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()

@@ -58,6 +58,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     seqn: int = 3,
     remat: bool = False,
+    compute_dtype: Optional[Any] = None,
 ) -> Callable:
     """Build the jit-able train step.
 
@@ -65,6 +66,11 @@ def make_train_step(
       - ``inp``: ``[B, L, H, W, C]`` input frames already rasterized onto the
         HR grid (the ``inp_scaled_cnt`` stream);
       - ``gt``: ``[B, L, H, W, C]`` ground-truth HR frames.
+
+    ``compute_dtype``: standard mixed precision — ``jnp.bfloat16`` runs the
+    forward/backward convs at the MXU's native width (params are CAST for the
+    apply, master copies and optimizer state stay f32, losses accumulate in
+    f32). The reference trains pure f32; bf16 is the TPU-first option.
 
     Returns ``(state, metrics) = train_step(state, batch)``.
     """
@@ -76,6 +82,9 @@ def make_train_step(
 
     def loss_fn(params, batch):
         inp, gt = batch["inp"], batch["gt"]
+        if compute_dtype is not None:
+            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+            inp = inp.astype(compute_dtype)
         b, L = inp.shape[0], inp.shape[1]
         windows = _make_windows(inp, seqn)  # [Wc, B, seqn, H, W, C]
         # GT for window w is the middle frame of that window.
@@ -83,15 +92,20 @@ def make_train_step(
             [gt[:, i + mid_idx] for i in range(L - seqn + 1)], axis=0
         )
         states0 = model.init_states(b, inp.shape[2], inp.shape[3])
+        if compute_dtype is not None:
+            states0 = jax.tree.map(
+                lambda s: s.astype(compute_dtype), states0
+            )
 
         def body(states, xs):
             window, gtw = xs
             pred, states = apply_fn(params, window, states)
-            return states, (((pred - gtw) ** 2).mean(), pred)
+            err = pred.astype(jnp.float32) - gtw  # loss math in f32
+            return states, ((err**2).mean(), pred)
 
         _, (losses, preds) = jax.lax.scan(body, states0, (windows, gt_mid))
         # reference accumulates the SUM of per-window MSEs before backward
-        return losses.sum(), (losses, preds[-1])
+        return losses.sum(), (losses, preds[-1].astype(jnp.float32))
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
         (loss, (losses, last_pred)), grads = jax.value_and_grad(

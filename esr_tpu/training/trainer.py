@@ -35,6 +35,7 @@ import math
 from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from esr_tpu.config.build import (
@@ -136,8 +137,15 @@ class Trainer:
         # mesh + compiled steps
         self.mesh = mesh if mesh is not None else make_mesh()
         remat = bool(trainer_cfg.get("remat", False))
+        precision = trainer_cfg.get("precision", "f32")
+        if precision not in ("f32", "bf16"):
+            raise ValueError(f"unknown precision {precision!r}")
+        compute_dtype = jnp.bfloat16 if precision == "bf16" else None
         self.train_step = make_parallel_train_step(
-            make_train_step(self.model, self.optimizer, self.seqn, remat=remat),
+            make_train_step(
+                self.model, self.optimizer, self.seqn,
+                remat=remat, compute_dtype=compute_dtype,
+            ),
             self.mesh,
         )
         repl = NamedSharding(self.mesh, P())

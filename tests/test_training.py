@@ -114,3 +114,39 @@ def test_eval_step():
     ev = jax.jit(make_eval_step(model, seqn=3))
     out = ev(params, batch)
     assert np.isfinite(float(out["valid_loss"]))
+
+
+@pytest.mark.slow
+def test_train_step_bf16_mixed_precision():
+    """bf16 compute path: params stay f32 masters, loss finite and close to
+    the f32 step on the same batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.training.optim import make_optimizer
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    b, L, h, w = 2, 4, 16, 16
+    rng = np.random.default_rng(0)
+    batch = {
+        "inp": jnp.asarray(rng.random((b, L, h, w, 2)), jnp.float32),
+        "gt": jnp.asarray(rng.random((b, L, h, w, 2)), jnp.float32),
+    }
+    states = model.init_states(b, h, w)
+    params = model.init(jax.random.PRNGKey(0), batch["inp"][:, :3], states)
+    opt = make_optimizer("Adam", lr=1e-3)
+
+    step32 = jax.jit(make_train_step(model, opt, seqn=3))
+    step16 = jax.jit(make_train_step(model, opt, seqn=3, compute_dtype=jnp.bfloat16))
+    s0 = TrainState.create(params, opt)
+    s32, m32 = step32(s0, batch)
+    s16, m16 = step16(s0, batch)
+    l32, l16 = float(m32["loss"]), float(m16["loss"])
+    assert np.isfinite(l16)
+    assert abs(l16 - l32) / abs(l32) < 0.05, (l32, l16)
+    # master params remain f32 and were updated
+    leaf = jax.tree.leaves(s16.params)[0]
+    assert leaf.dtype == jnp.float32
+    assert not np.allclose(np.asarray(leaf), np.asarray(jax.tree.leaves(s0.params)[0]))
