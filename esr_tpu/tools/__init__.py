@@ -2,10 +2,20 @@
 
 from esr_tpu.tools.datalist import generate_datalist, write_txt
 from esr_tpu.tools.packagers import H5LadderPackager, H5Packager
+from esr_tpu.tools.simulate import (
+    EventSimulator,
+    convert_eventzoom,
+    sample_contrast_thresholds,
+    simulate_ladder_recording,
+)
 
 __all__ = [
     "generate_datalist",
     "write_txt",
     "H5Packager",
     "H5LadderPackager",
+    "EventSimulator",
+    "convert_eventzoom",
+    "sample_contrast_thresholds",
+    "simulate_ladder_recording",
 ]

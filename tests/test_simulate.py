@@ -1,0 +1,143 @@
+"""Event simulation: contrast-threshold model semantics + ladder generation."""
+
+import numpy as np
+import pytest
+
+from esr_tpu.tools.simulate import (
+    DEFAULT_SIM_CONFIG,
+    EventSimulator,
+    convert_eventzoom,
+    read_txt_events,
+    sample_contrast_thresholds,
+    simulate_ladder_recording,
+)
+
+
+def test_static_scene_produces_no_events():
+    frames = [np.full((8, 8), 0.5) for _ in range(5)]
+    sim = EventSimulator(cp=0.2, cn=0.2)
+    ev = sim.generate_from_frames(frames, np.arange(5) * 0.1)
+    assert ev.shape == (0, 4)
+
+
+def test_single_pixel_brightening_fires_positive_events():
+    f0 = np.full((4, 4), 0.1)
+    f1 = f0.copy()
+    f1[2, 3] = 0.9  # large positive log step at (y=2, x=3)
+    sim = EventSimulator(cp=0.3, cn=0.3, refractory_period=0.0)
+    ev = sim.generate_from_frames([f0, f1], [0.0, 1.0])
+    assert len(ev) > 0
+    assert np.all(ev[:, 3] == 1.0)  # all positive
+    assert np.all(ev[:, 0] == 3) and np.all(ev[:, 1] == 2)
+    # expected count = floor(delta_log / cp)
+    want = int(np.floor((np.log(0.9 + 1e-3) - np.log(0.1 + 1e-3)) / 0.3))
+    assert len(ev) == want
+    # interpolated timestamps are ordered within (0, 1]
+    assert np.all(np.diff(ev[:, 2]) >= 0)
+    assert ev[:, 2].min() > 0 and ev[:, 2].max() <= 1.0
+
+
+def test_darkening_fires_negative_and_refractory_suppresses():
+    f0 = np.full((2, 2), 0.9)
+    f1 = np.full((2, 2), 0.1)
+    sim = EventSimulator(cp=0.2, cn=0.2, refractory_period=0.0)
+    ev = sim.generate_from_frames([f0, f1], [0.0, 1.0])
+    assert len(ev) > 0 and np.all(ev[:, 3] == -1.0)
+
+    # a huge refractory period keeps at most one event per pixel
+    sim_rp = EventSimulator(cp=0.2, cn=0.2, refractory_period=10.0)
+    ev_rp = sim_rp.generate_from_frames([f0, f1], [0.0, 1.0])
+    assert len(ev_rp) == 4  # one per pixel
+    assert len(ev_rp) < len(ev)
+
+
+def test_reference_level_carries_across_frames():
+    """A ramp split over two frame pairs fires the same events as one jump
+    (the per-pixel reference level persists)."""
+    vals = [0.1, 0.35, 0.9]
+    frames2 = [np.full((1, 1), v) for v in vals]
+    sim = EventSimulator(cp=0.25, cn=0.25, refractory_period=0.0)
+    ev2 = sim.generate_from_frames(frames2, [0.0, 0.5, 1.0])
+
+    sim1 = EventSimulator(cp=0.25, cn=0.25, refractory_period=0.0)
+    ev1 = sim1.generate_from_frames(
+        [np.full((1, 1), 0.1), np.full((1, 1), 0.9)], [0.0, 1.0]
+    )
+    assert len(ev2) == len(ev1)
+
+
+def test_sample_contrast_thresholds_in_range():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        cp, cn = sample_contrast_thresholds(DEFAULT_SIM_CONFIG, rng)
+        assert DEFAULT_SIM_CONFIG["min_CT"] <= cp <= DEFAULT_SIM_CONFIG["max_CT"]
+        assert DEFAULT_SIM_CONFIG["min_CT"] <= cn <= DEFAULT_SIM_CONFIG["max_CT"]
+
+
+@pytest.mark.slow
+def test_simulate_ladder_recording_feeds_training_pipeline(tmp_path):
+    """Generated file must drive the real dataset/loader stack."""
+    rng = np.random.default_rng(3)
+    # moving gradient scene, 64x64, 6 frames
+    base = np.linspace(0, 1, 64)[None, :] * np.ones((64, 1))
+    frames = [
+        np.clip(np.roll(base, 4 * i, axis=1) + rng.normal(0, 0.01, (64, 64)), 0, 1)
+        for i in range(6)
+    ]
+    path = str(tmp_path / "sim.h5")
+    cp, cn = simulate_ladder_recording(
+        frames, np.arange(6) * 0.1, path,
+        rungs=("ori", "down2", "down4"), seed=1,
+    )
+    assert cp > 0 and cn > 0
+
+    from esr_tpu.data.dataset import EventWindowDataset
+    from esr_tpu.data.records import H5Recording
+
+    rec = H5Recording(path)
+    assert rec.stream("ori").num_events > rec.stream("down2").num_events > 0
+    cfg = {
+        "scale": 2,
+        "ori_scale": "down4",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 64,
+        "sliding_window": 32,
+        "need_gt_events": True,
+        "need_gt_frame": True,
+        "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    }
+    ds = EventWindowDataset(rec, cfg)
+    item = ds.get_item(0, seed=0)
+    assert item["inp_scaled_cnt"].shape == (32, 32, 2)
+    assert item["gt_cnt"].sum() > 0
+
+
+def test_read_txt_events_and_eventzoom_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+
+    def write_txt(dirpath, name):
+        dirpath.mkdir(parents=True, exist_ok=True)
+        n = 50
+        t = np.sort(rng.random(n))
+        x = rng.integers(0, 222, n)
+        y = rng.integers(0, 124, n)
+        p = rng.integers(0, 2, n)
+        arr = np.stack([t, x, y, p], axis=1)
+        np.savetxt(dirpath / name, arr, header="t x y p", comments="")
+        return arr
+
+    root = tmp_path / "ez"
+    for sub in ("data/ev_hr", "data/ev_lr_1", "data/ev_llr_1"):
+        write_txt(root / sub, "seq0.txt")
+
+    out = str(tmp_path / "h5")
+    n = convert_eventzoom(str(root), out)
+    assert n == 1
+
+    from esr_tpu.data.records import H5Recording
+
+    rec = H5Recording(out + "/seq0.h5")
+    assert rec.sensor_resolution == (124, 222)
+    ev = rec.stream("ori").window(0, 10)
+    assert set(np.unique(ev[3])) <= {-1.0, 1.0}  # polarity mapped 0 -> -1
