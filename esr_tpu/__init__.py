@@ -10,8 +10,16 @@ Network"), designed TPU-first:
 - the recurrent SR network as functional Flax modules with explicit state
   (``esr_tpu.models``)
 - BPTT over event windows via ``jax.lax.scan`` (``esr_tpu.training``)
-- data parallelism via ``jax.sharding`` meshes + XLA collectives
-  (``esr_tpu.parallel``)
+- data parallelism via ``jax.sharding`` meshes + XLA collectives, ring /
+  Ulysses context parallelism, multi-host glue (``esr_tpu.parallel``)
+- config system, iteration trainer, Orbax checkpoints (``esr_tpu.config``,
+  ``esr_tpu.training``)
+- streaming inference/eval harness (``esr_tpu.inference``)
+- native C++ host rasterization kernels (``esr_tpu.native``)
+- observability: trackers, timers, writers, event visualization
+  (``esr_tpu.utils``)
+- offline tools: datalists, HDF5 packagers, event simulation
+  (``esr_tpu.tools``)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

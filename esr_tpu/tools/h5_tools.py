@@ -1,0 +1,216 @@
+"""Small HDF5 maintenance tools: txt import, attribute editing, memmap export.
+
+Rebuilds the remaining offline utilities of
+``/root/reference/generate_dataset/tools/``:
+
+- :func:`extract_txt_to_h5` — generic event txt (``t x y p``, optional
+  ``width height`` header row) -> single-stream HDF5 via
+  :class:`~esr_tpu.tools.packagers.H5Packager`, chunked so arbitrarily long
+  files stream in O(chunk) memory (``txt_to_h5.py:24-103``);
+- :func:`add_hdf5_attribute` — batch attribute editing over files/dirs/lists
+  (``add_hdf5_attribute.py:28-36``);
+- :func:`h5_to_memmap` — events + frames exported as raw ``np.memmap``
+  arrays + ``metadata.json`` (``h5_to_memmap.py:16-134``);
+- :func:`read_h5_summary` — quick inspection of a recording
+  (``read_events.py``).
+
+The reference's rosbag converter (``rosbag_to_h5.py``) requires a ROS python
+stack this image does not ship; :func:`extract_rosbag_to_h5` raises with a
+clear message unless ``rosbag`` is importable.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from esr_tpu.tools.packagers import H5Packager
+
+
+def get_filepaths(path: str, extensions: Sequence[str] = (".h5", ".hdf")) -> List[str]:
+    """Path / directory / list-file -> file list
+    (``add_hdf5_attribute.py:13-26``)."""
+    path = path.rstrip("/")
+    if os.path.isdir(path):
+        out: List[str] = []
+        for ext in extensions:
+            out += sorted(glob.glob(os.path.join(path, f"*{ext}")))
+        return out
+    if any(path.endswith(e) for e in extensions):
+        return [path]
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def add_hdf5_attribute(
+    paths: Sequence[str], group: str, name: str, value, dry_run: bool = False
+) -> None:
+    import h5py
+
+    for p in paths:
+        print(f"adding {p}/{group}[{name}]={value}")
+        if dry_run:
+            continue
+        with h5py.File(p, "a") as f:
+            target = f[group] if group else f
+            target.attrs[name] = value
+
+
+def extract_txt_to_h5(
+    txt_path: str,
+    output_path: str,
+    zero_timestamps: bool = False,
+    chunksize: int = 100_000,
+    sensor_size: Optional[Tuple[int, int]] = None,
+) -> Tuple[int, int]:
+    """Stream a ``t x y p`` event txt into a single-stream HDF5.
+
+    First line may carry ``width height``; polarity 0 is mapped to -1.
+    Returns ``(num_pos, num_neg)``.
+    """
+    if sensor_size is None:
+        try:
+            with open(txt_path) as f:
+                w, h = (int(v) for v in f.readline().split()[:2])
+            sensor_size = (h, w)
+        except Exception:
+            sensor_size = None
+
+    pk = H5Packager(output_path)
+    num_pos = num_neg = 0
+    t0 = None
+    last_t = 0.0
+    max_x = max_y = 0
+    with open(txt_path) as f:
+        f.readline()  # header
+        while True:
+            rows = []
+            for _ in range(chunksize):
+                line = f.readline()
+                if not line:
+                    break
+                rows.append(line.split())
+            if not rows:
+                break
+            arr = np.asarray(rows, np.float64)
+            ts, xs, ys, ps = arr[:, 0], arr[:, 1], arr[:, 2], arr[:, 3]
+            ps = np.where(ps == 0, -1.0, np.sign(ps))
+            if t0 is None:
+                t0 = float(ts[0])
+            if zero_timestamps:
+                ts = ts - t0
+            pk.package_events(
+                xs.astype(np.int16), ys.astype(np.int16), ts, ps
+            )
+            num_pos += int((ps > 0).sum())
+            num_neg += int((ps < 0).sum())
+            last_t = float(ts[-1])
+            max_x = max(max_x, int(xs.max()))
+            max_y = max(max_y, int(ys.max()))
+    if sensor_size is None:
+        sensor_size = (max_y + 1, max_x + 1)
+    pk.add_metadata(
+        num_pos, num_neg, 0.0 if zero_timestamps else (t0 or 0.0), last_t,
+        sensor_size,
+    )
+    pk.close()
+    return num_pos, num_neg
+
+
+def h5_to_memmap(h5_path: str, output_dir: str, overwrite: bool = True) -> str:
+    """Export a single-stream recording as raw memmaps
+    (``h5_to_memmap.py:63-134``): ``t.npy`` float64 [N,1], ``xy.npy`` int16
+    [N,2], ``p.npy`` bool [N,1], per-image stacks + timestamps + event
+    indices, and the file attrs as ``metadata.json``."""
+    import h5py
+
+    if os.path.exists(output_dir):
+        if not overwrite:
+            raise FileExistsError(output_dir)
+        shutil.rmtree(output_dir)
+    mmap_dir = os.path.join(output_dir, "memmap")
+    os.makedirs(mmap_dir)
+
+    with h5py.File(h5_path, "r") as f:
+        n = f["events/ts"].shape[0]
+        t = np.memmap(os.path.join(mmap_dir, "t.npy"), "float64", "w+", shape=(n, 1))
+        xy = np.memmap(os.path.join(mmap_dir, "xy.npy"), "int16", "w+", shape=(n, 2))
+        p = np.memmap(os.path.join(mmap_dir, "p.npy"), "bool", "w+", shape=(n, 1))
+        t[:, 0] = f["events/ts"][:]
+        xy[:, 0] = f["events/xs"][:]
+        xy[:, 1] = f["events/ys"][:]
+        p[:, 0] = np.asarray(f["events/ps"][:]) > 0
+        t.flush(); xy.flush(); p.flush()
+
+        if "images" in f:
+            names = sorted(f["images"])
+            if names:
+                first = f[f"images/{names[0]}"]
+                h, w = first.attrs["size"][:2]
+                c = 1 if len(first.attrs["size"]) <= 2 else first.attrs["size"][2]
+                imgs = np.memmap(
+                    os.path.join(mmap_dir, "images.npy"), "uint8", "w+",
+                    shape=(len(names), int(h), int(w), int(c)),
+                )
+                img_ts = np.memmap(
+                    os.path.join(mmap_dir, "timestamps.npy"), "float64", "w+",
+                    shape=(len(names), 1),
+                )
+                idxs = np.memmap(
+                    os.path.join(mmap_dir, "image_event_indices.npy"),
+                    "uint64", "w+", shape=(len(names), 1),
+                )
+                for i, name in enumerate(names):
+                    d = f[f"images/{name}"]
+                    imgs[i] = np.asarray(d[:]).reshape(int(h), int(w), int(c))
+                    img_ts[i, 0] = d.attrs["timestamp"]
+                    idxs[i, 0] = d.attrs.get("event_idx", 0)
+                imgs.flush(); img_ts.flush(); idxs.flush()
+
+        meta = {
+            k: (v.tolist() if isinstance(v, np.ndarray) else
+                v.item() if isinstance(v, np.generic) else v)
+            for k, v in f.attrs.items()
+        }
+        meta["num_events"] = int(meta.get("num_events", n))
+    with open(os.path.join(mmap_dir, "metadata.json"), "w") as js:
+        json.dump(meta, js)
+    return mmap_dir
+
+
+def read_h5_summary(h5_path: str) -> Dict:
+    """Quick recording inspection (``read_events.py`` role): attrs + per-group
+    event counts."""
+    import h5py
+
+    out: Dict = {"attrs": {}, "groups": {}}
+    with h5py.File(h5_path, "r") as f:
+        for k, v in f.attrs.items():
+            out["attrs"][k] = v.tolist() if isinstance(v, np.ndarray) else v
+        for key in f:
+            if key.endswith("_events") or key == "events":
+                out["groups"][key] = int(f[f"{key}/ts"].shape[0])
+            elif key.endswith("images") or key == "images":
+                out["groups"][key] = len(f[key])
+    return out
+
+
+def extract_rosbag_to_h5(*args, **kwargs):
+    """Rosbag conversion requires the ROS python stack
+    (``rosbag_to_h5.py``); not shipped in this image."""
+    try:
+        import rosbag  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "rosbag conversion needs the ROS python stack (rosbag, "
+            "sensor_msgs); install ROS or convert offline with the "
+            "reference tooling, then import the h5 here."
+        ) from e
+    raise NotImplementedError(
+        "ROS detected but the converter is not implemented in this build"
+    )

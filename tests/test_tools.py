@@ -110,3 +110,60 @@ def test_single_stream_packager(tmp_path):
         assert f["events/ts"].shape == (n,)
         assert "event_idx" in f["images/image000000000"].attrs
         assert f["flow/flow000000000"].shape == (24, 32, 2)
+
+def test_extract_txt_to_h5_and_memmap(tmp_path):
+    import h5py
+
+    from esr_tpu.tools.h5_tools import (
+        add_hdf5_attribute,
+        extract_txt_to_h5,
+        get_filepaths,
+        h5_to_memmap,
+        read_h5_summary,
+    )
+
+    rng = np.random.default_rng(0)
+    n = 300
+    t = np.sort(rng.random(n)) + 5.0
+    x = rng.integers(0, 32, n)
+    y = rng.integers(0, 24, n)
+    p = rng.integers(0, 2, n)
+    txt = tmp_path / "ev.txt"
+    with open(txt, "w") as f:
+        f.write("32 24\n")
+        for row in zip(t, x, y, p):
+            f.write(" ".join(str(v) for v in row) + "\n")
+
+    h5 = str(tmp_path / "ev.h5")
+    npos, nneg = extract_txt_to_h5(str(txt), h5, zero_timestamps=True, chunksize=77)
+    assert npos + nneg == n
+    with h5py.File(h5) as f:
+        assert f["events/ts"].shape == (n,)
+        assert float(f["events/ts"][0]) == 0.0  # zeroed
+        assert tuple(f.attrs["sensor_resolution"]) == (24, 32)
+        assert set(np.unique(f["events/ps"][:])) <= {-1.0, 1.0}
+
+    # attribute editing over a directory
+    add_hdf5_attribute(get_filepaths(str(tmp_path)), "", "flavor", "test")
+    with h5py.File(h5) as f:
+        assert f.attrs["flavor"] == "test"
+
+    summary = read_h5_summary(h5)
+    assert summary["groups"]["events"] == n
+
+    mm = h5_to_memmap(h5, str(tmp_path / "mm"))
+    tmap = np.memmap(os.path.join(mm, "t.npy"), "float64", "r").reshape(n, 1)
+    xymap = np.memmap(os.path.join(mm, "xy.npy"), "int16", "r").reshape(n, 2)
+    assert np.all(np.diff(tmap[:, 0]) >= 0)
+    assert xymap[:, 0].max() < 32
+    import json
+
+    meta = json.load(open(os.path.join(mm, "metadata.json")))
+    assert meta["num_events"] == n
+
+
+def test_rosbag_gate():
+    from esr_tpu.tools.h5_tools import extract_rosbag_to_h5
+
+    with pytest.raises(ImportError):
+        extract_rosbag_to_h5()
