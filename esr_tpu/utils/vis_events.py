@@ -118,6 +118,45 @@ def render_event_stack(
     return rgb.transpose(0, 2, 1, 3, 4).reshape(gh * H, gw * W, 3)
 
 
+def render_event_3d(
+    events: np.ndarray,
+    resolution: Tuple[int, int],
+    gt_events: Optional[np.ndarray] = None,
+    gt_resolution: Optional[Tuple[int, int]] = None,
+    dpi: int = 100,
+) -> np.ndarray:
+    """(x, t, y) 3D scatter of an event cloud, blue=positive red=negative —
+    the reference's qualitative debugging view (``plot_event_3d``,
+    ``matplotlib_plot_events.py:283-323``; its open3d cloud export is not
+    ported — no open3d in this image). Returns an RGB uint8 image.
+
+    ``events``: ``[N, 4]`` (x, y, t, p); optional GT cloud side-by-side.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=(8 if gt_events is None else 14, 6), dpi=dpi)
+    clouds = [(events, resolution)]
+    if gt_events is not None:
+        clouds.append((gt_events, gt_resolution or resolution))
+    for i, (ev, res) in enumerate(clouds):
+        ax = fig.add_subplot(1, len(clouds), i + 1, projection="3d")
+        if len(ev):
+            x, y, t, p = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
+            y = res[0] - y  # image-down -> plot-up (reference :288,292)
+            ax.scatter(x[p > 0], t[p > 0], y[p > 0], c="b", marker=".", s=1)
+            ax.scatter(x[p < 0], t[p < 0], y[p < 0], c="r", marker=".", s=1)
+        ax.set_xlabel("x")
+        ax.set_ylabel("t")
+        ax.set_zlabel("y")
+    fig.canvas.draw()
+    img = np.asarray(fig.canvas.buffer_rgba())[..., :3].copy()
+    plt.close(fig)
+    return img
+
+
 def render_frame(frame: np.ndarray) -> np.ndarray:
     """``[H, W]`` or ``[H, W, 1]`` float [0,1] or uint8 → uint8 grayscale."""
     img = np.asarray(frame)
@@ -179,6 +218,20 @@ class EventVisualizer:
         self, frame: np.ndarray, is_save: bool = False, path: Optional[str] = None
     ) -> np.ndarray:
         img = render_frame(frame)
+        if is_save:
+            save_image(path, img)
+        return img
+
+    def plot_event_3d(
+        self,
+        event_list: np.ndarray,
+        resolution: Tuple[int, int],
+        gt_event_list: Optional[np.ndarray] = None,
+        gt_resolution: Optional[Tuple[int, int]] = None,
+        is_save: bool = False,
+        path: Optional[str] = None,
+    ) -> np.ndarray:
+        img = render_event_3d(event_list, resolution, gt_event_list, gt_resolution)
         if is_save:
             save_image(path, img)
         return img

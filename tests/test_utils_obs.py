@@ -144,3 +144,13 @@ def test_render_event_list_and_stack_and_frame(tmp_path):
         path=path,
     )
     assert os.path.exists(path) and out.shape == (8, 8, 3)
+
+
+def test_render_event_3d():
+    from esr_tpu.utils.vis_events import render_event_3d
+
+    ev = np.array([[1, 2, 0.1, 1], [3, 1, 0.5, -1]], np.float32)
+    img = render_event_3d(ev, (8, 8))
+    assert img.ndim == 3 and img.shape[-1] == 3 and img.dtype == np.uint8
+    both = render_event_3d(ev, (8, 8), gt_events=ev, gt_resolution=(16, 16))
+    assert both.shape[1] > img.shape[1]  # side-by-side panel is wider
