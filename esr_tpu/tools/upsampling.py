@@ -1,0 +1,252 @@
+"""Super-SloMo frame-rate upsampling (offline dataset generation), Flax.
+
+Rebuilds ``/root/reference/generate_dataset/upsampling/utils/model.py:12-283``
+and ``upsampler.py:22-228`` (the reference vendors avinashpaliwal/Super-SloMo
+and downloads ``SuperSloMo.ckpt`` from the VID2E release — NOT shipped in the
+repo either; it is gitignored there):
+
+- :class:`SloMoUNet` — the paper's UNet (7/7/5/3.. kernels, leaky-relu 0.1,
+  avg-pool downs, align-corners bilinear ups), NHWC;
+- :func:`backwarp` — ``I0 = warp(I1, F_0_1)`` via the framework's
+  torch-parity ``grid_sample`` (align_corners=True, matching the vendored
+  ``backWarp``);
+- :func:`interpolate_frame` — the arbitrary-time interpolation: flow
+  mixing coefficients ``[-t(1-t), t², (1-t)², -t(1-t)]``, residual flow +
+  visibility from the second UNet, visibility-weighted fusion
+  (``upsampler.py:176-205``);
+- :func:`upsample_adaptive` — intermediate-frame count from the max flow
+  magnitude (``:171-175``), i.e. ~1 px of motion between output frames;
+- :func:`convert_superslomo_checkpoint` — one-shot torch ``.ckpt``
+  (``state_dictFC``/``state_dictAT``) -> npz; :func:`load_superslomo_npz`
+  loads it into the two Flax param trees. Weights must be obtained offline
+  (zero-egress image); without them this module is architecture-only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from esr_tpu.ops.sampling import grid_sample
+
+Array = jax.Array
+
+
+def _resize_linear_ac(x: Array, oh: int, ow: int) -> Array:
+    """align_corners=True bilinear resize via interpolation matrices."""
+    b, h, w, c = x.shape
+
+    def mat(n_in, n_out):
+        if n_out == 1 or n_in == 1:
+            return np.ones((n_out, n_in), np.float32) / n_in
+        src = np.arange(n_out) * (n_in - 1) / (n_out - 1)
+        i0 = np.floor(src).astype(np.int64)
+        i1 = np.minimum(i0 + 1, n_in - 1)
+        f = src - i0
+        m = np.zeros((n_out, n_in), np.float32)
+        m[np.arange(n_out), i0] += 1 - f
+        m[np.arange(n_out), i1] += f
+        return m
+
+    my = jnp.asarray(mat(h, oh))
+    mx = jnp.asarray(mat(w, ow))
+    out = jnp.einsum("oh,bhwc->bowc", my, x)
+    return jnp.einsum("pw,bowc->bopc", mx, out)
+
+
+class _Down(nn.Module):
+    """avg-pool 2 -> conv+lrelu -> conv+lrelu (reference ``down``, :12-73)."""
+
+    features: int
+    kernel_size: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        k = self.kernel_size
+        p = (k - 1) // 2
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.features, (k, k), padding=((p, p), (p, p)), name="conv1")(x)
+        x = jax.nn.leaky_relu(x, 0.1)
+        x = nn.Conv(self.features, (k, k), padding=((p, p), (p, p)), name="conv2")(x)
+        return jax.nn.leaky_relu(x, 0.1)
+
+
+class _Up(nn.Module):
+    """bilinear x2 (align-corners) -> conv+lrelu -> conv(cat skip)+lrelu
+    (reference ``up``, :76-133)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: Array, skip: Array) -> Array:
+        x = _resize_linear_ac(x, 2 * x.shape[1], 2 * x.shape[2])
+        x = nn.Conv(self.features, (3, 3), padding=((1, 1), (1, 1)), name="conv1")(x)
+        x = jax.nn.leaky_relu(x, 0.1)
+        x = nn.Conv(
+            self.features, (3, 3), padding=((1, 1), (1, 1)), name="conv2"
+        )(jnp.concatenate([x, skip], axis=-1))
+        return jax.nn.leaky_relu(x, 0.1)
+
+
+class SloMoUNet(nn.Module):
+    """The Super-SloMo UNet (reference ``UNet``, :136-207)."""
+
+    out_channels: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = jax.nn.leaky_relu(
+            nn.Conv(32, (7, 7), padding=((3, 3), (3, 3)), name="conv1")(x), 0.1
+        )
+        s1 = jax.nn.leaky_relu(
+            nn.Conv(32, (7, 7), padding=((3, 3), (3, 3)), name="conv2")(x), 0.1
+        )
+        s2 = _Down(64, 5, name="down1")(s1)
+        s3 = _Down(128, 3, name="down2")(s2)
+        s4 = _Down(256, 3, name="down3")(s3)
+        s5 = _Down(512, 3, name="down4")(s4)
+        x = _Down(512, 3, name="down5")(s5)
+        x = _Up(512, name="up1")(x, s5)
+        x = _Up(256, name="up2")(x, s4)
+        x = _Up(128, name="up3")(x, s3)
+        x = _Up(64, name="up4")(x, s2)
+        x = _Up(32, name="up5")(x, s1)
+        x = nn.Conv(self.out_channels, (3, 3), padding=((1, 1), (1, 1)), name="conv3")(x)
+        return jax.nn.leaky_relu(x, 0.1)
+
+
+def backwarp(img: Array, flow: Array) -> Array:
+    """``I0 = backwarp(I1, F_0_1)`` — sample ``img [B, H, W, C]`` at
+    ``grid + flow [B, H, W, 2]`` (flow channels (u, v)); align_corners=True
+    normalization (reference ``backWarp``, :210-283)."""
+    b, h, w, c = img.shape
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, :] + flow[..., 0]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, :, None] + flow[..., 1]
+    grid = jnp.stack(
+        [2 * (gx / w - 0.5), 2 * (gy / h - 0.5)], axis=-1
+    )
+    return grid_sample(img, grid, align_corners=True)
+
+
+def interpolate_frame(
+    flow_params,
+    interp_params,
+    i0: Array,
+    i1: Array,
+    t: float,
+    flows: Optional[Tuple[Array, Array]] = None,
+) -> Array:
+    """One intermediate frame at relative time ``t`` in (0, 1)
+    (reference ``_upsample_adaptive`` body, ``upsampler.py:176-205``)."""
+    fc = SloMoUNet(out_channels=4)
+    at = SloMoUNet(out_channels=5)
+
+    if flows is None:
+        flow_out = fc.apply(flow_params, jnp.concatenate([i0, i1], axis=-1))
+        f01, f10 = flow_out[..., :2], flow_out[..., 2:]
+    else:
+        f01, f10 = flows
+
+    temp = -t * (1 - t)
+    ft0 = temp * f01 + (t * t) * f10
+    ft1 = ((1 - t) * (1 - t)) * f01 + temp * f10
+
+    g0 = backwarp(i0, ft0)
+    g1 = backwarp(i1, ft1)
+    interp_out = at.apply(
+        interp_params,
+        jnp.concatenate([i0, i1, f01, f10, ft1, ft0, g1, g0], axis=-1),
+    )
+    ft0_f = interp_out[..., :2] + ft0
+    ft1_f = interp_out[..., 2:4] + ft1
+    v0 = jax.nn.sigmoid(interp_out[..., 4:5])
+    v1 = 1 - v0
+
+    g0f = backwarp(i0, ft0_f)
+    g1f = backwarp(i1, ft1_f)
+    w0, w1 = 1 - t, t
+    return (w0 * v0 * g0f + w1 * v1 * g1f) / (w0 * v0 + w1 * v1 + 1e-12)
+
+
+def upsample_adaptive(
+    flow_params, interp_params, i0: Array, i1: Array, t0: float, t1: float
+) -> Tuple[List[np.ndarray], List[float]]:
+    """Adaptive interpolation: one output frame per ~pixel of peak motion
+    (reference ``:171-205``). Returns (frames, timestamps), excluding i1."""
+    fc = SloMoUNet(out_channels=4)
+    flow_out = fc.apply(flow_params, jnp.concatenate([i0, i1], axis=-1))
+    f01, f10 = flow_out[..., :2], flow_out[..., 2:]
+    n = int(np.ceil(float(jnp.maximum(
+        jnp.sqrt((f01**2).sum(-1)).max(), jnp.sqrt((f10**2).sum(-1)).max()
+    ))))
+    frames = [np.asarray(i0[0])]
+    stamps = [t0]
+    for k in range(1, max(n, 1)):
+        t = k / n
+        ft = interpolate_frame(
+            flow_params, interp_params, i0, i1, t, flows=(f01, f10)
+        )
+        frames.append(np.asarray(ft[0]))
+        stamps.append(t0 + t * (t1 - t0))
+    return frames, stamps
+
+
+# -- weight conversion -------------------------------------------------------
+
+_TORCH_TO_FLAX = None  # computed lazily
+
+
+def _torch_key_map() -> Dict[str, Tuple[str, ...]]:
+    """torch state-dict key -> flax param path for :class:`SloMoUNet`."""
+    mapping: Dict[str, Tuple[str, ...]] = {}
+    for tk, fk in (("conv1", "conv1"), ("conv2", "conv2"), ("conv3", "conv3")):
+        mapping[f"{tk}.weight"] = (fk, "kernel")
+        mapping[f"{tk}.bias"] = (fk, "bias")
+    for i in range(1, 6):
+        for c in ("conv1", "conv2"):
+            mapping[f"down{i}.{c}.weight"] = (f"down{i}", c, "kernel")
+            mapping[f"down{i}.{c}.bias"] = (f"down{i}", c, "bias")
+            mapping[f"up{i}.{c}.weight"] = (f"up{i}", c, "kernel")
+            mapping[f"up{i}.{c}.bias"] = (f"up{i}", c, "bias")
+    return mapping
+
+
+def convert_superslomo_checkpoint(ckpt_path: str, out_npz_path: str) -> None:
+    """torch ``SuperSloMo.ckpt`` -> flat npz (run offline where torch can
+    read the download; reference loads it at ``upsampler.py:45-69``)."""
+    import torch
+
+    ckpt = torch.load(ckpt_path, map_location="cpu")
+    out = {}
+    for name, sd in (("fc", ckpt["state_dictFC"]), ("at", ckpt["state_dictAT"])):
+        for k, v in sd.items():
+            out[f"{name}.{k}"] = v.numpy()
+    np.savez(out_npz_path, **out)
+
+
+def load_superslomo_npz(npz_path: str) -> Tuple[Dict, Dict]:
+    """npz -> ``(flow_params, interp_params)`` flax trees (OIHW -> HWIO)."""
+    data = np.load(npz_path)
+    key_map = _torch_key_map()
+
+    def build(prefix: str) -> Dict:
+        params: Dict = {}
+        for tk, path in key_map.items():
+            full = f"{prefix}.{tk}"
+            if full not in data.files:
+                raise KeyError(f"missing weight {full}")
+            v = data[full]
+            if v.ndim == 4:
+                v = np.transpose(v, (2, 3, 1, 0))
+            node = params
+            for p in path[:-1]:
+                node = node.setdefault(p, {})
+            node[path[-1]] = v
+        return {"params": params}
+
+    return build("fc"), build("at")
