@@ -359,6 +359,14 @@ class Trainer:
                     and iter_idx != 0
                 ):
                     val_log = self._valid(valid_stamp)
+                    if self.writer is not None:
+                        # stamp-aligned train scalars (reference :304-305)
+                        self.writer.add_scalar(
+                            "stamp_train_mse_loss", mse_loss, step=valid_stamp
+                        )
+                        self.writer.add_scalar(
+                            "stamp_train_loss", loss, step=valid_stamp
+                        )
                     logger.info(
                         "Valid stamp %d: %s",
                         valid_stamp,
