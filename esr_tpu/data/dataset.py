@@ -71,6 +71,16 @@ class EventWindowDataset:
             self.recording.stream(ladder.gt_prefix) if self.need_gt_events else None
         )
 
+        # stateful hot-pixel tracker (reference h5dataset.py:621-640 defines
+        # this but leaves the per-item call commented out, :367-368; here it
+        # is wired when the config block asks for it)
+        self.hot_filter = None
+        hot_cfg = config.get("hot_filter", {"enabled": False})
+        if hot_cfg.get("enabled", False):
+            from esr_tpu.data.hot_filter import HotPixelFilter
+
+            self.hot_filter = HotPixelFilter(self.inp_resolution, hot_cfg)
+
         self._compute_windows(config)
 
     # -- windowing ---------------------------------------------------------
@@ -264,6 +274,8 @@ class EventWindowDataset:
             inp_ev = np.zeros((4, 0), np.float32)  # sensor stall: no events
         else:
             inp_ev = self.inp_stream.window(idx0, idx1)
+            if self.hot_filter is not None:
+                inp_ev = self.hot_filter.filter_events(inp_ev)
             if self.augment_cfg["enabled"]:
                 inp_ev = self._augment_events(inp_ev, self.inp_resolution, seed)
             inp_ev = self._format(inp_ev)

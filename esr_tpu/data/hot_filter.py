@@ -1,0 +1,89 @@
+"""Hot-pixel filtering: stateful event-rate tracking + mask.
+
+Rebuilds the reference's hot-pixel machinery
+(``/root/reference/dataloader/h5dataset.py:621-640`` accumulation,
+``dataloader/encodings.py:348-363`` mask) as a host-side class. Note the
+reference *defines* this but keeps the per-item call commented out
+(``h5dataset.py:367-368``) — here it is actually wired: when
+``config['hot_filter']['enabled']`` the dataset drops events landing on hot
+pixels before rasterization.
+
+Semantics kept exactly: per item, a binary observation mask (any event at the
+pixel) accumulates into an event-rate average; once ``min_obvs`` items have
+been seen, up to ``max_px`` highest-rate pixels with rate > ``max_rate`` are
+masked (greedy argmax loop, reproduced vectorized).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def hot_mask_from_rate(
+    event_rate: np.ndarray,
+    idx: int,
+    max_px: int = 100,
+    min_obvs: int = 5,
+    max_rate: float = 0.8,
+) -> np.ndarray:
+    """Binary keep-mask ``[H, W]`` (reference ``get_hot_event_mask``).
+
+    The reference greedily zeroes the argmax up to ``max_px`` times while its
+    rate exceeds ``max_rate``; equivalently: mask the top-``max_px`` pixels
+    among those with rate > ``max_rate``.
+    """
+    mask = np.ones_like(event_rate, np.float32)
+    if idx <= min_obvs:
+        return mask
+    flat = event_rate.reshape(-1)
+    over = flat > max_rate
+    n_over = int(over.sum())
+    if n_over == 0:
+        return mask
+    k = min(max_px, n_over)
+    # top-k by rate among the over-threshold pixels
+    candidates = np.argsort(flat)[::-1][:k]
+    candidates = candidates[flat[candidates] > max_rate]
+    mask.reshape(-1)[candidates] = 0.0
+    return mask
+
+
+class HotPixelFilter:
+    """Stateful per-recording hot-pixel tracker (reference ``create_hot_mask``)."""
+
+    def __init__(self, resolution: Tuple[int, int], config: Dict):
+        self.resolution = tuple(resolution)
+        self.max_px = int(config.get("max_px", 100))
+        self.min_obvs = int(config.get("min_obvs", 5))
+        self.max_rate = float(config.get("max_rate", 0.8))
+        self.hot_events = np.zeros(self.resolution, np.float64)
+        self.hot_idx = 0
+
+    def update_and_mask(self, events: np.ndarray) -> np.ndarray:
+        """Observe one window ``[4, N]`` and return the current keep-mask."""
+        h, w = self.resolution
+        obs = np.zeros((h, w), np.float64)
+        if events.shape[1]:
+            xs = events[0].astype(np.int64)
+            ys = events[1].astype(np.int64)
+            ok = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
+            obs[ys[ok], xs[ok]] = 1.0  # binary observation (events_to_mask)
+        self.hot_events += obs
+        self.hot_idx += 1
+        rate = self.hot_events / self.hot_idx
+        return hot_mask_from_rate(
+            rate, self.hot_idx, self.max_px, self.min_obvs, self.max_rate
+        )
+
+    def filter_events(self, events: np.ndarray) -> np.ndarray:
+        """Update statistics, then drop events on hot pixels."""
+        mask = self.update_and_mask(events)
+        if events.shape[1] == 0:
+            return events
+        h, w = self.resolution
+        xs = events[0].astype(np.int64).clip(0, w - 1)
+        ys = events[1].astype(np.int64).clip(0, h - 1)
+        keep = mask[ys, xs] > 0
+        return events[:, keep]

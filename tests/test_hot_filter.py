@@ -1,0 +1,61 @@
+"""Hot-pixel filter: accumulation + mask + dataset wiring."""
+
+import numpy as np
+import pytest
+
+from esr_tpu.data.hot_filter import HotPixelFilter, hot_mask_from_rate
+
+
+def test_hot_mask_respects_min_obvs_and_threshold():
+    rate = np.zeros((4, 4))
+    rate[1, 2] = 0.95
+    # before min_obvs: everything kept
+    assert hot_mask_from_rate(rate.copy(), idx=3, min_obvs=5).min() == 1.0
+    # after: only the over-threshold pixel masked
+    m = hot_mask_from_rate(rate.copy(), idx=10, min_obvs=5, max_rate=0.8)
+    assert m[1, 2] == 0.0 and m.sum() == 15
+
+
+def test_hot_mask_max_px_cap():
+    rate = np.full((3, 3), 0.9)
+    m = hot_mask_from_rate(rate.copy(), idx=10, min_obvs=5, max_px=4, max_rate=0.8)
+    assert (m == 0).sum() == 4  # capped
+
+
+def test_filter_drops_persistent_pixel():
+    f = HotPixelFilter((8, 8), {"max_px": 10, "min_obvs": 3, "max_rate": 0.8})
+    # pixel (2, 3) fires every window; a roaming pixel fires once each
+    for i in range(6):
+        ev = np.array(
+            [[3.0, float(i % 8)], [2.0, float((i + 1) % 8)],
+             [0.1 * i, 0.1 * i + 0.05], [1.0, -1.0]]
+        )
+        out = f.filter_events(ev)
+    # after enough observations the persistent pixel's events are dropped
+    assert out.shape[1] == 1
+    assert out[0, 0] != 3.0 or out[1, 0] != 2.0
+
+
+def test_dataset_wires_hot_filter():
+    from esr_tpu.data.dataset import EventWindowDataset
+    from esr_tpu.data.synthetic import make_synthetic_recording
+
+    rec = make_synthetic_recording((64, 64), base_events=2048, seed=0)
+    cfg = {
+        "scale": 2, "ori_scale": "down4", "time_bins": 1, "mode": "events",
+        "window": 128, "sliding_window": 64,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+        "hot_filter": {"enabled": True, "max_px": 100, "min_obvs": 2,
+                       "max_rate": 0.5},
+        "item_keys": ["inp_cnt"],
+    }
+    ds = EventWindowDataset(rec, cfg)
+    assert ds.hot_filter is not None
+    base = EventWindowDataset(rec, {**cfg, "hot_filter": {"enabled": False}})
+    # consume several items so the tracker passes min_obvs
+    for i in range(min(6, len(ds))):
+        filtered = ds.get_item(i, seed=0)["inp_cnt"]
+        raw = base.get_item(i, seed=0)["inp_cnt"]
+    # filtering can only remove counts
+    assert filtered.sum() <= raw.sum()
