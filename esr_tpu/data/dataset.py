@@ -242,7 +242,8 @@ class EventWindowDataset:
         return down_cnt, down_scaled_cnt
 
     #: every key :meth:`get_item` can produce (reference item schema,
-    #: ``h5dataset.py:374-408``)
+    #: ``h5dataset.py:374-408``, plus the fixed-capacity raw-event streams
+    #: for device-side rasterization)
     ALL_KEYS = (
         "inp_stack", "inp_cnt",
         "inp_bicubic_cnt", "inp_bicubic_stack",
@@ -250,7 +251,35 @@ class EventWindowDataset:
         "inp_scaled_cnt", "inp_scaled_stack",
         "inp_down_cnt", "inp_down_scaled_cnt",
         "gt_stack", "gt_cnt", "gt_img", "gt_inp_size_img", "frame",
+        "inp_norm_events", "inp_events_valid",
+        "gt_raw_events", "gt_events_valid",
     )
+
+    @property
+    def inp_event_capacity(self) -> int:
+        """Static per-window event capacity (the reference's WINDOW constant,
+        plus the injected-noise budget)."""
+        cap = int(self.config["window"])
+        if self.add_noise["enabled"]:
+            cap += int(cap * self.add_noise.get("noise_level", 0.0))
+        return cap
+
+    @property
+    def gt_event_capacity(self) -> int:
+        """GT windows hold scale² x the input events (``h5dataset.py:451-475``)."""
+        return self.scale**2 * int(self.config["window"])
+
+    @staticmethod
+    def _padded(ev: np.ndarray, capacity: int):
+        """``[4, N]`` events -> (``[capacity, 4]`` rows (x,y,t,p), ``[capacity]``
+        validity) — the static-shape device feed."""
+        out = np.zeros((capacity, 4), np.float32)
+        valid = np.zeros((capacity,), np.float32)
+        n = min(ev.shape[1], capacity)
+        if n:
+            out[:n] = ev[:, :n].T
+            valid[:n] = 1.0
+        return out, valid
 
     def get_item(self, index: int, pause: bool = False, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Build the tensor dict for one window (``h5dataset.py:271-408``).
@@ -351,7 +380,25 @@ class EventWindowDataset:
                 cache["inp_down_scaled_cnt"] = down_scaled
             return cache["inp_down_cnt"], cache["inp_down_scaled_cnt"]
 
+        def inp_padded():
+            if "inp_norm_events" not in cache:
+                ev, valid = self._padded(norm_ev(), self.inp_event_capacity)
+                cache["inp_norm_events"] = ev
+                cache["inp_events_valid"] = valid
+            return cache["inp_norm_events"], cache["inp_events_valid"]
+
+        def gt_padded():
+            if "gt_raw_events" not in cache:
+                ev, valid = self._padded(gt_ev(), self.gt_event_capacity)
+                cache["gt_raw_events"] = ev
+                cache["gt_events_valid"] = valid
+            return cache["gt_raw_events"], cache["gt_events_valid"]
+
         builders = {
+            "inp_norm_events": lambda: inp_padded()[0],
+            "inp_events_valid": lambda: inp_padded()[1],
+            "gt_raw_events": lambda: gt_padded()[0],
+            "gt_events_valid": lambda: gt_padded()[1],
             "inp_stack": inp_stack,
             "inp_cnt": inp_cnt,
             "inp_bicubic_cnt": lambda: _resize(inp_cnt(), (kh, kw), "bicubic"),
