@@ -109,11 +109,19 @@ def bench_compute():
     return steps_per_sec, mfu, flops_per_step, bf16_steps, model, opt, state, seqn
 
 
-def bench_e2e(model, opt, seqn):
-    """Steps/s with the real HDF5 loader in the loop (starvation check)."""
+def bench_e2e(model, opt, seqn, device_rasterize=False):
+    """Steps/s with the real HDF5 loader in the loop (starvation check).
+
+    ``device_rasterize=True`` measures the raw-event feed: the host only
+    pads event windows; scatter-add runs inside the jit'd step.
+    """
     from esr_tpu.data.loader import ConcatSequenceDataset, SequenceLoader
     from esr_tpu.data.synthetic import write_synthetic_h5
-    from esr_tpu.training.train_step import TrainState, make_train_step
+    from esr_tpu.training.train_step import (
+        TrainState,
+        make_device_rasterizer,
+        make_train_step,
+    )
 
     cfg = {
         "scale": 2,
@@ -129,8 +137,13 @@ def bench_e2e(model, opt, seqn):
                          "augment_prob": [0.5, 0.5, 0.5]},
         "sequence": {"sequence_length": 10, "seqn": seqn, "step_size": None,
                      "pause": {"enabled": False}},
-        # the two streams the train step consumes (the Trainer sets the same)
-        "item_keys": ["inp_scaled_cnt", "gt_cnt"],
+        # only the streams the step consumes (the Trainer sets the same)
+        "item_keys": (
+            ["inp_norm_events", "inp_events_valid",
+             "gt_raw_events", "gt_events_valid"]
+            if device_rasterize
+            else ["inp_scaled_cnt", "gt_cnt"]
+        ),
     }
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "bench.h5")
@@ -143,7 +156,13 @@ def bench_e2e(model, opt, seqn):
         loader = SequenceLoader(
             dataset, batch_size=2, shuffle=True, drop_last=True, prefetch=2
         )
-        step = jax.jit(make_train_step(model, opt, seqn=seqn))
+        kh, kw = dataset.gt_resolution
+        rasterize = (
+            make_device_rasterizer((kh, kw)) if device_rasterize else None
+        )
+        step = jax.jit(
+            make_train_step(model, opt, seqn=seqn, rasterize=rasterize)
+        )
 
         def batches():
             epoch = 0
@@ -154,21 +173,26 @@ def bench_e2e(model, opt, seqn):
 
         it = batches()
 
-        def stage(bt):
-            return {
-                "inp": jnp.asarray(bt["inp_scaled_cnt"]),
-                "gt": jnp.asarray(bt["gt_cnt"]),
-            }
+        if device_rasterize:
+            def stage(bt):
+                return {
+                    "inp_events": jnp.asarray(bt["inp_norm_events"]),
+                    "inp_valid": jnp.asarray(bt["inp_events_valid"]),
+                    "gt_events": jnp.asarray(bt["gt_raw_events"]),
+                    "gt_valid": jnp.asarray(bt["gt_events_valid"]),
+                }
+        else:
+            def stage(bt):
+                return {
+                    "inp": jnp.asarray(bt["inp_scaled_cnt"]),
+                    "gt": jnp.asarray(bt["gt_cnt"]),
+                }
 
         first = stage(next(it))
-        kh, kw = first["inp"].shape[2], first["inp"].shape[3]
         states = model.init_states(2, kh, kw)
-        params = model.init(
-            jax.random.PRNGKey(0), first["inp"][:, :seqn], states
-        )
-        from esr_tpu.training.train_step import TrainState as TS
-
-        state = TS.create(params, opt)
+        dummy = jnp.zeros((2, seqn, kh, kw, 2), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), dummy, states)
+        state = TrainState.create(params, opt)
         state, m = step(state, first)  # compile
         jax.block_until_ready(m["loss"])
 
@@ -221,6 +245,10 @@ def main():
     except Exception:
         e2e = None
     try:
+        e2e_dev = bench_e2e(model, opt, seqn, device_rasterize=True)
+    except Exception:
+        e2e_dev = None
+    try:
         dcn_speedup = bench_dcn()
     except Exception:
         dcn_speedup = None
@@ -230,6 +258,9 @@ def main():
         "flops_per_step": flops,
         "bf16_steps_per_sec": round(bf16_steps, 3) if bf16_steps else None,
         "e2e_steps_per_sec": round(e2e, 3) if e2e else None,
+        "e2e_device_raster_steps_per_sec": (
+            round(e2e_dev, 3) if e2e_dev else None
+        ),
         "dcn_pallas_speedup": round(dcn_speedup, 3) if dcn_speedup else None,
         "device": jax.devices()[0].device_kind,
     }
