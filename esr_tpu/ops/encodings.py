@@ -165,6 +165,7 @@ def events_to_stack(
     sensor_size: Tuple[int, int],
     valid: Optional[Array] = None,
     polarity: bool = False,
+    binning: str = "half_open",
 ) -> Array:
     """Time-binned event stack.
 
@@ -174,18 +175,59 @@ def events_to_stack(
     ``events_to_stack_polarity``, ``encodings.py:153-201``; reference layout
     ``[2, B, H, W]``).
 
-    Bins span ``[t_first, t_last]`` of the *valid* events, half-open
-    assignment (see module docstring for the boundary-handling deviation).
+    Bins span ``[t_first, t_last]`` of the *valid* events.
+    ``binning='half_open'`` (default) assigns each event to exactly one bin
+    (the clean partition — module docstring); ``binning='inclusive'``
+    reproduces the reference's index-based bin membership EXACTLY — per bin,
+    events in ``[searchsorted_left(tstart), searchsorted_right(tend) + 1)``
+    of the time-sorted stream (``encodings.py:176-181,224-236``), which
+    double-counts boundary events into adjacent bins. Inclusive mode requires
+    ``ts`` ascending over the valid lanes (true for stream windows).
     """
+    assert binning in ("half_open", "inclusive"), binning
     h, w = sensor_size
-    v = _valid_or_ones(valid, xs.shape[0])
-    t0, _, dt = _normalized_bin_time(ts.astype(jnp.float32), v)
-    rel = (ts.astype(jnp.float32) - t0) / dt
-    bin_idx = jnp.clip(jnp.floor(rel * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    n = xs.shape[0]
+    v = _valid_or_ones(valid, n)
+    tsf = ts.astype(jnp.float32)
 
     inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
     xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
     yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+
+    if binning == "inclusive":
+        t0, _, dt = _normalized_bin_time(tsf, v)
+        delta = dt / num_bins
+        # padded lanes pushed past every bin end; valid prefix stays sorted
+        ts_eff = jnp.where(v > 0, tsf, jnp.inf)
+        starts = t0 + delta * jnp.arange(num_bins)
+        begs = jnp.searchsorted(ts_eff, starts, side="left")
+        ends = jnp.minimum(
+            jnp.searchsorted(ts_eff, starts + delta, side="right") + 1, n
+        )
+        idx = jnp.arange(n)
+        # [N, B] membership — an event may belong to adjacent bins
+        member = (idx[:, None] >= begs[None, :]) & (idx[:, None] < ends[None, :])
+
+        if polarity:
+            out = jnp.zeros((h, w, num_bins, 2), dtype=jnp.float32)
+            pos = jnp.where((ps > 0) & inb, v, 0.0)
+            neg = jnp.where((ps < 0) & inb, v, 0.0)
+            for b in range(num_bins):
+                m = member[:, b]
+                out = out.at[yi, xi, b, 0].add(jnp.where(m, pos, 0.0), mode="drop")
+                out = out.at[yi, xi, b, 1].add(jnp.where(m, neg, 0.0), mode="drop")
+            return out
+        vals = jnp.where(inb, ps.astype(jnp.float32) * v, 0.0)
+        out = jnp.zeros((h, w, num_bins), dtype=jnp.float32)
+        for b in range(num_bins):
+            out = out.at[yi, xi, b].add(
+                jnp.where(member[:, b], vals, 0.0), mode="drop"
+            )
+        return out
+
+    t0, _, dt = _normalized_bin_time(tsf, v)
+    rel = (tsf - t0) / dt
+    bin_idx = jnp.clip(jnp.floor(rel * num_bins).astype(jnp.int32), 0, num_bins - 1)
 
     if polarity:
         out = jnp.zeros((h, w, num_bins, 2), dtype=jnp.float32)

@@ -179,3 +179,39 @@ def test_stack_binning_agrees_away_from_boundaries():
     # the range endpoints themselves are the only possible disagreements
     diff = np.abs(ours - ref).sum()
     assert diff <= 4, diff
+
+def test_device_inclusive_binning_matches_reference_exactly():
+    """events_to_stack(binning='inclusive') reproduces the reference's
+    index-based bin membership bit-for-bit (incl. boundary double-counting)."""
+    from esr_tpu.ops import encodings as E
+
+    h, w = 7, 8
+    for seed in range(3):
+        xs, ys, ts, ps = _events(256, h, w, seed=seed, quantized_ts=True)
+        for tb in (1, 2, 4):
+            got = np.asarray(E.events_to_stack(
+                jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ts),
+                jnp.asarray(ps), tb, (h, w), binning="inclusive",
+            ))
+            want = reference_stack_binning(xs, ys, ts, ps, tb, (h, w))
+            np.testing.assert_allclose(got, want, atol=1e-5), (seed, tb)
+
+
+def test_device_inclusive_binning_with_padding():
+    from esr_tpu.ops import encodings as E
+
+    h, w = 5, 6
+    xs, ys, ts, ps = _events(64, h, w, seed=7)
+    pad = 32
+    xs_p = np.concatenate([xs, np.zeros(pad, np.float32)])
+    ys_p = np.concatenate([ys, np.zeros(pad, np.float32)])
+    ts_p = np.concatenate([ts, np.zeros(pad, np.float32)])
+    ps_p = np.concatenate([ps, np.zeros(pad, np.float32)])
+    valid = np.concatenate([np.ones(64, np.float32), np.zeros(pad, np.float32)])
+    got = np.asarray(E.events_to_stack(
+        jnp.asarray(xs_p), jnp.asarray(ys_p), jnp.asarray(ts_p),
+        jnp.asarray(ps_p), 4, (h, w),
+        valid=jnp.asarray(valid), binning="inclusive",
+    ))
+    want = reference_stack_binning(xs, ys, ts, ps, 4, (h, w))
+    np.testing.assert_allclose(got, want, atol=1e-5)
