@@ -10,6 +10,7 @@ train step does the BPTT windowing on device.
 
 from esr_tpu.data import np_encodings
 from esr_tpu.data.dataset import EventWindowDataset, SequenceDataset
+from esr_tpu.data.hot_filter import HotPixelFilter, hot_mask_from_rate
 from esr_tpu.data.loader import (
     ConcatSequenceDataset,
     InferenceSequenceLoader,
@@ -30,6 +31,8 @@ from esr_tpu.data.records import (
 from esr_tpu.data.synthetic import make_synthetic_recording, write_synthetic_h5
 
 __all__ = [
+    "HotPixelFilter",
+    "hot_mask_from_rate",
     "np_encodings",
     "EventWindowDataset",
     "SequenceDataset",

@@ -88,6 +88,26 @@ def read_meta(path: str) -> Dict:
         return yaml.safe_load(f)
 
 
+def find_latest_checkpoint(root: str) -> Optional[str]:
+    """Newest ``checkpoint-iteration{N}`` under ``root`` (searched
+    recursively, so a ``models/<experiment>`` dir spanning run ids works) —
+    the preemption-recovery hook: ``train.py -r auto`` resumes from whatever
+    the killed run saved last. Returns None when nothing is found."""
+    best: Optional[str] = None
+    best_iter = -1
+    for dirpath, dirnames, _ in os.walk(root):
+        for d in list(dirnames):
+            if d.startswith("checkpoint-iteration"):
+                try:
+                    it = int(d[len("checkpoint-iteration"):])
+                except ValueError:
+                    continue
+                path = os.path.join(dirpath, d)
+                if os.path.exists(os.path.join(path, "meta.yml")) and it > best_iter:
+                    best, best_iter = path, it
+    return best
+
+
 def restore_state(path: str, template: TrainState) -> TrainState:
     """Restore the raw state pytree into ``template``'s structure."""
     ckptr = _checkpointer()

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 from typing import Dict, Optional
 
 import jax
@@ -215,10 +216,19 @@ class Trainer:
         self.profile_cfg = trainer_cfg.get("profile", {}) or {}
         self.start_iteration = 0
 
-        # resume (reference :172-173, :687-725)
-        if run.resume is not None:
+        # resume (reference :172-173, :687-725); "auto" = newest checkpoint
+        # under this experiment's model dir (preemption recovery)
+        resume_path = run.resume
+        if resume_path == "auto":
+            from esr_tpu.training.checkpoint import find_latest_checkpoint
+
+            exp_root = os.path.dirname(run.save_dir)
+            resume_path = find_latest_checkpoint(exp_root)
+            if resume_path is None:
+                logger.info("auto-resume: no checkpoint found; fresh start")
+        if resume_path is not None:
             state, self.start_iteration, self.mnt_best = resume_checkpoint(
-                run.resume, state, config, reset=run.reset
+                resume_path, state, config, reset=run.reset
             )
 
         self.state = replicate(state, self.mesh)

@@ -315,3 +315,28 @@ def test_trainer_device_rasterize_e2e(corpus, tmp_path):
     result = trainer.train()
     assert np.isfinite(result["train_loss"]) and result["train_loss"] > 0
     assert trainer.mnt_best != float("inf")  # validation ran on the raw feed
+
+
+@pytest.mark.slow
+def test_auto_resume_finds_latest(corpus, tmp_path):
+    """'-r auto' preemption recovery: a fresh Trainer under the same
+    experiment picks up the newest checkpoint across run ids."""
+    tmp, datalist = corpus
+    config = _make_config(tmp_path, datalist, iterations=2, valid_step=100)
+    run = RunConfig(config, runid="ar1", seed=6)
+    trainer = Trainer(run)
+    trainer.train()
+    state = jax.device_get(trainer.state)
+    ckpt_lib.save_checkpoint(run.save_dir, state, config, 3, 0.5)
+    ckpt_lib.save_checkpoint(run.save_dir, state, config, 7, 0.4)
+
+    from esr_tpu.training.checkpoint import find_latest_checkpoint
+
+    exp_root = os.path.dirname(run.save_dir)
+    latest = find_latest_checkpoint(exp_root)
+    assert latest.endswith("checkpoint-iteration7")
+
+    run2 = RunConfig(config, runid="ar2", seed=7, resume="auto")
+    trainer2 = Trainer(run2)
+    assert trainer2.start_iteration == 8
+    assert trainer2.mnt_best == 0.4
