@@ -68,15 +68,19 @@ def save_checkpoint(
     names = [f"checkpoint-iteration{iteration}"]
     if save_best:
         names.append(f"model_best_until_iteration{iteration}")
-    path = ""
-    for name in names:
-        path = os.path.join(os.path.abspath(ckpt_dir), name)
-        ckptr.save(os.path.join(path, "state"), _to_host(state))
+    paths = [os.path.join(os.path.abspath(ckpt_dir), n) for n in names]
+    host_state = _to_host(state)
+    for path in paths:
+        ckptr.save(os.path.join(path, "state"), host_state)
+    # meta.yml is the COMMIT MARKER: it must only exist once the async Orbax
+    # save has landed, so a preemption mid-save leaves a directory that
+    # find_latest_checkpoint will ignore rather than a torn checkpoint.
+    ckptr.wait_until_finished()
+    for path in paths:
         with open(os.path.join(path, "meta.yml"), "w") as f:
             yaml.safe_dump(meta, f, sort_keys=False)
         logger.info("Saved checkpoint: %s", path)
-    ckptr.wait_until_finished()
-    return path
+    return paths[-1]
 
 
 def _to_host(tree):
@@ -89,22 +93,38 @@ def read_meta(path: str) -> Dict:
 
 
 def find_latest_checkpoint(root: str) -> Optional[str]:
-    """Newest ``checkpoint-iteration{N}`` under ``root`` (searched
-    recursively, so a ``models/<experiment>`` dir spanning run ids works) —
-    the preemption-recovery hook: ``train.py -r auto`` resumes from whatever
-    the killed run saved last. Returns None when nothing is found."""
+    """Most recently SAVED ``checkpoint-iteration{N}`` under ``root``
+    (searched recursively, so a ``models/<experiment>`` dir spanning run ids
+    works) — the preemption-recovery hook: ``train.py -r auto`` resumes from
+    whatever the killed run saved last.
+
+    "Latest" is by ``meta.yml`` mtime (iteration as tie-break), NOT by
+    iteration number: a ``--reset`` restart in a new run id would otherwise
+    be shadowed forever by an abandoned run's higher-iteration checkpoint.
+    Only committed checkpoints count — ``meta.yml`` is written after the
+    async Orbax save lands, so torn saves are skipped. Returns None when
+    nothing is found."""
     best: Optional[str] = None
-    best_iter = -1
+    best_key = (-1.0, -1)
     for dirpath, dirnames, _ in os.walk(root):
-        for d in list(dirnames):
-            if d.startswith("checkpoint-iteration"):
-                try:
-                    it = int(d[len("checkpoint-iteration"):])
-                except ValueError:
-                    continue
-                path = os.path.join(dirpath, d)
-                if os.path.exists(os.path.join(path, "meta.yml")) and it > best_iter:
-                    best, best_iter = path, it
+        matched = [d for d in dirnames if d.startswith("checkpoint-iteration")]
+        # never descend into checkpoint state trees (deep Orbax array dirs)
+        dirnames[:] = [
+            d for d in dirnames
+            if not d.startswith(("checkpoint-iteration", "model_best_until"))
+        ]
+        for d in matched:
+            try:
+                it = int(d[len("checkpoint-iteration"):])
+            except ValueError:
+                continue
+            path = os.path.join(dirpath, d)
+            meta = os.path.join(path, "meta.yml")
+            if not os.path.exists(meta):
+                continue  # uncommitted / torn save
+            key = (os.path.getmtime(meta), it)
+            if key > best_key:
+                best, best_key = path, key
     return best
 
 
@@ -125,8 +145,14 @@ def resume_checkpoint(
     config: Dict,
     reset: bool = False,
     training_mode: str = "iteration_based_train",
-) -> Tuple[TrainState, int, float]:
+) -> Tuple[TrainState, int, Optional[float]]:
     """Name-checked resume. Returns ``(state, start_iteration, monitor_best)``.
+
+    ``monitor_best`` is None when trainer progress was NOT restored (reset,
+    training-mode mismatch, model-name mismatch) — the caller keeps its
+    freshly initialized monitor sentinel, which depends on the monitor MODE
+    (+inf for 'min', -inf for 'max'), so a hard-coded value here would
+    corrupt 'max'-mode monitors.
 
     Mirrors the reference's semantics: same training mode and no ``--reset``
     → trainer progress restored (``start = iteration + 1``); otherwise weights
@@ -140,7 +166,7 @@ def resume_checkpoint(
             meta["model"]["name"],
             config["model"]["name"],
         )
-        return state, 0, float("inf")
+        return state, 0, None
 
     restored = restore_state(path, state)
 
@@ -163,7 +189,7 @@ def resume_checkpoint(
             opt_state=restored.opt_state,
             step=np.zeros((), np.int32),
         )
-        return restored, 0, float("inf")
+        return restored, 0, None
 
     start = int(trainer_meta.get("iteration", 0)) + 1
     best = float(trainer_meta.get("monitor_best", float("inf")))

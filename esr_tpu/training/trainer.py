@@ -216,8 +216,8 @@ class Trainer:
         self.profile_cfg = trainer_cfg.get("profile", {}) or {}
         self.start_iteration = 0
 
-        # resume (reference :172-173, :687-725); "auto" = newest checkpoint
-        # under this experiment's model dir (preemption recovery)
+        # resume (reference :172-173, :687-725); "auto" = most recently saved
+        # checkpoint under this experiment's model dir (preemption recovery)
         resume_path = run.resume
         if resume_path == "auto":
             from esr_tpu.training.checkpoint import find_latest_checkpoint
@@ -226,10 +226,28 @@ class Trainer:
             resume_path = find_latest_checkpoint(exp_root)
             if resume_path is None:
                 logger.info("auto-resume: no checkpoint found; fresh start")
+            # every host must make the SAME decision — one host silently
+            # fresh-starting while the rest resume breaks the replicated-
+            # params invariant; verify agreement and fail loudly instead
+            if self.num_shards > 1:
+                from jax.experimental import multihost_utils
+
+                mine = np.frombuffer(
+                    (resume_path or "").encode()[:512].ljust(512), np.uint8
+                ).copy()
+                main_choice = multihost_utils.broadcast_one_to_all(mine)
+                if not np.array_equal(np.asarray(main_choice), mine):
+                    raise RuntimeError(
+                        "auto-resume: hosts disagree on the checkpoint "
+                        f"(this host found {resume_path!r}); put save_dir on "
+                        "shared storage or pass -r <path> explicitly"
+                    )
         if resume_path is not None:
-            state, self.start_iteration, self.mnt_best = resume_checkpoint(
+            state, self.start_iteration, restored_best = resume_checkpoint(
                 resume_path, state, config, reset=run.reset
             )
+            if restored_best is not None:
+                self.mnt_best = restored_best
 
         self.state = replicate(state, self.mesh)
 

@@ -238,9 +238,11 @@ def test_resume_reset_and_name_check(corpus, tmp_path):
     state = jax.device_get(trainer.state)
     path = ckpt_lib.save_checkpoint(run.save_dir, state, config, 5, 0.25)
 
-    # --reset: weights restored, progress zeroed
+    # --reset: weights restored, progress zeroed, monitor untouched (None —
+    # the caller keeps its mode-appropriate sentinel; a hard-coded +inf
+    # would corrupt 'max'-mode monitors)
     st, start, best = ckpt_lib.resume_checkpoint(path, state, config, reset=True)
-    assert start == 0 and best == float("inf")
+    assert start == 0 and best is None
     np.testing.assert_array_equal(
         jax.tree.leaves(st.params)[0], jax.tree.leaves(state.params)[0]
     )
@@ -248,7 +250,7 @@ def test_resume_reset_and_name_check(corpus, tmp_path):
     # model-name mismatch: nothing restored
     bad = {**config, "model": {"name": "SomethingElse", "args": {}}}
     _, start, best = ckpt_lib.resume_checkpoint(path, state, bad)
-    assert start == 0 and best == float("inf")
+    assert start == 0 and best is None
 
 
 @pytest.mark.slow

@@ -7,6 +7,7 @@ TPU-native rebuild of ``train_ours_cnt_seq.py`` (reference ``:742-832``):
     python train.py -c cfg.yml -o "train_dataloader;batch_size=8" \\
                     -o "trainer;iteration_based_train;iterations=10000"
     python train.py -c cfg.yml -r <ckpt-dir> [--reset]
+    python train.py -c cfg.yml -r auto     # resume newest ckpt (preemption)
 
 Multi-host: launch once per host (e.g. on each TPU-pod worker); JAX
 rendezvous replaces ``torch.distributed.launch``. On a single host this runs
