@@ -1,3 +1,7 @@
-from . import encodings
-from . import resize
 from . import dcn
+from . import encodings
+from . import psroi
+from . import resize
+from esr_tpu.ops.psroi import deform_psroi_pooling
+
+__all__ = ["dcn", "encodings", "psroi", "resize", "deform_psroi_pooling"]

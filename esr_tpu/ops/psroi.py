@@ -1,0 +1,150 @@
+"""Deformable position-sensitive ROI pooling (DCNv2's second op), jnp.
+
+Rebuilds ``/root/reference/models/DCNv2/src/cuda/dcn_v2_psroi_pooling_cuda.cu``
+(forward kernel ``:58-145``; python wrapper ``dcn_v2.py:230-435``). The op is
+unused by ESR's flagship model (SURVEY marks it optional) but is part of the
+DCNv2 extension's public surface, so it is provided for API completeness.
+
+Semantics reproduced exactly:
+- ROI rect ``round(x1), round(y1), round(x2)+1, round(y2)+1`` scaled by
+  ``spatial_scale`` then shifted by -0.5; width/height floored at 0.1;
+- per output bin ``(ph, pw)``: ``sample_per_part²`` bilinear taps starting at
+  the bin corner, shifted by the learned per-part offset
+  ``trans[class, :, part_h, part_w] * trans_std * roi_size``;
+- position-sensitive channel: ``c = (ctop*group_size + gh)*group_size + gw``
+  with ``g* = floor(p* * group_size / pooled_size)``;
+- taps outside ``[-0.5, size-0.5]`` are skipped; inside taps clamp to
+  ``[0, size-1]``; output = sum / count (0 when no tap lands).
+
+The backward pass is XLA autodiff of the gather — the transpose matches the
+CUDA backward's atomicAdd scatter (``:148+``).
+
+Layouts are channel-last: ``data [B, H, W, C]`` with
+``C = output_dim * group_size²``; output ``[N, P, P, output_dim]``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _round_half_away(x: Array) -> Array:
+    """C ``round()`` semantics (half away from zero) — ``jnp.round`` is
+    half-to-even and disagrees at ``.5`` coordinates."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+def _bilinear_gather(img: Array, ys: Array, xs: Array, cs: Array) -> Array:
+    """Floor/ceil-corner bilinear sample of ``img [H, W, C]`` at clamped
+    coords (reference ``bilinear_interp_cuda``, ``:34-56``).
+
+    ``ys/xs/cs`` broadcast together; only the 4 corner values per tap are
+    gathered — no per-bin feature-plane materialization.
+    """
+    x1 = jnp.floor(xs).astype(jnp.int32)
+    x2 = jnp.ceil(xs).astype(jnp.int32)
+    y1 = jnp.floor(ys).astype(jnp.int32)
+    y2 = jnp.ceil(ys).astype(jnp.int32)
+    dx = xs - x1
+    dy = ys - y1
+    v11 = img[y1, x1, cs]
+    v12 = img[y2, x1, cs]
+    v21 = img[y1, x2, cs]
+    v22 = img[y2, x2, cs]
+    return (
+        (1 - dx) * (1 - dy) * v11
+        + (1 - dx) * dy * v12
+        + dx * (1 - dy) * v21
+        + dx * dy * v22
+    )
+
+
+def deform_psroi_pooling(
+    data: Array,
+    rois: Array,
+    trans: Optional[Array] = None,
+    *,
+    spatial_scale: float = 1.0,
+    output_dim: int,
+    group_size: int,
+    pooled_size: int,
+    part_size: Optional[int] = None,
+    sample_per_part: int = 4,
+    trans_std: float = 0.0,
+) -> Tuple[Array, Array]:
+    """Returns ``(output [N, P, P, output_dim], count [N, P, P, output_dim])``.
+
+    ``rois``: ``[N, 5]`` rows ``(batch_index, x1, y1, x2, y2)``;
+    ``trans``: ``[N, num_classes, 2, part_size, part_size]`` learned offsets
+    (None → undeformed, the ``no_trans`` path).
+    """
+    b, h, w, c = data.shape
+    p = pooled_size
+    part = part_size if part_size is not None else p
+    assert c == output_dim * group_size * group_size
+
+    no_trans = trans is None
+    if no_trans:
+        trans = jnp.zeros((rois.shape[0], 1, 2, part, part), data.dtype)
+    num_classes = trans.shape[1]
+    channels_each_class = max(output_dim // num_classes, 1)
+
+    spp = sample_per_part
+    ph = jnp.arange(p)
+    pw = jnp.arange(p)
+
+    # position-sensitive group per bin [P]
+    gh = jnp.clip((ph * group_size) // p, 0, group_size - 1)
+    gw = jnp.clip((pw * group_size) // p, 0, group_size - 1)
+    ctop = jnp.arange(output_dim)
+    # channel index [P(h), P(w), OD]
+    cidx = (
+        ctop[None, None, :] * group_size + gh[:, None, None]
+    ) * group_size + gw[None, :, None]
+    class_id = ctop // channels_each_class  # [OD]
+    part_h = jnp.floor(ph.astype(jnp.float32) / p * part).astype(jnp.int32)
+    part_w = jnp.floor(pw.astype(jnp.float32) / p * part).astype(jnp.int32)
+
+    def one_roi(roi, tr):
+        batch_ind = roi[0].astype(jnp.int32)
+        x1 = _round_half_away(roi[1]) * spatial_scale - 0.5
+        y1 = _round_half_away(roi[2]) * spatial_scale - 0.5
+        x2 = (_round_half_away(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (_round_half_away(roi[4]) + 1.0) * spatial_scale - 0.5
+        roi_w = jnp.maximum(x2 - x1, 0.1)
+        roi_h = jnp.maximum(y2 - y1, 0.1)
+        bin_w = roi_w / p
+        bin_h = roi_h / p
+        sub_w = bin_w / spp
+        sub_h = bin_h / spp
+
+        # learned offsets per (bin, class): [P(h), P(w), OD]
+        tx = tr[class_id[None, None, :], 0, part_h[:, None, None], part_w[None, :, None]] * trans_std
+        ty = tr[class_id[None, None, :], 1, part_h[:, None, None], part_w[None, :, None]] * trans_std
+        wstart = pw[None, :, None].astype(jnp.float32) * bin_w + x1 + tx * roi_w
+        hstart = ph[:, None, None].astype(jnp.float32) * bin_h + y1 + ty * roi_h
+
+        # sample grid [P, P, OD, spp, spp] — broadcast the two 1-D sample
+        # axes against each other so (ih, iw) pairs enumerate the full grid
+        ws = wstart[..., None, None] + jnp.arange(spp)[None, None, None, None, :] * sub_w
+        hs = hstart[..., None, None] + jnp.arange(spp)[None, None, None, :, None] * sub_h
+        ws, hs = jnp.broadcast_arrays(ws, hs)
+        ok = (ws >= -0.5) & (ws <= w - 0.5) & (hs >= -0.5) & (hs <= h - 0.5)
+        wc = jnp.clip(ws, 0.0, w - 1.0)
+        hc = jnp.clip(hs, 0.0, h - 1.0)
+
+        img = data[batch_ind]  # [H, W, C]
+        vals = _bilinear_gather(img, hc, wc, cidx[..., None, None])
+        vals = jnp.where(ok, vals, 0.0)
+        count = ok.sum(axis=(-1, -2)).astype(data.dtype)
+        total = vals.sum(axis=(-1, -2))
+        out = jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+        return out, count
+
+    out, count = jax.vmap(one_roi)(rois.astype(jnp.float32), trans)
+    return out, count

@@ -228,15 +228,19 @@ class Trainer:
                 logger.info("auto-resume: no checkpoint found; fresh start")
             # every host must make the SAME decision — one host silently
             # fresh-starting while the rest resume breaks the replicated-
-            # params invariant; verify agreement and fail loudly instead
+            # params invariant. Allgather-and-compare so EVERY host (incl.
+            # process 0, which a one-way broadcast could never fail on)
+            # raises loudly instead of stalling in later collectives.
             if self.num_shards > 1:
                 from jax.experimental import multihost_utils
 
                 mine = np.frombuffer(
                     (resume_path or "").encode()[:512].ljust(512), np.uint8
                 ).copy()
-                main_choice = multihost_utils.broadcast_one_to_all(mine)
-                if not np.array_equal(np.asarray(main_choice), mine):
+                all_choices = np.asarray(
+                    multihost_utils.process_allgather(mine)
+                )
+                if not (all_choices == mine[None]).all():
                     raise RuntimeError(
                         "auto-resume: hosts disagree on the checkpoint "
                         f"(this host found {resume_path!r}); put save_dir on "

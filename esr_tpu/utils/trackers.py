@@ -88,6 +88,41 @@ class YamlLogger:
         self.close()
 
 
+def normalize_nonzero(x):
+    """Standardize the NONZERO entries of an event tensor, zeros untouched
+    (reference ``normalize_tensor``, ``myutils/utils.py:14-32``): mean/std
+    are computed over nonzero elements only; works on numpy or jnp arrays."""
+    import numpy as np
+
+    nonzero = x != 0
+    num = nonzero.sum()
+    if isinstance(x, np.ndarray):
+        if num == 0:
+            return x
+        mean = x.sum() / num
+        # f32 cancellation can drive the variance a hair negative for
+        # near-constant inputs — clamp like the jnp branch does
+        std = np.sqrt(max((x**2).sum() / num - mean**2, 0.0))
+        return np.where(nonzero, (x - mean) / (std + 1e-12), 0.0)
+    import jax.numpy as jnp
+
+    safe = jnp.maximum(num, 1)
+    mean = x.sum() / safe
+    std = jnp.sqrt(jnp.maximum((x**2).sum() / safe - mean**2, 0.0))
+    out = jnp.where(nonzero, (x - mean) / (std + 1e-12), 0.0)
+    return jnp.where(num > 0, out, x)
+
+
+def inf_loop(loader):
+    """Endless loader wrapper advancing the epoch each cycle
+    (reference ``myutils/utils.py:109-115``)."""
+    epoch = 0
+    while True:
+        loader.set_epoch(epoch)
+        yield from loader
+        epoch += 1
+
+
 def _plain(obj):
     """Recursively convert numpy/jax scalars and arrays to YAML-safe python."""
     import numpy as np

@@ -1,0 +1,121 @@
+"""Deformable PSROI pooling: parity vs a direct numpy transcription of the
+reference CUDA kernel (dcn_v2_psroi_pooling_cuda.cu:58-145)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.ops.psroi import deform_psroi_pooling
+
+
+def np_psroi(data, rois, trans, spatial_scale, output_dim, group_size,
+             pooled_size, part_size, sample_per_part, trans_std):
+    """Loop transcription of the CUDA kernel, NHWC data."""
+    b, H, W, C = data.shape
+    n = rois.shape[0]
+    p = pooled_size
+    num_classes = 1 if trans is None else trans.shape[1]
+    channels_each_class = max(output_dim // num_classes, 1)
+    out = np.zeros((n, p, p, output_dim), np.float64)
+    cnt = np.zeros((n, p, p, output_dim), np.float64)
+
+    def bilinear(plane, y, x):
+        x1, x2 = int(np.floor(x)), int(np.ceil(x))
+        y1, y2 = int(np.floor(y)), int(np.ceil(y))
+        dx, dy = x - x1, y - y1
+        return ((1 - dx) * (1 - dy) * plane[y1, x1]
+                + (1 - dx) * dy * plane[y2, x1]
+                + dx * (1 - dy) * plane[y1, x2]
+                + dx * dy * plane[y2, x2])
+
+    def c_round(v):  # CUDA round(): half away from zero (NOT half-to-even)
+        return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
+    for i in range(n):
+        bi = int(rois[i, 0])
+        x1 = c_round(rois[i, 1]) * spatial_scale - 0.5
+        y1 = c_round(rois[i, 2]) * spatial_scale - 0.5
+        x2 = (c_round(rois[i, 3]) + 1.0) * spatial_scale - 0.5
+        y2 = (c_round(rois[i, 4]) + 1.0) * spatial_scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bw, bh = rw / p, rh / p
+        sw, sh = bw / sample_per_part, bh / sample_per_part
+        for ph in range(p):
+            for pw in range(p):
+                part_h = int(np.floor(ph / p * part_size))
+                part_w = int(np.floor(pw / p * part_size))
+                gh = min(max((ph * group_size) // p, 0), group_size - 1)
+                gw = min(max((pw * group_size) // p, 0), group_size - 1)
+                for ctop in range(output_dim):
+                    cls = ctop // channels_each_class
+                    tx = 0.0 if trans is None else trans[i, cls, 0, part_h, part_w] * trans_std
+                    ty = 0.0 if trans is None else trans[i, cls, 1, part_h, part_w] * trans_std
+                    ws = pw * bw + x1 + tx * rw
+                    hs = ph * bh + y1 + ty * rh
+                    c = (ctop * group_size + gh) * group_size + gw
+                    s = 0.0
+                    k = 0
+                    for ih in range(sample_per_part):
+                        for iw in range(sample_per_part):
+                            w_ = ws + iw * sw
+                            h_ = hs + ih * sh
+                            if w_ < -0.5 or w_ > W - 0.5 or h_ < -0.5 or h_ > H - 0.5:
+                                continue
+                            w_ = min(max(w_, 0.0), W - 1.0)
+                            h_ = min(max(h_, 0.0), H - 1.0)
+                            s += bilinear(data[bi, :, :, c], h_, w_)
+                            k += 1
+                    out[i, ph, pw, ctop] = 0.0 if k == 0 else s / k
+                    cnt[i, ph, pw, ctop] = k
+    return out, cnt
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("with_trans", [False, True])
+def test_psroi_matches_numpy_transcription(with_trans):
+    rng = np.random.default_rng(0)
+    od, gs, p = 4, 2, 3
+    C = od * gs * gs
+    data = rng.standard_normal((2, 12, 14, C)).astype(np.float32)
+    # incl. a .5 coordinate to pin CUDA round() (half-away-from-zero)
+    rois = np.array(
+        [[0, 1, 1, 9, 8], [1, 0, 2, 13, 11], [0, 2.5, 3.5, 4, 4]], np.float32
+    )
+    part, spp, tstd = 3, 2, 0.1
+    trans = (
+        rng.standard_normal((3, 2, 2, part, part)).astype(np.float32)
+        if with_trans else None
+    )
+
+    out, cnt = deform_psroi_pooling(
+        jnp.asarray(data), jnp.asarray(rois),
+        None if trans is None else jnp.asarray(trans),
+        spatial_scale=0.5, output_dim=od, group_size=gs, pooled_size=p,
+        part_size=part, sample_per_part=spp, trans_std=tstd,
+    )
+    want, wcnt = np_psroi(
+        data.astype(np.float64), rois, trans, 0.5, od, gs, p, part, spp, tstd
+    )
+    np.testing.assert_allclose(np.asarray(cnt), wcnt)
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+
+def test_psroi_gradients_finite():
+    import jax
+
+    rng = np.random.default_rng(1)
+    od, gs, p = 2, 2, 2
+    data = jnp.asarray(rng.standard_normal((1, 8, 8, od * gs * gs)), jnp.float32)
+    rois = jnp.asarray([[0, 1, 1, 6, 6]], jnp.float32)
+    trans = jnp.asarray(rng.standard_normal((1, 1, 2, p, p)) * 0.1, jnp.float32)
+
+    def loss(d, t):
+        out, _ = deform_psroi_pooling(
+            d, rois, t, spatial_scale=1.0, output_dim=od, group_size=gs,
+            pooled_size=p, sample_per_part=2, trans_std=0.1,
+        )
+        return (out**2).sum()
+
+    gd, gt = jax.grad(loss, argnums=(0, 1))(data, trans)
+    assert np.isfinite(np.asarray(gd)).all() and np.abs(np.asarray(gd)).sum() > 0
+    assert np.isfinite(np.asarray(gt)).all()

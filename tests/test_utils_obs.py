@@ -154,3 +154,41 @@ def test_render_event_3d():
     assert img.ndim == 3 and img.shape[-1] == 3 and img.dtype == np.uint8
     both = render_event_3d(ev, (8, 8), gt_events=ev, gt_resolution=(16, 16))
     assert both.shape[1] > img.shape[1]  # side-by-side panel is wider
+
+
+def test_normalize_nonzero_numpy_and_jnp():
+    import jax.numpy as jnp
+
+    from esr_tpu.utils.trackers import normalize_nonzero
+
+    x = np.array([[0.0, 2.0], [4.0, 0.0]], np.float32)
+    out = normalize_nonzero(x.copy())
+    nz = out[x != 0]
+    assert abs(nz.mean()) < 1e-6 and out[0, 0] == 0.0 and out[1, 1] == 0.0
+
+    outj = np.asarray(normalize_nonzero(jnp.asarray(x)))
+    np.testing.assert_allclose(outj, out, atol=1e-5)
+    # all-zero input unchanged
+    z = np.zeros((3, 3), np.float32)
+    assert normalize_nonzero(z.copy()).sum() == 0
+    assert float(np.asarray(normalize_nonzero(jnp.asarray(z))).sum()) == 0
+
+
+def test_inf_loop_advances_epochs():
+    from esr_tpu.utils.trackers import inf_loop
+
+    class FakeLoader:
+        def __init__(self):
+            self.epochs = []
+
+        def set_epoch(self, e):
+            self.epochs.append(e)
+
+        def __iter__(self):
+            return iter([1, 2])
+
+    fl = FakeLoader()
+    it = inf_loop(fl)
+    got = [next(it) for _ in range(5)]
+    assert got == [1, 2, 1, 2, 1]
+    assert fl.epochs == [0, 1, 2]
