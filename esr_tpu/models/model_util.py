@@ -11,7 +11,7 @@ jit-compatible because shapes are Python ints at trace time.
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +72,45 @@ def crop_image(x: Array, spec: PadSpec, scale: int = 1) -> Array:
     iy0 = cy - math.floor(spec.height * scale / 2)
     iy1 = cy + math.ceil(spec.height * scale / 2)
     return x[..., iy0:iy1, ix0:ix1, :]
+
+
+def compute_pad_3d(
+    depth: int,
+    height: int,
+    width: int,
+    factor: int,
+    factor_d: Optional[int] = None,
+) -> Tuple[PadSpec, PadSpec]:
+    """3D variant of :func:`compute_pad` (reference ``CropSize3D``,
+    ``model_util.py:167-205``, which takes independent per-axis patch sizes):
+    pad specs making D divisible by ``factor_d`` (default: ``factor`` —
+    temporal strides often differ from spatial ones) and (H, W) by
+    ``factor``. Returns ``(depth_spec, plane_spec)`` where ``depth_spec``
+    uses the height slot for D."""
+    return (
+        compute_pad(depth, 1, factor_d if factor_d is not None else factor, 1),
+        compute_pad(height, width, factor, factor),
+    )
+
+
+def pad_volume(x: Array, depth_spec: PadSpec, plane_spec: PadSpec) -> Array:
+    """Zero-pad ``[..., D, H, W, C]`` per :func:`compute_pad_3d` specs
+    (ceil-half leading pad, like the 2D path)."""
+    pads = [(0, 0)] * (x.ndim - 4) + [
+        (depth_spec.top, depth_spec.bottom),
+        (plane_spec.top, plane_spec.bottom),
+        (plane_spec.left, plane_spec.right),
+        (0, 0),
+    ]
+    return jnp.pad(x, pads)
+
+
+def crop_volume(x: Array, depth_spec: PadSpec, plane_spec: PadSpec) -> Array:
+    """Inverse of :func:`pad_volume` (crop back to the original dims)."""
+    d0, d = depth_spec.top, depth_spec.height
+    h0, h = plane_spec.top, plane_spec.height
+    w0, w = plane_spec.left, plane_spec.width
+    return x[..., d0 : d0 + d, h0 : h0 + h, w0 : w0 + w, :]
 
 
 def _align_to(x1: Array, x2: Array) -> Array:

@@ -12,7 +12,9 @@ Rebuilds the remaining offline utilities of
 - :func:`h5_to_memmap` — events + frames exported as raw ``np.memmap``
   arrays + ``metadata.json`` (``h5_to_memmap.py:16-134``);
 - :func:`read_h5_summary` — quick inspection of a recording
-  (``read_events.py``).
+  (``read_events.py``);
+- :func:`validate_frame_sizes` — frame-directory sanity check preceding
+  packaging (``generate_dataset/test_size.py``).
 
 The reference's rosbag converter (``rosbag_to_h5.py``) requires a ROS python
 stack this image does not ship; :func:`extract_rosbag_to_h5` raises with a
@@ -198,6 +200,37 @@ def read_h5_summary(h5_path: str) -> Dict:
             elif key.endswith("images") or key == "images":
                 out["groups"][key] = len(f[key])
     return out
+
+
+def validate_frame_sizes(
+    root: str, expected: Tuple[int, int] = (720, 1280), pattern: str = "*.jpg"
+) -> Dict[str, List[str]]:
+    """Frame-dataset sanity check (reference
+    ``generate_dataset/test_size.py:11-20``): EVERY frame must be landscape
+    and match ``expected`` (H, W); unreadable frames are flagged too.
+    Returns ``{'portrait': [...], 'mismatched': [...], 'unreadable': [...]}``
+    of offending sequence directories."""
+    import cv2
+
+    bad: Dict[str, List[str]] = {"portrait": [], "mismatched": [], "unreadable": []}
+    for dirpath, _, _ in os.walk(root):
+        frames = sorted(glob.glob(os.path.join(dirpath, pattern)))
+        if not frames:
+            continue
+        flags = set()
+        for fp in frames:
+            img = cv2.imread(fp)
+            if img is None:
+                flags.add("unreadable")
+                continue
+            h, w = img.shape[:2]
+            if h > w:
+                flags.add("portrait")
+            if (h, w) != tuple(expected):
+                flags.add("mismatched")
+        for k in flags:
+            bad[k].append(dirpath)
+    return bad
 
 
 def extract_rosbag_to_h5(*args, **kwargs):

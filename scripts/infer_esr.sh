@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Evaluate a checkpoint on a datalist (reference analogue: scripts/infer_ours.sh).
+#
+#   scripts/infer_esr.sh <ckpt-dir> <datalist.txt> <output-dir> [extra infer.py args]
+set -euo pipefail
+CKPT=${1:?usage: infer_esr.sh <ckpt-dir> <datalist.txt> <out-dir> [args...]}
+LIST=${2:?usage: infer_esr.sh <ckpt-dir> <datalist.txt> <out-dir> [args...]}
+OUT=${3:?usage: infer_esr.sh <ckpt-dir> <datalist.txt> <out-dir> [args...]}
+shift 3
+exec python "$(dirname "$0")/../infer.py" \
+    --model_path "$CKPT" --data_list "$LIST" --output_path "$OUT" "$@"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Launch training (single host, all local devices, data-parallel).
+# Reference analogue: scripts/train_ours.sh (torch.distributed.launch);
+# under JAX SPMD no launcher is needed on one host. On TPU pods, run this
+# once per worker with --multihost.
+#
+#   scripts/train_esr.sh configs/train_esr_2x.yml run0 [extra train.py args]
+set -euo pipefail
+CONFIG=${1:?usage: train_esr.sh <config.yml> <runid> [args...]}
+RUNID=${2:?usage: train_esr.sh <config.yml> <runid> [args...]}
+shift 2
+exec python "$(dirname "$0")/../train.py" -c "$CONFIG" -id "$RUNID" "$@"

@@ -1,0 +1,55 @@
+"""3D pad/crop helpers + frame-size validation tool."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from esr_tpu.models.model_util import compute_pad_3d, crop_volume, pad_volume
+
+
+def test_pad_crop_volume_roundtrip():
+    x = jnp.asarray(np.random.default_rng(0).random((2, 5, 9, 11, 3)), jnp.float32)
+    dspec, pspec = compute_pad_3d(5, 9, 11, 4)
+    padded = pad_volume(x, dspec, pspec)
+    assert padded.shape == (2, 8, 12, 12, 3)
+    assert all(s % 4 == 0 for s in padded.shape[1:4])
+    back = crop_volume(padded, dspec, pspec)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_validate_frame_sizes(tmp_path):
+    import cv2
+
+    from esr_tpu.tools.h5_tools import validate_frame_sizes
+
+    good = tmp_path / "seq_good"; good.mkdir()
+    cv2.imwrite(str(good / "f0.jpg"), np.zeros((720, 1280, 3), np.uint8))
+    portrait = tmp_path / "seq_portrait"; portrait.mkdir()
+    cv2.imwrite(str(portrait / "f0.jpg"), np.zeros((1280, 720, 3), np.uint8))
+    odd = tmp_path / "seq_odd"; odd.mkdir()
+    cv2.imwrite(str(odd / "f0.jpg"), np.zeros((480, 640, 3), np.uint8))
+
+    bad = validate_frame_sizes(str(tmp_path))
+    assert any(p.endswith("seq_portrait") for p in bad["portrait"])
+    assert any(p.endswith("seq_odd") for p in bad["mismatched"])
+    assert not any(p.endswith("seq_good") for p in bad["portrait"] + bad["mismatched"])
+
+
+def test_pad_volume_independent_depth_factor():
+    x = jnp.ones((1, 5, 9, 11, 2))
+    dspec, pspec = compute_pad_3d(5, 9, 11, 8, factor_d=2)
+    padded = pad_volume(x, dspec, pspec)
+    assert padded.shape == (1, 6, 16, 16, 2)  # D->mult of 2, HW->mult of 8
+
+
+def test_validate_frame_sizes_deep_and_unreadable(tmp_path):
+    import cv2
+
+    from esr_tpu.tools.h5_tools import validate_frame_sizes
+
+    seq = tmp_path / "seq"; seq.mkdir()
+    cv2.imwrite(str(seq / "f0.jpg"), np.zeros((720, 1280, 3), np.uint8))
+    cv2.imwrite(str(seq / "f1.jpg"), np.zeros((1280, 720, 3), np.uint8))  # later frame bad
+    (seq / "f2.jpg").write_bytes(b"not a jpeg")
+    bad = validate_frame_sizes(str(tmp_path))
+    assert any(p.endswith("seq") for p in bad["portrait"])
+    assert any(p.endswith("seq") for p in bad["unreadable"])
