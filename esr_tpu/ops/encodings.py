@@ -14,11 +14,12 @@ Design choices vs the reference:
   data path jit-able and TPU-resident.
 - **Channel-last layouts.** TPU convs want NHWC, so rasterized outputs are
   ``[H, W, C]`` (reference: ``[C, H, W]``).
-- **Clean time binning.** The reference assigns events to temporal bins with
-  an inclusive binary search that double-counts exact-boundary events
-  (``encodings.py:176-181``). We use the standard half-open binning
-  ``bin = floor((t - t0)/dt * B)`` which is exact for the headline config
-  (TIME_BINS=1) and preserves the sum-over-bins == count-image invariant.
+- **Clean time binning by default.** The reference assigns events to temporal
+  bins with an inclusive binary search that double-counts exact-boundary
+  events (``encodings.py:176-181``). ``events_to_stack`` defaults to the
+  standard half-open binning ``bin = floor((t - t0)/dt * B)`` — exact for the
+  headline config (TIME_BINS=1) and sum-preserving — and offers
+  ``binning='inclusive'`` for bit-exact reference parity when needed.
 
 Events are a struct-of-arrays: ``xs, ys, ts, ps`` each ``[N]`` float32 (or
 int for coords), ``ps in {-1, +1}``, ``ts`` normalized to ``[0, 1]`` by the
