@@ -1,0 +1,87 @@
+"""Adapters: frame-recurrent models as windowed-trainer peers.
+
+The reference instantiates ANY model by config name into one trainer
+(``eval(config['model']['name'])``, ``train_ours_cnt_seq.py:762``), but its
+UNet family actually has a per-frame ``forward(x)`` signature while the
+trainer feeds ``B x N x C x kH x kW`` windows — the UNets are only nominally
+config-selectable. :class:`FrameRecurrentSR` closes that gap for real: it
+wraps a frame-recurrent model (UNetRecurrent / SRUNetRecurrent) with the
+windowed interface the BPTT step expects:
+
+- the window's frames are fed through the wrapped model IN ORDER, threading
+  its recurrent states (so temporal context accumulates exactly like the
+  reference's persistent-state loop);
+- the prediction for the window is the output at the middle frame
+  (``mid_idx = (N-1)//2`` — the frame the loss supervises,
+  ``train_ours_cnt_seq.py:195,220``);
+- a resolution mismatch between model output and input grid (SRUNetRecurrent
+  emits 2x) is reconciled by the reference's own rule: bicubic resize to the
+  target grid (``train_ours_cnt_seq.py:224-225``).
+
+Registered names: ``SRUNetRecurrentSeq``, ``UNetRecurrentSeq`` — drop-in
+``model.name`` values for the standard training YAML.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from esr_tpu.models.unet import SRUNetRecurrent, UNetRecurrent
+
+Array = jax.Array
+
+
+class FrameRecurrentSR(nn.Module):
+    """Windowed-trainer interface over a frame-recurrent model.
+
+    ``__call__(x [B, N, H, W, inch], states) -> (out [B, H, W, inch], states)``
+    — the same contract as ``DeepRecurrNet``.
+    """
+
+    model: nn.Module
+    num_frame: int = 3
+
+    @property
+    def inch(self) -> int:
+        return self.model.num_bins
+
+    def init_states(self, batch: int, height: int, width: int):
+        return self.model.init_states(batch, height, width)
+
+    def __call__(self, x: Array, states) -> Tuple[Array, Any]:
+        b, n, h, w, c = x.shape
+        assert n == self.num_frame, (
+            f"window length {n} != num_frame {self.num_frame} "
+            "(keep model.args.num_frame == dataset.sequence.seqn, like "
+            "DeepRecurrNet)"
+        )
+        mid = (n - 1) // 2
+        out_mid = None
+        for i in range(n):
+            out, states = self.model(x[:, i], states)
+            if i == mid:
+                out_mid = out
+        if out_mid.shape[1:3] != (h, w):
+            from esr_tpu.ops.resize import interpolate
+
+            out_mid = interpolate(out_mid, (h, w), "bicubic")
+        return out_mid, states
+
+
+def srunet_recurrent_seq(num_frame: int = 3, **kwargs) -> FrameRecurrentSR:
+    """``SRUNetRecurrent`` as a windowed-trainer model (2x SR output,
+    bicubic-reconciled to the input grid per the reference train rule)."""
+    kwargs.setdefault("num_output_channels", 2)
+    kwargs.setdefault("num_bins", 2)
+    return FrameRecurrentSR(model=SRUNetRecurrent(**kwargs), num_frame=num_frame)
+
+
+def unet_recurrent_seq(num_frame: int = 3, **kwargs) -> FrameRecurrentSR:
+    """``UNetRecurrent`` as a windowed-trainer model (same-resolution head)."""
+    kwargs.setdefault("num_output_channels", 2)
+    kwargs.setdefault("num_bins", 2)
+    return FrameRecurrentSR(model=UNetRecurrent(**kwargs), num_frame=num_frame)

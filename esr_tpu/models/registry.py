@@ -8,11 +8,12 @@ registry").
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Type
+from typing import Callable, Dict
 
 from flax import linen as nn
 
-MODEL_REGISTRY: Dict[str, Type[nn.Module]] = {}
+# values are Module classes OR factory callables returning a Module
+MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
 
 
 def register_model(name: str) -> Callable:
@@ -41,11 +42,19 @@ def _register_builtins():
         UNetRecurrent,
     )
 
+    from esr_tpu.models.adapters import (
+        srunet_recurrent_seq,
+        unet_recurrent_seq,
+    )
+
     MODEL_REGISTRY.setdefault("DeepRecurrNet", DeepRecurrNet)
     MODEL_REGISTRY.setdefault("UNetFlow", UNetFlow)
     MODEL_REGISTRY.setdefault("UNetRecurrent", UNetRecurrent)
     MODEL_REGISTRY.setdefault("MultiResUNet", MultiResUNet)
     MODEL_REGISTRY.setdefault("SRUNetRecurrent", SRUNetRecurrent)
+    # windowed-trainer peers (same YAML/trainer as DeepRecurrNet)
+    MODEL_REGISTRY.setdefault("SRUNetRecurrentSeq", srunet_recurrent_seq)
+    MODEL_REGISTRY.setdefault("UNetRecurrentSeq", unet_recurrent_seq)
 
 
 _register_builtins()
