@@ -5,5 +5,17 @@ from esr_tpu.inference.harness import (
     aggregate_results,
     run_inference,
 )
+from esr_tpu.inference.export import (
+    export_checkpoint,
+    load_exported_model,
+    save_exported_model,
+)
 
-__all__ = ["InferenceRunner", "aggregate_results", "run_inference"]
+__all__ = [
+    "InferenceRunner",
+    "aggregate_results",
+    "run_inference",
+    "export_checkpoint",
+    "load_exported_model",
+    "save_exported_model",
+]

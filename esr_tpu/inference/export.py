@@ -1,0 +1,136 @@
+"""Model export: serialized, ahead-of-time-lowered forward functions.
+
+The reference ships an ONNX/TensorRT deployment variant of its hot op
+(``models/DCNv2/dcn_v2_onnx.py`` — a ``symbolic()`` hook emitting a TensorRT
+"Plugin" node). The TPU-native equivalent of that deployment path is
+``jax.export``: the jitted forward — recurrent state threading, Pallas DCN
+kernel and all — is lowered once to StableHLO and serialized to a
+self-contained artifact that any later jax (or pure-XLA) runtime can load and
+run without the model source. Unlike the reference's per-op plugin, the WHOLE
+program is exported, so there is nothing to re-register on the consumer side.
+
+Artifact layout (a single ``.npz``-style zip is deliberately avoided — the
+serialized module is opaque bytes + a small JSON sidecar):
+
+- ``<path>`` — ``jax.export`` serialization of
+  ``fn(params, x, states) -> (y, states)``;
+- ``<path>.json`` — model name/config, input/state tree structure and shapes,
+  so consumers can build feeds without importing this package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _shape_dtype(tree):
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), jnp.asarray(a).dtype), tree
+    )
+
+
+def export_forward(
+    model,
+    params,
+    example_input: Any,
+    example_states: Any,
+    platforms: Tuple[str, ...] = ("tpu", "cpu"),
+) -> bytes:
+    """Lower ``model.apply(params, x, states) -> (y, states)`` and serialize.
+
+    ``platforms`` lists the lowering targets baked into the artifact; the
+    default covers the TPU serving path plus a CPU fallback so the artifact
+    loads anywhere. A multi-platform artifact must lower every op for every
+    target, which the TPU-only Pallas DCN kernel cannot — models exposing a
+    ``dcn_impl`` knob are transparently rebound to the portable jnp
+    formulation (identical math; the kernel is a speed/precision upgrade,
+    ``ops/dcn.py:142-148``). Export with ``platforms=('tpu',)`` to keep the
+    fused kernel in the artifact.
+    """
+    if len(platforms) > 1 and getattr(model, "dcn_impl", None) in ("auto", "pallas"):
+        model = model.clone(dcn_impl="jnp")
+
+    def fn(params, x, states):
+        return model.apply(params, x, states)
+
+    exported = jax.export.export(jax.jit(fn), platforms=list(platforms))(
+        _shape_dtype(params), _shape_dtype(example_input),
+        _shape_dtype(example_states),
+    )
+    return bytes(exported.serialize())
+
+
+def load_exported(data: bytes) -> Callable:
+    """Deserialize an :func:`export_forward` artifact into a callable with
+    the original ``(params, x, states) -> (y, states)`` signature."""
+    return jax.export.deserialize(data).call
+
+
+def save_exported_model(
+    path: str,
+    model,
+    params,
+    example_input: Any,
+    example_states: Any,
+    config: Optional[Dict] = None,
+    platforms: Tuple[str, ...] = ("tpu", "cpu"),
+) -> str:
+    """Serialize to ``path`` (+ ``path.json`` sidecar). Returns ``path``."""
+    blob = export_forward(model, params, example_input, example_states, platforms)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+
+    def describe(tree):
+        leaves, treedef = jax.tree.flatten(tree)
+        return {
+            "treedef": str(treedef),
+            "shapes": [list(np.shape(l)) for l in leaves],
+            "dtypes": [str(jnp.asarray(l).dtype) for l in leaves],
+        }
+
+    sidecar = {
+        "model": type(model).__name__,
+        "config": config or {},
+        "platforms": list(platforms),
+        "input": describe(example_input),
+        "states": describe(example_states),
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f, indent=2)
+    return path
+
+
+def load_exported_model(path: str) -> Tuple[Callable, Dict]:
+    """Load ``(callable, sidecar_dict)`` back from :func:`save_exported_model`."""
+    with open(path, "rb") as f:
+        fn = load_exported(f.read())
+    sidecar: Dict = {}
+    if os.path.exists(path + ".json"):
+        with open(path + ".json") as f:
+            sidecar = json.load(f)
+    return fn, sidecar
+
+
+def export_checkpoint(ckpt_path: str, out_path: str,
+                      batch: int = 1, height: int = 64, width: int = 64) -> str:
+    """Checkpoint directory -> deployable artifact: rebuilds the model from
+    the embedded config (the same convention inference uses,
+    ``training/checkpoint.py:load_for_inference``) and exports its forward
+    at the given input geometry."""
+    from esr_tpu.training.checkpoint import load_for_inference
+
+    model, params, config = load_for_inference(ckpt_path)
+    seqn = int(config.get("model", {}).get("args", {}).get("num_frame", 3))
+    inch = int(getattr(model, "inch", 2))
+    x = jnp.zeros((batch, seqn, height, width, inch), jnp.float32)
+    states = model.init_states(batch, height, width)
+    return save_exported_model(
+        out_path, model, params, x, states, config=config
+    )

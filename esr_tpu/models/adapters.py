@@ -24,10 +24,9 @@ Registered names: ``SRUNetRecurrentSeq``, ``UNetRecurrentSeq`` — drop-in
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
-import jax.numpy as jnp
 from flax import linen as nn
 
 from esr_tpu.models.unet import SRUNetRecurrent, UNetRecurrent
@@ -59,6 +58,9 @@ class FrameRecurrentSR(nn.Module):
             "(keep model.args.num_frame == dataset.sequence.seqn, like "
             "DeepRecurrNet)"
         )
+        # same window invariant as DeepRecurrNet (esr.py): an even window has
+        # no middle frame to supervise
+        assert n >= 3 and n % 2 == 1, f"num_frame must be odd and >= 3, got {n}"
         mid = (n - 1) // 2
         out_mid = None
         for i in range(n):

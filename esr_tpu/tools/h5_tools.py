@@ -13,6 +13,14 @@ Rebuilds the remaining offline utilities of
   arrays + ``metadata.json`` (``h5_to_memmap.py:16-134``);
 - :func:`read_h5_summary` — quick inspection of a recording
   (``read_events.py``);
+- :func:`read_h5_events` / :func:`read_h5_event_components` — whole-recording
+  event readers incl. the legacy ``events/x`` key scheme
+  (``read_events.py:59-75``);
+- :func:`read_memmap` — loader for the :func:`h5_to_memmap` layout
+  (``read_events.py:10-57`` reads the same tree);
+- :func:`events_to_ply` — event cloud -> binary PLY point cloud for external
+  3D viewers (``myutils/vis_events/tools/hxy_events2ply.py``), written
+  dependency-free (no ``plyfile`` in this image);
 - :func:`validate_frame_sizes` — frame-directory sanity check preceding
   packaging (``generate_dataset/test_size.py``).
 
@@ -149,15 +157,17 @@ def h5_to_memmap(h5_path: str, output_dir: str, overwrite: bool = True) -> str:
         p[:, 0] = np.asarray(f["events/ps"][:]) > 0
         t.flush(); xy.flush(); p.flush()
 
+        images_shape = None
         if "images" in f:
             names = sorted(f["images"])
             if names:
                 first = f[f"images/{names[0]}"]
                 h, w = first.attrs["size"][:2]
                 c = 1 if len(first.attrs["size"]) <= 2 else first.attrs["size"][2]
+                images_shape = [len(names), int(h), int(w), int(c)]
                 imgs = np.memmap(
                     os.path.join(mmap_dir, "images.npy"), "uint8", "w+",
-                    shape=(len(names), int(h), int(w), int(c)),
+                    shape=tuple(images_shape),
                 )
                 img_ts = np.memmap(
                     os.path.join(mmap_dir, "timestamps.npy"), "float64", "w+",
@@ -180,6 +190,8 @@ def h5_to_memmap(h5_path: str, output_dir: str, overwrite: bool = True) -> str:
             for k, v in f.attrs.items()
         }
         meta["num_events"] = int(meta.get("num_events", n))
+        if images_shape is not None:
+            meta["images_shape"] = images_shape
     with open(os.path.join(mmap_dir, "metadata.json"), "w") as js:
         json.dump(meta, js)
     return mmap_dir
@@ -200,6 +212,133 @@ def read_h5_summary(h5_path: str) -> Dict:
             elif key.endswith("images") or key == "images":
                 out["groups"][key] = len(f[key])
     return out
+
+
+def read_h5_event_components(
+    h5_path: str, group: str = "events"
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """``(xs, ys, ts, ps)`` for a whole recording, ``ps`` in {+1, -1};
+    accepts both the current ``xs/ys/ts/ps`` keys and the legacy
+    ``x/y/ts/p`` scheme (``read_events.py:68-75``)."""
+    import h5py
+
+    with h5py.File(h5_path, "r") as f:
+        if f"{group}/x" in f:  # legacy
+            return (
+                f[f"{group}/x"][:], f[f"{group}/y"][:], f[f"{group}/ts"][:],
+                np.where(np.asarray(f[f"{group}/p"][:]) > 0, 1, -1),
+            )
+        return (
+            f[f"{group}/xs"][:], f[f"{group}/ys"][:], f[f"{group}/ts"][:],
+            np.where(np.asarray(f[f"{group}/ps"][:]) > 0, 1, -1),
+        )
+
+
+def read_h5_events(h5_path: str, group: str = "events") -> np.ndarray:
+    """``[N, 4]`` ``(x, y, t, p)`` stack (``read_events.py:59-66``)."""
+    xs, ys, ts, ps = read_h5_event_components(h5_path, group)
+    return np.stack([xs, ys, ts, ps], axis=1).astype(np.float64)
+
+
+def read_memmap(mmap_dir: str, return_events: bool = False) -> Dict:
+    """Load a :func:`h5_to_memmap` directory back as (mem-mapped) arrays
+    (role of ``read_events.py:read_memmap_events``, ``:10-57``).
+
+    Shapes are recovered from the file sizes plus ``metadata.json`` (the
+    arrays are raw memmaps, not ``.npy``-with-header). With
+    ``return_events=False`` the event arrays stay memory-mapped."""
+    with open(os.path.join(mmap_dir, "metadata.json")) as js:
+        meta = json.load(js)
+    n = os.path.getsize(os.path.join(mmap_dir, "t.npy")) // 8
+    data: Dict = {"metadata": meta, "num_events": n, "path": mmap_dir}
+    t = np.memmap(os.path.join(mmap_dir, "t.npy"), "float64", "r", shape=(n, 1))
+    xy = np.memmap(os.path.join(mmap_dir, "xy.npy"), "int16", "r", shape=(n, 2))
+    p = np.memmap(os.path.join(mmap_dir, "p.npy"), "bool", "r", shape=(n, 1))
+    if return_events:
+        data["t"], data["xy"], data["p"] = t[:], xy[:], p[:]
+    else:
+        data["t"], data["xy"], data["p"] = t, xy, p
+    data["t0"] = float(t[0, 0]) if n else 0.0
+
+    ts_path = os.path.join(mmap_dir, "timestamps.npy")
+    if os.path.exists(ts_path):
+        n_img = os.path.getsize(ts_path) // 8
+        data["frame_stamps"] = np.memmap(ts_path, "float64", "r", shape=(n_img, 1))
+        data["index"] = np.memmap(
+            os.path.join(mmap_dir, "image_event_indices.npy"),
+            "uint64", "r", shape=(n_img, 1),
+        )
+        img_path = os.path.join(mmap_dir, "images.npy")
+        shape = meta.get("images_shape")
+        if shape is None and os.path.exists(img_path):
+            # pre-images_shape exports: frames were written at sensor size
+            res = meta.get("sensor_resolution")
+            if res is not None:
+                h, w = int(res[0]), int(res[1])
+                c = os.path.getsize(img_path) // max(n_img * h * w, 1)
+                if c > 0:
+                    shape = [n_img, h, w, c]
+        if shape is not None and os.path.exists(img_path):
+            data["images"] = np.memmap(
+                img_path, "uint8", "r", shape=tuple(shape)
+            )
+    return data
+
+
+def events_to_ply(
+    events: np.ndarray,
+    resolution: Tuple[int, int],
+    output_path: str,
+    text: bool = False,
+) -> int:
+    """Event cloud -> PLY point cloud (``hxy_events2ply.py:22-71``): vertices
+    ``(x, y, z=t)`` with ``t`` min-max-normalized to the sensor height so the
+    cloud is roughly cubic, colored red=positive / blue=negative. Written as
+    binary-little-endian (or ASCII with ``text=True``) without ``plyfile``.
+
+    ``events``: ``[N, 4]`` ``(x, y, t, p)``, ``p`` in {+1, -1}.
+    Returns the number of vertices written.
+    """
+    events = np.asarray(events)
+    n = len(events)
+    xs = events[:, 0].astype("<f4")
+    ys = events[:, 1].astype("<f4")
+    ts = events[:, 2].astype(np.float64)
+    ps = events[:, 3]
+    if n:
+        rng = ts.max() - ts.min()
+        ts = (ts - ts.min()) / (rng if rng else 1.0) * resolution[0]
+
+    vertices = np.empty(
+        n,
+        dtype=[("x", "<f4"), ("y", "<f4"), ("z", "<f4"),
+               ("red", "u1"), ("green", "u1"), ("blue", "u1")],
+    )
+    vertices["x"] = xs
+    vertices["y"] = ys
+    vertices["z"] = ts.astype("<f4")
+    vertices["red"] = np.where(ps > 0, 255, 0).astype("u1")
+    vertices["green"] = 0
+    vertices["blue"] = np.where(ps < 0, 255, 0).astype("u1")
+
+    fmt = "ascii" if text else "binary_little_endian"
+    header = (
+        f"ply\nformat {fmt} 1.0\nelement vertex {n}\n"
+        "property float x\nproperty float y\nproperty float z\n"
+        "property uchar red\nproperty uchar green\nproperty uchar blue\n"
+        "end_header\n"
+    )
+    with open(output_path, "wb") as f:
+        f.write(header.encode("ascii"))
+        if text:
+            for v in vertices:
+                f.write(
+                    f"{v['x']:g} {v['y']:g} {v['z']:g} "
+                    f"{v['red']} {v['green']} {v['blue']}\n".encode("ascii")
+                )
+        else:
+            f.write(vertices.tobytes())
+    return n
 
 
 def validate_frame_sizes(

@@ -218,7 +218,9 @@ def load_for_inference(path: str) -> Tuple[Any, Any, Dict]:
     # independent of spatial size; any /8-friendly dummy works). The optimizer
     # is rebuilt from the embedded config purely to shape its state slot.
     n = config["model"].get("args", {}).get("num_frame", 3)
-    inch = config["model"].get("args", {}).get("inch", 2)
+    # channel count comes from the built model (seq adapters derive it from
+    # num_bins; 'inch' is absent from their configs)
+    inch = int(getattr(model, "inch", 2))
     x = jnp.zeros((1, n, 16, 16, inch), jnp.float32)
     states = model.init_states(1, 16, 16)
     it_cfg = config.get("trainer", {}).get("iteration_based_train", {})

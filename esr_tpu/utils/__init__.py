@@ -8,6 +8,7 @@ from esr_tpu.utils.trackers import MetricTracker, YamlLogger
 from esr_tpu.utils.timers import Timer, timing_stats, print_timing_info
 from esr_tpu.utils.logging import setup_logging
 from esr_tpu.utils.writer import MetricWriter
+from esr_tpu.utils.pipeline_vis import PipelineVisualizer, flow_to_image, minmax_norm
 
 __all__ = [
     "MetricTracker",
@@ -17,4 +18,7 @@ __all__ = [
     "print_timing_info",
     "setup_logging",
     "MetricWriter",
+    "PipelineVisualizer",
+    "flow_to_image",
+    "minmax_norm",
 ]

@@ -1,0 +1,180 @@
+"""Live-pipeline visualization: flow color wheel, IWE/brightness rendering,
+per-sequence PNG stores.
+
+Rebuilds the reference's cv2-window ``Visualization`` class
+(``myutils/vis_events/tools``' sibling ``myutils/vis_events/visualization.py:11-391``)
+for a headless TPU VM: rendering is pure numpy (+``matplotlib.colors`` for the
+HSV wheel), windows are dropped (no display on a pod worker), and the
+``store()`` directory layout — ``<dir>/<sequence>/{events,flow,frames,iwe,
+brightness}/%09d.png`` plus ``timestamps.txt`` — is kept so downstream
+tooling that walks reference result trees keeps working.
+
+Parity notes:
+- ``flow_to_image`` reproduces ``visualization.py:289-314``: hue = angle
+  remapped from ``atan2`` to [0,1], saturation 1, value = min-max-normalized
+  magnitude, converted with ``matplotlib.colors.hsv_to_rgb`` (identical
+  function, identical discretization to uint8).
+- ``minmax_norm`` is the robust P1/P99 normalization of ``:316-326``.
+- event count images reuse :func:`esr_tpu.utils.vis_events.render_event_cnt`,
+  whose percentile semantics match ``events_to_image`` (``:328-391``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from .vis_events import render_event_cnt, save_image
+
+
+def flow_to_image(flow_x: np.ndarray, flow_y: np.ndarray) -> np.ndarray:
+    """Color-encode optical flow with the CVPR'21 photometric-constancy
+    scheme (reference ``visualization.py:289-314``).
+
+    ``flow_x``/``flow_y``: ``[H, W]`` components. Returns ``[H, W, 3]`` uint8.
+    """
+    import matplotlib.colors
+
+    flow_x = np.asarray(flow_x, np.float64)
+    flow_y = np.asarray(flow_y, np.float64)
+    mag = np.sqrt(flow_x**2 + flow_y**2)
+    min_mag = mag.min()
+    mag_range = mag.max() - min_mag
+
+    ang = np.arctan2(flow_y, flow_x) + np.pi
+    ang = ang / (2.0 * np.pi)
+
+    hsv = np.zeros(flow_x.shape + (3,))
+    hsv[:, :, 0] = ang
+    hsv[:, :, 1] = 1.0
+    hsv[:, :, 2] = mag - min_mag
+    if mag_range != 0.0:
+        hsv[:, :, 2] /= mag_range
+
+    return (255 * matplotlib.colors.hsv_to_rgb(hsv)).astype(np.uint8)
+
+
+def minmax_norm(x: np.ndarray) -> np.ndarray:
+    """Robust min-max normalization to [0,1] over the P1..P99 range
+    (reference ``visualization.py:316-326``)."""
+    lo = np.percentile(x, 1)
+    den = np.percentile(x, 99) - lo
+    if den != 0:
+        x = (x - lo) / den
+    return np.clip(x, 0, 1)
+
+
+def _chw_to_hwc(arr: np.ndarray, channels: int) -> np.ndarray:
+    """``[B, C, H, W]`` or ``[C, H, W]`` or ``[H, W, C]`` → ``[H, W, C]``
+    (the reference transposes batch-first torch tensors; we accept either
+    layout since the framework is NHWC)."""
+    a = np.asarray(arr)
+    if a.ndim == 4:  # batched: take item 0, either layout
+        a = a[0]
+    if a.ndim == 2:
+        a = a[..., None]
+    if a.shape[0] == channels and a.shape[-1] != channels:
+        a = np.transpose(a, (1, 2, 0))
+    return a
+
+
+class PipelineVisualizer:
+    """Renders and stores every intermediate of the self-supervised flow /
+    reconstruction pipeline: input events, flow, image of warped events,
+    reconstructed brightness, input frames.
+
+    ``store()`` mirrors the reference's result-tree layout
+    (``visualization.py:209-286``); rendering without storing is ``render()``
+    (the headless stand-in for the cv2-window ``update()``, ``:146-207``).
+    """
+
+    def __init__(self, store_dir: Optional[str] = None,
+                 color_scheme: str = "green_red") -> None:
+        self.store_dir = store_dir
+        self.color_scheme = color_scheme
+        self.img_idx = 0
+        self._sequence: Optional[str] = None
+        self._ts_file = None
+
+    # -- rendering ---------------------------------------------------------
+
+    def render(
+        self,
+        inputs: Optional[Dict[str, np.ndarray]] = None,
+        flow: Optional[np.ndarray] = None,
+        iwe: Optional[np.ndarray] = None,
+        brightness: Optional[np.ndarray] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Render whatever is present into uint8 images keyed like the
+        reference's windows/subdirs."""
+        out: Dict[str, np.ndarray] = {}
+        inputs = inputs or {}
+        ev = inputs.get("inp_cnt", inputs.get("e_cnt"))
+        if ev is not None:
+            out["events"] = render_event_cnt(
+                _chw_to_hwc(ev, 2), color_scheme=self.color_scheme
+            )
+        frames = inputs.get("inp_frames")
+        if frames is not None:
+            f = _chw_to_hwc(frames, 2)
+            # prev/curr side by side, raw 0..255 grayscale (reference :168-176)
+            pair = np.concatenate([f[:, :, 0], f[:, :, 1]], axis=1)
+            out["frames"] = np.clip(pair, 0, 255).astype(np.uint8)
+        if flow is not None:
+            f = _chw_to_hwc(flow, 2)
+            out["flow"] = flow_to_image(f[:, :, 0], f[:, :, 1])
+        if iwe is not None:
+            out["iwe"] = render_event_cnt(
+                _chw_to_hwc(iwe, 2), color_scheme=self.color_scheme
+            )
+        if brightness is not None:
+            b = _chw_to_hwc(brightness, 1)
+            out["brightness"] = (
+                minmax_norm(b[:, :, 0]) * 255
+            ).astype(np.uint8)
+        return out
+
+    # -- storage -----------------------------------------------------------
+
+    def store(
+        self,
+        inputs: Optional[Dict[str, np.ndarray]],
+        flow: Optional[np.ndarray],
+        iwe: Optional[np.ndarray],
+        brightness: Optional[np.ndarray],
+        sequence: str,
+        ts: Optional[float] = None,
+    ) -> Dict[str, str]:
+        """Write rendered PNGs under ``store_dir/sequence/<kind>/%09d.png``
+        and append ``ts`` to ``timestamps.txt``; resets the frame index when
+        the sequence changes (reference ``:225-237``). Returns the paths
+        written."""
+        assert self.store_dir is not None, "PipelineVisualizer needs store_dir"
+        root = os.path.join(self.store_dir, sequence)
+        if sequence != self._sequence:
+            for sub in ("events", "flow", "frames", "iwe", "brightness"):
+                os.makedirs(os.path.join(root, sub), exist_ok=True)
+            if self._ts_file is not None:
+                self._ts_file.close()
+            self._ts_file = open(os.path.join(root, "timestamps.txt"), "w")
+            self._sequence = sequence
+            self.img_idx = 0
+
+        rendered = self.render(inputs, flow, iwe, brightness)
+        written: Dict[str, str] = {}
+        for kind, img in rendered.items():
+            path = os.path.join(root, kind, "%09d.png" % self.img_idx)
+            save_image(path, img)
+            written[kind] = path
+        if ts is not None and self._ts_file is not None:
+            self._ts_file.write(str(ts) + "\n")
+            self._ts_file.flush()
+        self.img_idx += 1
+        return written
+
+    def close(self) -> None:
+        if self._ts_file is not None:
+            self._ts_file.close()
+            self._ts_file = None

@@ -1,0 +1,227 @@
+"""Pipeline visualization (flow color wheel, store layout) + PLY/memmap tools."""
+
+import os
+
+import numpy as np
+import pytest
+
+from esr_tpu.tools.h5_tools import (
+    events_to_ply,
+    h5_to_memmap,
+    read_h5_event_components,
+    read_h5_events,
+    read_memmap,
+)
+from esr_tpu.utils.pipeline_vis import PipelineVisualizer, flow_to_image, minmax_norm
+
+
+def test_flow_to_image_matches_reference_formula():
+    """Pin the HSV wheel against a direct transcription of the reference's
+    flow_to_image (visualization.py:289-314)."""
+    import matplotlib.colors
+
+    rng = np.random.default_rng(0)
+    fx = rng.normal(size=(13, 17))
+    fy = rng.normal(size=(13, 17))
+
+    # independent transcription
+    mag = np.linalg.norm(np.stack((fx, fy), 2), axis=2)
+    ang = (np.arctan2(fy, fx) + np.pi) / (2 * np.pi)
+    hsv = np.stack(
+        [ang, np.ones_like(ang), (mag - mag.min()) / (mag.max() - mag.min())], -1
+    )
+    expected = (255 * matplotlib.colors.hsv_to_rgb(hsv)).astype(np.uint8)
+
+    np.testing.assert_array_equal(flow_to_image(fx, fy), expected)
+
+
+def test_flow_to_image_cardinal_hues():
+    """Pure +x flow maps to hue 0.5 (cyan-ish), pure -x to hue 0/1 (red);
+    uniform magnitude field stays black (mag_range == 0 -> value 0)."""
+    fx = np.ones((4, 4))
+    fy = np.zeros((4, 4))
+    img = flow_to_image(fx, fy)
+    # constant magnitude -> value channel is 0 everywhere
+    assert img.max() == 0
+
+    # two-magnitude field: the larger-magnitude pixels get value 1
+    fx2 = np.ones((2, 2))
+    fx2[0, 0] = 2.0
+    img2 = flow_to_image(fx2, np.zeros((2, 2)))
+    assert img2[0, 0].max() == 255
+    # +x flow after +pi shift -> angle pi -> hue .5 -> cyan (G=B>R)
+    assert img2[0, 0, 1] == img2[0, 0, 2] > img2[0, 0, 0]
+
+
+def test_minmax_norm_percentile_range():
+    x = np.linspace(0, 100, 1000).reshape(10, 100)
+    y = minmax_norm(x)
+    assert y.min() == 0.0 and y.max() == 1.0
+    # values below P1 clip to 0, above P99 clip to 1
+    assert (y == 0).sum() >= 10 and (y == 1).sum() >= 10
+
+
+def test_pipeline_visualizer_render_keys():
+    rng = np.random.default_rng(1)
+    viz = PipelineVisualizer()
+    out = viz.render(
+        inputs={
+            "inp_cnt": rng.poisson(1.0, size=(1, 8, 9, 2)).astype(np.float32),
+            "inp_frames": rng.uniform(0, 255, size=(1, 8, 9, 2)),
+        },
+        flow=rng.normal(size=(1, 8, 9, 2)),
+        iwe=rng.poisson(1.0, size=(8, 9, 2)).astype(np.float32),
+        brightness=rng.normal(size=(8, 9, 1)),
+    )
+    assert set(out) == {"events", "frames", "flow", "iwe", "brightness"}
+    assert out["events"].shape == (8, 9, 3)
+    assert out["frames"].shape == (8, 18)  # prev/curr side by side
+    assert out["flow"].shape == (8, 9, 3)
+    assert out["brightness"].dtype == np.uint8
+
+
+def test_pipeline_visualizer_chw_layout_accepted():
+    """Reference feeds B,C,H,W torch tensors; NHWC and NCHW must render
+    identically."""
+    rng = np.random.default_rng(2)
+    cnt_nhwc = rng.poisson(1.0, size=(1, 8, 9, 2)).astype(np.float32)
+    cnt_nchw = np.transpose(cnt_nhwc, (0, 3, 1, 2))
+    viz = PipelineVisualizer()
+    a = viz.render(inputs={"inp_cnt": cnt_nhwc})["events"]
+    b = viz.render(inputs={"inp_cnt": cnt_nchw})["events"]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_pipeline_visualizer_store_layout(tmp_path):
+    rng = np.random.default_rng(3)
+    viz = PipelineVisualizer(store_dir=str(tmp_path))
+    for i in range(2):
+        written = viz.store(
+            inputs={"inp_cnt": rng.poisson(1.0, (1, 6, 7, 2)).astype(np.float32)},
+            flow=rng.normal(size=(6, 7, 2)),
+            iwe=None,
+            brightness=None,
+            sequence="recA",
+            ts=0.5 * i,
+        )
+    assert viz.img_idx == 2
+    for kind in ("events", "flow"):
+        assert os.path.exists(tmp_path / "recA" / kind / "000000000.png")
+        assert os.path.exists(tmp_path / "recA" / kind / "000000001.png")
+    # empty dirs still created (reference :227-233)
+    assert (tmp_path / "recA" / "brightness").is_dir()
+    assert written["events"].endswith("000000001.png")
+
+    # sequence switch resets the index and opens a new timestamps file
+    viz.store({"inp_cnt": np.ones((1, 6, 7, 2))}, None, None, None, "recB", ts=9.0)
+    assert viz.img_idx == 1
+    viz.close()
+    assert (tmp_path / "recA" / "timestamps.txt").read_text() == "0.0\n0.5\n"
+    assert (tmp_path / "recB" / "timestamps.txt").read_text() == "9.0\n"
+
+
+@pytest.fixture
+def recording(tmp_path):
+    import h5py
+
+    path = str(tmp_path / "rec.h5")
+    rng = np.random.default_rng(4)
+    n = 257
+    xs = rng.integers(0, 9, n)
+    ys = rng.integers(0, 7, n)
+    ts = np.sort(rng.uniform(0, 1, n))
+    ps = rng.choice([-1, 1], n)
+    with h5py.File(path, "w") as f:
+        f.create_dataset("events/xs", data=xs.astype(np.int16))
+        f.create_dataset("events/ys", data=ys.astype(np.int16))
+        f.create_dataset("events/ts", data=ts)
+        f.create_dataset("events/ps", data=ps.astype(np.int8))
+        f.attrs["sensor_resolution"] = [7, 9]
+    return path, xs, ys, ts, ps
+
+
+def test_read_h5_events_and_legacy_keys(recording, tmp_path):
+    import h5py
+
+    path, xs, ys, ts, ps = recording
+    ev = read_h5_events(path)
+    assert ev.shape == (257, 4)
+    np.testing.assert_array_equal(ev[:, 0], xs)
+    np.testing.assert_array_equal(ev[:, 3], ps)
+
+    # legacy x/y/p bool scheme
+    legacy = str(tmp_path / "legacy.h5")
+    with h5py.File(legacy, "w") as f:
+        f.create_dataset("events/x", data=xs.astype(np.int16))
+        f.create_dataset("events/y", data=ys.astype(np.int16))
+        f.create_dataset("events/ts", data=ts)
+        f.create_dataset("events/p", data=(ps > 0))
+    lx, ly, lt, lp = read_h5_event_components(legacy)
+    np.testing.assert_array_equal(lx, xs)
+    np.testing.assert_array_equal(lp, ps)  # bools mapped back to +/-1
+
+
+def test_memmap_roundtrip(recording, tmp_path):
+    import h5py
+
+    path, xs, ys, ts, ps = recording
+    # add two frames so the image branch round-trips too
+    rng = np.random.default_rng(5)
+    frames = rng.integers(0, 255, size=(2, 7, 9), dtype=np.uint8)
+    with h5py.File(path, "a") as f:
+        for i in range(2):
+            d = f.create_dataset(f"images/image{i:09d}", data=frames[i])
+            d.attrs["size"] = [7, 9]
+            d.attrs["timestamp"] = float(ts[100 * i])
+            d.attrs["event_idx"] = 100 * i
+
+    mmap_dir = h5_to_memmap(path, str(tmp_path / "mm"))
+    data = read_memmap(mmap_dir)
+    assert data["num_events"] == 257
+    np.testing.assert_array_equal(np.asarray(data["xy"])[:, 0], xs)
+    np.testing.assert_array_equal(np.asarray(data["t"])[:, 0], ts)
+    np.testing.assert_array_equal(np.asarray(data["p"])[:, 0], ps > 0)
+    assert data["t0"] == ts[0]
+    assert data["metadata"]["sensor_resolution"] == [7, 9]
+    assert data["metadata"]["images_shape"] == [2, 7, 9, 1]
+    np.testing.assert_array_equal(
+        np.asarray(data["images"])[:, :, :, 0], frames
+    )
+    np.testing.assert_array_equal(
+        np.asarray(data["index"])[:, 0], [0, 100]
+    )
+    np.testing.assert_allclose(
+        np.asarray(data["frame_stamps"])[:, 0], [ts[0], ts[100]]
+    )
+
+
+def test_events_to_ply_binary_and_ascii(recording, tmp_path):
+    path, xs, ys, ts, ps = recording
+    ev = read_h5_events(path)
+    out = str(tmp_path / "cloud.ply")
+    n = events_to_ply(ev, (7, 9), out)
+    assert n == 257
+
+    raw = open(out, "rb").read()
+    header, _, body = raw.partition(b"end_header\n")
+    assert b"element vertex 257" in header
+    assert b"binary_little_endian" in header
+    vertices = np.frombuffer(
+        body,
+        dtype=[("x", "<f4"), ("y", "<f4"), ("z", "<f4"),
+               ("red", "u1"), ("green", "u1"), ("blue", "u1")],
+    )
+    assert len(vertices) == 257
+    np.testing.assert_array_equal(vertices["x"], xs.astype("<f4"))
+    # z is ts normalized onto [0, H]
+    assert vertices["z"].min() == 0.0
+    np.testing.assert_allclose(vertices["z"].max(), 7.0, rtol=1e-6)
+    np.testing.assert_array_equal(vertices["red"] == 255, ps > 0)
+    np.testing.assert_array_equal(vertices["blue"] == 255, ps < 0)
+
+    # ascii variant parses with plain text tools
+    out_txt = str(tmp_path / "cloud_ascii.ply")
+    events_to_ply(ev[:5], (7, 9), out_txt, text=True)
+    lines = open(out_txt).read().splitlines()
+    assert lines[1] == "format ascii 1.0"
+    assert len(lines) == lines.index("end_header") + 1 + 5
