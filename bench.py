@@ -78,6 +78,9 @@ def bench_compute():
     step_fn = make_train_step(model, opt, seqn=seqn)
     step = jax.jit(step_fn, donate_argnums=(0,))
 
+    # fresh buffers for the bf16 run below: the f32 timing donates its state,
+    # which deletes the params leaves it shares
+    params16 = jax.tree.map(jnp.array, params)
     state = TrainState.create(params, opt)
     flops_per_step = None
     try:
@@ -100,12 +103,15 @@ def bench_compute():
     bf16_steps = None
     try:
         step16 = jax.jit(
-            make_train_step(model, opt, seqn=seqn, compute_dtype=jnp.bfloat16)
+            make_train_step(model, opt, seqn=seqn, compute_dtype=jnp.bfloat16),
+            donate_argnums=(0,),
         )
-        s16 = TrainState.create(params, opt)
+        s16 = TrainState.create(params16, opt)
         bf16_steps, _ = _time_steps(step16, s16, batch)
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 - report, don't kill the line
+        import sys
+
+        print(f"bench: bf16 stage failed: {e!r}", file=sys.stderr)
     return steps_per_sec, mfu, flops_per_step, bf16_steps, model, opt, state, seqn
 
 
@@ -161,7 +167,8 @@ def bench_e2e(model, opt, seqn, device_rasterize=False):
             make_device_rasterizer((kh, kw)) if device_rasterize else None
         )
         step = jax.jit(
-            make_train_step(model, opt, seqn=seqn, rasterize=rasterize)
+            make_train_step(model, opt, seqn=seqn, rasterize=rasterize),
+            donate_argnums=(0,),
         )
 
         def batches():
@@ -240,18 +247,21 @@ def main():
         bench_compute()
     )
     # sub-benches are best-effort: one failing stage must not kill the line
-    try:
-        e2e = bench_e2e(model, opt, seqn)
-    except Exception:
-        e2e = None
-    try:
-        e2e_dev = bench_e2e(model, opt, seqn, device_rasterize=True)
-    except Exception:
-        e2e_dev = None
-    try:
-        dcn_speedup = bench_dcn()
-    except Exception:
-        dcn_speedup = None
+    import sys
+
+    def best_effort(name, fn):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: {name} stage failed: {e!r}", file=sys.stderr)
+            return None
+
+    e2e = best_effort("e2e", lambda: bench_e2e(model, opt, seqn))
+    e2e_dev = best_effort(
+        "e2e_device_raster",
+        lambda: bench_e2e(model, opt, seqn, device_rasterize=True),
+    )
+    dcn_speedup = best_effort("dcn", bench_dcn)
 
     extra = {
         "mfu": round(mfu, 4) if mfu is not None else None,

@@ -165,6 +165,13 @@ def _pallas_forward(
     kh, kw, wcin, cout = weight.shape
     _, ho, wo, dg, k, _ = offsets.shape
     assert wcin == cin and k == kh * kw and cin % dg == 0
+    # The kernel is the accuracy-oriented path: all operands f32 (Mosaic
+    # rejects mixed-dtype dots, and the one-hot S matmul wants f32 anyway).
+    # Callers in bf16 pipelines get their dtype restored by the wrapper.
+    x = x.astype(jnp.float32)
+    offsets = offsets.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    weight = weight.astype(jnp.float32)
     cg = cin // dg
     no = ho * wo
     hw_pad = _round_up(h * w, 128)
@@ -236,7 +243,10 @@ def deform_conv2d_pallas(
     out = _pallas_forward(x, offsets, mask, weight, stride, padding, dilation, interp)
     if bias is not None:
         out = out + bias
-    return out
+    # Accumulation is f32 inside the kernel; the public output follows the
+    # input dtype so the op composes with bf16 mixed-precision pipelines
+    # exactly like the jnp formulation (whose output dtype is x.dtype).
+    return out.astype(x.dtype)
 
 
 def _fwd(x, offsets, mask, weight, bias, stride, padding, dilation, interpret):
@@ -256,8 +266,8 @@ def _bwd(stride, padding, dilation, interpret, res, g):
             stride=stride, padding=padding, dilation=dilation,
         )
 
-    _, vjp = jax.vjp(ref_fn, x, offsets, mask, weight, bias)
-    gx, goff, gmask, gw, gb = vjp(g)
+    primal, vjp = jax.vjp(ref_fn, x, offsets, mask, weight, bias)
+    gx, goff, gmask, gw, gb = vjp(g.astype(primal.dtype))
     return gx, goff, gmask, gw, (gb if bias is not None else None)
 
 

@@ -179,11 +179,15 @@ def events_to_stack(
     Bins span ``[t_first, t_last]`` of the *valid* events.
     ``binning='half_open'`` (default) assigns each event to exactly one bin
     (the clean partition — module docstring); ``binning='inclusive'``
-    reproduces the reference's index-based bin membership EXACTLY — per bin,
-    events in ``[searchsorted_left(tstart), searchsorted_right(tend) + 1)``
-    of the time-sorted stream (``encodings.py:176-181,224-236``), which
-    double-counts boundary events into adjacent bins. Inclusive mode requires
-    ``ts`` ascending over the valid lanes (true for stream windows).
+    reproduces the reference's index-based bin membership — per bin, events
+    in ``[searchsorted_left(tstart), searchsorted_right(tend))`` of the
+    time-sorted stream, i.e. the CLOSED time interval ``[tstart, tend]``
+    (``encodings.py:224-236``: its custom binary search returns ``l-1`` on a
+    miss for ``side='right'``, and the ``+1`` there just compensates), which
+    double-counts exact-boundary events into adjacent bins. Verified against
+    the executed reference in ``tests/test_reference_parity_ops.py``.
+    Inclusive mode requires ``ts`` ascending over the valid lanes (true for
+    stream windows).
     """
     assert binning in ("half_open", "inclusive"), binning
     h, w = sensor_size
@@ -202,9 +206,10 @@ def events_to_stack(
         ts_eff = jnp.where(v > 0, tsf, jnp.inf)
         starts = t0 + delta * jnp.arange(num_bins)
         begs = jnp.searchsorted(ts_eff, starts, side="left")
-        ends = jnp.minimum(
-            jnp.searchsorted(ts_eff, starts + delta, side="right") + 1, n
-        )
+        # The reference's custom binary search returns r (== l-1) on a miss
+        # for side='right', then adds 1 (encodings.py:229-230) — net effect
+        # is exactly searchsorted-right: the closed interval [tstart, tend].
+        ends = jnp.searchsorted(ts_eff, starts + delta, side="right")
         idx = jnp.arange(n)
         # [N, B] membership — an event may belong to adjacent bins
         member = (idx[:, None] >= begs[None, :]) & (idx[:, None] < ends[None, :])

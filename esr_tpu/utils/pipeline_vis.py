@@ -97,6 +97,10 @@ class PipelineVisualizer:
         self.img_idx = 0
         self._sequence: Optional[str] = None
         self._ts_file = None
+        # per-sequence next frame index, so revisiting a sequence resumes
+        # instead of overwriting (the reference's dir-existence check,
+        # visualization.py:226-237, silently misfiles interleaved sequences)
+        self._seq_idx: Dict[str, int] = {}
 
     # -- rendering ---------------------------------------------------------
 
@@ -154,15 +158,23 @@ class PipelineVisualizer:
         assert self.store_dir is not None, "PipelineVisualizer needs store_dir"
         root = os.path.join(self.store_dir, sequence)
         if sequence != self._sequence:
+            fresh = sequence not in self._seq_idx
             for sub in ("events", "flow", "frames", "iwe", "brightness"):
                 os.makedirs(os.path.join(root, sub), exist_ok=True)
             if self._ts_file is not None:
                 self._ts_file.close()
-            self._ts_file = open(os.path.join(root, "timestamps.txt"), "w")
+            self._ts_file = open(
+                os.path.join(root, "timestamps.txt"), "w" if fresh else "a"
+            )
             self._sequence = sequence
-            self.img_idx = 0
+            self.img_idx = self._seq_idx.get(sequence, 0)
 
         rendered = self.render(inputs, flow, iwe, brightness)
+        if "frames" in rendered:
+            # the stored stream is the CURRENT frame only (reference
+            # :250-252); the prev/curr pair is the live-view rendering
+            f = _chw_to_hwc((inputs or {})["inp_frames"], 2)
+            rendered["frames"] = np.clip(f[:, :, 1], 0, 255).astype(np.uint8)
         written: Dict[str, str] = {}
         for kind, img in rendered.items():
             path = os.path.join(root, kind, "%09d.png" % self.img_idx)
@@ -172,6 +184,7 @@ class PipelineVisualizer:
             self._ts_file.write(str(ts) + "\n")
             self._ts_file.flush()
         self.img_idx += 1
+        self._seq_idx[sequence] = self.img_idx
         return written
 
     def close(self) -> None:

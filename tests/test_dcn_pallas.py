@@ -91,6 +91,38 @@ def test_pallas_gradients_match_jnp():
         )
 
 
+@pytest.mark.slow
+def test_pallas_bf16_forward_and_grad():
+    """bf16 mixed-precision composition: output dtype follows the input
+    (like the jnp formulation) and the custom_vjp accepts the bf16
+    cotangent the train step produces under compute_dtype=bf16."""
+    x, offsets, mask, weight, bias = _inputs(b=1, h=5, w=6, cin=8, cout=8, dg=2)
+    cast = lambda a: a.astype(jnp.bfloat16)
+    x16, o16, m16, w16, b16 = map(cast, (x, offsets, mask, weight, bias))
+
+    out = deform_conv2d_pallas(x16, o16, m16, w16, b16)
+    assert out.dtype == jnp.bfloat16
+    ref = deform_conv2d(x16, o16, m16, w16, b16)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=0.15, rtol=0.15,  # one bf16 rounding apart
+    )
+
+    def loss(fn):
+        return lambda *a: (fn(*a).astype(jnp.float32) ** 2).sum()
+
+    gp = jax.grad(loss(deform_conv2d_pallas), argnums=(0, 3))(
+        x16, o16, m16, w16, b16
+    )
+    gr = jax.grad(loss(deform_conv2d), argnums=(0, 3))(x16, o16, m16, w16, b16)
+    for a, b in zip(gp, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.3, rtol=0.3,
+        )
+
+
 def test_auto_dispatch_selects_jnp_on_cpu():
     x, offsets, mask, weight, bias = _inputs(b=1, h=4, w=4, cin=4, cout=4, dg=1)
     assert jax.default_backend() == "cpu"

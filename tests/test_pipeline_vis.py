@@ -115,9 +115,34 @@ def test_pipeline_visualizer_store_layout(tmp_path):
     # sequence switch resets the index and opens a new timestamps file
     viz.store({"inp_cnt": np.ones((1, 6, 7, 2))}, None, None, None, "recB", ts=9.0)
     assert viz.img_idx == 1
+    # revisiting recA resumes: index continues, timestamps append, no
+    # overwrite of existing frames
+    w3 = viz.store(
+        {"inp_cnt": np.ones((1, 6, 7, 2))}, None, None, None, "recA", ts=1.0
+    )
+    assert w3["events"].endswith("000000002.png")
     viz.close()
-    assert (tmp_path / "recA" / "timestamps.txt").read_text() == "0.0\n0.5\n"
+    assert (tmp_path / "recA" / "timestamps.txt").read_text() == "0.0\n0.5\n1.0\n"
     assert (tmp_path / "recB" / "timestamps.txt").read_text() == "9.0\n"
+
+
+def test_pipeline_visualizer_store_writes_current_frame_only(tmp_path):
+    """The stored frames stream is H x W (current frame, reference
+    visualization.py:250-252); the prev/curr pair is only the live view."""
+    rng = np.random.default_rng(6)
+    frames = rng.uniform(0, 255, size=(1, 6, 7, 2))
+    viz = PipelineVisualizer(store_dir=str(tmp_path))
+    viz.store({"inp_cnt": np.ones((1, 6, 7, 2)), "inp_frames": frames},
+              None, None, None, "rec", ts=None)
+    viz.close()
+    from PIL import Image
+
+    img = np.asarray(Image.open(tmp_path / "rec" / "frames" / "000000000.png"))
+    assert img.shape[:2] == (6, 7)
+    np.testing.assert_array_equal(
+        img if img.ndim == 2 else img[..., 0],
+        np.clip(frames[0, :, :, 1], 0, 255).astype(np.uint8),
+    )
 
 
 @pytest.fixture

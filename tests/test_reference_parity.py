@@ -1,0 +1,183 @@
+"""Model-level parity against the reference's OWN torch modules.
+
+The strongest oracle available: the reference's ``models/unet.py`` +
+``models/submodules.py`` import cleanly with CPU torch (no CUDA extension,
+no torchvision), so we can instantiate the actual reference networks, copy
+their weights into our Flax models, and require the forward passes to agree
+through multiple recurrent steps. This is not a transcription that could
+share a misreading — it executes the reference code itself.
+
+Gated on the reference checkout being present; skipped elsewhere.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REF = "/root/reference"
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.path.isdir(os.path.join(REF, "models")),
+        reason="reference checkout not mounted",
+    ),
+]
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from esr_tpu.models.unet import SRUNetRecurrent, UNetRecurrent  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ref_unet():
+    if REF not in sys.path:
+        sys.path.insert(0, REF)
+    import models.unet as ru
+
+    return ru
+
+
+def _t2f(w: "torch.Tensor", b: "torch.Tensor"):
+    """torch OIHW conv -> flax {kernel HWIO, bias}."""
+    return {
+        "kernel": jnp.asarray(w.detach().permute(2, 3, 1, 0).numpy()),
+        "bias": jnp.asarray(b.detach().numpy()),
+    }
+
+
+def _convert_state_dict(sd, num_encoders, num_residual_blocks,
+                        recurrent_block_type, num_skip_up=0):
+    """Reference UNet(Recurrent) state_dict -> our flax param tree."""
+    p = {
+        "head": {"Conv_0": _t2f(sd["head.conv2d.weight"], sd["head.conv2d.bias"])},
+        "pred": {"Conv_0": _t2f(sd["pred.conv2d.weight"], sd["pred.conv2d.bias"])},
+        "encoders": {},
+    }
+    for i in range(num_encoders):
+        enc = {
+            "ConvLayer_0": {
+                "Conv_0": _t2f(
+                    sd[f"encoders.{i}.conv.conv2d.weight"],
+                    sd[f"encoders.{i}.conv.conv2d.bias"],
+                )
+            }
+        }
+        rb = f"encoders.{i}.recurrent_block"
+        if recurrent_block_type == "convgru":
+            enc["ConvGRUCell_0"] = {
+                gate: _t2f(sd[f"{rb}.{gate}.weight"], sd[f"{rb}.{gate}.bias"])
+                for gate in ("reset_gate", "update_gate", "out_gate")
+            }
+        else:
+            enc["ConvLSTMCell_0"] = {
+                "Conv_0": _t2f(sd[f"{rb}.Gates.weight"], sd[f"{rb}.Gates.bias"])
+            }
+        p["encoders"][f"encoder_{i}"] = enc
+    for i in range(num_residual_blocks):
+        p[f"res_{i}"] = {
+            "Conv_0": _t2f(
+                sd[f"resblocks.{i}.conv1.weight"], sd[f"resblocks.{i}.conv1.bias"]
+            ),
+            "Conv_1": _t2f(
+                sd[f"resblocks.{i}.conv2.weight"], sd[f"resblocks.{i}.conv2.bias"]
+            ),
+        }
+    for i in range(num_encoders):
+        p[f"decoder_{i}"] = {
+            "ConvLayer_0": {
+                "Conv_0": _t2f(
+                    sd[f"decoders.{i}.conv2d.weight"],
+                    sd[f"decoders.{i}.conv2d.bias"],
+                )
+            }
+        }
+    for i in range(num_skip_up):
+        p[f"skip_up_{i}"] = {
+            "ConvLayer_0": {
+                "Conv_0": _t2f(
+                    sd[f"skip_upsampler.{i}.conv2d.weight"],
+                    sd[f"skip_upsampler.{i}.conv2d.bias"],
+                )
+            }
+        }
+    return {"params": p}
+
+
+COMMON = dict(
+    base_num_channels=4,
+    num_encoders=2,
+    num_residual_blocks=1,
+    num_bins=2,
+    kernel_size=5,
+    skip_type="sum",
+    norm=None,
+    use_upsample_conv=True,
+)
+
+
+@pytest.mark.parametrize("rb", ["convgru", "convlstm"])
+def test_unet_recurrent_matches_reference(ref_unet, rb):
+    """3 recurrent steps of UNetRecurrent: our flax forward must track the
+    reference torch forward bit-for-bit-ish (conv reassociation only)."""
+    torch.manual_seed(0)
+    ref = ref_unet.UNetRecurrent(dict(COMMON, recurrent_block_type=rb))
+    ref.eval()
+
+    ours = UNetRecurrent(
+        num_output_channels=1, recurrent_block_type=rb, final_activation=None,
+        **COMMON,
+    )
+    params = _convert_state_dict(ref.state_dict(), 2, 1, rb)
+
+    rng = np.random.default_rng(0)
+    states = ours.init_states(1, 16, 16)
+    for step in range(3):
+        x = rng.standard_normal((1, 16, 16, 2)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(x).permute(0, 3, 1, 2))
+        y_ours, states = ours.apply(params, jnp.asarray(x), states)
+        np.testing.assert_allclose(
+            np.asarray(y_ours),
+            y_ref.permute(0, 2, 3, 1).numpy(),
+            atol=2e-5, rtol=1e-4,
+            err_msg=f"step {step} ({rb})",
+        )
+
+
+@pytest.mark.parametrize("rb", ["convgru", "convlstm"])
+def test_srunet_recurrent_matches_reference(ref_unet, rb):
+    """SRUNetRecurrent (the SR decoder with skip upsamplers, 2x output):
+    reference unet.py:393-498."""
+    torch.manual_seed(1)
+    ref = ref_unet.SRUNetRecurrent(
+        dict(COMMON, recurrent_block_type=rb, num_output_channels=2)
+    )
+    ref.eval()
+
+    ours = SRUNetRecurrent(
+        num_output_channels=2, recurrent_block_type=rb, final_activation=None,
+        **COMMON,
+    )
+    params = _convert_state_dict(
+        ref.state_dict(), 2, 1, rb, num_skip_up=COMMON["num_encoders"] + 1
+    )
+
+    rng = np.random.default_rng(1)
+    states = ours.init_states(1, 16, 16)
+    for step in range(3):
+        x = rng.standard_normal((1, 16, 16, 2)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(x).permute(0, 3, 1, 2))
+        y_ours, states = ours.apply(params, jnp.asarray(x), states)
+        assert y_ours.shape == (1, 32, 32, 2)  # 2x SR
+        np.testing.assert_allclose(
+            np.asarray(y_ours),
+            y_ref.permute(0, 2, 3, 1).numpy(),
+            atol=2e-5, rtol=1e-4,
+            err_msg=f"step {step} ({rb})",
+        )

@@ -1,0 +1,314 @@
+"""Op/loss-level parity against the reference's OWN executed torch code.
+
+Companion to ``test_reference_parity.py`` (models): here the oracles are the
+reference's rasterization core (``dataloader/encodings.py``), IWE warping
+(``myutils/iwe.py``) and the self-supervised flow/reconstruction losses
+(``loss/flow.py``, ``loss/reconstruction.py``), imported from the mounted
+checkout and run on CPU torch. Two import shims are needed and documented in
+the fixtures: the compiled Cython ext (absent) and the ``loss`` package
+``__init__`` (pulls scikit-image, absent) — both irrelevant to the functions
+under test.
+
+Gated on the reference checkout; skipped elsewhere.
+"""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REF = "/root/reference"
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.path.isdir(os.path.join(REF, "dataloader")),
+        reason="reference checkout not mounted",
+    ),
+]
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from esr_tpu.losses.flow import averaged_iwe, event_warping_loss  # noqa: E402
+from esr_tpu.losses.reconstruction import BrightnessConstancy  # noqa: E402
+from esr_tpu.ops import encodings as our_enc  # noqa: E402
+from esr_tpu.ops import iwe as our_iwe  # noqa: E402
+
+
+def _ref_path():
+    if REF not in sys.path:
+        sys.path.insert(0, REF)
+
+
+@pytest.fixture(scope="module")
+def ref_enc():
+    """Reference encodings with the unbuilt Cython ext stubbed out (only the
+    ``cython_event_redistribute`` wrappers use it; not under test here)."""
+    _ref_path()
+    import dataloader.cython_event_redistribute as cpkg
+
+    if not hasattr(cpkg, "event_redistribute"):
+        cpkg.event_redistribute = types.ModuleType(
+            "dataloader.cython_event_redistribute.event_redistribute"
+        )
+    import dataloader.encodings as enc
+
+    return enc
+
+
+@pytest.fixture(scope="module")
+def ref_loss():
+    """Reference loss modules loaded under a stub ``loss`` package so the
+    real ``loss/__init__`` (which imports scikit-image for restore.py) never
+    runs; flow/reconstruction themselves only need torch + myutils."""
+    _ref_path()
+    if "loss" not in sys.modules or not hasattr(sys.modules["loss"], "__path__"):
+        pkg = types.ModuleType("loss")
+        pkg.__path__ = [os.path.join(REF, "loss")]
+        sys.modules["loss"] = pkg
+    import loss.flow as rflow
+    import loss.reconstruction as rrecon
+
+    return rflow, rrecon
+
+
+@pytest.fixture(scope="module")
+def ref_iwe():
+    _ref_path()
+    import myutils.iwe as riwe
+
+    return riwe
+
+
+def _events(seed=0, n=300, h=10, w=14, b=1):
+    rng = np.random.default_rng(seed)
+    xs = rng.integers(0, w, (b, n)).astype(np.float32)
+    ys = rng.integers(0, h, (b, n)).astype(np.float32)
+    ts = np.sort(rng.uniform(0, 1, (b, n)), axis=1).astype(np.float32)
+    ps = rng.choice([-1.0, 1.0], (b, n)).astype(np.float32)
+    return xs, ys, ts, ps
+
+
+# ---------------------------------------------------------------- encodings
+
+
+def test_events_to_channels_matches_reference(ref_enc):
+    xs, ys, ts, ps = _events(0)
+    ref = ref_enc.events_to_channels(
+        torch.from_numpy(xs[0]), torch.from_numpy(ys[0]), torch.from_numpy(ps[0]),
+        sensor_size=(10, 14),
+    )
+    ours = our_enc.events_to_channels(
+        jnp.asarray(xs[0]), jnp.asarray(ys[0]), jnp.asarray(ps[0]), (10, 14)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(2, 0, 1), ref.numpy(), atol=1e-6
+    )
+
+
+def test_events_to_voxel_matches_reference(ref_enc):
+    xs, ys, ts, ps = _events(1)
+    nb = 5
+    ref = ref_enc.events_to_voxel(
+        torch.from_numpy(xs[0]), torch.from_numpy(ys[0]),
+        torch.from_numpy(ts[0]), torch.from_numpy(ps[0]),
+        nb, sensor_size=(10, 14),
+    )
+    ours = our_enc.events_to_voxel(
+        jnp.asarray(xs[0]), jnp.asarray(ys[0]), jnp.asarray(ts[0]),
+        jnp.asarray(ps[0]), nb, (10, 14),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(2, 0, 1), ref.numpy(), atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("nb", [1, 4])
+def test_events_to_stack_inclusive_matches_reference(ref_enc, nb):
+    """The inclusive-searchsorted bin membership (VERDICT weak #4) checked
+    against the reference's actual implementation, TIME_BINS>1 included."""
+    xs, ys, ts, ps = _events(2)
+    ref = ref_enc.events_to_stack_no_polarity(
+        torch.from_numpy(xs[0]), torch.from_numpy(ys[0]),
+        torch.from_numpy(ts[0]), torch.from_numpy(ps[0]),
+        nb, sensor_size=(10, 14),
+    )
+    ours = our_enc.events_to_stack(
+        jnp.asarray(xs[0]), jnp.asarray(ys[0]), jnp.asarray(ts[0]),
+        jnp.asarray(ps[0]), nb, (10, 14), binning="inclusive",
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(2, 0, 1), ref.numpy(), atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("nb", [1, 4])
+def test_events_to_stack_polarity_matches_reference(ref_enc, nb):
+    xs, ys, ts, ps = _events(3)
+    ref = ref_enc.events_to_stack_polarity(
+        torch.from_numpy(xs[0]), torch.from_numpy(ys[0]),
+        torch.from_numpy(ts[0]), torch.from_numpy(ps[0]),
+        nb, sensor_size=(10, 14),
+    )
+    ours = our_enc.events_to_stack(
+        jnp.asarray(xs[0]), jnp.asarray(ys[0]), jnp.asarray(ts[0]),
+        jnp.asarray(ps[0]), nb, (10, 14), polarity=True, binning="inclusive",
+    )
+    # ours [H, W, B, 2] -> reference [2, B, H, W]
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(3, 2, 0, 1), ref.numpy(), atol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------- iwe
+
+
+def _iwe_inputs(seed, b=2, n=200, h=10, w=14):
+    xs, ys, ts, ps = _events(seed, n=n, h=h, w=w, b=b)
+    events = np.stack([ts, ys, xs, ps], axis=2)  # [B, N, 4] (ts, y, x, p)
+    rng = np.random.default_rng(seed + 100)
+    flow = rng.normal(scale=0.02, size=(b, h, w, 2)).astype(np.float32)
+    pol_mask = np.stack([(ps > 0), (ps < 0)], axis=2).astype(np.float32)
+    return events, flow, pol_mask
+
+
+@pytest.mark.parametrize("round_idx", [True, False])
+def test_deblur_events_matches_reference(ref_iwe, round_idx):
+    events, flow, pol_mask = _iwe_inputs(4)
+    res = (10, 14)
+    # the reference's bilinear branch unconditionally cats the polarity mask
+    # (iwe.py:121-122) — None crashes it, so both sides get the pos mask
+    pm = None if round_idx else pol_mask[:, :, 0:1]
+    ref = ref_iwe.deblur_events(
+        torch.from_numpy(flow).permute(0, 3, 1, 2),
+        torch.from_numpy(events), res,
+        flow_scaling=max(res), round_idx=round_idx,
+        polarity_mask=None if pm is None else torch.from_numpy(pm),
+    )
+    ours = our_iwe.deblur_events(
+        jnp.asarray(flow), jnp.asarray(events), res,
+        flow_scaling=max(res), round_idx=round_idx,
+        polarity_mask=None if pm is None else jnp.asarray(pm),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours)[..., 0], ref.numpy()[:, 0], atol=1e-4
+    )
+
+
+def test_compute_pol_iwe_matches_reference(ref_iwe):
+    events, flow, pol_mask = _iwe_inputs(5)
+    res = (10, 14)
+    ref = ref_iwe.compute_pol_iwe(
+        torch.from_numpy(flow).permute(0, 3, 1, 2),
+        torch.from_numpy(events), res,
+        torch.from_numpy(pol_mask[:, :, 0:1]),
+        torch.from_numpy(pol_mask[:, :, 1:2]),
+        flow_scaling=max(res), round_idx=True,
+    )
+    ours = our_iwe.compute_pol_iwe(
+        jnp.asarray(flow), jnp.asarray(events), res,
+        jnp.asarray(pol_mask[:, :, 0:1]), jnp.asarray(pol_mask[:, :, 1:2]),
+        flow_scaling=max(res), round_idx=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), atol=1e-4
+    )
+
+
+# -------------------------------------------------------------------- losses
+
+
+def test_event_warping_loss_matches_reference(ref_loss):
+    rflow, _ = ref_loss
+    events, flow, pol_mask = _iwe_inputs(6)
+    res = (10, 14)
+    m = rflow.EventWarping({"loss": {"flow_regul_weight": 0.3}}, "cpu")
+    ref = m(
+        [torch.from_numpy(flow).permute(0, 3, 1, 2)],
+        torch.from_numpy(events), torch.from_numpy(pol_mask), res,
+    )
+    ours = event_warping_loss(
+        [jnp.asarray(flow)], jnp.asarray(events), jnp.asarray(pol_mask), res,
+        regul_weight=0.3,
+    )
+    np.testing.assert_allclose(float(ours), float(ref), rtol=2e-4)
+
+
+def test_averaged_iwe_matches_reference(ref_loss):
+    rflow, _ = ref_loss
+    events, flow, pol_mask = _iwe_inputs(7)
+    res = (10, 14)
+    m = rflow.AveragedIWE(
+        {"loader": {"resolution": res, "batch_size": 2}}, "cpu"
+    )
+    ref = m(
+        torch.from_numpy(flow).permute(0, 3, 1, 2),
+        torch.from_numpy(events), torch.from_numpy(pol_mask),
+    )
+    ours = averaged_iwe(
+        jnp.asarray(flow), jnp.asarray(events), jnp.asarray(pol_mask), res
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), atol=1e-4
+    )
+
+
+def test_brightness_constancy_matches_reference(ref_loss):
+    _, rrecon = ref_loss
+    events, flow, pol_mask = _iwe_inputs(8)
+    res = (10, 14)
+    rng = np.random.default_rng(9)
+    img = rng.normal(size=(2, res[0], res[1], 1)).astype(np.float32)
+    cnt = np.stack(
+        [
+            np.asarray(
+                our_enc.events_to_channels(
+                    jnp.asarray(events[b, :, 2]), jnp.asarray(events[b, :, 1]),
+                    jnp.asarray(events[b, :, 3]), res,
+                )
+            )
+            for b in range(2)
+        ]
+    )
+
+    m = rrecon.BrightnessConstancy(
+        {
+            "loader": {"resolution": res, "batch_size": 2},
+            "loss": {"reconstruction_regul_weight": (1.0, 1.0)},
+        },
+        "cpu",
+    )
+    ref_gen = m.generative_model(
+        torch.from_numpy(flow).permute(0, 3, 1, 2),
+        torch.from_numpy(img).permute(0, 3, 1, 2),
+        {
+            "inp_cnt": torch.from_numpy(cnt).permute(0, 3, 1, 2),
+            "inp_list": torch.from_numpy(events),
+            "inp_pol_mask": torch.from_numpy(pol_mask),
+        },
+    )
+
+    ours = BrightnessConstancy(res, weights=(1.0, 1.0))
+    our_gen = ours.generative_model(
+        jnp.asarray(flow), jnp.asarray(img), jnp.asarray(cnt),
+        jnp.asarray(events), jnp.asarray(pol_mask),
+    )
+    np.testing.assert_allclose(float(our_gen), float(ref_gen), rtol=2e-4)
+
+    prev = rng.normal(size=(2, res[0], res[1], 1)).astype(np.float32)
+    ref_tc = m.temporal_consistency(
+        torch.from_numpy(flow).permute(0, 3, 1, 2),
+        torch.from_numpy(prev).permute(0, 3, 1, 2),
+        torch.from_numpy(img).permute(0, 3, 1, 2),
+    )
+    our_tc = ours.temporal_consistency(
+        jnp.asarray(flow), jnp.asarray(prev), jnp.asarray(img)
+    )
+    np.testing.assert_allclose(
+        np.asarray(our_tc, dtype=np.float64).ravel(),
+        np.asarray(ref_tc, dtype=np.float64).ravel(),
+        rtol=2e-4,
+    )

@@ -80,20 +80,23 @@ def test_ssim_golden_values():
 def reference_stack_binning(xs, ys, ts, ps, num_bins, sensor_size):
     """Numpy transcription of the reference's bin assignment
     (``events_to_stack_no_polarity``, ``encodings.py:224-236``): per bin,
-    events in ``[searchsorted_left(tstart), searchsorted_right(tend) + 1)``
-    of the SORTED ts — inclusive ends that duplicate boundary events."""
+    events in the CLOSED time interval ``[tstart, tend]``, i.e. index range
+    ``[searchsorted_left(tstart), searchsorted_right(tend))`` — the
+    reference's custom binary search returns ``l-1`` on a miss for
+    ``side='right'`` and its ``+1`` compensates exactly (pinned against the
+    executed reference in ``test_reference_parity_ops.py``). Exact-boundary
+    events land in both adjacent bins."""
     h, w = sensor_size
     order = np.argsort(ts, kind="stable")
     xs, ys, ts, ps = xs[order], ys[order], ts[order], ps[order]
     out = np.zeros((h, w, num_bins), np.float32)
     dt = ts[-1] - ts[0] + 1e-6
     delta = dt / num_bins
-    n = len(ts)
     for bi in range(num_bins):
         tstart = ts[0] + delta * bi
         tend = tstart + delta
         beg = int(np.searchsorted(ts, tstart, side="left"))
-        end = min(int(np.searchsorted(ts, tend, side="right")) + 1, n)
+        end = int(np.searchsorted(ts, tend, side="right"))
         for i in range(beg, end):
             out[int(ys[i]), int(xs[i]), bi] += ps[i]
     return out
