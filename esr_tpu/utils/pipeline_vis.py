@@ -104,15 +104,22 @@ class PipelineVisualizer:
 
     # -- rendering ---------------------------------------------------------
 
+    @staticmethod
+    def _frame_u8(frame: np.ndarray) -> np.ndarray:
+        return np.clip(frame, 0, 255).astype(np.uint8)
+
     def render(
         self,
         inputs: Optional[Dict[str, np.ndarray]] = None,
         flow: Optional[np.ndarray] = None,
         iwe: Optional[np.ndarray] = None,
         brightness: Optional[np.ndarray] = None,
+        frames_pair: bool = True,
     ) -> Dict[str, np.ndarray]:
         """Render whatever is present into uint8 images keyed like the
-        reference's windows/subdirs."""
+        reference's windows/subdirs. ``frames_pair`` renders the prev/curr
+        side-by-side live view (reference ``update()`` ``:168-176``); False
+        renders the current frame only (the ``store()`` stream ``:250-252``)."""
         out: Dict[str, np.ndarray] = {}
         inputs = inputs or {}
         ev = inputs.get("inp_cnt", inputs.get("e_cnt"))
@@ -123,9 +130,11 @@ class PipelineVisualizer:
         frames = inputs.get("inp_frames")
         if frames is not None:
             f = _chw_to_hwc(frames, 2)
-            # prev/curr side by side, raw 0..255 grayscale (reference :168-176)
-            pair = np.concatenate([f[:, :, 0], f[:, :, 1]], axis=1)
-            out["frames"] = np.clip(pair, 0, 255).astype(np.uint8)
+            out["frames"] = self._frame_u8(
+                np.concatenate([f[:, :, 0], f[:, :, 1]], axis=1)
+                if frames_pair
+                else f[:, :, 1]
+            )
         if flow is not None:
             f = _chw_to_hwc(flow, 2)
             out["flow"] = flow_to_image(f[:, :, 0], f[:, :, 1])
@@ -169,12 +178,7 @@ class PipelineVisualizer:
             self._sequence = sequence
             self.img_idx = self._seq_idx.get(sequence, 0)
 
-        rendered = self.render(inputs, flow, iwe, brightness)
-        if "frames" in rendered:
-            # the stored stream is the CURRENT frame only (reference
-            # :250-252); the prev/curr pair is the live-view rendering
-            f = _chw_to_hwc((inputs or {})["inp_frames"], 2)
-            rendered["frames"] = np.clip(f[:, :, 1], 0, 255).astype(np.uint8)
+        rendered = self.render(inputs, flow, iwe, brightness, frames_pair=False)
         written: Dict[str, str] = {}
         for kind, img in rendered.items():
             path = os.path.join(root, kind, "%09d.png" % self.img_idx)
