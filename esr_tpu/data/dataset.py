@@ -13,9 +13,10 @@ the training distribution):
   re-reading ``ts[:]`` from HDF5 per query;
 - GT frames are resized with the framework's own torch-parity bicubic
   (``esr_tpu.ops.resize``) instead of OpenCV;
-- augmentation randomness comes from ``np.random.Generator`` seeded exactly
-  once per (sequence, mechanism) — same role as the reference's
-  ``random.seed(seed_H/W/P)`` dance (``h5dataset.py:652-670``).
+- augmentation flip decisions reproduce the reference's
+  ``random.seed(seed_H/W/P)`` draws exactly (``h5dataset.py:652-670``), so
+  seeded items are bit-comparable across frameworks (pinned in
+  ``tests/test_reference_parity_ops.py``).
 """
 
 from __future__ import annotations
@@ -158,18 +159,31 @@ class EventWindowDataset:
             ev[2] = (ts - ts[0]) / (ts[-1] - ts[0] + 1e-6)
         return ev
 
+    @staticmethod
+    def _flip_coin(seed: int, prob: float) -> bool:
+        """The reference's exact draw — ``random.seed(s); random.random()``
+        (``h5dataset.py:656-668``) — so a given (seed, mechanism) makes the
+        identical flip decision here and there: seeded items, and therefore
+        training batches, are bit-comparable across the two frameworks.
+        ``random.Random(seed)`` produces the bit-identical Mersenne-Twister
+        draw without touching the process-global RNG, which the loader's
+        threaded prefetch would otherwise race on."""
+        import random
+
+        return random.Random(seed).random() < prob
+
     def _augment_events(self, events: np.ndarray, resolution, seed: int) -> np.ndarray:
         xs, ys, ts, ps = events
         for i, mechanism in enumerate(self.augment_cfg["augment"]):
             prob = self.augment_cfg["augment_prob"][i]
             if mechanism == "Horizontal":
-                if np.random.default_rng(seed).random() < prob:
+                if self._flip_coin(seed, prob):
                     xs = resolution[1] - 1 - xs
             elif mechanism == "Vertical":
-                if np.random.default_rng(seed + 1).random() < prob:
+                if self._flip_coin(seed + 1, prob):
                     ys = resolution[0] - 1 - ys
             elif mechanism == "Polarity":
-                if np.random.default_rng(seed + 2).random() < prob:
+                if self._flip_coin(seed + 2, prob):
                     ps = ps * -1
         return np.stack([xs, ys, ts, ps])
 
@@ -177,10 +191,10 @@ class EventWindowDataset:
         for i, mechanism in enumerate(self.augment_cfg["augment"]):
             prob = self.augment_cfg["augment_prob"][i]
             if mechanism == "Horizontal":
-                if np.random.default_rng(seed).random() < prob:
+                if self._flip_coin(seed, prob):
                     img = np.flip(img, 1)
             elif mechanism == "Vertical":
-                if np.random.default_rng(seed + 1).random() < prob:
+                if self._flip_coin(seed + 1, prob):
                     img = np.flip(img, 0)
         return img
 

@@ -20,3 +20,32 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def shim_reference_imports(ref_root: str) -> None:
+    """Make the mounted reference checkout importable for the parity tests
+    (shared by test_reference_parity.py and test_reference_parity_ops.py):
+
+    - put the checkout on sys.path;
+    - alias matplotlib's removed ``seaborn-whitegrid`` style
+      (``myutils/vis_events/matplotlib_plot_events.py:5``);
+    - stub the unbuilt Cython ``event_redistribute`` extension
+      (``dataloader/encodings.py:5`` imports it at module scope; the
+      wrappers that use it are not under test).
+    """
+    import sys
+    import types
+
+    if ref_root not in sys.path:
+        sys.path.insert(0, ref_root)
+    import matplotlib.style
+
+    lib = matplotlib.style.library
+    if "seaborn-whitegrid" not in lib and "seaborn-v0_8-whitegrid" in lib:
+        lib["seaborn-whitegrid"] = lib["seaborn-v0_8-whitegrid"]
+    import dataloader.cython_event_redistribute as cpkg
+
+    if not hasattr(cpkg, "event_redistribute"):
+        cpkg.event_redistribute = types.ModuleType(
+            "dataloader.cython_event_redistribute.event_redistribute"
+        )

@@ -47,27 +47,22 @@ def ref_unet():
 def ref_model():
     """The reference's flagship module, importable once its optional heavy
     deps are shimmed (none are exercised by ``DeepRecurrNet`` with
-    ``has_dcnatten=False``):
+    ``has_dcnatten=False``): the shared shims from
+    :func:`conftest.shim_reference_imports`, plus
 
     - ``_ext`` — the unbuilt DCNv2 CUDA extension (``dcn_v2.py`` imports it
       at module scope; ``DCN_sep`` is only instantiated when
       ``has_dcnatten=True``);
     - ``torchvision.models.resnet`` / ``open3d`` — absent in this image,
       pulled transitively via ``model.py``'s star imports, unused here;
-    - matplotlib's removed ``seaborn-whitegrid`` style, aliased to the
-      current ``seaborn-v0_8-whitegrid`` (``matplotlib_plot_events.py:5``);
     - ``EventRecognition`` — a dangling name ``h5dataloader.py:17`` imports
       but ``h5dataset.py`` never defines (reference bug, SURVEY §7.3-7).
     """
     import types
 
-    if REF not in sys.path:
-        sys.path.insert(0, REF)
-    import matplotlib.style
+    from conftest import shim_reference_imports
 
-    lib = matplotlib.style.library
-    if "seaborn-whitegrid" not in lib and "seaborn-v0_8-whitegrid" in lib:
-        lib["seaborn-whitegrid"] = lib["seaborn-v0_8-whitegrid"]
+    shim_reference_imports(REF)
     sys.modules.setdefault("_ext", types.ModuleType("_ext"))
     sys.modules.setdefault("open3d", types.ModuleType("open3d"))
     if "torchvision" not in sys.modules:
@@ -78,12 +73,6 @@ def ref_model():
         sys.modules.update(
             {"torchvision": tv, "torchvision.models": tvm,
              "torchvision.models.resnet": tvr}
-        )
-    import dataloader.cython_event_redistribute as cpkg
-
-    if not hasattr(cpkg, "event_redistribute"):
-        cpkg.event_redistribute = types.ModuleType(
-            "dataloader.cython_event_redistribute.event_redistribute"
         )
     import dataloader.h5dataset as h5ds
 

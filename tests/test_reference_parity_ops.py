@@ -39,21 +39,16 @@ from esr_tpu.ops import iwe as our_iwe  # noqa: E402
 
 
 def _ref_path():
-    if REF not in sys.path:
-        sys.path.insert(0, REF)
+    from conftest import shim_reference_imports
+
+    shim_reference_imports(REF)
 
 
 @pytest.fixture(scope="module")
 def ref_enc():
-    """Reference encodings with the unbuilt Cython ext stubbed out (only the
-    ``cython_event_redistribute`` wrappers use it; not under test here)."""
+    """Reference encodings (the Cython ext stub comes from the shared
+    :func:`conftest.shim_reference_imports`)."""
     _ref_path()
-    import dataloader.cython_event_redistribute as cpkg
-
-    if not hasattr(cpkg, "event_redistribute"):
-        cpkg.event_redistribute = types.ModuleType(
-            "dataloader.cython_event_redistribute.event_redistribute"
-        )
     import dataloader.encodings as enc
 
     return enc
@@ -81,6 +76,14 @@ def ref_iwe():
     import myutils.iwe as riwe
 
     return riwe
+
+
+@pytest.fixture(scope="module")
+def ref_h5ds():
+    _ref_path()
+    import dataloader.h5dataset as h5ds
+
+    return h5ds
 
 
 def _events(seed=0, n=300, h=10, w=14, b=1):
@@ -216,6 +219,88 @@ def test_compute_pol_iwe_matches_reference(ref_iwe):
     np.testing.assert_allclose(
         np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), atol=1e-4
     )
+
+
+# ------------------------------------------------------------- data pipeline
+
+
+def test_h5dataset_items_match_reference(ref_h5ds, tmp_path):
+    """Window math + every dense encoding of a real item, ours vs the
+    executed reference H5Dataset on the same synthetic ladder recording
+    (2x SR, down16, events mode — the training recipe)."""
+    from esr_tpu.data.dataset import EventWindowDataset
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    path = str(tmp_path / "rec.h5")
+    write_synthetic_h5(
+        path, (720, 1280), base_events=12_000, num_frames=3,
+        rungs=("down8", "down16"), seed=3,
+    )
+    cfg = {
+        "scale": 2, "ori_scale": "down16", "time_bins": 1, "mode": "events",
+        "window": 1024, "sliding_window": 512,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False},
+    }
+    ref = ref_h5ds.H5Dataset(path, cfg)
+    ours = EventWindowDataset(path, cfg)
+
+    assert len(ref) == len(ours)
+    np.testing.assert_array_equal(
+        np.asarray(ours.event_indices), np.asarray(ref.event_indices)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ours.gt_event_indices), np.asarray(ref.gt_event_indices)
+    )
+
+    # channel-last (ours) -> channel-first (reference)
+    to_cf = lambda a: np.transpose(np.asarray(a), (2, 0, 1))
+    keys = [
+        "inp_cnt", "inp_stack", "inp_bicubic_cnt", "inp_bicubic_stack",
+        "inp_near_cnt", "inp_near_stack", "inp_scaled_cnt",
+        "inp_scaled_stack", "inp_down_cnt", "inp_down_scaled_cnt",
+        "gt_cnt", "gt_stack",
+    ]
+    for i in (0, len(ours) // 2, len(ours) - 1):
+        r = ref.__getitem__(i, seed=0)
+        o = ours.get_item(i, seed=0)
+        for k in keys:
+            np.testing.assert_allclose(
+                to_cf(o[k]), r[k].numpy(), atol=2e-4, err_msg=f"item {i} {k}"
+            )
+
+
+def test_h5dataset_augment_matches_reference(ref_h5ds, tmp_path):
+    """Seeded flip/polarity augmentation produces identical count images."""
+    from esr_tpu.data.dataset import EventWindowDataset
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    path = str(tmp_path / "rec.h5")
+    write_synthetic_h5(
+        path, (720, 1280), base_events=8_000, num_frames=3,
+        rungs=("down8", "down16"), seed=4,
+    )
+    cfg = {
+        "scale": 2, "ori_scale": "down16", "time_bins": 1, "mode": "events",
+        "window": 1024, "sliding_window": 512,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {
+            "enabled": True,
+            "augment": ["Horizontal", "Vertical", "Polarity"],
+            "augment_prob": [0.5, 0.5, 0.5],
+        },
+    }
+    ref = ref_h5ds.H5Dataset(path, cfg)
+    ours = EventWindowDataset(path, cfg)
+    to_cf = lambda a: np.transpose(np.asarray(a), (2, 0, 1))
+    for seed in (1, 7, 42):
+        r = ref.__getitem__(0, seed=seed)
+        o = ours.get_item(0, seed=seed)
+        for k in ("inp_cnt", "gt_cnt", "inp_scaled_cnt"):
+            np.testing.assert_allclose(
+                to_cf(o[k]), r[k].numpy(), atol=2e-4,
+                err_msg=f"seed {seed} {k}",
+            )
 
 
 # -------------------------------------------------------------------- losses
