@@ -50,6 +50,10 @@ class EventWindowDataset:
         self.recording: Recording = open_recording(recording)
         self.scale = int(config["scale"])
         self.time_bins = int(config["time_bins"])
+        # 'half_open' (default): clean one-bin-per-event partition;
+        # 'inclusive': the reference's closed-interval binning, for
+        # bit-parity runs (matters only when time_bins > 1)
+        self.stack_binning = config.get("stack_binning", "half_open")
         self.need_gt_events = config.get("need_gt_events", False)
         self.need_gt_frame = config.get("need_gt_frame", False)
         self.augment_cfg = config.get("data_augment", DEFAULT_AUGMENT)
@@ -219,7 +223,8 @@ class EventWindowDataset:
 
     def _stack(self, ev: np.ndarray, resolution) -> np.ndarray:
         return NE.events_to_stack_np(
-            ev[0], ev[1], ev[2], ev[3], self.time_bins, tuple(resolution)
+            ev[0], ev[1], ev[2], ev[3], self.time_bins, tuple(resolution),
+            binning=self.stack_binning,
         )
 
     def _normalized(self, ev: np.ndarray, resolution) -> np.ndarray:
@@ -239,7 +244,8 @@ class EventWindowDataset:
             return NE.events_to_channels_np(xs, ys, norm_ev[3], tuple(resolution))
         if kind == "stack":
             return NE.events_to_stack_np(
-                xs, ys, norm_ev[2], norm_ev[3], self.time_bins, tuple(resolution)
+                xs, ys, norm_ev[2], norm_ev[3], self.time_bins, tuple(resolution),
+                binning=self.stack_binning,
             )
         if kind == "events":
             return np.stack([np.floor(xs), np.floor(ys), norm_ev[2], norm_ev[3]])

@@ -60,15 +60,46 @@ def events_to_stack_np(
     ps: np.ndarray,
     num_bins: int,
     sensor_size: Tuple[int, int],
+    binning: str = "half_open",
 ) -> np.ndarray:
-    """Signed time-binned stack ``[H, W, B]`` (half-open binning).
+    """Signed time-binned stack ``[H, W, B]``.
 
-    Native C++ kernel when available; numpy fallback below.
+    ``binning='half_open'`` (default): each event in exactly one bin — the
+    clean partition; native C++ kernel when available, numpy fallback below.
+    ``binning='inclusive'``: the reference's closed-interval membership
+    (events in ``[tstart, tend]`` per bin, boundary events double-counted;
+    ``encodings.py:224-236`` — see :func:`esr_tpu.ops.encodings
+    .events_to_stack` for the binary-search derivation). Requires ``ts``
+    ascending, true for stream windows. Pinned against the executed
+    reference in ``tests/test_reference_parity_ops.py``.
     """
     h, w = sensor_size
     out = np.zeros((h, w, num_bins), np.float32)
     if xs.size == 0:
         return out
+    if binning == "inclusive":
+        # reference degenerate-window guard (encodings.py:219-220): all-zero
+        # timestamps or <= 3 events yield an all-zero stack
+        if ts.sum() == 0 or len(ts) <= 3:
+            return out
+        t0 = ts[0]
+        delta = (ts[-1] - t0 + 1e-6) / num_bins
+        inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
+        for bi in range(num_bins):
+            beg = int(np.searchsorted(ts, t0 + delta * bi, side="left"))
+            end = int(np.searchsorted(ts, t0 + delta * (bi + 1), side="right"))
+            m = inb[beg:end]
+            flat = (
+                ys[beg:end][m].astype(np.int64) * w
+                + xs[beg:end][m].astype(np.int64)
+            )
+            out[:, :, bi] = (
+                np.bincount(flat, weights=ps[beg:end][m], minlength=h * w)
+                .astype(np.float32)
+                .reshape(h, w)
+            )
+        return out
+    assert binning == "half_open", binning
     from esr_tpu import native
 
     nout = native.rasterize_stack(xs, ys, ts, ps, num_bins, sensor_size)

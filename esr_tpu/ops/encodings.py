@@ -214,6 +214,12 @@ def events_to_stack(
         # [N, B] membership — an event may belong to adjacent bins
         member = (idx[:, None] >= begs[None, :]) & (idx[:, None] < ends[None, :])
 
+        # reference degenerate-window guard (encodings.py:219-220): all-zero
+        # valid timestamps or <= 3 valid events -> all-zero stack
+        n_valid = v.sum()
+        ts_sum = jnp.where(v > 0, tsf, 0.0).sum()
+        alive = jnp.where((ts_sum == 0) | (n_valid <= 3), 0.0, 1.0)
+
         if polarity:
             out = jnp.zeros((h, w, num_bins, 2), dtype=jnp.float32)
             pos = jnp.where((ps > 0) & inb, v, 0.0)
@@ -222,14 +228,14 @@ def events_to_stack(
                 m = member[:, b]
                 out = out.at[yi, xi, b, 0].add(jnp.where(m, pos, 0.0), mode="drop")
                 out = out.at[yi, xi, b, 1].add(jnp.where(m, neg, 0.0), mode="drop")
-            return out
+            return out * alive
         vals = jnp.where(inb, ps.astype(jnp.float32) * v, 0.0)
         out = jnp.zeros((h, w, num_bins), dtype=jnp.float32)
         for b in range(num_bins):
             out = out.at[yi, xi, b].add(
                 jnp.where(member[:, b], vals, 0.0), mode="drop"
             )
-        return out
+        return out * alive
 
     t0, _, dt = _normalized_bin_time(tsf, v)
     rel = (tsf - t0) / dt

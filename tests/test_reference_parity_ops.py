@@ -221,6 +221,41 @@ def test_compute_pol_iwe_matches_reference(ref_iwe):
     )
 
 
+def test_events_to_stack_degenerate_guard_matches_reference(ref_enc):
+    """The reference zeroes the stack for <=3 events or all-zero timestamps
+    (encodings.py:219-220); inclusive mode must reproduce that, in both the
+    jnp op and the numpy host mirror."""
+    from esr_tpu.data import np_encodings as NE
+
+    h, w = 6, 7
+    cases = [
+        # 3 events (len <= 3 guard)
+        (np.array([1.0, 2, 3]), np.array([1.0, 1, 2]),
+         np.array([0.1, 0.5, 0.9]), np.array([1.0, -1, 1])),
+        # all-zero timestamps (ts.sum() == 0 guard)
+        (np.array([1.0, 2, 3, 4, 5]), np.array([1.0, 1, 2, 2, 3]),
+         np.zeros(5), np.array([1.0, 1, -1, 1, -1])),
+    ]
+    for xs, ys, ts, ps in cases:
+        ref = ref_enc.events_to_stack_no_polarity(
+            torch.from_numpy(xs), torch.from_numpy(ys),
+            torch.from_numpy(ts), torch.from_numpy(ps),
+            4, sensor_size=(h, w),
+        )
+        assert float(ref.abs().sum()) == 0.0
+        ours_np = NE.events_to_stack_np(
+            xs.astype(np.float32), ys.astype(np.float32),
+            ts.astype(np.float32), ps.astype(np.float32),
+            4, (h, w), binning="inclusive",
+        )
+        np.testing.assert_array_equal(ours_np, 0.0)
+        ours_jnp = our_enc.events_to_stack(
+            jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ts),
+            jnp.asarray(ps), 4, (h, w), binning="inclusive",
+        )
+        np.testing.assert_array_equal(np.asarray(ours_jnp), 0.0)
+
+
 # ------------------------------------------------------------- data pipeline
 
 
@@ -265,6 +300,37 @@ def test_h5dataset_items_match_reference(ref_h5ds, tmp_path):
         r = ref.__getitem__(i, seed=0)
         o = ours.get_item(i, seed=0)
         for k in keys:
+            np.testing.assert_allclose(
+                to_cf(o[k]), r[k].numpy(), atol=2e-4, err_msg=f"item {i} {k}"
+            )
+
+
+def test_h5dataset_tb4_inclusive_matches_reference(ref_h5ds, tmp_path):
+    """TIME_BINS=4 with stack_binning='inclusive' (the bit-parity knob):
+    every stack encoding must match the executed reference, which uses the
+    closed-interval binning."""
+    from esr_tpu.data.dataset import EventWindowDataset
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    path = str(tmp_path / "rec.h5")
+    write_synthetic_h5(
+        path, (720, 1280), base_events=10_000, num_frames=3,
+        rungs=("down8", "down16"), seed=11,
+    )
+    cfg = {
+        "scale": 2, "ori_scale": "down16", "time_bins": 4, "mode": "events",
+        "window": 1024, "sliding_window": 512,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False},
+    }
+    ref = ref_h5ds.H5Dataset(path, cfg)
+    ours = EventWindowDataset(path, dict(cfg, stack_binning="inclusive"))
+    to_cf = lambda a: np.transpose(np.asarray(a), (2, 0, 1))
+    for i in (0, len(ours) - 1):
+        r = ref.__getitem__(i, seed=0)
+        o = ours.get_item(i, seed=0)
+        for k in ("inp_stack", "inp_scaled_stack", "gt_stack",
+                  "inp_bicubic_stack", "inp_near_stack"):
             np.testing.assert_allclose(
                 to_cf(o[k]), r[k].numpy(), atol=2e-4, err_msg=f"item {i} {k}"
             )
