@@ -51,8 +51,9 @@ class EventWindowDataset:
         self.scale = int(config["scale"])
         self.time_bins = int(config["time_bins"])
         # 'half_open' (default): clean one-bin-per-event partition;
-        # 'inclusive': the reference's closed-interval binning, for
-        # bit-parity runs (matters only when time_bins > 1)
+        # 'inclusive': the reference's closed-interval binning for bit-parity
+        # runs (differs when time_bins > 1, and at any time_bins via the
+        # degenerate-window guard: <=3 events or all-zero ts -> zero stack)
         self.stack_binning = config.get("stack_binning", "half_open")
         self.need_gt_events = config.get("need_gt_events", False)
         self.need_gt_frame = config.get("need_gt_frame", False)

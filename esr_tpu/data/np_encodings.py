@@ -69,9 +69,10 @@ def events_to_stack_np(
     ``binning='inclusive'``: the reference's closed-interval membership
     (events in ``[tstart, tend]`` per bin, boundary events double-counted;
     ``encodings.py:224-236`` — see :func:`esr_tpu.ops.encodings
-    .events_to_stack` for the binary-search derivation). Requires ``ts``
-    ascending, true for stream windows. Pinned against the executed
-    reference in ``tests/test_reference_parity_ops.py``.
+    .events_to_stack` for the binary-search derivation and the residual
+    duplicate-at-edge caveat). Requires ``ts`` ascending, true for stream
+    windows. Pinned against the executed reference in
+    ``tests/test_reference_parity_ops.py``.
     """
     h, w = sensor_size
     out = np.zeros((h, w, num_bins), np.float32)
@@ -84,19 +85,15 @@ def events_to_stack_np(
             return out
         t0 = ts[0]
         delta = (ts[-1] - t0 + 1e-6) / num_bins
-        inb = (xs >= 0) & (xs < w) & (ys >= 0) & (ys < h)
         for bi in range(num_bins):
-            beg = int(np.searchsorted(ts, t0 + delta * bi, side="left"))
-            end = int(np.searchsorted(ts, t0 + delta * (bi + 1), side="right"))
-            m = inb[beg:end]
-            flat = (
-                ys[beg:end][m].astype(np.int64) * w
-                + xs[beg:end][m].astype(np.int64)
-            )
-            out[:, :, bi] = (
-                np.bincount(flat, weights=ps[beg:end][m], minlength=h * w)
-                .astype(np.float32)
-                .reshape(h, w)
+            # tstart + delta (not t0 + delta*(bi+1)): float addition is not
+            # associative, and the reference/jnp op compute tend this way —
+            # a 1-ulp edge shift would move exact-boundary events
+            tstart = t0 + delta * bi
+            beg = int(np.searchsorted(ts, tstart, side="left"))
+            end = int(np.searchsorted(ts, tstart + delta, side="right"))
+            out[:, :, bi] = events_to_image_np(
+                xs[beg:end], ys[beg:end], ps[beg:end], sensor_size
             )
         return out
     assert binning == "half_open", binning

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Event-visualization walkthrough on a recording (or a synthetic one).
+
+Headless equivalent of the reference's ``myutils/event_visual_example.py``
+(which opens cv2 windows over an H5 recording): renders a window of events
+as count image / per-pixel event image / time-binned stack / 3D cloud plus
+the nearest GT frame, and writes PNGs.
+
+    python scripts/vis_example.py [--h5 PATH] [--out DIR] [--window 4096]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from esr_tpu.tools.h5_tools import read_h5_summary  # noqa: E402
+from esr_tpu.utils.vis_events import EventVisualizer  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--h5", default=None, help="recording (default: synthesize one)")
+    ap.add_argument("--out", default="/tmp/esr_vis", help="output directory")
+    ap.add_argument("--group", default="events", help="event group prefix")
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--window", type=int, default=4096)
+    ap.add_argument("--time-bins", type=int, default=4)
+    args = ap.parse_args()
+
+    path = args.h5
+    if path is None:
+        from esr_tpu.data.synthetic import write_synthetic_h5
+
+        path = os.path.join(tempfile.mkdtemp(), "example.h5")
+        write_synthetic_h5(
+            path, (180, 240), base_events=50_000, num_frames=4,
+            rungs=("ori",), seed=0,
+        )
+        args.group = "ori_events"
+        print(f"synthesized {path}")
+
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        g = f[args.group]
+        sl = slice(args.start, args.start + args.window)
+        xs, ys = np.asarray(g["xs"][sl]), np.asarray(g["ys"][sl])
+        ts, ps = np.asarray(g["ts"][sl]), np.asarray(g["ps"][sl])
+        res = tuple(int(v) for v in f.attrs["sensor_resolution"])
+        frame = None
+        img_group = args.group.replace("events", "images")
+        if img_group in f and len(f[img_group]):
+            name = sorted(f[img_group])[0]
+            frame = np.asarray(f[f"{img_group}/{name}"][:])
+
+    print(f"{len(ts)} events over {ts[-1] - ts[0]:.4f}s at {res}")
+    print("recording summary:", read_h5_summary(path)["groups"])
+
+    os.makedirs(args.out, exist_ok=True)
+    viz = EventVisualizer()
+    ps_signed = np.where(ps > 0, 1, -1)
+    events = np.stack([xs, ys, ts, ps_signed], axis=1).astype(np.float64)
+
+    from esr_tpu.data.np_encodings import (
+        events_to_channels_np,
+        events_to_stack_np,
+    )
+
+    cnt = events_to_channels_np(xs, ys, ps_signed, res)
+    tsn = (ts - ts[0]) / max(ts[-1] - ts[0], 1e-9)
+    stack = events_to_stack_np(
+        xs.astype(np.float32), ys.astype(np.float32),
+        tsn.astype(np.float32), ps_signed.astype(np.float32),
+        args.time_bins, res,
+    )
+
+    out = args.out
+    viz.plot_event_cnt(cnt, is_save=True, path=f"{out}/event_cnt.png")
+    viz.plot_event_cnt(
+        cnt, is_save=True, path=f"{out}/event_cnt_white.png",
+        is_black_background=False,
+    )
+    viz.plot_event_img(events, res, is_save=True, path=f"{out}/event_img.png")
+    viz.plot_event_stack(stack, is_save=True, path=f"{out}/event_stack.png")
+    viz.plot_event_3d(events, res, is_save=True, path=f"{out}/event_3d.png")
+    if frame is not None:
+        viz.plot_frame(frame, is_save=True, path=f"{out}/frame.png")
+    print(f"wrote {sorted(os.listdir(out))} to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -221,6 +221,49 @@ def test_compute_pol_iwe_matches_reference(ref_iwe):
     )
 
 
+def test_stack2cnt_matches_reference(ref_enc):
+    rng = np.random.default_rng(10)
+    stack = rng.normal(scale=2.0, size=(2, 6, 7, 4)).astype(np.float32)
+    ref = ref_enc.stack2cnt(torch.from_numpy(stack).permute(0, 3, 1, 2))
+    ours = our_enc.stack2cnt(jnp.asarray(stack))
+    np.testing.assert_allclose(
+        np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), atol=1e-6
+    )
+
+
+def test_event_conversion_matches_reference(ref_enc):
+    rng = np.random.default_rng(11)
+    b, n, h, w = 2, 150, 8, 9
+    xs = rng.integers(0, w, (b, n)).astype(np.float32)
+    ys = rng.integers(0, h, (b, n)).astype(np.float32)
+    ts = rng.uniform(0, 1, (b, n)).astype(np.float32)  # UNsorted on purpose
+    ps = rng.choice([-1.0, 1.0], (b, n)).astype(np.float32)
+    events = np.stack([xs, ys, ts, ps], axis=2)
+
+    ref = ref_enc.event_conversion(
+        torch.from_numpy(events), time_bins=4, resolution=(h, w),
+        time_bins_voxel=3,
+    )
+    ours = our_enc.event_conversion(
+        jnp.asarray(events), time_bins=4, resolution=(h, w),
+        time_bins_voxel=3,
+    )
+    for k, tb in (("e_cnt", 2), ("e_voxel", 3), ("e_stack", 4)):
+        np.testing.assert_allclose(
+            np.asarray(ours[k]).transpose(0, 3, 1, 2),
+            ref[k].numpy(), atol=1e-5, err_msg=k,
+        )
+
+
+def test_event_restore_matches_reference(ref_enc):
+    rng = np.random.default_rng(12)
+    ev = rng.uniform(0, 1, (2, 50, 4)).astype(np.float32)
+    ev[:, :, 3] = rng.choice([-0.7, 0.3, 1.0, -1.0], (2, 50))
+    ref = ref_enc.event_restore(torch.from_numpy(ev.copy()), (8, 9))
+    ours = our_enc.event_restore(jnp.asarray(ev), (8, 9))
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-6)
+
+
 def test_events_to_stack_degenerate_guard_matches_reference(ref_enc):
     """The reference zeroes the stack for <=3 events or all-zero timestamps
     (encodings.py:219-220); inclusive mode must reproduce that, in both the
