@@ -52,10 +52,20 @@ def main() -> None:
         xs, ys = np.asarray(g["xs"][sl]), np.asarray(g["ys"][sl])
         ts, ps = np.asarray(g["ts"][sl]), np.asarray(g["ps"][sl])
         res = tuple(int(v) for v in f.attrs["sensor_resolution"])
+        if len(ts) == 0:
+            sys.exit(
+                f"no events in [{args.start}, {args.start + args.window}) — "
+                f"the recording has {f[args.group]['ts'].shape[0]} events"
+            )
         frame = None
         img_group = args.group.replace("events", "images")
         if img_group in f and len(f[img_group]):
-            name = sorted(f[img_group])[0]
+            # GT frame nearest in time to the window start
+            names = sorted(f[img_group])
+            stamps = np.array(
+                [f[f"{img_group}/{n}"].attrs.get("timestamp", 0.0) for n in names]
+            )
+            name = names[int(np.abs(stamps - ts[0]).argmin())]
             frame = np.asarray(f[f"{img_group}/{name}"][:])
 
     print(f"{len(ts)} events over {ts[-1] - ts[0]:.4f}s at {res}")
