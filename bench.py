@@ -47,19 +47,29 @@ def _peak_flops() -> float:
     return 197e12
 
 
-def _time_steps(step, state, batch, iters=20, reps=3):
+def _best_of_reps(run_iters, reps=3):
     """Best-of-``reps`` timing: the tunnel/host adds sporadic latency, and
-    the best rep is the least-contended estimate of device throughput."""
+    the best rep is the least-contended estimate of device throughput.
+    ``run_iters()`` executes one timed block and returns seconds/iter."""
+    return min(run_iters() for _ in range(reps))
+
+
+def _time_steps(step, state, batch, iters=20, reps=3):
     state, metrics = step(state, batch)  # warmup/compile
     jax.block_until_ready(metrics["loss"])
-    best = float("inf")
-    for _ in range(reps):
+    carry = {"state": state}
+
+    def run():
+        s = carry["state"]
         t0 = time.perf_counter()
         for _ in range(iters):
-            state, metrics = step(state, batch)
-        jax.block_until_ready(metrics["loss"])
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return 1.0 / best, state
+            s, m = step(s, batch)
+        jax.block_until_ready(m["loss"])
+        carry["state"] = s
+        return (time.perf_counter() - t0) / iters
+
+    best = _best_of_reps(run, reps)
+    return 1.0 / best, carry["state"]
 
 
 def bench_compute():
@@ -233,14 +243,15 @@ def bench_dcn():
     def timed(f, iters=50, reps=3):
         g = jax.jit(f)
         jax.block_until_ready(g())
-        best = float("inf")
-        for _ in range(reps):
+
+        def run():
             t0 = time.perf_counter()
             for _ in range(iters):
                 r = g()
             jax.block_until_ready(r)
-            best = min(best, (time.perf_counter() - t0) / iters)
-        return best
+            return (time.perf_counter() - t0) / iters
+
+        return _best_of_reps(run, reps)
 
     t_jnp = timed(lambda: deform_conv2d(x, off, mask, wt))
     t_pal = timed(lambda: deform_conv2d_pallas(x, off, mask, wt))
@@ -248,6 +259,9 @@ def bench_dcn():
 
 
 def main():
+    from esr_tpu.parallel.mesh import honor_platform_env
+
+    honor_platform_env()
     steps_per_sec, mfu, flops, bf16_steps, model, opt, state, seqn = (
         bench_compute()
     )

@@ -27,6 +27,21 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu <entry point>`` behave as documented.
+
+    An installed TPU plugin ignores the env var, so apply it through
+    ``jax.config`` (the authoritative path — see ``tests/conftest.py``)
+    before the backend initializes. Shared by ``train.py`` / ``infer.py`` /
+    ``bench.py``; no-op when the var is unset or the backend already
+    matches."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+
 def make_mesh(
     devices: Optional[Sequence] = None, axis_name: str = "data"
 ) -> Mesh:

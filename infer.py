@@ -47,6 +47,9 @@ def get_flags():
 
 def main():
     flags = get_flags()
+    from esr_tpu.parallel.mesh import honor_platform_env
+
+    honor_platform_env()
     assert (flags.data_path is None) != (flags.data_list is None), (
         "pass exactly one of --data_path / --data_list"
     )

@@ -51,6 +51,9 @@ def get_args():
 
 def main():
     args = get_args()
+    from esr_tpu.parallel.mesh import honor_platform_env
+
+    honor_platform_env()
     if args.multihost:
         initialize_multihost()
 
