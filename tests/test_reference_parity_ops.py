@@ -221,6 +221,94 @@ def test_compute_pol_iwe_matches_reference(ref_iwe):
     )
 
 
+@pytest.mark.parametrize(
+    "h,w,scale", [(13, 17, 1), (14, 18, 2), (31, 29, 4), (16, 24, 1)]
+)
+def test_crop_size_pad_crop_matches_reference(h, w, scale):
+    """Pad distribution (ceil-top/left) + scaled center-crop indices vs the
+    executed reference CropSize (model_util.py:133-164), odd sizes included."""
+    _ref_path()
+    import models.model_util as rmu
+
+    from esr_tpu.models.model_util import compute_pad, crop_image, pad_image
+
+    rng = np.random.default_rng(h * w)
+    x = rng.standard_normal((2, 3, h, w)).astype(np.float32)  # torch NCHW
+
+    ref = rmu.CropSize(w, h, {"h": 8, "w": 8}, scale=scale)
+    ref_padded = ref.pad(torch.from_numpy(x)).numpy()
+
+    spec = compute_pad(h, w, 8, 8)
+    ours_padded = np.asarray(
+        pad_image(jnp.asarray(np.transpose(x, (0, 2, 3, 1))), spec)
+    )
+    np.testing.assert_array_equal(
+        np.transpose(ours_padded, (0, 3, 1, 2)), ref_padded
+    )
+
+    # crop a fake scale-sized output back
+    y = rng.standard_normal(
+        (2, 3, spec.padded_height * scale, spec.padded_width * scale)
+    ).astype(np.float32)
+    ref_crop = ref.crop(torch.from_numpy(y)).numpy()
+    ours_crop = np.asarray(
+        crop_image(jnp.asarray(np.transpose(y, (0, 2, 3, 1))), spec, scale=scale)
+    )
+    np.testing.assert_array_equal(
+        np.transpose(ours_crop, (0, 3, 1, 2)), ref_crop
+    )
+    assert ref_crop.shape[-2:] == (h * scale, w * scale)
+
+
+def test_crop_parameters_matches_reference():
+    """CropParameters / ScaleCropParameters (the e2vid-era helpers,
+    model_util.py:51-130): factor 2**num_encoders, same pad/crop indices."""
+    _ref_path()
+    import models.model_util as rmu
+
+    from esr_tpu.models.model_util import compute_pad, crop_image, pad_image
+
+    h, w, enc, scale = 21, 27, 3, 2
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 2, h, w)).astype(np.float32)
+
+    ref = rmu.CropParameters(w, h, enc)
+    spec = compute_pad(h, w, 2**enc, 2**enc)
+    np.testing.assert_array_equal(
+        np.transpose(
+            np.asarray(pad_image(jnp.asarray(np.transpose(x, (0, 2, 3, 1))), spec)),
+            (0, 3, 1, 2),
+        ),
+        ref.pad(torch.from_numpy(x)).numpy(),
+    )
+    y = rng.standard_normal((1, 2, spec.padded_height, spec.padded_width)).astype(
+        np.float32
+    )
+    np.testing.assert_array_equal(
+        np.transpose(
+            np.asarray(crop_image(jnp.asarray(np.transpose(y, (0, 2, 3, 1))), spec)),
+            (0, 3, 1, 2),
+        ),
+        ref.crop(torch.from_numpy(y)).numpy(),
+    )
+
+    sref = rmu.ScaleCropParameters(w, h, enc, scale)
+    ys = rng.standard_normal(
+        (1, 2, spec.padded_height * scale, spec.padded_width * scale)
+    ).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.transpose(
+            np.asarray(
+                crop_image(
+                    jnp.asarray(np.transpose(ys, (0, 2, 3, 1))), spec, scale=scale
+                )
+            ),
+            (0, 3, 1, 2),
+        ),
+        sref.crop(torch.from_numpy(ys)).numpy(),
+    )
+
+
 def test_stack2cnt_matches_reference(ref_enc):
     rng = np.random.default_rng(10)
     stack = rng.normal(scale=2.0, size=(2, 6, 7, 4)).astype(np.float32)

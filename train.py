@@ -19,7 +19,7 @@ from __future__ import annotations
 import argparse
 
 from esr_tpu.config.parser import RunConfig
-from esr_tpu.parallel.mesh import initialize_multihost
+from esr_tpu.parallel.mesh import honor_platform_env, initialize_multihost
 
 
 def get_args():
@@ -51,8 +51,6 @@ def get_args():
 
 def main():
     args = get_args()
-    from esr_tpu.parallel.mesh import honor_platform_env
-
     honor_platform_env()
     if args.multihost:
         initialize_multihost()
