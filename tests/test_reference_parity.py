@@ -190,6 +190,100 @@ def test_unet_recurrent_matches_reference(ref_unet, rb):
         )
 
 
+@pytest.mark.parametrize("rb", ["convgru", "convlstm"])
+def test_unet_flow_matches_reference(ref_unet, rb):
+    """UNetFlow (img+flow heads, reference unet.py:170-227): same key scheme
+    as UNetRecurrent; outputs compared per head over 3 recurrent steps."""
+    from esr_tpu.models.unet import UNetFlow
+
+    torch.manual_seed(3)
+    kwargs = dict(COMMON)
+    ref = ref_unet.UNetFlow(dict(kwargs, recurrent_block_type=rb))
+    ref.eval()
+
+    ours = UNetFlow(recurrent_block_type=rb, **kwargs)
+    params = _convert_state_dict(ref.state_dict(), 2, 1, rb)
+
+    rng = np.random.default_rng(3)
+    states = ours.init_states(1, 16, 16)
+    for step in range(3):
+        x = rng.standard_normal((1, 16, 16, 2)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(x).permute(0, 3, 1, 2))
+        y_ours, states = ours.apply(params, jnp.asarray(x), states)
+        for key in ("image", "flow"):
+            np.testing.assert_allclose(
+                np.asarray(y_ours[key]),
+                y_ref[key].permute(0, 2, 3, 1).numpy(),
+                atol=2e-5, rtol=1e-4,
+                err_msg=f"step {step} {key} ({rb})",
+            )
+
+
+def test_multires_unet_matches_reference(ref_unet):
+    """MultiResUNet (predictions at each decoder, concat skips, reference
+    unet.py:304-390). Its final_activation default 'none' crashes upstream
+    (getattr(torch,'none')), so both sides use sigmoid."""
+    from esr_tpu.models.unet import MultiResUNet
+
+    torch.manual_seed(4)
+    ref = ref_unet.MultiResUNet(
+        dict(
+            num_bins=2, num_output_channels=1, base_num_channels=4,
+            num_encoders=2, num_residual_blocks=1, norm=None,
+            use_upsample_conv=True, kernel_size=5, skip_type="concat",
+            final_activation="sigmoid",
+        )
+    )
+    ref.eval()
+
+    ours = MultiResUNet(
+        num_bins=2, num_output_channels=1, base_num_channels=4,
+        num_encoders=2, num_residual_blocks=1, kernel_size=5,
+        final_activation="sigmoid",
+    )
+    sd = ref.state_dict()
+    p = {
+        f"encoder_{i}": {
+            "Conv_0": _t2f(
+                sd[f"encoders.{i}.conv2d.weight"], sd[f"encoders.{i}.conv2d.bias"]
+            )
+        }
+        for i in range(2)
+    }
+    p["res_0"] = {
+        "Conv_0": _t2f(sd["resblocks.0.conv1.weight"], sd["resblocks.0.conv1.bias"]),
+        "Conv_1": _t2f(sd["resblocks.0.conv2.weight"], sd["resblocks.0.conv2.bias"]),
+    }
+    for i in range(2):
+        p[f"decoder_{i}"] = {
+            "ConvLayer_0": {
+                "Conv_0": _t2f(
+                    sd[f"decoders.{i}.conv2d.weight"],
+                    sd[f"decoders.{i}.conv2d.bias"],
+                )
+            }
+        }
+        p[f"pred_{i}"] = {
+            "Conv_0": _t2f(
+                sd[f"preds.{i}.conv2d.weight"], sd[f"preds.{i}.conv2d.bias"]
+            )
+        }
+    params = {"params": p}
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, 16, 16, 2)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(x).permute(0, 3, 1, 2))
+    y_ours = ours.apply(params, jnp.asarray(x))
+    assert len(y_ref) == len(y_ours) == 2  # one prediction per decoder level
+    for lvl, (r, o) in enumerate(zip(y_ref, y_ours)):
+        np.testing.assert_allclose(
+            np.asarray(o), r.permute(0, 2, 3, 1).numpy(),
+            atol=2e-5, rtol=1e-4, err_msg=f"level {lvl}",
+        )
+
+
 def _esr_flax_path(key: str):
     """Reference DeepRecurrNet state_dict key -> our flax param path."""
     parts = key.split(".")
