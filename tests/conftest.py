@@ -22,6 +22,17 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def torch_conv_to_flax(w, b=None):
+    """torch OIHW conv ``(weight, bias)`` -> flax ``{kernel HWIO, bias}``
+    (shared by the executed-reference parity suites)."""
+    import jax.numpy as jnp
+
+    out = {"kernel": jnp.asarray(w.detach().permute(2, 3, 1, 0).numpy())}
+    if b is not None:
+        out["bias"] = jnp.asarray(b.detach().numpy())
+    return out
+
+
 def shim_reference_imports(ref_root: str) -> None:
     """Make the mounted reference checkout importable for the parity tests
     (shared by test_reference_parity.py and test_reference_parity_ops.py):

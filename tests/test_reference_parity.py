@@ -83,12 +83,7 @@ def ref_model():
     return rm
 
 
-def _t2f(w: "torch.Tensor", b: "torch.Tensor"):
-    """torch OIHW conv -> flax {kernel HWIO, bias}."""
-    return {
-        "kernel": jnp.asarray(w.detach().permute(2, 3, 1, 0).numpy()),
-        "bias": jnp.asarray(b.detach().numpy()),
-    }
+from conftest import torch_conv_to_flax as _t2f  # noqa: E402
 
 
 def _convert_state_dict(sd, num_encoders, num_residual_blocks,

@@ -550,6 +550,89 @@ def test_sequence_dataset_matches_reference(ref_h5ds, tmp_path):
                 )
 
 
+# --------------------------------------------------------- extended modules
+
+
+def test_inception_and_dilated_block_match_reference():
+    """InceptionBlock (1x1 -> dilated kxk -> 1x1, ReLU between) and the
+    DilatedBlock branch-sum vs the executed reference
+    (submodules.py:9-63)."""
+    _ref_path()
+    import models.submodules as rsm
+
+    from esr_tpu.models.extended import DilatedBlock, InceptionBlock
+
+    torch.manual_seed(5)
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 8, 9, 6)).astype(np.float32)
+
+    from conftest import torch_conv_to_flax
+
+    ref = rsm.InceptionBlock(6, 16, kernel_size=3, dilation=2)
+    ref.eval()
+    sd = ref.state_dict()
+    ours = InceptionBlock(16, kernel_size=3, dilation=2)
+    params = {
+        "params": {
+            f"Conv_{i}": torch_conv_to_flax(
+                sd[f"conv.{2 * i}.weight"], sd[f"conv.{2 * i}.bias"]
+            )
+            for i in range(3)
+        }
+    }
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(x).permute(0, 3, 1, 2))
+    y = ours.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(y).transpose(0, 3, 1, 2), y_ref.numpy(), atol=2e-5, rtol=1e-4
+    )
+
+    dref = rsm.DilatedBlock(6, 16, kernel_size=3, cardinatity=2)
+    dref.eval()
+    dsd = dref.state_dict()
+    dours = DilatedBlock(16, kernel_size=3, cardinality=2)
+    dp = {}
+    for dil, branch in ((1, "DConv1"), (2, "DConv2"), (3, "DConv3")):
+        for i in range(2):
+            dp[f"d{dil}_{i}"] = {
+                f"Conv_{j}": torch_conv_to_flax(
+                    dsd[f"{branch}.{i}.conv.{2 * j}.weight"],
+                    dsd[f"{branch}.{i}.conv.{2 * j}.bias"],
+                )
+                for j in range(3)
+            }
+    with torch.no_grad():
+        yd_ref = dref(torch.from_numpy(x).permute(0, 3, 1, 2))
+    yd = dours.apply({"params": dp}, jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(yd).transpose(0, 3, 1, 2), yd_ref.numpy(),
+        atol=5e-5, rtol=1e-4,
+    )
+
+
+def test_mean_shift_matches_reference():
+    """MeanShift frozen 1x1 conv (submodules.py:862-871)."""
+    _ref_path()
+    import models.submodules as rsm
+
+    from esr_tpu.models.extended import MeanShift
+
+    mean, std = (0.40, 0.44, 0.47), (1.0, 1.1, 0.9)
+    rng = np.random.default_rng(6)
+    x = rng.uniform(0, 255, (2, 5, 6, 3)).astype(np.float32)
+    for sign in (-1, 1):
+        ref = rsm.MeanShift(mean, std, sign=sign)
+        ref.eval()
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(x).permute(0, 3, 1, 2))
+        ours = MeanShift(rgb_mean=mean, rgb_std=std, sign=sign)
+        y = ours.apply({}, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(y).transpose(0, 3, 1, 2), y_ref.numpy(),
+            atol=1e-4, rtol=1e-5,
+        )
+
+
 # -------------------------------------------------------------------- losses
 
 
