@@ -550,6 +550,87 @@ def test_sequence_dataset_matches_reference(ref_h5ds, tmp_path):
                 )
 
 
+# ------------------------------------------------------------- Super-SloMo
+
+
+def test_superslomo_unet_and_backwarp_match_reference(tmp_path):
+    """The offline frame-rate upsampler: our SloMoUNet + backwarp vs the
+    executed reference (generate_dataset/upsampling/utils/model.py),
+    weights converted through the shipped checkpoint converter path."""
+    _ref_path()
+    import importlib.util
+
+    # model.py imports torchvision (absent here) at module scope but never
+    # uses it in UNet/backWarp
+    if "torchvision" not in sys.modules:
+        tv = types.ModuleType("torchvision")
+        tvt = types.ModuleType("torchvision.transforms")
+        tv.transforms = tvt
+        sys.modules.update({"torchvision": tv, "torchvision.transforms": tvt})
+    spec = importlib.util.spec_from_file_location(
+        "ref_slomo_model", f"{REF}/generate_dataset/upsampling/utils/model.py"
+    )
+    rmod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rmod)
+
+    from esr_tpu.tools.upsampling import (
+        SloMoUNet,
+        backwarp,
+        convert_superslomo_checkpoint,
+        load_superslomo_npz,
+    )
+
+    torch.manual_seed(6)
+    ref_fc = rmod.UNet(6, 4)
+    ref_at = rmod.UNet(20, 5)
+    ref_fc.eval(); ref_at.eval()
+
+    # round-trip the weights through the ACTUAL converter: fake ckpt ->
+    # npz -> flax trees
+    ckpt = str(tmp_path / "SuperSloMo.ckpt")
+    torch.save(
+        {"state_dictFC": ref_fc.state_dict(), "state_dictAT": ref_at.state_dict()},
+        ckpt,
+    )
+    npz = str(tmp_path / "slomo.npz")
+    convert_superslomo_checkpoint(ckpt, npz)
+    flow_params, interp_params = load_superslomo_npz(npz)
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((1, 32, 32, 6)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref_fc(torch.from_numpy(x).permute(0, 3, 1, 2))
+    y = SloMoUNet(out_channels=4).apply(flow_params, jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(y).transpose(0, 3, 1, 2), y_ref.numpy(),
+        atol=1e-4, rtol=1e-3,
+    )
+
+    x20 = rng.standard_normal((1, 32, 32, 20)).astype(np.float32)
+    with torch.no_grad():
+        y_ref2 = ref_at(torch.from_numpy(x20).permute(0, 3, 1, 2))
+    y2 = SloMoUNet(out_channels=5).apply(interp_params, jnp.asarray(x20))
+    np.testing.assert_allclose(
+        np.asarray(y2).transpose(0, 3, 1, 2), y_ref2.numpy(),
+        atol=1e-4, rtol=1e-3,
+    )
+
+    # backwarp incl. the reference's W-based normalization quirk
+    img = rng.standard_normal((1, 24, 20, 3)).astype(np.float32)
+    flow = (rng.standard_normal((1, 24, 20, 2)) * 2).astype(np.float32)
+    ref_bw = rmod.backWarp(20, 24, "cpu")
+    with torch.no_grad():
+        w_ref = ref_bw(
+            torch.from_numpy(img).permute(0, 3, 1, 2),
+            torch.from_numpy(flow).permute(0, 3, 1, 2),
+        )
+    w_ours = backwarp(jnp.asarray(img), jnp.asarray(flow))
+    np.testing.assert_allclose(
+        np.asarray(w_ours).transpose(0, 3, 1, 2), w_ref.numpy(),
+        atol=1e-4, rtol=1e-3,
+    )
+
+
 # --------------------------------------------------------- extended modules
 
 
