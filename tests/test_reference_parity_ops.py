@@ -550,6 +550,57 @@ def test_sequence_dataset_matches_reference(ref_h5ds, tmp_path):
                 )
 
 
+def _rows_sorted(ev: np.ndarray) -> np.ndarray:
+    """Lexicographic row order (t, x, y, p) — both sides sort by time only,
+    so ties are order-ambiguous; multiset comparison needs a total order."""
+    idx = np.lexsort((ev[:, 3], ev[:, 1], ev[:, 0], ev[:, 2]))
+    return ev[idx]
+
+
+def test_event_redistribute_matches_reference_python(ref_enc):
+    """Inverse encoding (stack -> events): our fixed-capacity kernel vs the
+    reference's pure-python fallback (encodings.py:416-463), linear mode
+    (deterministic)."""
+    rng = np.random.default_rng(13)
+    stack = rng.integers(-3, 4, size=(5, 6, 3)).astype(np.float32)
+    ref = ref_enc.python_event_redistribute_NoPolarityStack(
+        torch.from_numpy(np.transpose(stack, (2, 0, 1))[None]), mode="linear"
+    ).numpy()[0]
+    ref = ref[ref[:, 2] > 0]  # drop zero-padded rows (real t >= 1/(100B))
+
+    cap = int(np.abs(np.round(stack)).sum()) + 8
+    ev, valid = our_enc.event_redistribute(jnp.asarray(stack), cap)
+    ours = np.asarray(ev)[np.asarray(valid) > 0]
+
+    assert len(ours) == len(ref)
+    np.testing.assert_allclose(
+        _rows_sorted(ours), _rows_sorted(ref), atol=1e-5
+    )
+
+
+def test_event_redistribute_polarity_matches_reference_python(ref_enc):
+    """Polarity variant vs encodings.py:366-413 ([B, P, C, Y, X] input)."""
+    rng = np.random.default_rng(14)
+    stack = rng.integers(0, 4, size=(4, 5, 2, 2)).astype(np.float32)  # H W B P
+    # reference layout [B, P, C, Y, X]; its positive channel emits +1,
+    # negative channel -1 (value sign decides, so negate channel 1)
+    ref_in = np.transpose(stack, (3, 2, 0, 1)).copy()  # P C Y X
+    ref_in[1] *= -1
+    ref = ref_enc.python_event_redistribute_PolarityStack(
+        torch.from_numpy(ref_in[None]), mode="linear"
+    ).numpy()[0]
+    ref = ref[ref[:, 2] > 0]
+
+    cap = int(np.abs(np.round(stack)).sum()) + 8
+    ev, valid = our_enc.event_redistribute_polarity(jnp.asarray(stack), cap)
+    ours = np.asarray(ev)[np.asarray(valid) > 0]
+
+    assert len(ours) == len(ref)
+    np.testing.assert_allclose(
+        _rows_sorted(ours), _rows_sorted(ref), atol=1e-5
+    )
+
+
 # ------------------------------------------------------------- Super-SloMo
 
 
