@@ -259,15 +259,45 @@ def bench_dcn():
 
 
 def main():
+    # If TPU client creation hangs (a wedged tunnel blocks make_c_api_client
+    # indefinitely), still emit one parseable JSON line before bailing — a
+    # silent hang records nothing. A python timer thread suffices for THIS
+    # hang: it blocks with the GIL released (observed: faulthandler's
+    # watchdog thread fires during it); a hang that held the GIL would need
+    # an external monitor.
+    import sys
+    import threading
+
+    def _watchdog():
+        print(
+            json.dumps(
+                {
+                    "metric": "train_steps_per_sec_per_chip_seqlen8",
+                    "value": None,
+                    "unit": "steps/s",
+                    "vs_baseline": None,
+                    "extra": {"error": "timed out (TPU backend init hang?)"},
+                }
+            )
+        )
+        sys.stdout.flush()
+        os._exit(2)
+
+    timer = threading.Timer(1500.0, _watchdog)  # 25 min >> normal ~8 min
+    timer.daemon = True
+    timer.start()
+
     from esr_tpu.parallel.mesh import honor_platform_env
 
     honor_platform_env()
     steps_per_sec, mfu, flops, bf16_steps, model, opt, state, seqn = (
         bench_compute()
     )
-    # sub-benches are best-effort: one failing stage must not kill the line
-    import sys
+    # backend init + first compiles succeeded: the covered failure mode is
+    # past; disarm so a slow (contended) sub-bench is not mislabeled a hang
+    timer.cancel()
 
+    # sub-benches are best-effort: one failing stage must not kill the line
     def best_effort(name, fn):
         try:
             return fn()

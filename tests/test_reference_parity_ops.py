@@ -563,6 +563,10 @@ def test_event_redistribute_matches_reference_python(ref_enc):
     (deterministic)."""
     rng = np.random.default_rng(13)
     stack = rng.integers(-3, 4, size=(5, 6, 3)).astype(np.float32)
+    # reference quirk precondition: its entry.sum()!=0 early-out returns a
+    # single pad row when the SIGNED counts cancel to exactly 0, even though
+    # events exist — keep the fixture away from that degenerate case
+    assert float(np.round(stack).sum()) != 0.0
     ref = ref_enc.python_event_redistribute_NoPolarityStack(
         torch.from_numpy(np.transpose(stack, (2, 0, 1))[None]), mode="linear"
     ).numpy()[0]
