@@ -22,6 +22,42 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def ensure_module(name: str, defaults: dict | None = None):
+    """Get-or-create a dotted module path for the parity-test import shims.
+
+    The real module always wins (so a genuinely installed package is never
+    shadowed); otherwise each missing segment becomes a stub ModuleType,
+    EXTENDING whatever earlier fixtures already registered — never assuming
+    a previous stub's shape. ``defaults`` are set only when absent.
+    """
+    import importlib
+    import sys
+    import types
+
+    try:
+        mod = importlib.import_module(name)
+    except ImportError:
+        parent = None
+        full = ""
+        mod = None
+        for part in name.split("."):
+            full = f"{full}.{part}" if full else part
+            mod = sys.modules.get(full)
+            if mod is None:
+                try:
+                    mod = importlib.import_module(full)
+                except ImportError:
+                    mod = types.ModuleType(full)
+                    sys.modules[full] = mod
+            if parent is not None and not hasattr(parent, part):
+                setattr(parent, part, mod)
+            parent = mod
+    for key, value in (defaults or {}).items():
+        if not hasattr(mod, key):
+            setattr(mod, key, value)
+    return mod
+
+
 def torch_conv_to_flax(w, b=None):
     """torch OIHW conv ``(weight, bias)`` -> flax ``{kernel HWIO, bias}``
     (shared by the executed-reference parity suites)."""

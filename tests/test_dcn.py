@@ -92,7 +92,11 @@ def test_gradients_finite_and_nonzero():
 
 
 def test_matches_torchvision_deform_conv():
-    torchvision = pytest.importorskip("torchvision")
+    # require the real package: the reference-parity fixtures may have
+    # registered a bare torchvision stub (conftest.ensure_module), which
+    # satisfies importorskip("torchvision") but has no ops submodule
+    pytest.importorskip("torchvision.ops")
+    import torchvision
     import torch
 
     b, h, w, cin, cout, dg = 2, 7, 9, 4, 5, 2

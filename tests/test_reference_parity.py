@@ -58,22 +58,15 @@ def ref_model():
     - ``EventRecognition`` — a dangling name ``h5dataloader.py:17`` imports
       but ``h5dataset.py`` never defines (reference bug, SURVEY §7.3-7).
     """
-    import types
-
-    from conftest import shim_reference_imports
+    from conftest import ensure_module, shim_reference_imports
 
     shim_reference_imports(REF)
-    sys.modules.setdefault("_ext", types.ModuleType("_ext"))
-    sys.modules.setdefault("open3d", types.ModuleType("open3d"))
-    if "torchvision" not in sys.modules:
-        tv = types.ModuleType("torchvision")
-        tvm = types.ModuleType("torchvision.models")
-        tvr = types.ModuleType("torchvision.models.resnet")
-        tvr.resnet34 = lambda *a, **k: None
-        sys.modules.update(
-            {"torchvision": tv, "torchvision.models": tvm,
-             "torchvision.models.resnet": tvr}
-        )
+    ensure_module("_ext")
+    ensure_module("open3d")
+    ensure_module(
+        "torchvision.models.resnet",
+        defaults={"resnet34": lambda *a, **k: None},
+    )
     import dataloader.h5dataset as h5ds
 
     if not hasattr(h5ds, "EventRecognition"):

@@ -616,17 +616,10 @@ def test_superslomo_unet_and_backwarp_match_reference(tmp_path):
     import importlib.util
 
     # model.py imports torchvision (absent here) at module scope but never
-    # uses it in UNet/backWarp. Another parity fixture may have stubbed
-    # torchvision WITHOUT the transforms submodule — extend incrementally,
-    # never assume a previous stub's shape.
-    tv = sys.modules.get("torchvision")
-    if tv is None:
-        tv = types.ModuleType("torchvision")
-        sys.modules["torchvision"] = tv
-    if "torchvision.transforms" not in sys.modules:
-        tvt = types.ModuleType("torchvision.transforms")
-        tv.transforms = tvt
-        sys.modules["torchvision.transforms"] = tvt
+    # uses it in UNet/backWarp
+    from conftest import ensure_module
+
+    ensure_module("torchvision.transforms")
     spec = importlib.util.spec_from_file_location(
         "ref_slomo_model", f"{REF}/generate_dataset/upsampling/utils/model.py"
     )
