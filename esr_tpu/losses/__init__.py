@@ -17,7 +17,10 @@ from esr_tpu.losses.restore import (
 from esr_tpu.losses.lpips import (
     LPIPS,
     convert_alexnet_backbone_pth,
+    convert_backbone_pth,
+    convert_lpips_lin_pth,
     load_alexnet_npz,
+    load_backbone_npz,
     load_lpips_params,
 )
 from esr_tpu.losses.flow import event_warping_loss, averaged_iwe
@@ -33,7 +36,10 @@ __all__ = [
     "LPIPS",
     "load_lpips_params",
     "convert_alexnet_backbone_pth",
+    "convert_backbone_pth",
+    "convert_lpips_lin_pth",
     "load_alexnet_npz",
+    "load_backbone_npz",
     "event_warping_loss",
     "averaged_iwe",
     "BrightnessConstancy",

@@ -2,18 +2,28 @@
 
 Rebuilds the vendored PerceptualSimilarity stack
 (``/root/reference/loss/PerceptualSimilarity/models/networks_basic.py:32-110``):
-input scaling layer -> AlexNet feature taps (relu1..relu5) -> per-layer
-channel normalization -> squared diff -> learned 1x1 linear calibration ->
+input scaling layer -> backbone feature taps -> per-layer channel
+normalization -> squared diff -> learned 1x1 linear calibration ->
 spatial average -> sum over layers.
 
-Weights: the linear-calibration weights ship with this repo
+All three backbone choices the reference's ``DistModel`` exposes
+(``dist_model.py:45-74`` ``net in {'alex','vgg','squeeze'}``) are
+implemented: AlexNet (5 taps), VGG16 (5 taps), SqueezeNet1.1 (7 taps,
+incl. torch's ceil-mode pooling semantics).
+
+Weights: the linear-calibration weights for alex ship with this repo
 (``esr_tpu/losses/lpips_lin_alex.npz``, converted from the public
-richzhang/PerceptualSimilarity v0.1 release — ~1.2k floats). The AlexNet
-backbone weights come from torchvision's pretrained model, which is not
+richzhang/PerceptualSimilarity v0.1 release — ~1.2k floats). The backbone
+weights come from torchvision's pretrained models, which are not
 redistributable here; :func:`load_lpips_params` converts a torch state dict
 when one is supplied and otherwise falls back to a fixed-seed random
 backbone (a deterministic but *uncalibrated* perceptual distance — fine for
 relative comparisons, documented for absolute ones).
+
+The full pipeline (backbone conversion -> normalization -> lins -> distance)
+is pinned against the reference's own executed ``PNetLin`` with seeded
+weights in ``tests/test_lpips_parity.py``, so calibrated torchvision weights
+are a pure data drop-in.
 
 The reference's multi-channel handling (``loss/restore.py:28-38``: each
 channel replicated to RGB, distances averaged) is reproduced by
@@ -33,7 +43,7 @@ from flax import linen as nn
 Array = jax.Array
 
 # (channels, kernel, stride, pool_before) for the 5 AlexNet feature stages;
-# taps are taken after each stage's ReLU (pretrained_networks.py:66-96).
+# taps are taken after each stage's ReLU (pretrained_networks.py:57-95).
 _ALEX_STAGES = (
     (64, 11, 4, False),
     (192, 5, 1, True),
@@ -41,13 +51,64 @@ _ALEX_STAGES = (
     (256, 3, 1, False),
     (256, 3, 1, False),
 )
-_ALEX_CHNS = tuple(s[0] for s in _ALEX_STAGES)
+
+# VGG16 stage table (pretrained_networks.py:97-135): conv channel widths per
+# tap block; every conv is 3x3/s1/p1, taps after the block's last ReLU, 2x2
+# max-pool between blocks.
+_VGG_STAGES = (
+    (64, 64),
+    (128, 128),
+    (256, 256, 256),
+    (512, 512, 512),
+    (512, 512, 512),
+)
+
+# SqueezeNet1.1 (pretrained_networks.py:6-54): Fire(squeeze, expand) specs
+# grouped into the reference's 7 slices. Entry = ('conv',) | ('pool',) |
+# ('fire', squeeze_ch, expand_ch); tap after each group.
+_SQUEEZE_SLICES = (
+    (("conv",),),
+    (("pool",), ("fire", 16, 64), ("fire", 16, 64)),
+    (("pool",), ("fire", 32, 128), ("fire", 32, 128)),
+    (("pool",), ("fire", 48, 192)),
+    (("fire", 48, 192),),
+    (("fire", 64, 256),),
+    (("fire", 64, 256),),
+)
+
+# Per-net tap channel counts (networks_basic.py:44-52).
+_NET_CHNS = {
+    "alex": tuple(s[0] for s in _ALEX_STAGES),
+    "vgg16": tuple(s[-1] for s in _VGG_STAGES),
+    "squeeze": (64, 128, 256, 384, 384, 512, 512),
+}
 
 # ScalingLayer constants (networks_basic.py:103-110).
 _SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
 _SCALE = np.array([0.458, 0.448, 0.450], np.float32)
 
 _LIN_WEIGHTS_FILE = os.path.join(os.path.dirname(__file__), "lpips_lin_alex.npz")
+
+
+def _max_pool_ceil(x: Array, window: int = 3, stride: int = 2) -> Array:
+    """torch ``MaxPool2d(window, stride, ceil_mode=True)`` on NHWC.
+
+    Torch's ceil mode emits ``ceil((H - k) / s) + 1`` windows, the trailing
+    partial window clipped to the input; padding the right/bottom edge with
+    ``-inf`` to the implied extent then VALID-pooling is exactly that.
+    """
+    _, h, w, _ = x.shape
+    out_h = -(-(h - window) // stride) + 1
+    out_w = -(-(w - window) // stride) + 1
+    pad_h = (out_h - 1) * stride + window - h
+    pad_w = (out_w - 1) * stride + window - w
+    if pad_h or pad_w:
+        x = jnp.pad(
+            x,
+            ((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+            constant_values=-jnp.inf,
+        )
+    return nn.max_pool(x, (window, window), strides=(stride, stride))
 
 
 class _AlexFeatures(nn.Module):
@@ -69,17 +130,98 @@ class _AlexFeatures(nn.Module):
         return taps
 
 
+class _VGG16Features(nn.Module):
+    """VGG16 ``features`` trunk returning the 5 relu{1_2..5_3} taps."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Sequence[Array]:
+        taps = []
+        conv_idx = 0
+        for block, widths in enumerate(_VGG_STAGES):
+            if block:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            for ch in widths:
+                x = nn.Conv(
+                    ch, (3, 3), padding=((1, 1), (1, 1)),
+                    name=f"conv{conv_idx}",
+                )(x)
+                x = jax.nn.relu(x)
+                conv_idx += 1
+            taps.append(x)
+        return taps
+
+
+class _Fire(nn.Module):
+    """SqueezeNet Fire: 1x1 squeeze -> ReLU -> concat(1x1, 3x3p1 expands)."""
+
+    squeeze_ch: int
+    expand_ch: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        s = jax.nn.relu(nn.Conv(self.squeeze_ch, (1, 1), name="squeeze")(x))
+        e1 = jax.nn.relu(nn.Conv(self.expand_ch, (1, 1), name="expand1x1")(s))
+        e3 = jax.nn.relu(
+            nn.Conv(
+                self.expand_ch, (3, 3), padding=((1, 1), (1, 1)),
+                name="expand3x3",
+            )(s)
+        )
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class _SqueezeFeatures(nn.Module):
+    """SqueezeNet1.1 trunk returning the reference's 7 slice taps
+    (pretrained_networks.py:6-54; ceil-mode max pools)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Sequence[Array]:
+        taps = []
+        fire_idx = 0
+        for ops in _SQUEEZE_SLICES:
+            for op in ops:
+                if op[0] == "conv":
+                    x = nn.Conv(
+                        64, (3, 3), strides=(2, 2), padding="VALID",
+                        name="conv0",
+                    )(x)
+                    x = jax.nn.relu(x)
+                elif op[0] == "pool":
+                    x = _max_pool_ceil(x)
+                else:
+                    x = _Fire(op[1], op[2], name=f"fire{fire_idx}")(x)
+                    fire_idx += 1
+            taps.append(x)
+        return taps
+
+
+_NET_TRUNKS = {
+    "alex": _AlexFeatures,
+    "vgg16": _VGG16Features,
+    "squeeze": _SqueezeFeatures,
+}
+
+
+def _canon_net(net: str) -> str:
+    # DistModel accepts 'vgg' for vgg16 (networks_basic.py:44).
+    return "vgg16" if net == "vgg" else net
+
+
 class LPIPS(nn.Module):
     """Learned perceptual distance ``forward(x, y) -> [B]``.
 
     Inputs ``[B, H, W, 3]``. ``normalize=True`` maps [0, 1] -> [-1, 1]
     first (reference ``perceptual_loss.__call__``, ``loss/restore.py:18-23``).
+    ``net`` selects the backbone, same choices as the reference's
+    ``DistModel.initialize(net=...)``.
     """
 
     use_lins: bool = True
+    net: str = "alex"
 
     @nn.compact
     def __call__(self, x: Array, y: Array, normalize: bool = True) -> Array:
+        net = _canon_net(self.net)
         if normalize:
             x = 2.0 * x - 1.0
             y = 2.0 * y - 1.0
@@ -88,9 +230,10 @@ class LPIPS(nn.Module):
         x = (x - shift) / scale
         y = (y - shift) / scale
 
-        net = _AlexFeatures(name="alex")
-        fx = net(x)
-        fy = net(y)
+        trunk = _NET_TRUNKS[net](name=net)
+        fx = trunk(x)
+        fy = trunk(y)
+        chns = _NET_CHNS[net]
 
         total = 0.0
         for i, (a, b) in enumerate(zip(fx, fy)):
@@ -101,8 +244,8 @@ class LPIPS(nn.Module):
                 # 1x1 conv with non-negative learned weights, no bias.
                 w = self.param(
                     f"lin{i}",
-                    nn.initializers.constant(1.0 / _ALEX_CHNS[i]),
-                    (_ALEX_CHNS[i],),
+                    nn.initializers.constant(1.0 / chns[i]),
+                    (chns[i],),
                 )
                 val = (diff * jnp.abs(w)).sum(axis=-1)
             else:
@@ -129,85 +272,151 @@ def _torch_conv_to_flax(w: np.ndarray) -> np.ndarray:
     return np.transpose(w, (2, 3, 1, 0))
 
 
+# torchvision ``features`` indices of the conv layers, per net. For squeeze,
+# entries are (features_idx, fire_member) pairs; the first bare index is the
+# stem conv.
+_ALEX_CONV_IDX = (0, 3, 6, 8, 10)
+_VGG_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+_SQUEEZE_FIRE_IDX = (3, 4, 6, 7, 9, 10, 11, 12)
+
+
+def _load_backbone(p: Dict[str, Any], net: str, state: Dict[str, Any]) -> None:
+    """Copy a torchvision ``<net>.features`` state dict (numpy or torch
+    values, keys ``features.<i>....``) into the flax param subtree ``p``."""
+
+    def arr(key):
+        return np.asarray(state[key], np.float32)
+
+    if net == "alex":
+        for i, li in enumerate(_ALEX_CONV_IDX):
+            p[f"conv{i}"]["kernel"] = _torch_conv_to_flax(
+                arr(f"features.{li}.weight"))
+            p[f"conv{i}"]["bias"] = arr(f"features.{li}.bias")
+    elif net == "vgg16":
+        for i, li in enumerate(_VGG_CONV_IDX):
+            p[f"conv{i}"]["kernel"] = _torch_conv_to_flax(
+                arr(f"features.{li}.weight"))
+            p[f"conv{i}"]["bias"] = arr(f"features.{li}.bias")
+    elif net == "squeeze":
+        p["conv0"]["kernel"] = _torch_conv_to_flax(arr("features.0.weight"))
+        p["conv0"]["bias"] = arr("features.0.bias")
+        for i, li in enumerate(_SQUEEZE_FIRE_IDX):
+            for member in ("squeeze", "expand1x1", "expand3x3"):
+                p[f"fire{i}"][member]["kernel"] = _torch_conv_to_flax(
+                    arr(f"features.{li}.{member}.weight"))
+                p[f"fire{i}"][member]["bias"] = arr(
+                    f"features.{li}.{member}.bias")
+    else:  # pragma: no cover
+        raise ValueError(f"unknown LPIPS net {net!r}")
+
+
 def load_lpips_params(
     alexnet_state: Optional[Dict[str, Any]] = None,
     lin_npz_path: Optional[str] = None,
     rng_seed: int = 0,
     allow_uncalibrated: bool = False,
+    net: str = "alex",
+    backbone_state: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the LPIPS param pytree.
 
-    ``alexnet_state``: a torchvision ``alexnet().state_dict()``-style mapping
-    (numpy or torch tensors) with keys ``features.{0,3,6,8,10}.{weight,bias}``
-    — the pretrained backbone the reference loads
+    ``backbone_state`` (or the legacy alias ``alexnet_state``): a torchvision
+    ``<net>().state_dict()``-style mapping (numpy or torch tensors) with
+    ``features.*`` keys — the pretrained backbone the reference loads
     (``loss/PerceptualSimilarity/models/dist_model.py:66-74``). Convert one
-    offline with :func:`convert_alexnet_backbone_pth`.
+    offline with :func:`convert_backbone_pth`.
 
     Without it the backbone is random-initialized from ``rng_seed`` and the
     resulting "lpips" numbers are MEANINGLESS as perceptual distances (only
     usable as a smoke-test statistic). That fallback must be requested
     explicitly with ``allow_uncalibrated=True``; otherwise this raises.
     """
-    if alexnet_state is None and not allow_uncalibrated:
+    net = _canon_net(net)
+    state = backbone_state if backbone_state is not None else alexnet_state
+    if state is None and not allow_uncalibrated:
         raise ValueError(
-            "No AlexNet backbone weights supplied. LPIPS with a random "
-            "backbone does not measure perceptual similarity. Pass "
-            "alexnet_state=<converted torchvision state dict> (see "
-            "convert_alexnet_backbone_pth), or opt in to the uncalibrated "
+            "No backbone weights supplied. LPIPS with a random backbone "
+            "does not measure perceptual similarity. Pass "
+            "backbone_state=<converted torchvision state dict> (see "
+            "convert_backbone_pth), or opt in to the uncalibrated "
             "fallback explicitly with allow_uncalibrated=True."
         )
-    model = LPIPS()
+    model = LPIPS(net=net)
     dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
     params = model.init(jax.random.PRNGKey(rng_seed), dummy, dummy)
     params = jax.tree.map(np.asarray, params)
     p = params["params"]
 
-    torch_layer_idx = (0, 3, 6, 8, 10)
-    if alexnet_state is not None:
-        for i, li in enumerate(torch_layer_idx):
-            w = np.asarray(alexnet_state[f"features.{li}.weight"], np.float32)
-            b = np.asarray(alexnet_state[f"features.{li}.bias"], np.float32)
-            p["alex"][f"conv{i}"]["kernel"] = _torch_conv_to_flax(w)
-            p["alex"][f"conv{i}"]["bias"] = b
+    if state is not None:
+        _load_backbone(p[net], net, state)
 
-    path = lin_npz_path or _LIN_WEIGHTS_FILE
-    if os.path.exists(path):
+    path = lin_npz_path or (_LIN_WEIGHTS_FILE if net == "alex" else None)
+    if path is not None and os.path.exists(path):
         lins = np.load(path)
-        for i in range(5):
+        for i in range(len(_NET_CHNS[net])):
             p[f"lin{i}"] = np.asarray(lins[f"lin{i}"], np.float32)
+    elif not allow_uncalibrated:
+        # Same contract as the backbone: constant-init lins are not LPIPS.
+        raise ValueError(
+            f"No lin calibration weights for net={net!r} (convert the "
+            "richzhang release with convert_lpips_lin_pth and pass "
+            "lin_npz_path), or opt in to the uncalibrated fallback "
+            "explicitly with allow_uncalibrated=True."
+        )
     return params
 
 
-def convert_lpips_lin_pth(pth_path: str, out_npz_path: str) -> None:
-    """One-shot converter: richzhang LPIPS v0.1 ``alex.pth`` (keys
+def convert_lpips_lin_pth(pth_path: str, out_npz_path: str, net: str = "alex") -> None:
+    """One-shot converter: richzhang LPIPS v0.1 ``<net>.pth`` (keys
     ``lin{i}.model.1.weight`` of shape ``[1, C, 1, 1]``) -> flat npz."""
     import torch
 
     sd = torch.load(pth_path, map_location="cpu")
     out = {
         f"lin{i}": sd[f"lin{i}.model.1.weight"].numpy().reshape(-1)
-        for i in range(5)
+        for i in range(len(_NET_CHNS[_canon_net(net)]))
     }
     np.savez(out_npz_path, **out)
 
 
-def convert_alexnet_backbone_pth(pth_path: str, out_npz_path: str) -> None:
-    """One-shot converter for the backbone: a torchvision
-    ``alexnet-owt-*.pth`` state dict -> npz of the five feature convs.
-    Run wherever the torchvision weights are available; the npz is what
-    :func:`load_alexnet_npz` consumes at eval time."""
+def convert_backbone_pth(pth_path: str, out_npz_path: str, net: str = "alex") -> None:
+    """One-shot converter for the backbone: a torchvision state dict
+    (``alexnet-owt-*.pth`` / ``vgg16-*.pth`` / ``squeezenet1_1-*.pth``) ->
+    npz of the feature convs. Run wherever the torchvision weights are
+    available; the npz is what :func:`load_backbone_npz` consumes at eval
+    time."""
     import torch
 
+    net = _canon_net(net)
     sd = torch.load(pth_path, map_location="cpu")
     out = {}
-    for li in (0, 3, 6, 8, 10):
-        out[f"features.{li}.weight"] = sd[f"features.{li}.weight"].numpy()
-        out[f"features.{li}.bias"] = sd[f"features.{li}.bias"].numpy()
+    if net == "alex":
+        keys = [f"features.{li}" for li in _ALEX_CONV_IDX]
+    elif net == "vgg16":
+        keys = [f"features.{li}" for li in _VGG_CONV_IDX]
+    else:
+        keys = ["features.0"] + [
+            f"features.{li}.{m}"
+            for li in _SQUEEZE_FIRE_IDX
+            for m in ("squeeze", "expand1x1", "expand3x3")
+        ]
+    for k in keys:
+        out[f"{k}.weight"] = sd[f"{k}.weight"].numpy()
+        out[f"{k}.bias"] = sd[f"{k}.bias"].numpy()
     np.savez(out_npz_path, **out)
 
 
-def load_alexnet_npz(npz_path: str) -> Dict[str, np.ndarray]:
+def convert_alexnet_backbone_pth(pth_path: str, out_npz_path: str) -> None:
+    """Back-compat alias for :func:`convert_backbone_pth` (net='alex')."""
+    convert_backbone_pth(pth_path, out_npz_path, net="alex")
+
+
+def load_backbone_npz(npz_path: str) -> Dict[str, np.ndarray]:
     """Load a converted backbone npz into the mapping
     :func:`load_lpips_params` expects."""
     data = np.load(npz_path)
     return {k: data[k] for k in data.files}
+
+
+# Back-compat alias.
+load_alexnet_npz = load_backbone_npz

@@ -1,0 +1,313 @@
+"""Executed-reference parity for the FULL LPIPS pipeline.
+
+The reference's headline eval metric is LPIPS with a pretrained torchvision
+backbone + calibrated lin weights
+(``loss/PerceptualSimilarity/models/dist_model.py:66-74``, used at
+``infer_ours_cnt.py:262-268``). This image has no torchvision and no egress,
+but the *pipeline* is still provable end-to-end: instantiate the reference's
+own ``PNetLin`` (``networks_basic.py:32-110``) against a **seeded-random**
+torch backbone (torchvision shimmed with the standard public architectures),
+push those exact weights through our converter chain
+(``torch.save`` -> ``convert_backbone_pth`` -> ``load_backbone_npz`` ->
+``load_lpips_params``), and pin the resulting distances. Calibrated weights
+then become a pure data drop-in.
+
+All three DistModel backbone choices are covered: alex, vgg (=vgg16),
+squeeze (7 taps, ceil-mode pooling — exercised with a 66x66 input where
+ceil and floor window counts genuinely differ).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from conftest import ensure_module, shim_reference_imports  # noqa: E402
+
+REF = "/root/reference"
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted"
+)
+
+
+# ---------------------------------------------------------------------------
+# torchvision shim: the standard public architectures (weights random). Only
+# the ``features`` attribute is consumed by the reference's
+# pretrained_networks.py wrappers.
+# ---------------------------------------------------------------------------
+
+
+def _alexnet_features():
+    return tnn.Sequential(
+        tnn.Conv2d(3, 64, kernel_size=11, stride=4, padding=2),
+        tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(kernel_size=3, stride=2),
+        tnn.Conv2d(64, 192, kernel_size=5, padding=2),
+        tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(kernel_size=3, stride=2),
+        tnn.Conv2d(192, 384, kernel_size=3, padding=1),
+        tnn.ReLU(inplace=True),
+        tnn.Conv2d(384, 256, kernel_size=3, padding=1),
+        tnn.ReLU(inplace=True),
+        tnn.Conv2d(256, 256, kernel_size=3, padding=1),
+        tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(kernel_size=3, stride=2),
+    )
+
+
+def _vgg16_features():
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    layers, in_ch = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(tnn.MaxPool2d(kernel_size=2, stride=2))
+        else:
+            layers += [tnn.Conv2d(in_ch, v, kernel_size=3, padding=1),
+                       tnn.ReLU(inplace=True)]
+            in_ch = v
+    return tnn.Sequential(*layers)
+
+
+class _TorchFire(tnn.Module):
+    def __init__(self, in_ch, squeeze_ch, e1_ch, e3_ch):
+        super().__init__()
+        self.squeeze = tnn.Conv2d(in_ch, squeeze_ch, kernel_size=1)
+        self.squeeze_activation = tnn.ReLU(inplace=True)
+        self.expand1x1 = tnn.Conv2d(squeeze_ch, e1_ch, kernel_size=1)
+        self.expand1x1_activation = tnn.ReLU(inplace=True)
+        self.expand3x3 = tnn.Conv2d(squeeze_ch, e3_ch, kernel_size=3, padding=1)
+        self.expand3x3_activation = tnn.ReLU(inplace=True)
+
+    def forward(self, x):
+        x = self.squeeze_activation(self.squeeze(x))
+        return torch.cat([
+            self.expand1x1_activation(self.expand1x1(x)),
+            self.expand3x3_activation(self.expand3x3(x)),
+        ], 1)
+
+
+def _squeezenet1_1_features():
+    return tnn.Sequential(
+        tnn.Conv2d(3, 64, kernel_size=3, stride=2),
+        tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(kernel_size=3, stride=2, ceil_mode=True),
+        _TorchFire(64, 16, 64, 64),
+        _TorchFire(128, 16, 64, 64),
+        tnn.MaxPool2d(kernel_size=3, stride=2, ceil_mode=True),
+        _TorchFire(128, 32, 128, 128),
+        _TorchFire(256, 32, 128, 128),
+        tnn.MaxPool2d(kernel_size=3, stride=2, ceil_mode=True),
+        _TorchFire(256, 48, 192, 192),
+        _TorchFire(384, 48, 192, 192),
+        _TorchFire(384, 64, 256, 256),
+        _TorchFire(512, 64, 256, 256),
+    )
+
+
+class _FeaturesOnly:
+    def __init__(self, features):
+        self.features = features
+
+
+_FEATURE_FACTORIES = {
+    "alexnet": _alexnet_features,
+    "vgg16": _vgg16_features,
+    "squeezenet1_1": _squeezenet1_1_features,
+}
+
+
+@pytest.fixture(scope="module")
+def ref_networks():
+    """Import the reference's networks_basic with its absent deps stubbed."""
+    shim_reference_imports(REF)
+    ensure_module("skimage", {})
+    ensure_module(
+        "skimage.metrics",
+        {
+            "structural_similarity": lambda *a, **k: 0.0,
+            "peak_signal_noise_ratio": lambda *a, **k: 0.0,
+        },
+    )
+    ensure_module("skimage.color", {})
+    ensure_module("skimage.transform", {})
+    ensure_module("IPython", {"embed": lambda *a, **k: None})
+    ensure_module("tqdm", {"tqdm": lambda x, *a, **k: x})
+
+    tv_models = ensure_module("torchvision.models")
+    _MISSING = object()
+    saved = {n: getattr(tv_models, n, _MISSING) for n in _FEATURE_FACTORIES}
+    for name, factory in _FEATURE_FACTORIES.items():
+        # The reference calls e.g. tv.alexnet(pretrained=False) and takes
+        # .features (pretrained_networks.py:60); weights stay whatever
+        # torch's RNG draws under the caller's seed.
+        setattr(
+            tv_models, name,
+            (lambda f: lambda pretrained=False, **kw: _FeaturesOnly(f()))(
+                factory
+            ),
+        )
+
+    import loss.PerceptualSimilarity.models.networks_basic as networks
+
+    yield networks
+
+    # Restore whatever was there so a genuinely installed torchvision is
+    # never left shadowed for later tests.
+    for name, orig in saved.items():
+        if orig is _MISSING:
+            delattr(tv_models, name)
+        else:
+            setattr(tv_models, name, orig)
+
+
+def _ref_backbone_state(pnet):
+    """Recover the torchvision-style ``features.<i>...`` state dict from the
+    instantiated PNetLin (its slices hold references to the original
+    ``features`` modules, re-registered under their original indices —
+    pretrained_networks.py:67-76)."""
+    state = {}
+    for slice_name in ("slice1", "slice2", "slice3", "slice4", "slice5",
+                       "slice6", "slice7"):
+        sl = getattr(pnet.net, slice_name, None)
+        if sl is None:
+            continue
+        for idx, mod in sl.named_children():
+            for k, v in mod.state_dict().items():
+                state[f"features.{idx}.{k}"] = v
+    return state
+
+
+@pytest.mark.parametrize(
+    "ref_net,our_net,hw",
+    [("alex", "alex", 64), ("vgg", "vgg16", 64), ("squeeze", "squeeze", 66)],
+)
+def test_pnetlin_full_distance_parity(ref_networks, tmp_path, ref_net,
+                                      our_net, hw):
+    """Reference PNetLin (executed) vs our LPIPS, identical seeded weights
+    pushed through the real converter chain. 66x66 for squeeze makes torch's
+    ceil-mode pooling diverge from floor mode, pinning _max_pool_ceil."""
+    from esr_tpu.losses.lpips import (
+        LPIPS,
+        _NET_CHNS,
+        convert_backbone_pth,
+        load_backbone_npz,
+        load_lpips_params,
+    )
+
+    torch.manual_seed(1234)
+    pnet = ref_networks.PNetLin(
+        pnet_type=ref_net, pnet_rand=True, use_dropout=True,
+        spatial=False, version="0.1", lpips=True,
+    )
+    pnet.eval()
+
+    chns = _NET_CHNS[our_net]
+    # Positive lin weights (calibrated LPIPS weights are non-negative; our
+    # layer applies |w|, so parity requires w >= 0 — asserted for the
+    # shipped alex lins in test_shipped_lin_weights_nonnegative).
+    rng = np.random.default_rng(7)
+    lin_ws = [rng.uniform(0.01, 1.0, size=(c,)).astype(np.float32)
+              for c in chns]
+    for i, w in enumerate(lin_ws):
+        conv = getattr(pnet, f"lin{i}").model[1]
+        with torch.no_grad():
+            conv.weight.copy_(torch.from_numpy(w.reshape(1, -1, 1, 1)))
+
+    # Our side: same backbone through the real offline-converter chain.
+    state = _ref_backbone_state(pnet)
+    pth = tmp_path / "backbone.pth"
+    npz = tmp_path / "backbone.npz"
+    torch.save(state, str(pth))
+    convert_backbone_pth(str(pth), str(npz), net=our_net)
+    params = load_lpips_params(
+        backbone_state=load_backbone_npz(str(npz)), net=our_net,
+        lin_npz_path="/nonexistent",  # lins set explicitly below
+        allow_uncalibrated=True,
+    )
+    for i, w in enumerate(lin_ws):
+        params["params"][f"lin{i}"] = w
+
+    rng2 = np.random.default_rng(42)
+    x = rng2.uniform(size=(2, hw, hw, 3)).astype(np.float32)
+    y = np.clip(x + rng2.normal(scale=0.1, size=x.shape), 0, 1).astype(
+        np.float32)
+
+    with torch.no_grad():
+        ref_val = pnet(
+            torch.from_numpy(2 * np.transpose(x, (0, 3, 1, 2)) - 1),
+            torch.from_numpy(2 * np.transpose(y, (0, 3, 1, 2)) - 1),
+        ).numpy().reshape(-1)
+
+    ours = np.asarray(LPIPS(net=our_net).apply(params, x, y, normalize=True))
+
+    assert ref_val.shape == ours.shape == (2,)
+    assert np.all(ref_val > 0)
+    np.testing.assert_allclose(ours, ref_val, rtol=2e-4, atol=1e-6)
+
+
+def test_shipped_lin_weights_nonnegative():
+    """The |w| in our lin layer is an identity exactly when the calibrated
+    weights are non-negative — verify that holds for the shipped alex lins."""
+    from esr_tpu.losses.lpips import _LIN_WEIGHTS_FILE
+
+    lins = np.load(_LIN_WEIGHTS_FILE)
+    for i in range(5):
+        assert (lins[f"lin{i}"] >= 0).all()
+
+
+def test_multi_channel_replication_parity(ref_networks):
+    """Reference loss/restore.py:26-38 replicates each non-RGB channel to
+    3 channels and averages the per-channel distances; pin our
+    LPIPS.multi_channel against that recipe executed with the reference
+    PNetLin."""
+    from esr_tpu.losses.lpips import LPIPS, load_lpips_params
+
+    torch.manual_seed(99)
+    pnet = ref_networks.PNetLin(
+        pnet_type="alex", pnet_rand=True, use_dropout=True,
+        spatial=False, version="0.1", lpips=True,
+    )
+    pnet.eval()
+    state = _ref_backbone_state(pnet)
+    chns = (64, 192, 384, 256, 256)
+    rng = np.random.default_rng(3)
+    lin_ws = [rng.uniform(0.01, 1.0, size=(c,)).astype(np.float32)
+              for c in chns]
+    for i, w in enumerate(lin_ws):
+        with torch.no_grad():
+            getattr(pnet, f"lin{i}").model[1].weight.copy_(
+                torch.from_numpy(w.reshape(1, -1, 1, 1)))
+
+    params = load_lpips_params(
+        backbone_state={k: v.numpy() for k, v in state.items()},
+        lin_npz_path="/nonexistent",
+        allow_uncalibrated=True,
+    )
+    for i, w in enumerate(lin_ws):
+        params["params"][f"lin{i}"] = w
+
+    rng2 = np.random.default_rng(5)
+    pred = rng2.uniform(size=(1, 64, 64, 2)).astype(np.float32)
+    tgt = rng2.uniform(size=(1, 64, 64, 2)).astype(np.float32)
+
+    # Reference recipe (loss/restore.py:28-38): per channel, repeat to RGB,
+    # [0,1] -> [-1,1], mean over channels of the scalar distances.
+    dists = []
+    for c in range(2):
+        p3 = np.repeat(pred[..., c:c + 1], 3, axis=-1)
+        t3 = np.repeat(tgt[..., c:c + 1], 3, axis=-1)
+        with torch.no_grad():
+            d = pnet(
+                torch.from_numpy(2 * np.transpose(p3, (0, 3, 1, 2)) - 1),
+                torch.from_numpy(2 * np.transpose(t3, (0, 3, 1, 2)) - 1),
+            ).numpy().mean()
+        dists.append(d)
+    ref_val = float(np.mean(dists))
+
+    ours = float(LPIPS().multi_channel(params, pred, tgt))
+    np.testing.assert_allclose(ours, ref_val, rtol=2e-4, atol=1e-6)
