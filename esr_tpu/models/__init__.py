@@ -1,5 +1,6 @@
 from .layers import (
     ConvLayer,
+    TorchBatchNorm,
     TransposedConvLayer,
     UpsampleConvLayer,
     RecurrentConvLayer,
@@ -7,6 +8,7 @@ from .layers import (
     ConvLSTMCell,
     ConvGRUCell,
     MLP,
+    apply_seq,
 )
 from .esr import DeepRecurrNet, FeatsExtract, TimePropagation, STFusion
 from .registry import get_model, register_model, MODEL_REGISTRY

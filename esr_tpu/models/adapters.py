@@ -51,7 +51,9 @@ class FrameRecurrentSR(nn.Module):
     def init_states(self, batch: int, height: int, width: int):
         return self.model.init_states(batch, height, width)
 
-    def __call__(self, x: Array, states) -> Tuple[Array, Any]:
+    def __call__(
+        self, x: Array, states, train: bool = False
+    ) -> Tuple[Array, Any]:
         b, n, h, w, c = x.shape
         assert n == self.num_frame, (
             f"window length {n} != num_frame {self.num_frame} "
@@ -64,7 +66,7 @@ class FrameRecurrentSR(nn.Module):
         mid = (n - 1) // 2
         out_mid = None
         for i in range(n):
-            out, states = self.model(x[:, i], states)
+            out, states = self.model(x[:, i], states, train)
             if i == mid:
                 out_mid = out
         if out_mid.shape[1:3] != (h, w):

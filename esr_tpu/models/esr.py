@@ -31,6 +31,7 @@ from flax import linen as nn
 
 from esr_tpu.ops.dcn import dcn_offsets_from_conv, deform_conv2d_auto
 from esr_tpu.models.layers import (
+    apply_seq,
     ConvLayer,
     ConvGRUCell,
     MLP,
@@ -58,13 +59,13 @@ class FeatsExtract(nn.Module):
     activation: str = "relu"
 
     @nn.compact
-    def __call__(self, x: Array) -> List[Array]:
+    def __call__(self, x: Array, train: bool = False) -> List[Array]:
         outs = []
         for mult in (2, 4, 8):
             x = ConvLayer(
                 mult * self.basech, 3, stride=2, padding=1,
                 activation=self.activation, norm=self.norm,
-            )(x)
+            )(x, train)
             outs.append(x)
         return outs[::-1]
 
@@ -88,10 +89,10 @@ class TimePropagation(nn.Module):
         assert self.has_ltc or self.has_gtc
         c = self.channels
         if self.has_ltc:
-            self.pred_map = nn.Sequential([
+            self.pred_map = [
                 ConvLayer(c, 3, padding=1, activation=self.activation, norm=self.norm),
                 ConvLayer(1, 3, padding=1, activation="sigmoid", norm=self.norm),
-            ])
+            ]
             self.local_res = ResidualBlock(3 * c, norm=self.norm)
             self.local_out = ConvLayer(c, 3, padding=1, activation=None, norm=self.norm)
         if self.has_gtc:
@@ -103,13 +104,17 @@ class TimePropagation(nn.Module):
                 c, 1, padding=0, activation=self.activation, norm=self.norm
             )
 
-    def _local_time_corre(self, f0: Array, f1: Array, f2: Array) -> Array:
-        map0 = self.pred_map(jnp.concatenate([f0, f1], axis=-1))
-        map1 = self.pred_map(jnp.concatenate([f1, f2], axis=-1))
+    def _local_time_corre(
+        self, f0: Array, f1: Array, f2: Array, train: bool
+    ) -> Array:
+        map0 = apply_seq(self.pred_map, jnp.concatenate([f0, f1], axis=-1), train)
+        map1 = apply_seq(self.pred_map, jnp.concatenate([f1, f2], axis=-1), train)
         fused = jnp.concatenate([f0 * map0, f1, f2 * map1], axis=-1)
-        return self.local_out(self.local_res(fused)) + f1
+        return self.local_out(self.local_res(fused, train), train) + f1
 
-    def __call__(self, x: Array, states: States) -> Tuple[Array, States]:
+    def __call__(
+        self, x: Array, states: States, train: bool = False
+    ) -> Tuple[Array, States]:
         """``x: [B, N, H, W, C]`` -> same shape; states threaded through."""
         b, n, h, w, c = x.shape
 
@@ -120,7 +125,7 @@ class TimePropagation(nn.Module):
                     (n - 2, n - 1, n - 1) if i == n - 1 else (i - 1, i, i + 1)
                 )
                 feats.append(
-                    self._local_time_corre(x[:, i0], x[:, i1], x[:, i2])
+                    self._local_time_corre(x[:, i0], x[:, i1], x[:, i2], train)
                 )
             feats = jnp.stack(feats, axis=1)
         else:
@@ -133,8 +138,8 @@ class TimePropagation(nn.Module):
                 if self.gtc_frozen:
                     state_fwd = jnp.zeros_like(state_fwd)
                     state_bwd = jnp.zeros_like(state_bwd)
-                out_f, state_fwd = self.gru(feats[:, i], state_fwd)
-                out_b, state_bwd = self.gru(feats[:, n - 1 - i], state_bwd)
+                out_f, state_fwd = self.gru(feats[:, i], state_fwd, train)
+                out_b, state_bwd = self.gru(feats[:, n - 1 - i], state_bwd, train)
                 xs.append(out_f)
                 revs.append(out_b)
             if self.gtc_frozen:
@@ -143,7 +148,7 @@ class TimePropagation(nn.Module):
             merged = jnp.concatenate(
                 [jnp.stack(xs, 1), jnp.stack(revs, 1)], axis=-1
             ).reshape(b * n, h, w, 2 * c)
-            feats = self.global_fusion(merged).reshape(b, n, h, w, c)
+            feats = self.global_fusion(merged, train).reshape(b, n, h, w, c)
             states = (state_fwd, state_bwd)
 
         return feats + x, states
@@ -166,10 +171,10 @@ class STFusion(nn.Module):
         assert (self.num_frame + 1) % 2 == 0 and self.num_frame >= 3
         c = self.channels
         if self.has_dcnatten:
-            self.offset_conv = nn.Sequential([
+            self.offset_conv = [
                 ConvLayer(c, 3, padding=1, activation=self.activation, norm=self.norm),
                 ConvLayer(c, 3, padding=1, activation=None, norm=self.norm),
-            ])
+            ]
             # DCN_sep: offsets/mask from a separate feature via a
             # zero-initialized conv (dcn_v2.py:205-212); weights of the
             # deformable conv itself use the torch default init.
@@ -185,22 +190,22 @@ class STFusion(nn.Module):
             self.dcn_bias = self.param(
                 "dcn_bias", torch_conv_bias_init(c * 9), (c,)
             )
-            self.post_dcn = nn.Sequential([
+            self.post_dcn = [
                 ConvLayer(c, 3, padding=1, activation=self.activation, norm=self.norm),
                 ConvLayer(c, 3, padding=1, activation=None, norm=self.norm),
-            ])
+            ]
             self.spatial_kernel = ConvLayer(
                 2, 1, padding=0, activation="sigmoid", norm=self.norm
             )
             self.channel_mlp = MLP(hidden_dim=c // 2, output_dim=2 * c, num_layers=2)
-            self.dcn_fusion = nn.Sequential([
+            self.dcn_fusion = [
                 ConvLayer(c, 3, padding=1, activation=self.activation, norm=self.norm),
                 ConvLayer(c, 3, padding=1, activation=None, norm=self.norm),
-            ])
-        self.dense_fusion = nn.Sequential([
+            ]
+        self.dense_fusion = [
             ConvLayer(c, 3, padding=1, activation=self.activation, norm=self.norm),
             ConvLayer(c, 3, padding=1, activation=None, norm=self.norm),
-        ])
+        ]
         if self.has_scaleaggre:
             self.attens = [
                 ConvLayer(1, 3, padding=1, activation="sigmoid", norm=self.norm,
@@ -217,12 +222,12 @@ class STFusion(nn.Module):
     def mid_idx(self) -> int:
         return (self.num_frame - 1) // 2
 
-    def _fuse(self, feat0: Array, feat1: Array) -> Array:
+    def _fuse(self, feat0: Array, feat1: Array, train: bool) -> Array:
         """Deformable-align ``feat0`` to ``feat1`` and gate-fuse
         (reference ``model.py:208-231``)."""
         c = feat0.shape[-1]
         raw = self.dcn_offset_mask(
-            self.offset_conv(jnp.concatenate([feat0, feat1], axis=-1))
+            apply_seq(self.offset_conv, jnp.concatenate([feat0, feat1], axis=-1), train)
         )
         offsets, mask = dcn_offsets_from_conv(raw, self.deformable_groups, 9)
         aligned = jax.nn.relu(
@@ -231,21 +236,21 @@ class STFusion(nn.Module):
                 impl=self.dcn_impl,
             )
         )
-        feat = self.post_dcn(jnp.concatenate([aligned, feat1], axis=-1))
-        sk = self.spatial_kernel(feat)  # [B, H, W, 2]
+        feat = apply_seq(self.post_dcn, jnp.concatenate([aligned, feat1], axis=-1), train)
+        sk = self.spatial_kernel(feat, train)  # [B, H, W, 2]
         # channel gate: spatial max-pool -> MLP -> sigmoid, [B, 2C]
         ck = jax.nn.sigmoid(self.channel_mlp(jnp.max(feat, axis=(1, 2))))
         ck = ck[:, None, None, :]
         y0 = aligned * sk[..., 0:1] * ck[..., :c]
         y1 = feat1 * sk[..., 1:2] * ck[..., c:]
-        return self.dcn_fusion(jnp.concatenate([y0, y1], axis=-1))
+        return apply_seq(self.dcn_fusion, jnp.concatenate([y0, y1], axis=-1), train)
 
-    def _dense_fuse(self, x: Array) -> Array:
+    def _dense_fuse(self, x: Array, train: bool) -> Array:
         """Fuse N frames into one (reference ``model.py:233-251``)."""
         b, n, h, w, c = x.shape
         if self.has_dcnatten:
             outs = [
-                self._fuse(x[:, i], x[:, self.mid_idx])
+                self._fuse(x[:, i], x[:, self.mid_idx], train)
                 for i in range(n)
                 if i != self.mid_idx
             ]
@@ -253,28 +258,32 @@ class STFusion(nn.Module):
             out = jnp.concatenate(outs, axis=-1)
         else:
             out = x.transpose(0, 2, 3, 1, 4).reshape(b, h, w, n * c)
-        return self.dense_fusion(out)
+        return apply_seq(self.dense_fusion, out, train)
 
-    def _scale_aggre(self, x: Array, feats: Array, scale_idx: int) -> Array:
+    def _scale_aggre(
+        self, x: Array, feats: Array, scale_idx: int, train: bool
+    ) -> Array:
         """Attention-aggregate skip features + 2x upsample
         (reference ``model.py:253-273``)."""
         if self.has_scaleaggre:
             b, n, h, w, c = feats.shape
             flat = feats.reshape(b * n, h, w, c)
-            atten = self.attens[scale_idx](flat)
+            atten = self.attens[scale_idx](flat, train)
             agg = (flat * atten).reshape(b, n, h, w, c).mean(axis=1)
             x = x + agg
-        return self.recons[scale_idx](x)
+        return self.recons[scale_idx](x, train)
 
-    def __call__(self, x: Array, feats_list: Sequence[Array]) -> Array:
+    def __call__(
+        self, x: Array, feats_list: Sequence[Array], train: bool = False
+    ) -> Array:
         """``x: [B, N, H, W, C]``; ``feats_list[i]: [B*N, 2^i*H, 2^i*W, C/2^i]``."""
         b, n, h, w, c = x.shape
         assert n == self.num_frame
-        out = self._dense_fuse(x)
+        out = self._dense_fuse(x, train)
         for idx, feats in enumerate(feats_list):
             fh, fw, fc = feats.shape[-3:]
             out = self._scale_aggre(
-                out, feats.reshape(b, n, fh, fw, fc), idx
+                out, feats.reshape(b, n, fh, fw, fc), idx, train
             )
         return out
 
@@ -336,7 +345,9 @@ class DeepRecurrNet(nn.Module):
         z = ConvGRUCell.zeros_state(batch, h8, w8, c)
         return (z, z)
 
-    def __call__(self, x: Array, states: States) -> Tuple[Array, States]:
+    def __call__(
+        self, x: Array, states: States, train: bool = False
+    ) -> Tuple[Array, States]:
         b, n, h, w, cin = x.shape
         spec = model_util.compute_pad(h, w, self.down_scale, self.down_scale)
         need_crop = (spec.padded_height, spec.padded_width) != (h, w)
@@ -345,15 +356,15 @@ class DeepRecurrNet(nn.Module):
         ph, pw = x.shape[2], x.shape[3]
 
         flat = x.reshape(b * n, ph, pw, cin)
-        flat = self.head(flat)
-        feats_list = self.feat_extract(flat)
+        flat = self.head(flat, train)
+        feats_list = self.feat_extract(flat, train)
         bottleneck = feats_list[0]
         bh, bw, bc = bottleneck.shape[-3:]
 
         seq = bottleneck.reshape(b, n, bh, bw, bc)
-        seq, states = self.time_propagate(seq, states)
-        out = self.spacetime_fuse(seq, feats_list)
-        out = self.tail(out)
+        seq, states = self.time_propagate(seq, states, train)
+        out = self.spacetime_fuse(seq, feats_list, train)
+        out = self.tail(out, train)
 
         if need_crop:
             out = model_util.crop_image(out, spec, scale=1)

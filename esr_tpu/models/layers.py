@@ -63,18 +63,79 @@ def get_activation(name: Optional[str]) -> Optional[Callable]:
     return _ACTIVATIONS[name]
 
 
-class _NormWrapper(nn.Module):
-    """Optional norm following a conv (reference ConvLayer norm handling).
+class TorchBatchNorm(nn.Module):
+    """``torch.nn.BatchNorm2d`` semantics on NHWC (reference ConvLayer
+    ``norm='BN'``, ``models/submodules.py:166-199``).
 
-    Only stateless norms are supported: ``'IN'`` (instance norm; the
-    reference's ``track_running_stats=True`` variant is approximated by the
-    batch statistics, which is what torch uses in training mode) and ``None``.
-    ``'BN'`` is rejected explicitly: batch statistics would need a train flag
-    threaded through every module and a mutable ``batch_stats`` collection in
-    the train step — none of the reference's shipped configs use BN (the
-    headline config sets ``norm: null`` and the reference's SyncBN conversion
-    is a no-op in practice, SURVEY.md §5), so until a config needs it we fail
-    loudly rather than silently running inference-mode BN.
+    Torch-exact details the stock flax BatchNorm differs on:
+
+    - running stats blend with ``new = (1-m)*old + m*batch`` where torch's
+      ``momentum`` (default 0.1) weights the NEW value;
+    - the running variance accumulates the UNBIASED batch variance
+      (``n/(n-1)``) while normalization in train mode uses the biased one
+      (torch ``_BatchNorm.forward``).
+
+    **SyncBN**: the reference wraps models in
+    ``torch.nn.SyncBatchNorm.convert_sync_batchnorm``
+    (``train_ours_cnt_seq.py:763``) because DDP would otherwise compute
+    per-GPU statistics. Under ``jit`` + GSPMD a batch sharded over the mesh
+    computes GLOBAL batch moments by construction — ``x.mean`` over the
+    batch axis IS the cross-replica mean, XLA inserts the collectives — so
+    the SyncBN analogue is implicit in this framework's trainer
+    architecture. ``axis_name`` exists only for explicit-collective contexts
+    (``shard_map``/``pmap``) where each program instance sees a shard.
+    """
+
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+        if train:
+            red = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=red)
+            mean2 = jnp.mean(jnp.square(xf), axis=red)
+            n = x.size // c
+            if self.axis_name is not None:
+                mean = jax.lax.pmean(mean, self.axis_name)
+                mean2 = jax.lax.pmean(mean2, self.axis_name)
+                n = n * jax.lax.psum(1, self.axis_name)
+            # clamp: f32 cancellation in E[x^2]-E[x]^2 can go slightly
+            # negative when |mean| >> std, and rsqrt(negative) is NaN
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                bessel = n / (n - 1) if n > 1 else 1.0
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
+                ra_var.value = (1.0 - m) * ra_var.value + m * var * bessel
+            use_mean, use_var = mean, var
+        else:
+            use_mean, use_var = ra_mean.value, ra_var.value
+        y = (x.astype(jnp.float32) - use_mean) * jax.lax.rsqrt(
+            use_var + self.epsilon
+        )
+        y = y * scale + bias
+        return y.astype(x.dtype)
+
+
+class _NormWrapper(nn.Module):
+    """Optional norm following a conv (reference ConvLayer norm handling):
+    ``'BN'`` (:class:`TorchBatchNorm` — needs the ``train`` flag and a
+    mutable ``batch_stats`` collection in the caller's apply), ``'IN'``
+    (instance norm; the reference's ``track_running_stats=True`` variant is
+    approximated by the batch statistics, which is what torch uses in
+    training mode), or ``None``.
     """
 
     norm: Optional[str] = None
@@ -85,12 +146,22 @@ class _NormWrapper(nn.Module):
         if self.norm == "IN":
             # InstanceNorm == GroupNorm with one group per channel.
             x = nn.GroupNorm(num_groups=None, group_size=1)(x)
+        elif self.norm == "BN":
+            x = TorchBatchNorm(momentum=self.bn_momentum)(x, train)
         elif self.norm is not None:
             raise NotImplementedError(
-                f"norm={self.norm!r} is not supported (only 'IN' or None); "
-                "BN needs train-flag threading + batch_stats handling"
+                f"norm={self.norm!r} is not supported ('BN', 'IN' or None)"
             )
         return x
+
+
+def apply_seq(layers: Sequence[Any], x: Array, train: bool = False) -> Array:
+    """Apply a list of norm-aware layers in order, forwarding ``train``
+    (replaces ``nn.Sequential``, which forwards extra args to the first
+    layer only)."""
+    for layer in layers:
+        x = layer(x, train)
+    return x
 
 
 class ConvLayer(nn.Module):
@@ -324,7 +395,9 @@ class RecurrentConvLayer(nn.Module):
     norm: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x: Array, state: Any) -> Tuple[Array, Any]:
+    def __call__(
+        self, x: Array, state: Any, train: bool = False
+    ) -> Tuple[Array, Any]:
         x = ConvLayer(
             self.features,
             self.kernel_size,
@@ -332,7 +405,7 @@ class RecurrentConvLayer(nn.Module):
             self.padding,
             self.activation,
             self.norm,
-        )(x)
+        )(x, train)
         if self.recurrent_block_type == "convgru":
             new_state = ConvGRUCell(self.features, kernel_size=3)(x, state)
             return new_state, new_state

@@ -138,7 +138,9 @@ class _RecurrentEncoderStack(nn.Module):
     norm: Optional[str]
 
     @nn.compact
-    def __call__(self, x: Array, states: Tuple) -> Tuple[Array, List[Array], Tuple]:
+    def __call__(
+        self, x: Array, states: Tuple, train: bool = False
+    ) -> Tuple[Array, List[Array], Tuple]:
         blocks, new_states = [], []
         for i, c in enumerate(self.sizes):
             x, s = RecurrentConvLayer(
@@ -149,7 +151,7 @@ class _RecurrentEncoderStack(nn.Module):
                 recurrent_block_type=self.recurrent_block_type,
                 norm=self.norm,
                 name=f"encoder_{i}",
-            )(x, states[i])
+            )(x, states[i], train)
             blocks.append(x)
             new_states.append(s)
         return x, blocks, tuple(new_states)
@@ -178,15 +180,17 @@ class UNetRecurrent(_UNetBase):
             self.num_output_channels, 1, activation=None, norm=self.norm
         )
 
-    def __call__(self, x: Array, states: Tuple) -> Tuple[Array, Tuple]:
-        x = self.head(x)
+    def __call__(
+        self, x: Array, states: Tuple, train: bool = False
+    ) -> Tuple[Array, Tuple]:
+        x = self.head(x, train)
         head = x
-        x, blocks, states = self.encoders(x, states)
+        x, blocks, states = self.encoders(x, states, train)
         for res in self.resblocks:
-            x = res(x)
+            x = res(x, train)
         for i, dec in enumerate(self.decoders):
-            x = dec(self._skip(x, blocks[self.num_encoders - i - 1]))
-        img = self.pred(self._skip(x, head))
+            x = dec(self._skip(x, blocks[self.num_encoders - i - 1]), train)
+        img = self.pred(self._skip(x, head), train)
         return self._final_act(img), states
 
 
@@ -213,15 +217,15 @@ class UNetFlow(_UNetBase):
         ]
         self.pred = ConvLayer(3, 1, activation=None, norm=None)
 
-    def __call__(self, x: Array, states: Tuple):
-        x = self.head(x)
+    def __call__(self, x: Array, states: Tuple, train: bool = False):
+        x = self.head(x, train)
         head = x
-        x, blocks, states = self.encoders(x, states)
+        x, blocks, states = self.encoders(x, states, train)
         for res in self.resblocks:
-            x = res(x)
+            x = res(x, train)
         for i, dec in enumerate(self.decoders):
-            x = dec(self._skip(x, blocks[self.num_encoders - i - 1]))
-        img_flow = self.pred(self._skip(x, head))
+            x = dec(self._skip(x, blocks[self.num_encoders - i - 1]), train)
+        img_flow = self.pred(self._skip(x, head), train)
         return (
             {"image": img_flow[..., 0:1], "flow": img_flow[..., 1:3]},
             states,
@@ -268,20 +272,20 @@ class MultiResUNet(_UNetBase):
             for i, _ in enumerate(reversed(self.encoder_input_sizes))
         ]
 
-    def __call__(self, x: Array) -> List[Array]:
+    def __call__(self, x: Array, train: bool = False) -> List[Array]:
         blocks = []
         for enc in self.enc:
-            x = enc(x)
+            x = enc(x, train)
             blocks.append(x)
         for res in self.resblocks:
-            x = res(x)
+            x = res(x, train)
         predictions: List[Array] = []
         for i, (dec, pred) in enumerate(zip(self.decoders, self.preds)):
             x = skip_concat(x, blocks[self.num_encoders - i - 1])
             if i > 0:
                 x = skip_concat(predictions[-1], x)
-            x = dec(x)
-            predictions.append(pred(x))
+            x = dec(x, train)
+            predictions.append(pred(x, train))
         return predictions
 
 
@@ -324,17 +328,23 @@ class SRUNetRecurrent(_UNetBase):
             self.num_output_channels, 1, activation=None, norm=self.norm
         )
 
-    def __call__(self, x: Array, states: Tuple) -> Tuple[Array, Tuple]:
-        x = self.head(x)
+    def __call__(
+        self, x: Array, states: Tuple, train: bool = False
+    ) -> Tuple[Array, Tuple]:
+        x = self.head(x, train)
         head = x
-        x, blocks, states = self.encoders(x, states)
+        x, blocks, states = self.encoders(x, states, train)
         for res in self.resblocks:
-            x = res(x)
+            x = res(x, train)
         for i, dec in enumerate(self.decoders):
             x = dec(
                 self._skip(
-                    x, self.skip_upsampler[i](blocks[self.num_encoders - i - 1])
-                )
+                    x,
+                    self.skip_upsampler[i](
+                        blocks[self.num_encoders - i - 1], train
+                    ),
+                ),
+                train,
             )
-        img = self.pred(self._skip(x, self.skip_upsampler[-1](head)))
+        img = self.pred(self._skip(x, self.skip_upsampler[-1](head, train)), train)
         return self._final_act(img), states

@@ -25,8 +25,32 @@ import optax
 Array = jax.Array
 
 
+def _split_vars(variables):
+    """``model.init`` output -> (trainable params, batch_stats or None).
+
+    Accepts either a full flax variables dict (``{'params': ..., opt.
+    'batch_stats': ...}`` — what every caller passes) or a bare param tree.
+    """
+    if isinstance(variables, dict) and "params" in variables:
+        return variables["params"], variables.get("batch_stats", None)
+    return variables, None
+
+
+def _merge_vars(params, stats):
+    out = {"params": params}
+    if stats is not None:
+        out["batch_stats"] = stats
+    return out
+
+
 class TrainState(NamedTuple):
-    """Carried training state (params + optimizer + step counter)."""
+    """Carried training state (variables + optimizer + step counter).
+
+    ``params`` holds the FULL flax variables dict (the ``'params'``
+    collection plus, for BN models, ``'batch_stats'`` running averages —
+    reference ``nn.BatchNorm2d`` buffers). The optimizer state covers only
+    the trainable ``'params'`` subtree.
+    """
 
     params: Any
     opt_state: Any
@@ -36,7 +60,7 @@ class TrainState(NamedTuple):
     def create(cls, params, optimizer: optax.GradientTransformation):
         return cls(
             params=params,
-            opt_state=optimizer.init(params),
+            opt_state=optimizer.init(_split_vars(params)[0]),
             step=jnp.zeros((), jnp.int32),
         )
 
@@ -112,16 +136,30 @@ def make_train_step(
     """
     mid_idx = (seqn - 1) // 2
 
-    apply_fn = model.apply
-    if remat:
-        apply_fn = jax.checkpoint(apply_fn)
+    # train=True / mutable are baked in BEFORE jax.checkpoint wraps the
+    # callable: checkpoint flattens every argument into tracers, which would
+    # turn a passed-through `train` bool into a tracer and break flax's
+    # `if train:` branches.
+    def _fwd_plain(variables, window, states):
+        return model.apply(variables, window, states, train=True)
 
-    def loss_fn(params, batch):
+    def _fwd_bn(variables, window, states):
+        return model.apply(
+            variables, window, states, train=True, mutable=["batch_stats"]
+        )
+
+    if remat:
+        _fwd_plain = jax.checkpoint(_fwd_plain)
+        _fwd_bn = jax.checkpoint(_fwd_bn)
+
+    def loss_fn(param_col, stats, batch):
         if rasterize is not None:
             batch = rasterize(batch)
         inp, gt = batch["inp"], batch["gt"]
         if compute_dtype is not None:
-            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+            param_col = jax.tree.map(
+                lambda p: p.astype(compute_dtype), param_col
+            )
             inp = inp.astype(compute_dtype)
         b, L = inp.shape[0], inp.shape[1]
         windows = _make_windows(inp, seqn)  # [Wc, B, seqn, H, W, C]
@@ -135,25 +173,55 @@ def make_train_step(
                 lambda s: s.astype(compute_dtype), states0
             )
 
-        def body(states, xs):
-            window, gtw = xs
-            pred, states = apply_fn(params, window, states)
-            err = pred.astype(jnp.float32) - gtw  # loss math in f32
-            return states, ((err**2).mean(), pred)
+        if stats is None:
 
-        _, (losses, preds) = jax.lax.scan(body, states0, (windows, gt_mid))
+            def body(states, xs):
+                window, gtw = xs
+                pred, states = _fwd_plain(
+                    {"params": param_col}, window, states
+                )
+                err = pred.astype(jnp.float32) - gtw  # loss math in f32
+                return states, ((err**2).mean(), pred)
+
+            _, (losses, preds) = jax.lax.scan(
+                body, states0, (windows, gt_mid)
+            )
+            new_stats = None
+        else:
+            # BN models: running stats update on every window forward (torch
+            # updates per forward() call inside the reference's BPTT loop),
+            # so the stats ride the scan carry alongside the GRU states.
+            def body(carry, xs):
+                states, st = carry
+                window, gtw = xs
+                (pred, states), mut = _fwd_bn(
+                    {"params": param_col, "batch_stats": st}, window, states
+                )
+                err = pred.astype(jnp.float32) - gtw
+                return (states, mut["batch_stats"]), ((err**2).mean(), pred)
+
+            (_, new_stats), (losses, preds) = jax.lax.scan(
+                body, (states0, stats), (windows, gt_mid)
+            )
         # reference accumulates the SUM of per-window MSEs before backward
-        return losses.sum(), (losses, preds[-1].astype(jnp.float32))
+        return losses.sum(), (losses, preds[-1].astype(jnp.float32), new_stats)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
-        (loss, (losses, last_pred)), grads = jax.value_and_grad(
+        param_col, stats = _split_vars(state.params)
+        (loss, (losses, last_pred, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
-        )(state.params, batch)
+        )(param_col, stats, batch)
         updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
+            grads, state.opt_state, param_col
         )
-        params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(params, opt_state, state.step + 1)
+        param_col = optax.apply_updates(param_col, updates)
+        new_state = TrainState(
+            _merge_vars(param_col, new_stats)
+            if isinstance(state.params, dict) and "params" in state.params
+            else param_col,
+            opt_state,
+            state.step + 1,
+        )
         metrics = {
             "loss": loss,
             "loss_per_window": losses,

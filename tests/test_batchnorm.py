@@ -1,0 +1,275 @@
+"""BatchNorm ('BN') support: executed-reference parity + SyncBN semantics.
+
+The reference's ConvLayer family accepts ``norm='BN'``
+(``models/submodules.py:166-199``, ``nn.BatchNorm2d(momentum=0.1)``) and the
+train driver converts to SyncBatchNorm for DDP
+(``train_ours_cnt_seq.py:763``). Here:
+
+- ``TorchBatchNorm`` is pinned against the executed reference layer in train
+  mode (batch moments), for the running-stat update rule (momentum blend +
+  UNBIASED variance accumulation), and in eval mode (running stats);
+- the SyncBN analogue is structural: under jit+GSPMD a sharded batch
+  computes GLOBAL moments (XLA all-reduces the mean), asserted by comparing
+  an 8-device sharded train step's batch_stats with a single-device run on
+  the identical global batch;
+- a BN DeepRecurrNet config trains end-to-end through make_train_step on the
+  8-device mesh (batch_stats threaded through the scan and TrainState).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from conftest import torch_conv_to_flax as _t2f  # noqa: E402
+
+REF = "/root/reference"
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def ref_submodules():
+    from conftest import shim_reference_imports
+
+    shim_reference_imports(REF)
+    import models.submodules as sm
+
+    return sm
+
+
+def test_convlayer_bn_matches_reference_train_and_eval(ref_submodules):
+    """3 train-mode forwards (stats accumulate across calls) then an
+    eval-mode forward, each pinned against the executed reference ConvLayer
+    with identical weights."""
+    from esr_tpu.models.layers import ConvLayer
+
+    torch.manual_seed(0)
+    ref = ref_submodules.ConvLayer(
+        3, 8, kernel_size=3, stride=2, padding=1, activation="relu",
+        norm="BN",
+    )
+    ref.train()
+
+    ours = ConvLayer(8, 3, stride=2, padding=1, activation="relu", norm="BN")
+    rng = np.random.default_rng(1)
+    x0 = rng.standard_normal((4, 10, 12, 3)).astype(np.float32)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x0), train=False)
+    params = jax.tree.map(np.asarray, variables["params"])
+    # reference ConvLayer with BN has bias=False on the conv
+    params["Conv_0"] = {
+        "kernel": np.asarray(
+            _t2f(ref.conv2d.weight)["kernel"], np.float32
+        )
+    }
+    stats = jax.tree.map(np.asarray, variables["batch_stats"])
+
+    apply = jax.jit(
+        lambda v, x: ours.apply(
+            v, x, train=True, mutable=["batch_stats"]
+        )
+    )
+
+    for step in range(3):
+        x = rng.standard_normal((4, 10, 12, 3)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+        y_ours, mut = apply(
+            {"params": params, "batch_stats": stats}, jnp.asarray(x)
+        )
+        stats = mut["batch_stats"]
+        np.testing.assert_allclose(
+            np.asarray(y_ours),
+            y_ref.permute(0, 2, 3, 1).numpy(),
+            atol=1e-5, rtol=1e-5, err_msg=f"train fwd {step}",
+        )
+        # running stats after this forward: torch blends
+        # (1-m)*old + m*new with UNBIASED batch var
+        bn_path = next(iter(
+            k for k in stats if k.startswith("_NormWrapper")
+        ))
+        np.testing.assert_allclose(
+            np.asarray(stats[bn_path]["TorchBatchNorm_0"]["mean"]),
+            ref.norm_layer.running_mean.numpy(),
+            atol=1e-6, rtol=1e-5, err_msg=f"running_mean {step}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats[bn_path]["TorchBatchNorm_0"]["var"]),
+            ref.norm_layer.running_var.numpy(),
+            atol=1e-6, rtol=1e-5, err_msg=f"running_var {step}",
+        )
+
+    # eval mode uses the accumulated running stats
+    ref.eval()
+    x = rng.standard_normal((2, 10, 12, 3)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+    y_ours = ours.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x), train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+        atol=1e-5, rtol=1e-5, err_msg="eval fwd",
+    )
+
+
+def test_residual_block_bn_matches_reference(ref_submodules):
+    """ResidualBlock with norm='BN' (two BN layers) against the executed
+    reference, train then eval."""
+    from esr_tpu.models.layers import ResidualBlock
+
+    torch.manual_seed(3)
+    ref = ref_submodules.ResidualBlock(6, 6, norm="BN")
+    ref.train()
+
+    ours = ResidualBlock(6, norm="BN")
+    rng = np.random.default_rng(2)
+    x0 = rng.standard_normal((2, 8, 8, 6)).astype(np.float32)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x0), train=False)
+    params = jax.tree.map(np.asarray, variables["params"])
+    params["Conv_0"] = {"kernel": np.asarray(_t2f(ref.conv1.weight)["kernel"])}
+    params["Conv_1"] = {"kernel": np.asarray(_t2f(ref.conv2.weight)["kernel"])}
+    stats = variables["batch_stats"]
+
+    for _ in range(2):
+        x = rng.standard_normal((2, 8, 8, 6)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+        y_ours, mut = ours.apply(
+            {"params": params, "batch_stats": stats},
+            jnp.asarray(x), train=True, mutable=["batch_stats"],
+        )
+        stats = mut["batch_stats"]
+        np.testing.assert_allclose(
+            np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    ref.eval()
+    x = rng.standard_normal((2, 8, 8, 6)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+    y_ours = ours.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x), train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def _tiny_bn_model():
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    return DeepRecurrNet(
+        inch=2, basech=4, num_frame=3, norm="BN",
+        has_dcnatten=False, has_scaleaggre=True, dcn_impl="jnp",
+    )
+
+
+def _init_state(model, batch, h, w, seqn=3):
+    import optax
+    from esr_tpu.training.train_step import TrainState
+
+    states = model.init_states(batch, h, w)
+    dummy = jnp.zeros((batch, seqn, h, w, 2), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, states)
+    assert "batch_stats" in variables, "BN model must carry batch_stats"
+    opt = optax.adam(1e-3)
+    return TrainState.create(
+        jax.tree.map(np.asarray, variables), opt
+    ), opt
+
+
+@pytest.mark.slow
+def test_bn_model_trains_on_mesh_and_syncbn_semantics():
+    """BN DeepRecurrNet: (a) trains on the 8-device mesh through
+    make_train_step — finite loss, batch_stats move; (b) GSPMD SyncBN: the
+    sharded-batch run's batch_stats match a single-device run on the same
+    global batch (global moments, not per-shard)."""
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    model = _tiny_bn_model()
+    B, L, H, W = 8, 5, 16, 16
+    state0, opt = _init_state(model, B, H, W)
+    step = make_train_step(model, opt, seqn=3)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "inp": rng.uniform(size=(B, L, H, W, 2)).astype(np.float32),
+        "gt": rng.uniform(size=(B, L, H, W, 2)).astype(np.float32),
+    }
+
+    # single-device run (global batch on one device)
+    s1, m1 = jax.jit(step)(state0, jax.tree.map(jnp.asarray, batch))
+    assert np.isfinite(float(m1["loss"]))
+
+    # sharded run: batch over 8 devices
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    bsharding = NamedSharding(mesh, P("data"))
+    rsharding = NamedSharding(mesh, P())
+    sharded_batch = {
+        k: jax.device_put(v, bsharding) for k, v in batch.items()
+    }
+    state_r = jax.device_put(state0, rsharding)
+    s8, m8 = jax.jit(step)(state_r, sharded_batch)
+
+    # (a) stats moved away from init
+    init_stats = state0.params["batch_stats"]
+    moved = jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        init_stats, s8.params["batch_stats"],
+    )
+    assert max(jax.tree.leaves(moved)) > 1e-6
+
+    # (b) SyncBN: sharded == single-device global stats AND loss
+    np.testing.assert_allclose(
+        float(m8["loss"]), float(m1["loss"]), rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        s1.params["batch_stats"], s8.params["batch_stats"],
+    )
+
+    # second step consumes the first step's stats (threading through
+    # TrainState round-trips)
+    s8b, m8b = jax.jit(step)(s8, sharded_batch)
+    assert np.isfinite(float(m8b["loss"]))
+
+
+@pytest.mark.slow
+def test_bn_model_eval_step_uses_running_stats():
+    from esr_tpu.training.train_step import make_eval_step, make_train_step
+    import optax
+
+    model = _tiny_bn_model()
+    B, L, H, W = 2, 5, 16, 16
+    state0, opt = _init_state(model, B, H, W)
+    rng = np.random.default_rng(1)
+    batch = {
+        "inp": jnp.asarray(
+            rng.uniform(size=(B, L, H, W, 2)), jnp.float32
+        ),
+        "gt": jnp.asarray(
+            rng.uniform(size=(B, L, H, W, 2)), jnp.float32
+        ),
+    }
+    step = make_train_step(model, opt, seqn=3)
+    s1, _ = jax.jit(step)(state0, batch)
+
+    eval_step = make_eval_step(model, seqn=3)
+    out0 = jax.jit(eval_step)(state0.params, batch)
+    out1 = jax.jit(eval_step)(s1.params, batch)
+    # different params AND different running stats -> different valid loss
+    assert float(out0["valid_loss"]) != float(out1["valid_loss"])
+    assert np.isfinite(float(out1["valid_loss"]))

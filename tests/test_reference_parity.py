@@ -281,7 +281,7 @@ def _esr_flax_path(key: str):
         return ("feat_extract", f"ConvLayer_{parts[2]}", "Conv_0")
     if parts[0] == "time_propagate":
         if parts[1] == "pred_map":
-            return ("time_propagate", "pred_map", f"layers_{parts[2]}", "Conv_0")
+            return ("time_propagate", f"pred_map_{parts[2]}", "Conv_0")
         if parts[1] == "local_fusion":
             if parts[2] == "0":  # ResidualBlock conv1/conv2
                 return ("time_propagate", "local_res",
@@ -295,8 +295,7 @@ def _esr_flax_path(key: str):
             return ("time_propagate", "global_fusion", "Conv_0")
     if parts[0] == "spacetime_fuse":
         if parts[1] == "dense_fusion":
-            return ("spacetime_fuse", "dense_fusion", f"layers_{parts[2]}",
-                    "Conv_0")
+            return ("spacetime_fuse", f"dense_fusion_{parts[2]}", "Conv_0")
         if parts[1] == "attens":
             return ("spacetime_fuse", f"atten_{parts[2]}", "Conv_0")
         if parts[1] == "recons":
