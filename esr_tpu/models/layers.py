@@ -267,7 +267,11 @@ class UpsampleConvLayer(nn.Module):
 
 
 class ResidualBlock(nn.Module):
-    """conv-relu-conv + identity (reference ``submodules.py:347-409``)."""
+    """conv-relu-conv + identity (reference ``submodules.py:347-409``).
+
+    Like the reference, only ConvLayer exposes ``BN_momentum``; this block
+    (and TransposedConvLayer) hard-code torch's default 0.1.
+    """
 
     features: int
     stride: int = 1

@@ -41,6 +41,12 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+# Bumped when the TrainState pytree layout changes. 2: optimizer state
+# covers only the 'params' collection (batch_stats ride outside it);
+# format-1 checkpoints had opt_state rooted at the full variables dict.
+CHECKPOINT_FORMAT = 2
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: TrainState,
@@ -52,6 +58,7 @@ def save_checkpoint(
 ) -> str:
     """Write ``checkpoint-iteration{N}`` (and the best-alias when asked)."""
     meta = {
+        "format": CHECKPOINT_FORMAT,
         "model": {"name": config["model"]["name"]},
         "optimizer": {"name": config["optimizer"]["name"]},
         "lr_scheduler": {
@@ -160,6 +167,15 @@ def resume_checkpoint(
     """
     meta = read_meta(path)
 
+    fmt = meta.get("format", 1)
+    if fmt != CHECKPOINT_FORMAT:
+        raise ValueError(
+            f"Checkpoint {path} has state format {fmt}, this build writes "
+            f"{CHECKPOINT_FORMAT} (TrainState pytree layout changed); "
+            "restoring would fail with an opaque structure mismatch. "
+            "Re-train or convert the checkpoint offline."
+        )
+
     if meta["model"]["name"] != config["model"]["name"]:
         logger.warning(
             "Checkpoint model %r != configured %r — not resuming.",
@@ -211,6 +227,12 @@ def load_for_inference(path: str) -> Tuple[Any, Any, Dict]:
     from esr_tpu.config.build import build_model, build_optimizer
 
     meta = read_meta(path)
+    fmt = meta.get("format", 1)
+    if fmt != CHECKPOINT_FORMAT:
+        raise ValueError(
+            f"Checkpoint {path} has state format {fmt}, this build reads "
+            f"{CHECKPOINT_FORMAT} — see resume_checkpoint."
+        )
     config = meta["config"]
     model = build_model(config["model"])
 

@@ -273,3 +273,52 @@ def test_bn_model_eval_step_uses_running_stats():
     # different params AND different running stats -> different valid loss
     assert float(out0["valid_loss"]) != float(out1["valid_loss"])
     assert np.isfinite(float(out1["valid_loss"]))
+
+
+def test_torchbatchnorm_axis_name_shard_map():
+    """TorchBatchNorm(axis_name=...) — the explicit-collective path for
+    shard_map/pmap contexts where each program instance sees only its
+    shard: per-shard pmean'd moments must equal the global-batch moments
+    (and the Bessel n must be the GLOBAL count)."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from esr_tpu.models.layers import TorchBatchNorm
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((8, 6, 6, 3)).astype(np.float32) * 2 + 1
+
+    # global run (no axis): full batch on one device
+    bn_global = TorchBatchNorm()
+    v = bn_global.init(jax.random.PRNGKey(0), jnp.asarray(x), train=False)
+    y_global, mut_global = bn_global.apply(
+        v, jnp.asarray(x), train=True, mutable=["batch_stats"]
+    )
+
+    # sharded run: batch split over 8 devices, moments synced via pmean
+    bn_sync = TorchBatchNorm(axis_name="data")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P("data")), out_specs=(P("data"), P()),
+    )
+    def sharded_apply(variables, xs):
+        out, mut = bn_sync.apply(
+            variables, xs, train=True, mutable=["batch_stats"]
+        )
+        return out, mut
+
+    y_shard, mut_shard = sharded_apply(v, jnp.asarray(x))
+
+    np.testing.assert_allclose(
+        np.asarray(y_shard), np.asarray(y_global), atol=1e-5, rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5
+        ),
+        mut_shard["batch_stats"], mut_global["batch_stats"],
+    )

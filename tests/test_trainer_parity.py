@@ -1,0 +1,290 @@
+"""Whole-system executed-reference TRAINING parity.
+
+The strongest "matches the reference" statement available in this
+environment (no GPU, no egress for the released checkpoint): run the
+reference's ACTUAL ``Trainer.iteration_based_training`` loop
+(``train_ours_cnt_seq.py:186-341`` — zero_grad / reset_states / window loop
+/ summed MSE on the mid frame / one backward+step per sequence) on CPU
+torch, and our jit'd BPTT train step, from the SAME converted initial
+weights, the SAME Adam hyperparameters, and the SAME synthetic sequence
+batches — then compare per-iteration training losses.
+
+The reference loop is executed verbatim; only its environment is faked:
+
+- ``torch.distributed`` runs as a real single-process gloo group
+  (``reduce_tensor`` is an identity at world_size 1, ``dist.barrier`` real);
+- the dataloader is a stub yielding precomputed window dicts with the
+  reference's ``inputs_seq`` contract (list over the L-seqn+1 overlapping
+  windows; ``inp_scaled_cnt``/``gt_cnt`` of shape [B, N, 2, H, W]);
+- config access goes through a minimal parser facade; TensorBoard writes to
+  a tmp dir (the loop calls ``writer.writer.add_scalar`` unconditionally on
+  rank 0);
+- ``trainer.train_metrics`` is replaced post-construction with a recorder so
+  per-iteration ``train_loss`` values can be captured (instrumentation only
+  — the trainer's arithmetic is untouched).
+
+Achieved tolerance is asserted at rtol 2e-3 on every per-iteration loss
+(f32 forward parity is ~1e-3 rtol per the single-forward suite; 5 Adam
+steps compound it only mildly at this scale).
+"""
+
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+REF = "/root/reference"
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted"
+)
+
+SEQN = 3
+ITERS = 5
+B, L, H, W = 2, 5, 16, 16
+LR = 1e-3
+
+
+@pytest.fixture(scope="module")
+def ref_train_mod():
+    """Import the reference's train driver module with its absent deps
+    stubbed and a single-process gloo group up."""
+    from conftest import ensure_module, shim_reference_imports
+
+    shim_reference_imports(REF)
+    ensure_module("_ext")
+    ensure_module("open3d")
+    ensure_module(
+        "torchvision.models.resnet", defaults={"resnet34": lambda *a, **k: None}
+    )
+    ensure_module("torchvision.models")
+    ensure_module("skimage", {})
+    ensure_module(
+        "skimage.metrics",
+        {
+            "structural_similarity": lambda *a, **k: 0.0,
+            "peak_signal_noise_ratio": lambda *a, **k: 0.0,
+        },
+    )
+    ensure_module("skimage.color", {})
+    ensure_module("skimage.transform", {})
+    ensure_module("IPython", {"embed": lambda *a, **k: None})
+    ensure_module("tqdm", {"tqdm": lambda x, *a, **k: x})
+    # the chamfer CUDA extension directory is not in the checkout at all
+    ensure_module(
+        "extensions.chamfer_distance", {"ChamferDistance": object}
+    )
+
+    import dataloader.h5dataset as h5ds
+
+    if not hasattr(h5ds, "EventRecognition"):
+        h5ds.EventRecognition = None
+
+    import torch.distributed as dist
+
+    if not dist.is_initialized():
+        dist.init_process_group(
+            "gloo", init_method="tcp://127.0.0.1:29517", rank=0, world_size=1
+        )
+
+    import train_ours_cnt_seq as T
+
+    return T
+
+
+class _FakeParser:
+    """The slice of the reference YAMLParser surface Trainer touches."""
+
+    def __init__(self, cfg, save_dir, log_dir):
+        self._cfg = cfg
+        self.save_dir = save_dir
+        self.log_dir = log_dir
+        self.args = SimpleNamespace(resume=None)
+
+    def __getitem__(self, key):
+        return self._cfg[key]
+
+
+class _FakeSeqLoader:
+    """Reference ``HDF5DataLoaderSequence`` contract: iterating yields, per
+    sequence batch, the list of overlapping-window dicts the collate
+    produces (``h5dataloader.py:210-233``)."""
+
+    def __init__(self, batches, seqn):
+        self.batches = batches  # [(inp [B,L,2,H,W], gt [B,L,2,H,W]) torch]
+        self.seqn = seqn
+        ds = SimpleNamespace(
+            inp_sensor_resolution=(H, W), gt_sensor_resolution=(H, W)
+        )
+        self.dataset = SimpleNamespace(datasets=[ds])
+        self.sampler = SimpleNamespace(set_epoch=lambda epoch: None)
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        for inp, gt in self.batches:
+            wins = []
+            for s in range(inp.shape[1] - self.seqn + 1):
+                wins.append(
+                    {
+                        # contiguous: the reference collate materializes
+                        # windows (cat), and model.py:329 uses .view
+                        "inp_scaled_cnt": inp[:, s : s + self.seqn]
+                        .contiguous(),
+                        "gt_cnt": gt[:, s : s + self.seqn].contiguous(),
+                    }
+                )
+            yield wins
+
+
+class _Recorder:
+    """Stands in for the reference MetricTracker (same update/reset
+    surface): its pandas idiom (``df[col].values[:] = 0``) is read-only
+    under modern pandas copy-on-write, and recording raw per-iteration
+    values is what the assertion needs anyway."""
+
+    def __init__(self, keys=None, writer=None):
+        self.values = {}
+
+    def reset(self):
+        pass
+
+    def update(self, key, value, n=1):
+        self.values.setdefault(key, []).append(value)
+
+
+def _make_batches(rng):
+    return [
+        (
+            rng.uniform(0, 2, size=(B, L, 2, H, W)).astype(np.float32),
+            rng.uniform(0, 2, size=(B, L, 2, H, W)).astype(np.float32),
+        )
+        for _ in range(ITERS)
+    ]
+
+
+def test_five_iteration_training_loss_parity(ref_train_mod, tmp_path):
+    import torch.nn as tnn
+    from torch.optim import Adam
+    from torch.optim.lr_scheduler import StepLR
+
+    from test_reference_parity import _convert_esr_state_dict
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    T = ref_train_mod
+    torch.manual_seed(7)
+    ref_model = T.DeepRecurrNet(
+        inch=2, basech=4, num_frame=SEQN, has_dcnatten=False
+    )
+    ref_model.train()
+
+    rng = np.random.default_rng(11)
+    batches = _make_batches(rng)
+    loader = _FakeSeqLoader(
+        [(torch.from_numpy(i), torch.from_numpy(g)) for i, g in batches], SEQN
+    )
+
+    big = 10**9
+    cfg = {
+        "trainer": {
+            "monitor": "off",
+            "tensorboard": True,
+            "vis": {"enabled": False},
+            "epoch_based_train": {"enabled": False},
+            "iteration_based_train": {
+                "enabled": True,
+                "iterations": ITERS,
+                "save_period": big,
+                "train_log_step": 1,
+                "valid_log_step": 1,
+                "valid_step": big,
+                "lr_change_rate": big,
+            },
+        }
+    }
+    parser = _FakeParser(
+        cfg, save_dir=str(tmp_path / "save"), log_dir=str(tmp_path / "log")
+    )
+    optimizer = Adam(ref_model.parameters(), lr=LR)
+    # env-compat: the reference MetricTracker's pandas reset is read-only
+    # under pandas CoW; swap in the recorder class (same surface) so
+    # Trainer.__init__ constructs working metric trackers.
+    saved_tracker = T.MetricTracker
+    T.MetricTracker = _Recorder
+    try:
+        trainer = T.Trainer(
+            {
+                "config_parser": parser,
+                "train_dataloader": loader,
+                "valid_dataloader": None,
+                "esr_model": ref_model,
+                "esr_loss": {"mse": tnn.MSELoss()},
+                "esr_optimizer": optimizer,
+                "esr_lr_scheduler": StepLR(optimizer, step_size=1, gamma=1.0),
+                "logger": __import__("logging").getLogger(
+                    "ref-trainer-parity"
+                ),
+                "device": torch.device("cpu"),
+            }
+        )
+        trainer.train()
+    finally:
+        T.MetricTracker = saved_tracker
+    ref_losses = trainer.train_metrics.values["train_loss"]
+    assert len(ref_losses) == ITERS
+
+    # ---- ours: same initial weights, same data, same Adam ----
+    import optax
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    ours = DeepRecurrNet(inch=2, basech=4, num_frame=SEQN, has_dcnatten=False)
+    states = ours.init_states(B, H, W)
+    dummy = jnp.zeros((B, SEQN, H, W, 2), jnp.float32)
+    template = ours.init(jax.random.PRNGKey(0), dummy, states)
+    # convert the REFERENCE's initial weights (captured before training by
+    # re-seeding an identical model)
+    torch.manual_seed(7)
+    ref_init = T.DeepRecurrNet(
+        inch=2, basech=4, num_frame=SEQN, has_dcnatten=False
+    )
+    params = _convert_esr_state_dict(ref_init.state_dict(), template)
+
+    opt = optax.adam(LR)
+    state = TrainState.create(jax.tree.map(np.asarray, params), opt)
+    step = jax.jit(make_train_step(ours, opt, seqn=SEQN))
+
+    our_losses = []
+    for inp, gt in batches:
+        batch = {
+            "inp": jnp.asarray(np.transpose(inp, (0, 1, 3, 4, 2))),
+            "gt": jnp.asarray(np.transpose(gt, (0, 1, 3, 4, 2))),
+        }
+        state, metrics = step(state, batch)
+        our_losses.append(float(metrics["loss"]))
+
+    np.testing.assert_allclose(
+        our_losses, ref_losses, rtol=2e-3,
+        err_msg=f"ref={ref_losses} ours={our_losses}",
+    )
+
+    # and the post-training model agrees on a held-out forward
+    x = rng.standard_normal((B, SEQN, H, W, 2)).astype(np.float32)
+    ref_model.eval()
+    ref_model.reset_states()
+    with torch.no_grad():
+        y_ref = ref_model(
+            torch.from_numpy(np.transpose(x, (0, 1, 4, 2, 3))).contiguous()
+        )
+    y_ours, _ = ours.apply(
+        state.params, jnp.asarray(x), ours.init_states(B, H, W)
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+        atol=5e-4, rtol=5e-3,
+    )
