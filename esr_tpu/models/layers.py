@@ -269,14 +269,16 @@ class UpsampleConvLayer(nn.Module):
 class ResidualBlock(nn.Module):
     """conv-relu-conv + identity (reference ``submodules.py:347-409``).
 
-    Like the reference, only ConvLayer exposes ``BN_momentum``; this block
-    (and TransposedConvLayer) hard-code torch's default 0.1.
+    ``bn_momentum`` mirrors the reference's ``BN_momentum`` kwarg
+    (``submodules.py:360``); TransposedConvLayer, like its reference
+    counterpart, hard-codes torch's default 0.1.
     """
 
     features: int
     stride: int = 1
     norm: Optional[str] = None
     final_activation: bool = True
+    bn_momentum: float = 0.1
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
@@ -292,7 +294,7 @@ class ResidualBlock(nn.Module):
             kernel_init=torch_uniform_init(),
             bias_init=torch_conv_bias_init(cin * 9),
         )(x)
-        out = _NormWrapper(self.norm)(out, train)
+        out = _NormWrapper(self.norm, self.bn_momentum)(out, train)
         out = jax.nn.relu(out)
         out = nn.Conv(
             self.features,
@@ -302,7 +304,7 @@ class ResidualBlock(nn.Module):
             kernel_init=torch_uniform_init(),
             bias_init=torch_conv_bias_init(self.features * 9),
         )(out)
-        out = _NormWrapper(self.norm)(out, train)
+        out = _NormWrapper(self.norm, self.bn_momentum)(out, train)
         out = out + residual
         if self.final_activation:
             out = jax.nn.relu(out)

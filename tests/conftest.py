@@ -69,6 +69,35 @@ def torch_conv_to_flax(w, b=None):
     return out
 
 
+def shim_model_imports(ref_root: str):
+    """:func:`shim_reference_imports` + the stubs the reference's MODEL
+    stack needs (``models/model.py`` star-import chain). Returns the
+    imported ``models.model`` module. Shared by the flagship-parity and
+    trainer-parity suites so the stub list cannot drift between them.
+
+    - ``_ext`` — the unbuilt DCNv2 CUDA extension (``dcn_v2.py`` imports it
+      at module scope);
+    - ``torchvision.models.resnet`` / ``open3d`` — absent in this image,
+      pulled transitively via ``model.py``'s star imports, unused here;
+    - ``EventRecognition`` — a dangling name ``h5dataloader.py:17`` imports
+      from ``h5dataset``.
+    """
+    shim_reference_imports(ref_root)
+    ensure_module("_ext")
+    ensure_module("open3d")
+    ensure_module(
+        "torchvision.models.resnet",
+        defaults={"resnet34": lambda *a, **k: None},
+    )
+    import dataloader.h5dataset as h5ds
+
+    if not hasattr(h5ds, "EventRecognition"):
+        h5ds.EventRecognition = None
+    import models.model as rm
+
+    return rm
+
+
 def shim_reference_imports(ref_root: str) -> None:
     """Make the mounted reference checkout importable for the parity tests
     (shared by test_reference_parity.py and test_reference_parity_ops.py):

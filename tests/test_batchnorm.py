@@ -282,7 +282,7 @@ def test_torchbatchnorm_axis_name_shard_map():
     (and the Bessel n must be the GLOBAL count)."""
     from functools import partial
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from esr_tpu.models.layers import TorchBatchNorm

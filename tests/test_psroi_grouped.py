@@ -1,0 +1,251 @@
+"""Grouped deformable PSROI pooling: an independent numpy oracle.
+
+The reference's CPU mirror asserts ``channels == output_dim`` (i.e.
+``group_size == 1`` only, ``dcn_v2_cpu.cpp``), so the compiled-extension
+parity suite (test_reference_parity_native.py) cannot exercise grouping.
+This oracle is a scalar-loop numpy transcription written directly from the
+CUDA forward kernel
+(``/root/reference/models/DCNv2/src/cuda/dcn_v2_psroi_pooling_cuda.cu:58-145``)
+— per-thread index decomposition, ROI rounding, part/class/group index
+arithmetic, the sample_per_part x sample_per_part tap loop with the
+[-0.5, size-0.5] skip and [0, size-1] clamp, and C round() (half away from
+zero) — evaluated at group_size 3 and 7 where the position-sensitive channel
+selection actually varies per bin.
+
+Gradients: the CUDA backward is the exact adjoint of the forward gather
+(atomicAdd scatter, ``:148-244``), so our XLA-autodiff gradients are checked
+against central finite differences of THIS oracle for both data and trans.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from esr_tpu.ops.psroi import deform_psroi_pooling
+
+
+def _c_round(x):
+    # C round(): half away from zero
+    return math.floor(abs(x) + 0.5) * (1 if x >= 0 else -1)
+
+
+def _bilinear(plane, x, y):
+    """bilinear_interp_cuda (:34-56): floor/ceil corners, NO clamping here
+    (the caller clamps coords into [0, size-1] first)."""
+    h, w = plane.shape
+    x1, x2 = math.floor(x), math.ceil(x)
+    y1, y2 = math.floor(y), math.ceil(y)
+    dx, dy = x - x1, y - y1
+    v11 = plane[y1, x1]
+    v12 = plane[y2, x1]
+    v21 = plane[y1, x2]
+    v22 = plane[y2, x2]
+    return ((1 - dx) * (1 - dy) * v11 + (1 - dx) * dy * v12
+            + dx * (1 - dy) * v21 + dx * dy * v22)
+
+
+def psroi_oracle(data_nchw, rois, trans, spatial_scale, output_dim,
+                 group_size, pooled_size, part_size, sample_per_part,
+                 trans_std):
+    """Direct transcription of DeformablePSROIPoolForwardKernelCuda.
+
+    ``data_nchw [B, C, H, W]``, ``rois [N, 5]``,
+    ``trans [N, num_classes, 2, part, part]`` or None.
+    Returns ``(top_data, top_count)`` of shape [N, output_dim, P, P].
+    """
+    b, channels, height, width = data_nchw.shape
+    n_rois = rois.shape[0]
+    p = pooled_size
+    no_trans = trans is None
+    num_classes = 1 if no_trans else trans.shape[1]
+    channels_each_class = max(output_dim // num_classes, 1)
+
+    top = np.zeros((n_rois, output_dim, p, p), np.float64)
+    cnt = np.zeros_like(top)
+    for n in range(n_rois):
+        roi = rois[n]
+        roi_batch_ind = int(roi[0])
+        roi_start_w = _c_round(roi[1]) * spatial_scale - 0.5
+        roi_start_h = _c_round(roi[2]) * spatial_scale - 0.5
+        roi_end_w = (_c_round(roi[3]) + 1.0) * spatial_scale - 0.5
+        roi_end_h = (_c_round(roi[4]) + 1.0) * spatial_scale - 0.5
+        roi_width = max(roi_end_w - roi_start_w, 0.1)
+        roi_height = max(roi_end_h - roi_start_h, 0.1)
+        bin_size_h = roi_height / p
+        bin_size_w = roi_width / p
+        sub_h = bin_size_h / sample_per_part
+        sub_w = bin_size_w / sample_per_part
+        for ctop in range(output_dim):
+            class_id = ctop // channels_each_class
+            for ph in range(p):
+                for pw in range(p):
+                    part_h = math.floor(ph / p * part_size)
+                    part_w = math.floor(pw / p * part_size)
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[n, class_id, 0, part_h, part_w] * trans_std
+                        ty = trans[n, class_id, 1, part_h, part_w] * trans_std
+                    wstart = pw * bin_size_w + roi_start_w + tx * roi_width
+                    hstart = ph * bin_size_h + roi_start_h + ty * roi_height
+                    gw = min(max(math.floor(pw * group_size / p), 0),
+                             group_size - 1)
+                    gh = min(max(math.floor(ph * group_size / p), 0),
+                             group_size - 1)
+                    c = (ctop * group_size + gh) * group_size + gw
+                    s = 0.0
+                    k = 0
+                    for ih in range(sample_per_part):
+                        for iw in range(sample_per_part):
+                            x = wstart + iw * sub_w
+                            y = hstart + ih * sub_h
+                            if (x < -0.5 or x > width - 0.5
+                                    or y < -0.5 or y > height - 0.5):
+                                continue
+                            x = min(max(x, 0.0), width - 1.0)
+                            y = min(max(y, 0.0), height - 1.0)
+                            s += _bilinear(
+                                data_nchw[roi_batch_ind, c], x, y
+                            )
+                            k += 1
+                    top[n, ctop, ph, pw] = 0.0 if k == 0 else s / k
+                    cnt[n, ctop, ph, pw] = k
+    return top, cnt
+
+
+def _setup(group_size, pooled_size, output_dim=2, part_size=None,
+           sample_per_part=2, seed=0):
+    rng = np.random.default_rng(seed)
+    b, h, w = 2, 12, 14
+    c = output_dim * group_size * group_size
+    part = part_size if part_size is not None else pooled_size
+    data = rng.standard_normal((b, h, w, c)).astype(np.float64)
+    # ROIs: (batch, x1, y1, x2, y2), incl. one hugging the border and one
+    # with fractional coords (exercises the C round)
+    rois = np.array(
+        [
+            [0, 1.0, 2.0, 9.0, 10.0],
+            [1, 0.0, 0.0, 13.0, 11.0],
+            [0, 3.5, 1.5, 7.4, 8.6],
+        ],
+        np.float64,
+    )
+    num_classes = 2
+    trans = rng.standard_normal(
+        (rois.shape[0], num_classes, 2, part, part)
+    ).astype(np.float64) * 0.3
+    return data, rois, trans
+
+
+@pytest.mark.parametrize("group_size", [3, 7])
+@pytest.mark.parametrize("pooled", [3, 7, 5])
+def test_grouped_forward_matches_numpy_oracle(group_size, pooled):
+    data, rois, trans = _setup(group_size, pooled)
+    kwargs = dict(
+        spatial_scale=0.8, output_dim=2, group_size=group_size,
+        pooled_size=pooled, part_size=pooled, sample_per_part=2,
+        trans_std=0.2,
+    )
+    out, count = deform_psroi_pooling(
+        jnp.asarray(data, jnp.float32), jnp.asarray(rois, jnp.float32),
+        jnp.asarray(trans, jnp.float32), **kwargs,
+    )
+    top, cnt = psroi_oracle(
+        np.transpose(data, (0, 3, 1, 2)), rois, trans, 0.8, 2, group_size,
+        pooled, pooled, 2, 0.2,
+    )
+    # ours is [N, P, P, OD]; oracle [N, OD, P, P]
+    np.testing.assert_allclose(
+        np.asarray(out), np.transpose(top, (0, 2, 3, 1)),
+        atol=1e-5, rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(count), np.transpose(cnt, (0, 2, 3, 1)), atol=0
+    )
+
+
+def test_grouped_no_trans_matches_oracle():
+    data, rois, _ = _setup(3, 4)
+    out, count = deform_psroi_pooling(
+        jnp.asarray(data, jnp.float32), jnp.asarray(rois, jnp.float32),
+        None, spatial_scale=1.0, output_dim=2, group_size=3, pooled_size=4,
+        sample_per_part=3, trans_std=0.0,
+    )
+    top, cnt = psroi_oracle(
+        np.transpose(data, (0, 3, 1, 2)), rois, None, 1.0, 2, 3, 4, 4, 3,
+        0.0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.transpose(top, (0, 2, 3, 1)), atol=1e-5,
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(count), np.transpose(cnt, (0, 2, 3, 1)), atol=0
+    )
+
+
+@pytest.mark.parametrize("wrt", ["data", "trans"])
+def test_grouped_gradients_match_finite_differences(wrt):
+    """XLA autodiff (== the CUDA backward's atomicAdd adjoint) vs central
+    finite differences of the numpy oracle, at group_size=3.
+
+    trans perturbations move sample positions, so FD of the (piecewise-
+    smooth) forward is valid away from tap-skip boundaries; the fixed seed
+    keeps all taps interior."""
+    group_size, pooled, od = 3, 3, 2
+    data, rois, trans = _setup(group_size, pooled, output_dim=od, seed=3)
+    kwargs = dict(
+        spatial_scale=0.8, output_dim=od, group_size=group_size,
+        pooled_size=pooled, part_size=pooled, sample_per_part=2,
+        trans_std=0.2,
+    )
+    cot = np.random.default_rng(5).standard_normal(
+        (rois.shape[0], pooled, pooled, od)
+    ).astype(np.float64)
+
+    def scalar_fn(d, t):
+        out, _ = deform_psroi_pooling(
+            d, jnp.asarray(rois, jnp.float32), t, **kwargs
+        )
+        return (out * cot).sum()
+
+    g_data, g_trans = jax.grad(
+        lambda d, t: scalar_fn(d, t), argnums=(0, 1)
+    )(jnp.asarray(data, jnp.float32), jnp.asarray(trans, jnp.float32))
+
+    def oracle_scalar(d, t):
+        top, _ = psroi_oracle(
+            np.transpose(d, (0, 3, 1, 2)), rois, t, 0.8, od, group_size,
+            pooled, pooled, 2, 0.2,
+        )
+        return float((np.transpose(top, (0, 2, 3, 1)) * cot).sum())
+
+    eps = 1e-4
+    rng = np.random.default_rng(7)
+    if wrt == "data":
+        target, grad = data, np.asarray(g_data, np.float64)
+    else:
+        target, grad = trans, np.asarray(g_trans, np.float64)
+    flat_idx = rng.choice(target.size, size=25, replace=False)
+    for fi in flat_idx:
+        idx = np.unravel_index(fi, target.shape)
+        tp = target.copy()
+        tp[idx] += eps
+        tm = target.copy()
+        tm[idx] -= eps
+        if wrt == "data":
+            fd = (oracle_scalar(tp, trans) - oracle_scalar(tm, trans)) / (
+                2 * eps
+            )
+        else:
+            fd = (oracle_scalar(data, tp) - oracle_scalar(data, tm)) / (
+                2 * eps
+            )
+        np.testing.assert_allclose(
+            grad[idx], fd, atol=5e-3, rtol=5e-3,
+            err_msg=f"{wrt}{idx}",
+        )

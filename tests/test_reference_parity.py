@@ -47,33 +47,11 @@ def ref_unet():
 def ref_model():
     """The reference's flagship module, importable once its optional heavy
     deps are shimmed (none are exercised by ``DeepRecurrNet`` with
-    ``has_dcnatten=False``): the shared shims from
-    :func:`conftest.shim_reference_imports`, plus
+    ``has_dcnatten=False``) — see :func:`conftest.shim_model_imports`
+    (``EventRecognition`` is a reference bug, SURVEY §7.3-7)."""
+    from conftest import shim_model_imports
 
-    - ``_ext`` — the unbuilt DCNv2 CUDA extension (``dcn_v2.py`` imports it
-      at module scope; ``DCN_sep`` is only instantiated when
-      ``has_dcnatten=True``);
-    - ``torchvision.models.resnet`` / ``open3d`` — absent in this image,
-      pulled transitively via ``model.py``'s star imports, unused here;
-    - ``EventRecognition`` — a dangling name ``h5dataloader.py:17`` imports
-      but ``h5dataset.py`` never defines (reference bug, SURVEY §7.3-7).
-    """
-    from conftest import ensure_module, shim_reference_imports
-
-    shim_reference_imports(REF)
-    ensure_module("_ext")
-    ensure_module("open3d")
-    ensure_module(
-        "torchvision.models.resnet",
-        defaults={"resnet34": lambda *a, **k: None},
-    )
-    import dataloader.h5dataset as h5ds
-
-    if not hasattr(h5ds, "EventRecognition"):
-        h5ds.EventRecognition = None
-    import models.model as rm
-
-    return rm
+    return shim_model_imports(REF)
 
 
 from conftest import torch_conv_to_flax as _t2f  # noqa: E402

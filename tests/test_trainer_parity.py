@@ -54,14 +54,9 @@ LR = 1e-3
 def ref_train_mod():
     """Import the reference's train driver module with its absent deps
     stubbed and a single-process gloo group up."""
-    from conftest import ensure_module, shim_reference_imports
+    from conftest import ensure_module, shim_model_imports
 
-    shim_reference_imports(REF)
-    ensure_module("_ext")
-    ensure_module("open3d")
-    ensure_module(
-        "torchvision.models.resnet", defaults={"resnet34": lambda *a, **k: None}
-    )
+    shim_model_imports(REF)
     ensure_module("torchvision.models")
     ensure_module("skimage", {})
     ensure_module(
@@ -80,16 +75,16 @@ def ref_train_mod():
         "extensions.chamfer_distance", {"ChamferDistance": object}
     )
 
-    import dataloader.h5dataset as h5ds
-
-    if not hasattr(h5ds, "EventRecognition"):
-        h5ds.EventRecognition = None
+    import tempfile
 
     import torch.distributed as dist
 
     if not dist.is_initialized():
+        # file:// rendezvous: no port to collide on when several test
+        # processes run on one host
+        rdv = tempfile.mktemp(prefix="gloo_rdv_")
         dist.init_process_group(
-            "gloo", init_method="tcp://127.0.0.1:29517", rank=0, world_size=1
+            "gloo", init_method=f"file://{rdv}", rank=0, world_size=1
         )
 
     import train_ours_cnt_seq as T
