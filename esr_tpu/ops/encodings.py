@@ -219,7 +219,13 @@ def events_to_stack(
         member = (idx[:, None] >= begs[None, :]) & (idx[:, None] < ends[None, :])
 
         # reference degenerate-window guard (encodings.py:219-220): all-zero
-        # valid timestamps or <= 3 valid events -> all-zero stack
+        # valid timestamps or <= 3 valid events -> all-zero stack.
+        # Deliberate deviation: the reference evaluates len(ts) over its
+        # (unpadded) cloud, so "number of events" here is the VALID lane
+        # count — a padded cloud with 1-3 real events zeroes out where the
+        # reference fed the same padded rows would rasterize. The valid-mask
+        # semantics are the faithful translation (the reference never sees
+        # padding).
         n_valid = v.sum()
         ts_sum = jnp.where(v > 0, tsf, 0.0).sum()
         alive = jnp.where((ts_sum == 0) | (n_valid <= 3), 0.0, 1.0)

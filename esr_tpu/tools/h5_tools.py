@@ -275,8 +275,13 @@ def read_memmap(mmap_dir: str, return_events: bool = False) -> Dict:
             res = meta.get("sensor_resolution")
             if res is not None:
                 h, w = int(res[0]), int(res[1])
-                c = os.path.getsize(img_path) // max(n_img * h * w, 1)
-                if c > 0:
+                denom = n_img * h * w
+                size = os.path.getsize(img_path)
+                c = size // max(denom, 1)
+                # only trust the inference when the file divides exactly —
+                # frames not at sensor size (or a truncated file) would
+                # otherwise make np.memmap raise instead of skipping images
+                if c > 0 and c * denom == size:
                     shape = [n_img, h, w, c]
         if shape is not None and os.path.exists(img_path):
             data["images"] = np.memmap(

@@ -77,21 +77,42 @@ def save_checkpoint(
         names.append(f"model_best_until_iteration{iteration}")
     paths = [os.path.join(os.path.abspath(ckpt_dir), n) for n in names]
     host_state = _to_host(state)
+    # Orbax saves are COLLECTIVE under jax.distributed (internal
+    # sync_global_devices barriers): every process must call save(); Orbax
+    # itself writes array data from the primary host only.
     for path in paths:
         ckptr.save(os.path.join(path, "state"), host_state)
     # meta.yml is the COMMIT MARKER: it must only exist once the async Orbax
     # save has landed, so a preemption mid-save leaves a directory that
     # find_latest_checkpoint will ignore rather than a torn checkpoint.
     ckptr.wait_until_finished()
-    for path in paths:
-        with open(os.path.join(path, "meta.yml"), "w") as f:
-            yaml.safe_dump(meta, f, sort_keys=False)
-        logger.info("Saved checkpoint: %s", path)
+    if jax.process_index() == 0:
+        for path in paths:
+            with open(os.path.join(path, "meta.yml"), "w") as f:
+                yaml.safe_dump(meta, f, sort_keys=False)
+            logger.info("Saved checkpoint: %s", path)
     return paths[-1]
 
 
 def _to_host(tree):
-    return jax.tree.map(np.asarray, tree)
+    """Materialize a state pytree on the host.
+
+    Multi-process: DP state is fully replicated, so the process-local shard
+    carries the complete value — read shard 0. A genuinely sharded leaf
+    would silently save one shard, so refuse it loudly (gather first).
+    """
+
+    def get(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            if not x.is_fully_replicated:
+                raise ValueError(
+                    "checkpointing a non-replicated multi-process array "
+                    f"(global shape {x.shape}); all-gather it first"
+                )
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(x)
+
+    return jax.tree.map(get, tree)
 
 
 def read_meta(path: str) -> Dict:

@@ -68,13 +68,30 @@ class TrainState(NamedTuple):
 def _make_windows(seq: Array, seqn: int) -> Array:
     """``[B, L, ...] -> [Wc, B, seqn, ...]`` overlapping windows, stride 1.
 
-    Time-major output so the window axis can be scanned. Static slicing —
-    mirrors the reference's collate ``cat_tensor_dim0`` windowing
-    (``h5dataloader.py:210-233``) as an index view, no copy until XLA decides.
+    Mirrors the reference's collate ``cat_tensor_dim0`` windowing
+    (``h5dataloader.py:210-233``). NOTE: materializes all Wc overlapping
+    copies (~seqn x the sequence) — the train/eval scans below instead
+    ``dynamic_slice`` each window out of the sequence inside the scan body,
+    which keeps HBM at 1x; this helper remains for host-side/windowing
+    tests and small utilities.
     """
     L = seq.shape[1]
     wc = L - seqn + 1
     return jnp.stack([seq[:, i : i + seqn] for i in range(wc)], axis=0)
+
+
+def _window_slicer(inp: Array, gt: Array, seqn: int, mid_idx: int):
+    """Scan-body window access: ``i -> (inp[:, i:i+seqn], gt[:, i+mid])``
+    via dynamic_slice — no [Wc, ...] window tensor in HBM."""
+
+    def slice_window(i):
+        window = jax.lax.dynamic_slice_in_dim(inp, i, seqn, axis=1)
+        gtw = jax.lax.dynamic_index_in_dim(
+            gt, i + mid_idx, axis=1, keepdims=False
+        )
+        return window, gtw
+
+    return slice_window
 
 
 def make_device_rasterizer(gt_resolution: Tuple[int, int]) -> Callable:
@@ -162,49 +179,54 @@ def make_train_step(
             )
             inp = inp.astype(compute_dtype)
         b, L = inp.shape[0], inp.shape[1]
-        windows = _make_windows(inp, seqn)  # [Wc, B, seqn, H, W, C]
-        # GT for window w is the middle frame of that window.
-        gt_mid = jnp.stack(
-            [gt[:, i + mid_idx] for i in range(L - seqn + 1)], axis=0
-        )
+        # GT for window w is the middle frame of that window; each window is
+        # dynamic-sliced inside the scan (no [Wc, ...] HBM tensor).
+        slice_window = _window_slicer(inp, gt, seqn, mid_idx)
+        idxs = jnp.arange(L - seqn + 1)
         states0 = model.init_states(b, inp.shape[2], inp.shape[3])
         if compute_dtype is not None:
             states0 = jax.tree.map(
                 lambda s: s.astype(compute_dtype), states0
             )
+        # only the LAST window's prediction is reported — carry it instead
+        # of stacking every window's output
+        pred0 = jnp.zeros_like(gt[:, 0])
 
         if stats is None:
 
-            def body(states, xs):
-                window, gtw = xs
+            def body(carry, i):
+                states, _ = carry
+                window, gtw = slice_window(i)
                 pred, states = _fwd_plain(
                     {"params": param_col}, window, states
                 )
-                err = pred.astype(jnp.float32) - gtw  # loss math in f32
-                return states, ((err**2).mean(), pred)
+                predf = pred.astype(jnp.float32)  # loss math in f32
+                err = predf - gtw
+                return (states, predf), (err**2).mean()
 
-            _, (losses, preds) = jax.lax.scan(
-                body, states0, (windows, gt_mid)
+            (_, last_pred), losses = jax.lax.scan(
+                body, (states0, pred0), idxs
             )
             new_stats = None
         else:
             # BN models: running stats update on every window forward (torch
             # updates per forward() call inside the reference's BPTT loop),
             # so the stats ride the scan carry alongside the GRU states.
-            def body(carry, xs):
-                states, st = carry
-                window, gtw = xs
+            def body(carry, i):
+                states, st, _ = carry
+                window, gtw = slice_window(i)
                 (pred, states), mut = _fwd_bn(
                     {"params": param_col, "batch_stats": st}, window, states
                 )
-                err = pred.astype(jnp.float32) - gtw
-                return (states, mut["batch_stats"]), ((err**2).mean(), pred)
+                predf = pred.astype(jnp.float32)
+                err = predf - gtw
+                return (states, mut["batch_stats"], predf), (err**2).mean()
 
-            (_, new_stats), (losses, preds) = jax.lax.scan(
-                body, (states0, stats), (windows, gt_mid)
+            (_, new_stats, last_pred), losses = jax.lax.scan(
+                body, (states0, stats, pred0), idxs
             )
         # reference accumulates the SUM of per-window MSEs before backward
-        return losses.sum(), (losses, preds[-1].astype(jnp.float32), new_stats)
+        return losses.sum(), (losses, last_pred, new_stats)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
         param_col, stats = _split_vars(state.params)
@@ -245,18 +267,16 @@ def make_eval_step(
             batch = rasterize(batch)
         inp, gt = batch["inp"], batch["gt"]
         b, L = inp.shape[0], inp.shape[1]
-        windows = _make_windows(inp, seqn)
-        gt_mid = jnp.stack(
-            [gt[:, i + mid_idx] for i in range(L - seqn + 1)], axis=0
-        )
+        slice_window = _window_slicer(inp, gt, seqn, mid_idx)
+        idxs = jnp.arange(L - seqn + 1)
         states0 = model.init_states(b, inp.shape[2], inp.shape[3])
 
-        def body(states, xs):
-            window, gtw = xs
+        def body(states, i):
+            window, gtw = slice_window(i)
             pred, states = model.apply(params, window, states)
             return states, ((pred - gtw) ** 2).mean()
 
-        _, losses = jax.lax.scan(body, states0, (windows, gt_mid))
+        _, losses = jax.lax.scan(body, states0, idxs)
         # valid_loss = window-summed MSE, valid_mse_loss = last window's MSE —
         # the reference logs both (train_ours_cnt_seq.py:571-589: `loss`
         # accumulates, `mse_loss` holds the loop's final value).

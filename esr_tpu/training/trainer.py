@@ -13,8 +13,11 @@ the jit'd BPTT step, the TPU way:
   equivalent;
 - ``min valid_loss`` monitoring with early stop
   (``eval_model_performance``, ``:383-424``);
-- checkpoint every ``save_period`` and on new-best, main-process only
-  (``:316-319``), resume honored in ``__init__`` (``:172-173``);
+- checkpoint every ``save_period`` and on new-best (``:316-319``); the save
+  is COLLECTIVE — every process calls it (Orbax barriers internally and
+  writes meta/arrays from the primary host only; do NOT re-add an is_main
+  gate or multi-host saves deadlock) — resume honored in ``__init__``
+  (``:172-173``);
 - the LR gate lives inside the optimizer's schedule
   (``exponential_with_floor``) rather than an imperative
   ``scheduler.step()`` (``:322-325``) — same trajectory;
@@ -298,12 +301,29 @@ class Trainer:
         ``:541-633``). Metrics from jit are global; averaged over batches."""
         assert self.valid_loader is not None
         self.valid_metrics.reset()
-        for batch in self.valid_loader:
-            out = self.eval_step(self.state.params, self._stage(batch))
+        # keep device metrics in flight: float() right after dispatch forces
+        # a host round-trip per batch, serializing the pipeline. A bounded
+        # lookahead (consume the oldest once 2 are pending) pipelines
+        # staging with compute while keeping device residency O(1), not
+        # O(len(valid_loader)).
+        from collections import deque
+
+        pending: deque = deque()
+
+        def drain(out):
             self.valid_metrics.update("valid_loss", float(out["valid_loss"]))
             self.valid_metrics.update(
                 "valid_mse_loss", float(out["valid_mse_loss"])
             )
+
+        for batch in self.valid_loader:
+            pending.append(
+                self.eval_step(self.state.params, self._stage(batch))
+            )
+            if len(pending) > 2:
+                drain(pending.popleft())
+        while pending:
+            drain(pending.popleft())
         result = self.valid_metrics.result()
         if self.writer is not None:
             for k, v in result.items():
@@ -341,11 +361,12 @@ class Trainer:
         return stop_training, best
 
     def _save(self, iteration: int, best: bool) -> None:
-        if not self.is_main:
-            return
+        # EVERY process participates: Orbax saves are collective under
+        # jax.distributed (save_checkpoint writes meta/arrays from the
+        # primary host only).
         save_checkpoint(
             self.run.save_dir,
-            jax.device_get(self.state),
+            self.state,
             self.run.config,
             iteration,
             self.mnt_best,

@@ -2,11 +2,19 @@
 
 The reference can only be tested under a live DDP launch (SURVEY.md §4:
 "Multi-node/distributed testing: none"); here two actual processes rendezvous
-through ``jax.distributed.initialize`` (Gloo collectives), build the global
-('data',) mesh spanning both, shard per-host loader output with
-``stage_batch`` / ``make_array_from_process_local_data``, and take one
-all-reduced training step — asserting both processes observe the identical
-global loss and updated params.
+through ``jax.distributed.initialize`` (Gloo collectives) and run the
+FLAGSHIP model end-to-end across the process-spanning ('data',) mesh:
+
+- disjoint per-host loader shards (``ShardedSampler``);
+- two ``DeepRecurrNet`` BPTT train steps through ``make_train_step`` +
+  ``make_parallel_train_step`` (gradient all-reduce inserted by XLA);
+- a validation pass (``make_eval_step``) over the sharded batch;
+- a checkpoint written by process 0 ONLY (replicated multi-process arrays
+  materialized via ``_to_host``), then BOTH processes restore it and take
+  one more step;
+
+asserting at every stage that the two processes observe identical global
+losses and an identical post-resume parameter digest.
 """
 
 import subprocess
@@ -23,6 +31,7 @@ _WORKER = textwrap.dedent(
 
     pid = int(sys.argv[1])
     port = sys.argv[2]
+    ckpt_root = sys.argv[3]
 
     from esr_tpu.parallel.mesh import initialize_multihost
 
@@ -30,15 +39,25 @@ _WORKER = textwrap.dedent(
         coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
     )
 
+    import os
     import numpy as np
     import jax.numpy as jnp
     import optax
+    from jax.experimental import multihost_utils
 
     from esr_tpu.data.loader import ShardedSampler
+    from esr_tpu.models.esr import DeepRecurrNet
     from esr_tpu.parallel.mesh import (
         make_mesh, make_parallel_train_step, process_shard_info, replicate,
         stage_batch,
     )
+    from esr_tpu.training.checkpoint import (
+        find_latest_checkpoint, restore_state, save_checkpoint,
+    )
+    from esr_tpu.training.train_step import (
+        TrainState, make_eval_step, make_train_step,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     shard_id, num_shards = process_shard_info()
     assert (shard_id, num_shards) == (pid, 2), (shard_id, num_shards)
@@ -50,60 +69,104 @@ _WORKER = textwrap.dedent(
     print("INDICES", pid, my_indices.tolist())
 
     mesh = make_mesh()   # spans BOTH processes' cpu devices
-    n_global = len(jax.devices())
-    assert n_global == 2 * len(jax.local_devices())
+    assert len(jax.devices()) == 2 * len(jax.local_devices())
 
-    # tiny linear train step through the real DP machinery
-    w0 = jnp.zeros((4,), jnp.float32)
-    opt = optax.sgd(0.1)
+    # ---- the FLAGSHIP model through the real DP machinery ----
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3,
+                          has_dcnatten=False, dcn_impl="jnp")
+    B, L, H, W = 4, 5, 16, 16          # global batch 4 -> 2 rows per host
+    states0 = model.init_states(1, H, W)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 3, H, W, 2), jnp.float32),
+        states0,
+    )
+    opt = optax.adam(1e-3)
+    state = replicate(TrainState.create(variables, opt), mesh)
+    step = make_parallel_train_step(
+        make_train_step(model, opt, seqn=3), mesh, donate=False
+    )
 
-    def train_step(state, batch):
-        params, opt_state = state
-        def loss_fn(p):
-            return ((batch["x"] @ p - batch["y"]) ** 2).mean()
-        loss, g = jax.value_and_grad(loss_fn)(params)
-        up, opt_state = opt.update(g, opt_state, params)
-        return (optax.apply_updates(params, up), opt_state), {"loss": loss}
-
-    step = make_parallel_train_step(train_step, mesh, donate=False)
-    state = replicate((w0, opt.init(w0)), mesh)
-
-    # each host contributes its half of the global batch
-    rng = np.random.default_rng(0)          # same data on both, split by row
-    X = rng.standard_normal((2 * n_global, 4)).astype(np.float32)
-    Y = rng.standard_normal(2 * n_global).astype(np.float32)
-    rows = X.shape[0] // 2
-    local = {"x": X[pid * rows:(pid + 1) * rows],
-             "y": Y[pid * rows:(pid + 1) * rows]}
+    # identical global data on both hosts, split by row
+    rng = np.random.default_rng(0)
+    inp = rng.uniform(0, 2, size=(B, L, H, W, 2)).astype(np.float32)
+    gt = rng.uniform(0, 2, size=(B, L, H, W, 2)).astype(np.float32)
+    rows = B // num_shards
+    local = {
+        "inp": inp[pid * rows:(pid + 1) * rows],
+        "gt": gt[pid * rows:(pid + 1) * rows],
+    }
     batch = stage_batch(local, mesh)
 
-    state, metrics = step(state, batch)
-    print("LOSS", pid, float(metrics["loss"]))
-    print("W", pid, np.asarray(state[0]).round(6).tolist())
+    for i in range(2):
+        state, metrics = step(state, batch)
+        print(f"LOSS{i}", pid, float(metrics["loss"]))
+
+    # ---- validation pass over the sharded batch ----
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+    eval_step = jax.jit(
+        make_eval_step(model, seqn=3),
+        in_shardings=(repl, data_sh), out_shardings=repl,
+    )
+    val = eval_step(state.params, batch)
+    print("VALID", pid, float(val["valid_loss"]))
+
+    # ---- checkpoint from process 0, resume on BOTH ----
+    cfg = {"model": {"name": "DeepRecurrNet", "args": {}},
+           "optimizer": {"name": "Adam", "args": {"lr": 1e-3}}}
+    # collective: every process calls save (Orbax coordinates; meta + array
+    # data written from the primary host only)
+    save_checkpoint(ckpt_root, state, cfg, iteration=2, monitor_best=0.0)
+    multihost_utils.sync_global_devices("checkpoint saved")
+    path = find_latest_checkpoint(ckpt_root)
+    assert path is not None, ckpt_root
+    restored_host = restore_state(path, state)
+    state2 = replicate(restored_host, mesh)
+
+    state2, metrics2 = step(state2, batch)
+    print("LOSS2", pid, float(metrics2["loss"]))
+    digest = sum(
+        float(jnp.abs(leaf).sum())
+        for leaf in jax.tree.leaves(state2.params)
+    )
+    print("DIGEST", pid, round(digest, 4))
     """
 )
 
 
 @pytest.mark.slow
-def test_two_process_data_parallel_step(tmp_path):
-    port = "29731"
+def test_two_process_flagship_train_valid_checkpoint_resume(tmp_path):
+    import os
+    import socket
+
+    # free port at test time — a hardcoded one collides across concurrent
+    # runs (and with a straggler worker from a timed-out previous run)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    # one CPU device per process (the parent test env forces 8 virtual
+    # devices; a 16-device mesh would out-shard the tiny global batch)
+    env = dict(
+        os.environ, XLA_FLAGS="--xla_force_host_platform_device_count=1"
+    )
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(i), port],
+            [sys.executable, "-c", _WORKER, str(i), port, str(tmp_path)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
+            env=env,
         )
         for i in range(2)
     ]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=240)
+        out, _ = p.communicate(timeout=600)
         outs.append(out)
-        assert p.returncode == 0, out[-2000:]
+        assert p.returncode == 0, out[-3000:]
 
     def grab(out, key):
-        return [l for l in out.splitlines() if l.startswith(key)]
+        return [l for l in out.splitlines() if l.startswith(key + " ")]
 
     # loader shards are disjoint and cover the index space
     idx0 = eval(grab(outs[0], "INDICES")[0].split(" ", 2)[2])
@@ -111,10 +174,22 @@ def test_two_process_data_parallel_step(tmp_path):
     assert not set(idx0) & set(idx1)
     assert sorted(idx0 + idx1) == list(range(8))
 
-    # both processes agree on the GLOBAL loss and updated params
-    loss0 = float(grab(outs[0], "LOSS")[0].split()[2])
-    loss1 = float(grab(outs[1], "LOSS")[0].split()[2])
-    assert loss0 == pytest.approx(loss1, rel=1e-6)
-    w0 = grab(outs[0], "W")[0].split(" ", 2)[2]
-    w1 = grab(outs[1], "W")[0].split(" ", 2)[2]
-    assert w0 == w1
+    # both processes agree on every global metric at every stage
+    for key in ("LOSS0", "LOSS1", "VALID", "LOSS2"):
+        v0 = float(grab(outs[0], key)[0].split()[2])
+        v1 = float(grab(outs[1], key)[0].split()[2])
+        assert v0 == pytest.approx(v1, rel=1e-6), (key, v0, v1)
+        assert v0 > 0
+
+    # training progressed, and the resumed step continued from the saved
+    # state (loss keeps decreasing rather than restarting)
+    l0 = float(grab(outs[0], "LOSS0")[0].split()[2])
+    l1 = float(grab(outs[0], "LOSS1")[0].split()[2])
+    l2 = float(grab(outs[0], "LOSS2")[0].split()[2])
+    assert l1 < l0
+    assert l2 < l1
+
+    # identical post-resume params on both processes
+    d0 = grab(outs[0], "DIGEST")[0].split(" ", 2)[2]
+    d1 = grab(outs[1], "DIGEST")[0].split(" ", 2)[2]
+    assert d0 == d1
