@@ -15,7 +15,10 @@ Three measurements (VERDICT round-1 item 6):
   device), the input-starvation check SURVEY §7.3-6 calls the main
   steps/sec risk.
 - ``dcn_pallas_speedup``: fused Pallas DCNv2 kernel vs the jnp gather
-  formulation at the model's bottleneck shape.
+  formulation at the model's bottleneck shape (forward-only, the round-2
+  meaning); ``dcn_pallas_train_speedup``: same A/B in the training
+  direction — forward + full VJP under ``jax.grad``, both directions fused
+  since round 3.
 
 vs_baseline stays null until a measured reference-GPU number exists
 (the reference repo publishes none — BASELINE.md).
@@ -227,7 +230,14 @@ def bench_e2e(model, opt, seqn, device_rasterize=False):
 
 
 def bench_dcn():
-    """Pallas vs jnp DCNv2 at the flagship bottleneck shape."""
+    """Pallas vs jnp DCNv2 at the flagship bottleneck shape.
+
+    Measured on the TRAINING direction (forward + full VJP under
+    value_and_grad) — training is mostly backward, and since round 3 the
+    backward is fused too (``dcn_pallas._pallas_backward``). Returns
+    ``(train_speedup, fwd_speedup)``.
+    """
+    from esr_tpu.ops import dcn_pallas as DP
     from esr_tpu.ops.dcn import deform_conv2d
     from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
 
@@ -253,9 +263,18 @@ def bench_dcn():
 
         return _best_of_reps(run, reps)
 
-    t_jnp = timed(lambda: deform_conv2d(x, off, mask, wt))
-    t_pal = timed(lambda: deform_conv2d_pallas(x, off, mask, wt))
-    return t_jnp / t_pal
+    def grad_of(fn):
+        def loss(x_, o_, m_, w_):
+            return (fn(x_, o_, m_, w_) ** 2).sum()
+
+        return lambda: jax.grad(loss, argnums=(0, 1, 2, 3))(x, off, mask, wt)
+
+    t_jnp_f = timed(lambda: deform_conv2d(x, off, mask, wt))
+    t_pal_f = timed(lambda: deform_conv2d_pallas(x, off, mask, wt))
+    t_jnp_g = timed(grad_of(lambda *a: deform_conv2d(*a)))
+    DP.dcn_backward_impl("pallas")
+    t_pal_g = timed(grad_of(lambda *a: deform_conv2d_pallas(*a)))
+    return t_jnp_g / t_pal_g, t_jnp_f / t_pal_f
 
 
 def main():
@@ -310,7 +329,8 @@ def main():
         "e2e_device_raster",
         lambda: bench_e2e(model, opt, seqn, device_rasterize=True),
     )
-    dcn_speedup = best_effort("dcn", bench_dcn)
+    dcn_speedups = best_effort("dcn", bench_dcn)
+    dcn_train, dcn_fwd = dcn_speedups if dcn_speedups else (None, None)
 
     extra = {
         "mfu": round(mfu, 4) if mfu is not None else None,
@@ -320,7 +340,13 @@ def main():
         "e2e_device_raster_steps_per_sec": (
             round(e2e_dev, 3) if e2e_dev else None
         ),
-        "dcn_pallas_speedup": round(dcn_speedup, 3) if dcn_speedup else None,
+        # dcn_pallas_speedup keeps its round-2 meaning (forward-only) so
+        # BENCH history stays commensurable; the train direction (fwd+VJP
+        # under grad — the number that matters for training) is new
+        "dcn_pallas_speedup": round(dcn_fwd, 3) if dcn_fwd else None,
+        "dcn_pallas_train_speedup": (
+            round(dcn_train, 3) if dcn_train else None
+        ),
         "device": jax.devices()[0].device_kind,
     }
     print(

@@ -30,10 +30,23 @@ Everything lives in VMEM for one batch image (feature maps at the ESR
 bottleneck are tiny: ``H/8 × W/8 × 8·basech``), so the only HBM traffic is
 the input read and output write.
 
-The backward pass is the jnp formulation's VJP via ``jax.custom_vjp`` — the
-transpose of the gather is exactly the reference's atomicAdd col2im scatter
-(``dcn_v2_im2col_cuda.cu:56-123``), and XLA autodiff of the gather emits it.
-Gradients are therefore bit-identical to the jnp path the tests pin.
+The backward pass is fused the same way (``_dcn_bwd_kernel``): the S
+matrices are rebuilt in VMEM and the three cotangents come out of transposed
+MXU contractions —
+
+- ``grad_cols = Wᵀ_{g,k} · gᵀ`` then ``gxᵀ_g += grad_cols · Sᵀ`` (the
+  reference's atomicAdd col2im scatter, ``dcn_v2_im2col_cuda.cu:56-123``,
+  as a matmul);
+- ``gw_{g,k} += (imgᵀ_g · S) · gᵀ`` (im2col column re-use without ever
+  writing columns to HBM);
+- per-corner weight cotangents ``gwgt_c[o] = Σ_hw 1[hw = idx_c[o]] ·
+  (imgᵀ_gᵀ · grad_cols)[hw, o]`` — the same one-hot trick reduced over
+  rows — which the host turns into offset/mask gradients by VJP through
+  the (elementwise, XLA-fused) corner-weight computation.
+
+``dcn_backward_impl('jnp')`` switches back to XLA autodiff of the jnp
+formulation (used by tests to pin the fused gradients bit-close, and by the
+bench for A/B).
 """
 
 from __future__ import annotations
@@ -51,7 +64,25 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _corner_decomposition(
+def _tiling(hw: int, no: int) -> Tuple[int, int, int, int]:
+    """``(hw_pad, no_tile, no_pad, n_tiles)`` shared by forward/backward.
+
+    Output-pixel tiling bounds the S matrix (and iota) to
+    ``[hw_pad, no_tile]`` f32 in VMEM; shrink the tile as the image grows.
+    """
+    hw_pad = _round_up(hw, 128)
+    if hw_pad <= 1024:
+        cap = 512
+    elif hw_pad <= 4096:
+        cap = 256
+    else:
+        cap = 128
+    no_tile = min(cap, _round_up(no, 128))
+    no_pad = _round_up(no, no_tile)
+    return hw_pad, no_tile, no_pad, no_pad // no_tile
+
+
+def _corner_pairs(
     offsets: jax.Array,
     mask: jax.Array,
     h: int,
@@ -61,17 +92,12 @@ def _corner_decomposition(
     dilation: int,
     kh: int,
     kw: int,
-    hw_pad: int,
-    no_pad: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Sampling positions -> 4 (index, weight) corner pairs per tap.
-
-    Returns ``idx [B, dg, 4, K, No_pad] int32`` and
-    ``wgt [B, dg, 4, K, No_pad] f32`` (mask-premultiplied, zero when the
-    corner falls outside the image or in the No padding).
-    """
-    b, ho, wo, dg, k, _ = offsets.shape
-    no = ho * wo
+    """Sampling positions -> 4 (index, weight) corner pairs per tap, in the
+    natural ``[B, Ho, Wo, dg, K, 4]`` layout. Differentiable in
+    ``(offsets, mask)`` — the fused backward takes the VJP of the weight
+    output to turn corner-weight cotangents into offset/mask gradients."""
+    ho, wo = offsets.shape[1], offsets.shape[2]
 
     oy = jnp.arange(ho) * stride - padding
     ox = jnp.arange(wo) * stride - padding
@@ -103,9 +129,31 @@ def _corner_decomposition(
         idxs.append(jnp.where(inb, flat, 0))
         wgts.append(jnp.where(inb, cw, 0.0) * mask)
 
+    return jnp.stack(idxs, axis=-1), jnp.stack(wgts, axis=-1)
+
+
+def _corner_decomposition(
+    offsets: jax.Array,
+    mask: jax.Array,
+    h: int,
+    w: int,
+    stride: int,
+    padding: int,
+    dilation: int,
+    kh: int,
+    kw: int,
+    hw_pad: int,
+    no_pad: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Corner pairs in kernel layout: ``idx [B, dg, 4, K, No_pad] int32``
+    and ``wgt [B, dg, 4, K, No_pad] f32`` (mask-premultiplied, zero when
+    the corner falls outside the image or in the No padding)."""
+    b, ho, wo, dg, k, _ = offsets.shape
+    no = ho * wo
+    idx, wgt = _corner_pairs(
+        offsets, mask, h, w, stride, padding, dilation, kh, kw
+    )
     # [B, Ho, Wo, dg, K, 4] -> [B, dg, 4, K, No]
-    idx = jnp.stack(idxs, axis=-1)
-    wgt = jnp.stack(wgts, axis=-1)
     idx = idx.reshape(b, no, dg, k, 4).transpose(0, 2, 4, 3, 1)
     wgt = wgt.reshape(b, no, dg, k, 4).transpose(0, 2, 4, 3, 1)
 
@@ -174,18 +222,7 @@ def _pallas_forward(
     weight = weight.astype(jnp.float32)
     cg = cin // dg
     no = ho * wo
-    hw_pad = _round_up(h * w, 128)
-    # Output-pixel tiling bounds the S matrix (and iota) to
-    # [hw_pad, no_tile] f32 in VMEM; shrink the tile as the image grows.
-    if hw_pad <= 1024:
-        cap = 512
-    elif hw_pad <= 4096:
-        cap = 256
-    else:
-        cap = 128
-    no_tile = min(cap, _round_up(no, 128))
-    no_pad = _round_up(no, no_tile)
-    n_tiles = no_pad // no_tile
+    hw_pad, no_tile, no_pad, n_tiles = _tiling(h * w, no)
 
     idx, wgt = _corner_decomposition(
         offsets, mask, h, w, stride, padding, dilation, kh, kw, hw_pad, no_pad
@@ -249,6 +286,188 @@ def deform_conv2d_pallas(
     return out.astype(x.dtype)
 
 
+def _dcn_bwd_kernel(
+    xt_ref, idx_ref, wgt_ref, wt_ref, gt_ref,
+    gxt_ref, gw_ref, gwgt_ref,
+    *, dg, cg, k, hw_pad, no_tile, cout,
+):
+    """One (batch image, output tile) per program. Rebuilds each (group,
+    tap) S matrix and emits all three cotangents with MXU contractions:
+    ``grad_cols = Wᵀg``, ``gxᵀ += grad_cols·Sᵀ`` (col2im as matmul),
+    ``gw += (imgᵀ·S)·gᵀ``, and the corner-weight cotangents via the one-hot
+    trick reduced over rows. ``gxt`` accumulates across output tiles (same
+    block revisited over t), ``gw`` across the whole grid."""
+    from jax.experimental import pallas as pl
+
+    HIGH = jax.lax.Precision.HIGHEST
+    b_i = pl.program_id(0)
+    t_i = pl.program_id(1)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (hw_pad, no_tile), 0)
+
+    @pl.when(t_i == 0)
+    def _init_gx():
+        gxt_ref[0] = jnp.zeros_like(gxt_ref[0])
+
+    @pl.when((b_i == 0) & (t_i == 0))
+    def _init_gw():
+        gw_ref[...] = jnp.zeros_like(gw_ref[...])
+
+    gt_b = gt_ref[0]  # [Cout, no_tile]
+
+    def body(i, carry):
+        g = i // k
+        kk = i % k
+        img_g = xt_ref[0, pl.ds(g * cg, cg), :]  # [Cg, HWp]
+        s = jnp.zeros((hw_pad, no_tile), jnp.float32)
+        for c in range(4):
+            iv = idx_ref[0, g, c, kk, :]
+            wv = wgt_ref[0, g, c, kk, :]
+            s = s + jnp.where(iota == iv[None, :], wv[None, :], 0.0)
+
+        # grad_cols [Cg, no_tile] = W[g,kk]ᵀ [Cg, Cout] @ gᵀ [Cout, no_tile]
+        gcols = jax.lax.dot_general(
+            wt_ref[g, kk], gt_b, (((0,), (0,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+        # gxᵀ_g [Cg, HWp] += grad_cols @ Sᵀ  (the col2im scatter as a matmul)
+        gx_part = jax.lax.dot_general(
+            gcols, s, (((1,), (1,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+        gxt_ref[0, pl.ds(g * cg, cg), :] = (
+            gxt_ref[0, pl.ds(g * cg, cg), :] + gx_part
+        )
+        # gw[g,kk] [Cg, Cout] += cols @ gᵀᵀ, cols = imgᵀ_g @ S
+        cols = jax.lax.dot_general(
+            img_g, s, (((1,), (0,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+        gw_part = jax.lax.dot_general(
+            cols, gt_b, (((1,), (1,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+        gw_ref[g, kk] = gw_ref[g, kk] + gw_part
+        # P [HWp, no_tile] = imgᵀ_gᵀ @ grad_cols; corner cotangent =
+        # one-hot-selected row sum of P
+        p = jax.lax.dot_general(
+            img_g, gcols, (((0,), (0,)), ((), ())),
+            precision=HIGH, preferred_element_type=jnp.float32,
+        )
+        for c in range(4):
+            iv = idx_ref[0, g, c, kk, :]
+            gwgt_ref[0, g, c, kk, :] = jnp.sum(
+                jnp.where(iota == iv[None, :], p, 0.0), axis=0
+            )
+        return carry
+
+    jax.lax.fori_loop(0, dg * k, body, 0)
+
+
+def _pallas_backward(
+    x: jax.Array,
+    offsets: jax.Array,
+    mask: jax.Array,
+    weight: jax.Array,
+    g: jax.Array,
+    stride: int,
+    padding: int,
+    dilation: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused gradients ``(gx, goffsets, gmask, gweight)`` — no HBM column
+    tensor in the backward either."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, w, cin = x.shape
+    kh, kw, _, cout = weight.shape
+    _, ho, wo, dg, k, _ = offsets.shape
+    in_dtypes = (x.dtype, offsets.dtype, mask.dtype, weight.dtype)
+    xf = x.astype(jnp.float32)
+    of = offsets.astype(jnp.float32)
+    mf = mask.astype(jnp.float32)
+    wf = weight.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    cg = cin // dg
+    no = ho * wo
+    hw_pad, no_tile, no_pad, n_tiles = _tiling(h * w, no)
+
+    idx, wgt = _corner_decomposition(
+        of, mf, h, w, stride, padding, dilation, kh, kw, hw_pad, no_pad
+    )
+    xt = xf.reshape(b, h * w, cin).transpose(0, 2, 1)
+    xt = jnp.pad(xt, ((0, 0), (0, 0), (0, hw_pad - h * w)))
+    wt = wf.reshape(k, dg, cg, cout).transpose(1, 0, 3, 2)
+    gt = gf.reshape(b, no, cout).transpose(0, 2, 1)
+    gt = jnp.pad(gt, ((0, 0), (0, 0), (0, no_pad - no)))
+
+    kernel = functools.partial(
+        _dcn_bwd_kernel,
+        dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout,
+    )
+    gxt, gw, gwgt = pl.pallas_call(
+        kernel,
+        grid=(b, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, cin, hw_pad), lambda i, t: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+            pl.BlockSpec((dg, k, cout, cg), lambda i, t: (0, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cout, no_tile), lambda i, t: (i, 0, t), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cin, hw_pad), lambda i, t: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((dg, k, cg, cout), lambda i, t: (0, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, cin, hw_pad), jnp.float32),
+            jax.ShapeDtypeStruct((dg, k, cg, cout), jnp.float32),
+            jax.ShapeDtypeStruct((b, dg, 4, k, no_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt, idx, wgt, wt, gt)
+
+    gx = gxt[:, :, : h * w].transpose(0, 2, 1).reshape(b, h, w, cin)
+    # [dg, K, Cg, Cout] -> HWIO (cin splits (dg, cg), dg-major — the inverse
+    # of the forward's weight packing)
+    gweight = gw.transpose(1, 0, 2, 3).reshape(kh, kw, cin, cout)
+    # corner cotangents back to natural layout, then VJP through the
+    # (differentiable) corner-weight computation for offset/mask grads
+    gwgt_nat = (
+        gwgt[..., :no]
+        .transpose(0, 4, 1, 3, 2)
+        .reshape(b, ho, wo, dg, k, 4)
+    )
+
+    def wgt_fn(off_, mask_):
+        return _corner_pairs(
+            off_, mask_, h, w, stride, padding, dilation, kh, kw
+        )[1]
+
+    _, vjp = jax.vjp(wgt_fn, of, mf)
+    goff, gmask = vjp(gwgt_nat)
+    return (
+        gx.astype(in_dtypes[0]),
+        goff.astype(in_dtypes[1]),
+        gmask.astype(in_dtypes[2]),
+        gweight.astype(in_dtypes[3]),
+    )
+
+
+# Backward implementation selector: 'pallas' (fused, default) or 'jnp' (XLA
+# autodiff of the jnp formulation — the oracle the fused path is pinned
+# against, and the bench A/B baseline). Read at TRACE time: set it before
+# jit-tracing the step you want to measure.
+_BACKWARD_IMPL = "pallas"
+
+
+def dcn_backward_impl(impl: str) -> None:
+    global _BACKWARD_IMPL
+    assert impl in ("pallas", "jnp"), impl
+    _BACKWARD_IMPL = impl
+
+
 def _fwd(x, offsets, mask, weight, bias, stride, padding, dilation, interpret):
     out = deform_conv2d_pallas(
         x, offsets, mask, weight, bias, stride, padding, dilation, interpret
@@ -259,16 +478,29 @@ def _fwd(x, offsets, mask, weight, bias, stride, padding, dilation, interpret):
 def _bwd(stride, padding, dilation, interpret, res, g):
     x, offsets, mask, weight, bias = res
 
-    def ref_fn(x_, offsets_, mask_, weight_, bias_):
-        return _dcn_jnp.deform_conv2d(
-            x_, offsets_, mask_, weight_,
-            bias_ if bias is not None else None,
-            stride=stride, padding=padding, dilation=dilation,
-        )
+    if _BACKWARD_IMPL == "jnp":
 
-    primal, vjp = jax.vjp(ref_fn, x, offsets, mask, weight, bias)
-    gx, goff, gmask, gw, gb = vjp(g.astype(primal.dtype))
-    return gx, goff, gmask, gw, (gb if bias is not None else None)
+        def ref_fn(x_, offsets_, mask_, weight_, bias_):
+            return _dcn_jnp.deform_conv2d(
+                x_, offsets_, mask_, weight_,
+                bias_ if bias is not None else None,
+                stride=stride, padding=padding, dilation=dilation,
+            )
+
+        primal, vjp = jax.vjp(ref_fn, x, offsets, mask, weight, bias)
+        gx, goff, gmask, gw, gb = vjp(g.astype(primal.dtype))
+        return gx, goff, gmask, gw, (gb if bias is not None else None)
+
+    interp = _auto_interpret() if interpret is None else interpret
+    gx, goff, gmask, gw = _pallas_backward(
+        x, offsets, mask, weight, g, stride, padding, dilation, interp
+    )
+    gb = (
+        g.astype(jnp.float32).sum(axis=(0, 1, 2)).astype(bias.dtype)
+        if bias is not None
+        else None
+    )
+    return gx, goff, gmask, gw, gb
 
 
 deform_conv2d_pallas.defvjp(_fwd, _bwd)

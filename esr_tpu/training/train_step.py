@@ -190,7 +190,7 @@ def make_train_step(
             )
         # only the LAST window's prediction is reported — carry it instead
         # of stacking every window's output
-        pred0 = jnp.zeros_like(gt[:, 0])
+        pred0 = jnp.zeros_like(gt[:, 0], dtype=jnp.float32)
 
         if stats is None:
 
