@@ -75,6 +75,28 @@ def _time_steps(step, state, batch, iters=20, reps=3):
     return 1.0 / best, carry["state"]
 
 
+def _recipe_batch(b, L=10, h=90, w=160, seed=0):
+    """The deterministic reference-recipe-shaped batch every stage times."""
+    rng = np.random.default_rng(seed)
+    return {
+        "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+        "gt": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+    }
+
+
+def _flops_of(step_fn, state, batch):
+    """XLA cost-analysis flops of one compiled step (None when the backend
+    does not report them)."""
+    try:
+        compiled = jax.jit(step_fn).lower(state, batch).compile()
+        costs = compiled.cost_analysis()
+        if isinstance(costs, list):
+            costs = costs[0]
+        return float(costs.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
 def bench_compute():
     """Device-resident steps/s + MFU on the reference recipe shapes."""
     from esr_tpu.models.esr import DeepRecurrNet
@@ -85,11 +107,7 @@ def bench_compute():
     h, w = 90, 160
 
     model = DeepRecurrNet(inch=2, basech=8, num_frame=seqn)
-    rng = np.random.default_rng(0)
-    batch = {
-        "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
-        "gt": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
-    }
+    batch = _recipe_batch(b, L, h, w)
     states = model.init_states(b, h, w)
     params = model.init(jax.random.PRNGKey(0), batch["inp"][:, :seqn], states)
     opt = make_reference_optimizer()
@@ -100,15 +118,7 @@ def bench_compute():
     # which deletes the params leaves it shares
     params16 = jax.tree.map(jnp.array, params)
     state = TrainState.create(params, opt)
-    flops_per_step = None
-    try:
-        compiled = jax.jit(step_fn).lower(state, batch).compile()
-        costs = compiled.cost_analysis()
-        if isinstance(costs, list):
-            costs = costs[0]
-        flops_per_step = float(costs.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    flops_per_step = _flops_of(step_fn, state, batch)
 
     steps_per_sec, state = _time_steps(step, state, batch)
     mfu = (
@@ -131,6 +141,99 @@ def bench_compute():
 
         print(f"bench: bf16 stage failed: {e!r}", file=sys.stderr)
     return steps_per_sec, mfu, flops_per_step, bf16_steps, model, opt, state, seqn
+
+
+def bench_scaling(seqn=3, batches=(8, 16), shape=(10, 90, 160), basech=8):
+    """Per-chip batch scaling curve (VERDICT r2: is the 6.6% MFU small-batch
+    arithmetic intensity or a pipeline problem?). Returns
+    ``{f"b{n}": {"steps_per_sec": ..., "mfu": ...}}`` — b2 is the headline
+    measurement itself."""
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.training.optim import make_reference_optimizer
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    L, h, w = shape
+    model = DeepRecurrNet(inch=2, basech=basech, num_frame=seqn)
+    opt = make_reference_optimizer()
+    out = {}
+    for b in batches:
+        batch = _recipe_batch(b, L, h, w)
+        states = model.init_states(b, h, w)
+        params = model.init(
+            jax.random.PRNGKey(0), batch["inp"][:, :seqn], states
+        )
+        step_fn = make_train_step(model, opt, seqn=seqn)
+        state = TrainState.create(params, opt)
+        flops = _flops_of(step_fn, state, batch)
+        step = jax.jit(step_fn, donate_argnums=(0,))
+        sps, _ = _time_steps(step, state, batch, iters=10, reps=2)
+        out[f"b{b}"] = {
+            "steps_per_sec": round(sps, 3),
+            "sequences_per_sec": round(sps * b, 2),
+            "mfu": (
+                round(flops * sps / _peak_flops(), 4) if flops else None
+            ),
+        }
+    return out
+
+
+def bench_breakdown(model, opt, seqn, state, batch):
+    """Empirical cost centers: time the pieces of the train step separately
+    (forward-only loss, full fwd+bwd, optimizer update) so the top centers
+    are named with numbers rather than guessed. All times in ms/step."""
+    import optax
+
+    from esr_tpu.training.train_step import _split_vars
+
+    param_col, stats = _split_vars(state.params)
+
+    def fwd_only(params, batch):
+        # the scan'd forward exactly as the step runs it, no grad
+        from esr_tpu.training.train_step import make_eval_step
+
+        return make_eval_step(model, seqn=seqn)(params, batch)
+
+    def timed(f, *args, iters=20, reps=3):
+        g = jax.jit(f)
+        jax.block_until_ready(g(*args))
+
+        def run():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = g(*args)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / iters
+
+        return _best_of_reps(run, reps) * 1e3
+
+    out = {}
+    out["fwd_ms"] = round(timed(fwd_only, state.params, batch), 3)
+
+    def full(state_, batch_):
+        from esr_tpu.training.train_step import make_train_step
+
+        s2, m = make_train_step(model, opt, seqn=seqn)(state_, batch_)
+        # depend on EVERY updated param: returning only the loss would let
+        # XLA dead-code-eliminate the whole backward + optimizer update,
+        # and any single leaf would still let it prune the other grads
+        digest = sum(jnp.sum(l) for l in jax.tree.leaves(s2.params))
+        return m["loss"], digest
+
+    out["train_step_ms"] = round(timed(full, state, batch), 3)
+    # backward ~= train - fwd - opt; opt alone:
+    grads = jax.tree.map(jnp.zeros_like, param_col)
+
+    def opt_only(g_, s_, p_):
+        up, s2 = opt.update(g_, s_, p_)
+        return optax.apply_updates(p_, up)
+
+    out["optimizer_ms"] = round(
+        timed(opt_only, grads, state.opt_state, param_col), 3
+    )
+    out["bwd_minus_fwd_ms"] = round(
+        out["train_step_ms"] - out["fwd_ms"] - out["optimizer_ms"], 3
+    )
+    return out
 
 
 def bench_e2e(model, opt, seqn, device_rasterize=False):
@@ -331,6 +434,11 @@ def main():
     )
     dcn_speedups = best_effort("dcn", bench_dcn)
     dcn_train, dcn_fwd = dcn_speedups if dcn_speedups else (None, None)
+    scaling = best_effort("scaling", bench_scaling)
+    breakdown = best_effort(
+        "breakdown",
+        lambda: bench_breakdown(model, opt, seqn, state, _recipe_batch(2)),
+    )
 
     extra = {
         "mfu": round(mfu, 4) if mfu is not None else None,
@@ -347,6 +455,10 @@ def main():
         "dcn_pallas_train_speedup": (
             round(dcn_train, 3) if dcn_train else None
         ),
+        # batch-scaling curve + per-piece cost breakdown (the MFU question:
+        # small-batch arithmetic intensity vs pipeline problem)
+        "scaling": scaling,
+        "breakdown_ms": breakdown,
         "device": jax.devices()[0].device_kind,
     }
     print(

@@ -202,6 +202,8 @@ def run_inference(
     save_images: bool = True,
     lpips_backbone_npz: Optional[str] = None,
     allow_uncalibrated_lpips: bool = False,
+    lpips_net: str = "alex",
+    lpips_lin_npz: Optional[str] = None,
 ) -> Dict[str, float]:
     """Full driver: checkpoint -> model, datalist -> per-recording + mean
     reports under ``output_path`` (reference ``main`` mode 1, ``:295-347``).
@@ -221,18 +223,23 @@ def run_inference(
     if lpips_backbone_npz is not None or allow_uncalibrated_lpips:
         from esr_tpu.losses.lpips import (
             LPIPS,
-            load_alexnet_npz,
+            load_backbone_npz,
             load_lpips_params,
         )
 
         backbone = (
-            load_alexnet_npz(lpips_backbone_npz)
+            load_backbone_npz(lpips_backbone_npz)
             if lpips_backbone_npz
             else None
         )
-        lpips_model = LPIPS()
+        # net choice mirrors the reference DistModel (dist_model.py:45-74);
+        # non-alex nets need their converted lin npz alongside the backbone
+        lpips_model = LPIPS(net=lpips_net)
         lpips_params = load_lpips_params(
-            backbone, allow_uncalibrated=allow_uncalibrated_lpips
+            backbone_state=backbone,
+            net=lpips_net,
+            lin_npz_path=lpips_lin_npz,
+            allow_uncalibrated=allow_uncalibrated_lpips,
         )
 
     runner = InferenceRunner(

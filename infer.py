@@ -28,6 +28,12 @@ def get_flags():
     p.add_argument("--save_images", dest="save_images", action="store_true", default=True)
     p.add_argument("--no_save_images", dest="save_images", action="store_false")
     p.add_argument("--lpips_backbone", type=str, default=None)
+    p.add_argument(
+        "--lpips_net", type=str, default="alex",
+        choices=["alex", "vgg", "vgg16", "squeeze"],
+    )
+    p.add_argument("--lpips_lins", type=str, default=None,
+                   help="converted lin-weights npz (required for non-alex)")
     p.add_argument("--allow_uncalibrated_lpips", action="store_true")
 
     # dataset overrides (reference get_flags, infer_ours_cnt.py:135-157)
@@ -91,6 +97,8 @@ def main():
         save_images=flags.save_images,
         lpips_backbone_npz=flags.lpips_backbone,
         allow_uncalibrated_lpips=flags.allow_uncalibrated_lpips,
+        lpips_net=flags.lpips_net,
+        lpips_lin_npz=flags.lpips_lins,
     )
     print({k: round(v, 6) for k, v in mean.items()})
 
