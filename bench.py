@@ -164,8 +164,21 @@ def bench_scaling(seqn=3, batches=(8, 16), shape=(10, 90, 160), basech=8):
         )
         step_fn = make_train_step(model, opt, seqn=seqn)
         state = TrainState.create(params, opt)
-        flops = _flops_of(step_fn, state, batch)
-        step = jax.jit(step_fn, donate_argnums=(0,))
+        # ONE compile per batch size: AOT-compile the donated jit, read the
+        # cost analysis from it, and time the same compiled object
+        step = (
+            jax.jit(step_fn, donate_argnums=(0,))
+            .lower(state, batch)
+            .compile()
+        )
+        flops = None
+        try:
+            costs = step.cost_analysis()
+            if isinstance(costs, list):
+                costs = costs[0]
+            flops = float(costs.get("flops", 0.0)) or None
+        except Exception:
+            pass
         sps, _ = _time_steps(step, state, batch, iters=10, reps=2)
         out[f"b{b}"] = {
             "steps_per_sec": round(sps, 3),

@@ -1,6 +1,7 @@
 from .layers import (
     ConvLayer,
     TorchBatchNorm,
+    TorchInstanceNorm,
     TransposedConvLayer,
     UpsampleConvLayer,
     RecurrentConvLayer,

@@ -129,13 +129,61 @@ class TorchBatchNorm(nn.Module):
         return y.astype(x.dtype)
 
 
+class TorchInstanceNorm(nn.Module):
+    """``torch.nn.InstanceNorm2d(affine=False, track_running_stats=True)``
+    on NHWC — the exact variant the reference ConvLayer family constructs
+    (``models/submodules.py:189``).
+
+    Train mode normalizes each instance with its own spatial moments;
+    running stats blend the batch-mean of per-instance stats (variance
+    Bessel-corrected with n = H·W) and are what EVAL mode normalizes with —
+    semantics pinned empirically against torch and by the executed-reference
+    parity test. No affine parameters (torch's InstanceNorm default).
+    """
+
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        c = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+        xf = x.astype(jnp.float32)
+        if train:
+            mean_i = jnp.mean(xf, axis=(1, 2), keepdims=True)  # [B,1,1,C]
+            var_i = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=(1, 2), keepdims=True)
+                - jnp.square(mean_i),
+                0.0,
+            )
+            n = x.shape[1] * x.shape[2]
+            if not self.is_initializing():
+                m = self.momentum
+                bessel = n / (n - 1) if n > 1 else 1.0
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * jnp.mean(
+                    mean_i[:, 0, 0, :], axis=0
+                )
+                ra_var.value = (1.0 - m) * ra_var.value + m * jnp.mean(
+                    var_i[:, 0, 0, :] * bessel, axis=0
+                )
+            y = (xf - mean_i) * jax.lax.rsqrt(var_i + self.epsilon)
+        else:
+            y = (xf - ra_mean.value) * jax.lax.rsqrt(
+                ra_var.value + self.epsilon
+            )
+        return y.astype(x.dtype)
+
+
 class _NormWrapper(nn.Module):
     """Optional norm following a conv (reference ConvLayer norm handling):
-    ``'BN'`` (:class:`TorchBatchNorm` — needs the ``train`` flag and a
-    mutable ``batch_stats`` collection in the caller's apply), ``'IN'``
-    (instance norm; the reference's ``track_running_stats=True`` variant is
-    approximated by the batch statistics, which is what torch uses in
-    training mode), or ``None``.
+    ``'BN'`` (:class:`TorchBatchNorm`) and ``'IN'``
+    (:class:`TorchInstanceNorm`) — both need the ``train`` flag and a
+    mutable ``batch_stats`` collection in the caller's apply — or ``None``.
     """
 
     norm: Optional[str] = None
@@ -144,8 +192,7 @@ class _NormWrapper(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         if self.norm == "IN":
-            # InstanceNorm == GroupNorm with one group per channel.
-            x = nn.GroupNorm(num_groups=None, group_size=1)(x)
+            x = TorchInstanceNorm()(x, train)
         elif self.norm == "BN":
             x = TorchBatchNorm(momentum=self.bn_momentum)(x, train)
         elif self.norm is not None:

@@ -54,23 +54,40 @@ def main():
     summary = {"stages": {}, "ok": False}
 
     sys.path.insert(0, REPO)
-    # Write the artifact BEFORE touching jax: if the tunnel is wedged the
-    # watchdog os._exits this process and nothing after the import runs.
-    summary["error"] = "backend init did not complete (wedged tunnel?)"
+    # Backend probe in a SHORT-LIVED child: the parent must NEVER
+    # initialize the TPU backend itself — the tunnel admits one client at a
+    # time, so a parent holding the lease would park every train.py child
+    # in make_c_api_client until its timeout SIGKILLs it mid-init (the
+    # known tunnel-wedging failure mode). The probe child exits (releasing
+    # the lease) before any workload child starts; its own watchdog only
+    # fires on an ALREADY-wedged tunnel, where there is no healthy lease to
+    # corrupt.
+    summary["error"] = "backend probe did not complete (wedged tunnel?)"
     _write(args.out, summary)
-    import faulthandler
-
-    faulthandler.dump_traceback_later(240, exit=True)
-    import jax
-
-    from esr_tpu.parallel.mesh import honor_platform_env
-
-    honor_platform_env()
-    summary["backend"] = jax.default_backend()
-    summary["devices"] = [str(d) for d in jax.devices()]
+    probe_code = (
+        "import faulthandler, json, os\n"
+        "faulthandler.dump_traceback_later(180, exit=True)\n"
+        "import jax\n"
+        "if os.environ.get('JAX_PLATFORMS'):\n"
+        "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'devices': [str(d) for d in jax.devices()]}))\n"
+    )
+    try:
+        pr, _ = run(
+            [sys.executable, "-c", probe_code],
+            timeout=240, allow_cpu=args.allow_cpu,
+        )
+    except subprocess.TimeoutExpired:
+        _write(args.out, summary)
+        sys.exit(2)
+    if pr.returncode != 0:
+        _write(args.out, summary)
+        sys.exit(2)
+    summary.update(json.loads(pr.stdout.strip().splitlines()[-1]))
     summary.pop("error")
-    faulthandler.cancel_dump_traceback_later()
-    if jax.default_backend() != "tpu" and not args.allow_cpu:
+    _write(args.out, summary)
+    if summary["backend"] != "tpu" and not args.allow_cpu:
         summary["error"] = "backend is not tpu"
         _write(args.out, summary)
         sys.exit(3)
@@ -112,22 +129,35 @@ def main():
             "trainer;vis;enabled=false",
         ]
 
-        def train_cmd(extra):
+        def train_cmd(extra, ovr=overrides):
             cmd = [
                 sys.executable, "train.py", "-c", "configs/train_esr_2x.yml",
                 "-id", "tpu_smoke", "-seed", "0",
             ] + extra
-            for o in overrides:
+            for o in ovr:
                 cmd += ["-o", o]
             return cmd
 
-        r, dt = run(train_cmd([]), timeout=2400, allow_cpu=args.allow_cpu)
-        summary["stages"]["train"] = {
-            "rc": r.returncode, "seconds": round(dt, 1),
-            "tail": r.stderr[-1500:] if r.returncode else "",
-        }
-        if r.returncode != 0:
+        def staged(name, cmd, timeout=2400):
+            """Run one stage; record a timeout as a failed stage instead of
+            crashing with a stale artifact."""
+            try:
+                res, dt = run(cmd, timeout=timeout, allow_cpu=args.allow_cpu)
+            except subprocess.TimeoutExpired:
+                summary["stages"][name] = {
+                    "rc": None, "seconds": timeout, "tail": "stage timed out"
+                }
+                _write(args.out, summary)
+                return None
+            summary["stages"][name] = {
+                "rc": res.returncode, "seconds": round(dt, 1),
+                "tail": res.stderr[-1500:] if res.returncode else "",
+            }
             _write(args.out, summary)
+            return res
+
+        r = staged("train", train_cmd([]))
+        if r is None or r.returncode != 0:
             sys.exit(1)
 
         ckpts = glob.glob(f"{out_dir}/models/*/tpu_smoke/checkpoint-*")
@@ -137,22 +167,14 @@ def main():
         ro = [o for o in overrides if "iterations=" not in o]
         total = args.iters + args.resume_iters
         ro.append(f"trainer;iteration_based_train;iterations={total}")
-        cmd = [
-            sys.executable, "train.py", "-c", "configs/train_esr_2x.yml",
-            "-id", "tpu_smoke", "-seed", "0", "-r", "auto",
-        ]
-        for o in ro:
-            cmd += ["-o", o]
-        r2, dt2 = run(cmd, timeout=2400, allow_cpu=args.allow_cpu)
-        summary["stages"]["resume"] = {
-            "rc": r2.returncode, "seconds": round(dt2, 1),
-            "tail": r2.stderr[-1500:] if r2.returncode else "",
-        }
+        r2 = staged("resume", train_cmd(["-r", "auto"], ro))
 
         # inference from the checkpoint
+        r3 = None
         if ckpts:
             inf_out = os.path.join(tmp, "infer_out")
-            r3, dt3 = run(
+            r3 = staged(
+                "infer",
                 [
                     sys.executable, "infer.py",
                     "--model_path", sorted(ckpts)[0],
@@ -161,18 +183,13 @@ def main():
                     "--window", "256", "--sliding_window", "128",
                     "--seql", "4", "--no_save_images",
                 ],
-                timeout=2400, allow_cpu=args.allow_cpu,
             )
-            summary["stages"]["infer"] = {
-                "rc": r3.returncode, "seconds": round(dt3, 1),
-                "tail": r3.stderr[-1500:] if r3.returncode else "",
-            }
 
         summary["ok"] = (
             r.returncode == 0
             and bool(ckpts)
-            and r2.returncode == 0
-            and summary["stages"].get("infer", {}).get("rc") == 0
+            and r2 is not None and r2.returncode == 0
+            and r3 is not None and r3.returncode == 0
         )
     _write(args.out, summary)
     print(json.dumps(summary, indent=2))

@@ -322,3 +322,67 @@ def test_torchbatchnorm_axis_name_shard_map():
         ),
         mut_shard["batch_stats"], mut_global["batch_stats"],
     )
+
+
+def test_convlayer_in_matches_reference_train_and_eval(ref_submodules):
+    """norm='IN' — the reference constructs
+    InstanceNorm2d(track_running_stats=True) (submodules.py:189): train-mode
+    per-instance normalization, running stats accumulate the batch-mean of
+    per-instance moments, EVAL normalizes with the running stats, no affine
+    params. 2 train forwards then eval, executed side-by-side."""
+    from esr_tpu.models.layers import ConvLayer
+
+    torch.manual_seed(5)
+    ref = ref_submodules.ConvLayer(
+        3, 8, kernel_size=3, stride=1, padding=1, activation="relu",
+        norm="IN",
+    )
+    ref.train()
+
+    ours = ConvLayer(8, 3, stride=1, padding=1, activation="relu", norm="IN")
+    rng = np.random.default_rng(6)
+    x0 = rng.standard_normal((4, 9, 11, 3)).astype(np.float32)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x0), train=False)
+    params = jax.tree.map(np.asarray, variables["params"])
+    params["Conv_0"] = {
+        "kernel": np.asarray(_t2f(ref.conv2d.weight)["kernel"], np.float32),
+        "bias": ref.conv2d.bias.detach().numpy(),
+    }
+    stats = variables["batch_stats"]
+
+    for step in range(2):
+        x = rng.standard_normal((4, 9, 11, 3)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+        y_ours, mut = ours.apply(
+            {"params": params, "batch_stats": stats},
+            jnp.asarray(x), train=True, mutable=["batch_stats"],
+        )
+        stats = mut["batch_stats"]
+        np.testing.assert_allclose(
+            np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+            atol=1e-5, rtol=1e-4, err_msg=f"IN train fwd {step}",
+        )
+        bn_path = next(iter(stats))
+        np.testing.assert_allclose(
+            np.asarray(stats[bn_path]["TorchInstanceNorm_0"]["mean"]),
+            ref.norm_layer.running_mean.numpy(),
+            atol=1e-6, rtol=1e-5, err_msg=f"IN running_mean {step}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats[bn_path]["TorchInstanceNorm_0"]["var"]),
+            ref.norm_layer.running_var.numpy(),
+            atol=1e-6, rtol=1e-5, err_msg=f"IN running_var {step}",
+        )
+
+    ref.eval()
+    x = rng.standard_normal((2, 9, 11, 3)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+    y_ours = ours.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x), train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+        atol=1e-5, rtol=1e-4, err_msg="IN eval fwd",
+    )
