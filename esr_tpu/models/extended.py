@@ -7,11 +7,12 @@ module surface, so they are rebuilt here, channel-last and functional:
 
 - :class:`InceptionBlock` / :class:`DilatedBlock` (``:9-63``);
 - :class:`SelfAttention` — tied-QK offset attention over point sets
-  (``:80-112``); the reference's ``BatchNorm1d`` becomes a per-sample
-  normalization over points (no running stats — BN is deliberately
-  unsupported framework-wide, see ``layers._NormWrapper``);
+  (``:80-112``); the reference's ``BatchNorm1d`` is torch-exact
+  (``layers.TorchBatchNorm`` — train flag + ``batch_stats``, running
+  stats used in eval);
 - :class:`Conv3DBlock` / :class:`Deconv3DBlock` (``conv_block_3d`` family,
-  ``:518-565``) with the same substitution;
+  ``:518-565``; their ``'IN'`` option is stateless GroupNorm(group_size=1),
+  matching torch's default untracked InstanceNorm3d);
 - :func:`group_knn` / :class:`DenseEdgeConv` point ops (``:626-752``) as
   static-shape jnp (the reference's numpy-based duplicate masking becomes a
   pairwise-equality test, jit-able);
@@ -29,7 +30,11 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from esr_tpu.models.layers import get_activation, torch_uniform_init
+from esr_tpu.models.layers import (
+    TorchBatchNorm,
+    get_activation,
+    torch_uniform_init,
+)
 
 Array = jax.Array
 
@@ -97,7 +102,7 @@ class SelfAttention(nn.Module):
     channels: int
 
     @nn.compact
-    def __call__(self, x: Array) -> Array:
+    def __call__(self, x: Array, train: bool = False) -> Array:
         c4 = self.channels // 4
         qk = nn.Dense(c4, use_bias=False, name="qk")  # tied q/k projection
         q = qk(x)  # [B, N, C/4]
@@ -113,10 +118,10 @@ class SelfAttention(nn.Module):
         # x_v @ attention with x_v [B, C, N] -> x_r[:, :, n] = sum_m v_m A[m, n]
         x_r = jnp.einsum("bmc,bmn->bnc", v, attention)
         delta = nn.Dense(self.channels, name="trans")(x - x_r)
-        # BatchNorm1d -> stateless per-sample normalization over points
-        delta = nn.LayerNorm(
-            reduction_axes=(-2,), feature_axes=(-1,), name="after_norm"
-        )(delta)
+        # torch BatchNorm1d on [B, C, N]: per-channel moments over (B, N) —
+        # TorchBatchNorm reduces over all-but-last axes, so [B, N, C] maps
+        # exactly (train flag + batch_stats as with the conv layers)
+        delta = TorchBatchNorm(name="after_norm")(delta, train)
         return x + jax.nn.relu(delta)
 
 

@@ -91,3 +91,82 @@ def test_mean_shift():
     m = MeanShift(rgb_mean=(1.0, 1.0, 1.0), rgb_std=(1.0, 1.0, 1.0), sign=-1)
     out = m(x)
     np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
+
+def test_self_attention_matches_reference_executed():
+    """Executed reference SelfAttention (submodules.py:80-112) vs ours with
+    converted weights: tied q/k Conv1d, v/trans Conv1d, torch-exact
+    BatchNorm1d — train-mode forward, running stats, then eval mode."""
+    import os
+
+    import pytest as _pytest
+
+    torch = _pytest.importorskip("torch")
+    if not os.path.isdir("/root/reference"):
+        _pytest.skip("reference checkout not mounted")
+    from conftest import shim_reference_imports
+
+    shim_reference_imports("/root/reference")
+    import models.submodules as sm
+
+    torch.manual_seed(11)
+    C, B, N = 8, 2, 17
+    ref = sm.SelfAttention(C)
+    ref.train()
+
+    x0 = np.random.default_rng(3).random((B, N, C)).astype(np.float32)
+    ours = SelfAttention(channels=C)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x0))
+    params = jax.tree.map(np.asarray, variables["params"])
+
+    def conv1d_to_dense(conv):
+        # torch Conv1d k=1 weight [Cout, Cin, 1] -> dense kernel [Cin, Cout]
+        out = {"kernel": conv.weight.detach().numpy()[:, :, 0].T}
+        if conv.bias is not None:
+            out["bias"] = conv.bias.detach().numpy()
+        return out
+
+    params["qk"] = conv1d_to_dense(ref.q_conv)
+    params["v"] = conv1d_to_dense(ref.v_conv)
+    params["trans"] = conv1d_to_dense(ref.trans_conv)
+    params["after_norm"] = {
+        "scale": ref.after_norm.weight.detach().numpy(),
+        "bias": ref.after_norm.bias.detach().numpy(),
+    }
+    stats = variables["batch_stats"]
+
+    rng = np.random.default_rng(4)
+    for step in range(2):
+        x = rng.random((B, N, C)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(x))
+        y_ours, mut = ours.apply(
+            {"params": params, "batch_stats": stats},
+            jnp.asarray(x), train=True, mutable=["batch_stats"],
+        )
+        stats = mut["batch_stats"]
+        np.testing.assert_allclose(
+            np.asarray(y_ours), y_ref.numpy(), atol=2e-5, rtol=1e-4,
+            err_msg=f"train fwd {step}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats["after_norm"]["mean"]),
+            ref.after_norm.running_mean.numpy(),
+            atol=1e-6, rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats["after_norm"]["var"]),
+            ref.after_norm.running_var.numpy(),
+            atol=1e-6, rtol=1e-5,
+        )
+
+    ref.eval()
+    x = rng.random((B, N, C)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(x))
+    y_ours = ours.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x), train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.numpy(), atol=2e-5, rtol=1e-4,
+        err_msg="eval fwd",
+    )
