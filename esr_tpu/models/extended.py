@@ -11,8 +11,8 @@ module surface, so they are rebuilt here, channel-last and functional:
   (``layers.TorchBatchNorm`` — train flag + ``batch_stats``, running
   stats used in eval);
 - :class:`Conv3DBlock` / :class:`Deconv3DBlock` (``conv_block_3d`` family,
-  ``:518-565``; their ``'IN'`` option is stateless GroupNorm(group_size=1),
-  matching torch's default untracked InstanceNorm3d);
+  ``:518-565``; the reference's always-on BatchNorm3d is torch-exact via
+  TorchBatchNorm — a stateless ``'IN'`` option is kept as an extension);
 - :func:`group_knn` / :class:`DenseEdgeConv` point ops (``:626-752``) as
   static-shape jnp (the reference's numpy-based duplicate masking becomes a
   pairwise-equality test, jit-able);
@@ -127,23 +127,31 @@ class SelfAttention(nn.Module):
 
 class Conv3DBlock(nn.Module):
     """Conv3d + norm + activation (reference ``conv_block_3d``,
-    ``submodules.py:518-533``). ``x: [B, D, H, W, C]``."""
+    ``submodules.py:518-533``). ``x: [B, D, H, W, C]``.
+
+    The reference ALWAYS applies BatchNorm3d; ``norm='BN'`` (default) is
+    torch-exact via :class:`~esr_tpu.models.layers.TorchBatchNorm`
+    ([B, D, H, W, C] reduces over all-but-last axes = BatchNorm3d moments).
+    ``'IN'``/None are extensions.
+    """
 
     features: int
     kernel_size: int = 3
     stride: int = 1
     padding: int = 1
     activation: Optional[str] = "leaky_relu"
-    norm: Optional[str] = "IN"
+    norm: Optional[str] = "BN"
 
     @nn.compact
-    def __call__(self, x: Array) -> Array:
+    def __call__(self, x: Array, train: bool = False) -> Array:
         k, s, p = self.kernel_size, self.stride, self.padding
         x = nn.Conv(
             self.features, (k, k, k), strides=(s, s, s),
             padding=((p, p),) * 3,
         )(x)
-        if self.norm == "IN":
+        if self.norm == "BN":
+            x = TorchBatchNorm()(x, train)
+        elif self.norm == "IN":
             x = nn.GroupNorm(num_groups=None, group_size=1)(x)
         act = get_activation(self.activation)
         return act(x) if act is not None else x
@@ -157,17 +165,19 @@ class Deconv3DBlock(nn.Module):
     kernel_size: int = 3
     padding: int = 1
     activation: Optional[str] = "leaky_relu"
-    norm: Optional[str] = "IN"
+    norm: Optional[str] = "BN"
 
     @nn.compact
-    def __call__(self, x: Array) -> Array:
+    def __call__(self, x: Array, train: bool = False) -> Array:
         k, p = self.kernel_size, self.padding
         # torch ConvTranspose3d(stride=2, output_padding=1): out = 2*in
         x = nn.ConvTranspose(
             self.features, (k, k, k), strides=(2, 2, 2),
             padding=((k - 1 - p, k - p),) * 3,
         )(x)
-        if self.norm == "IN":
+        if self.norm == "BN":
+            x = TorchBatchNorm()(x, train)
+        elif self.norm == "IN":
             x = nn.GroupNorm(num_groups=None, group_size=1)(x)
         act = get_activation(self.activation)
         return act(x) if act is not None else x

@@ -170,3 +170,131 @@ def test_self_attention_matches_reference_executed():
         np.asarray(y_ours), y_ref.numpy(), atol=2e-5, rtol=1e-4,
         err_msg="eval fwd",
     )
+
+
+def _ref_submodules():
+    import os
+
+    import pytest as _pytest
+
+    torch = _pytest.importorskip("torch")
+    if not os.path.isdir("/root/reference"):
+        _pytest.skip("reference checkout not mounted")
+    from conftest import shim_reference_imports
+
+    shim_reference_imports("/root/reference")
+    import models.submodules as sm
+
+    return torch, sm
+
+
+def test_conv3d_block_matches_reference_executed():
+    """Executed reference conv_block_3d (Conv3d + BatchNorm3d + LeakyReLU,
+    submodules.py:517-533) vs Conv3DBlock: train forwards update running
+    stats, eval uses them."""
+    torch, sm = _ref_submodules()
+    torch.manual_seed(21)
+    ref = sm.conv_block_3d(3, 6, activation_type="LeakyReLU")
+    ref.train()
+
+    m = Conv3DBlock(features=6, activation="leaky_relu")
+    x0 = np.random.default_rng(0).random((2, 4, 6, 6, 3)).astype(np.float32)
+    variables = m.init(jax.random.PRNGKey(0), jnp.asarray(x0))
+    params = jax.tree.map(np.asarray, variables["params"])
+    # torch Conv3d weight [Cout, Cin, kD, kH, kW] -> flax [kD,kH,kW,Cin,Cout]
+    params["Conv_0"] = {
+        "kernel": ref[0].weight.detach().numpy().transpose(2, 3, 4, 1, 0),
+        "bias": ref[0].bias.detach().numpy(),
+    }
+    params["TorchBatchNorm_0"] = {
+        "scale": ref[1].weight.detach().numpy(),
+        "bias": ref[1].bias.detach().numpy(),
+    }
+    stats = variables["batch_stats"]
+
+    rng = np.random.default_rng(1)
+    for step in range(2):
+        x = rng.random((2, 4, 6, 6, 3)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3))))
+        y_ours, mut = m.apply(
+            {"params": params, "batch_stats": stats},
+            jnp.asarray(x), train=True, mutable=["batch_stats"],
+        )
+        stats = mut["batch_stats"]
+        np.testing.assert_allclose(
+            np.asarray(y_ours),
+            y_ref.permute(0, 2, 3, 4, 1).numpy(),
+            atol=2e-5, rtol=1e-4, err_msg=f"train fwd {step}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats["TorchBatchNorm_0"]["mean"]),
+            ref[1].running_mean.numpy(), atol=1e-6, rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats["TorchBatchNorm_0"]["var"]),
+            ref[1].running_var.numpy(), atol=1e-6, rtol=1e-5,
+        )
+
+    ref.eval()
+    x = rng.random((2, 4, 6, 6, 3)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3))))
+    y_ours = m.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x), train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 4, 1).numpy(),
+        atol=2e-5, rtol=1e-4,
+    )
+
+
+def test_deconv3d_block_matches_reference_executed():
+    """Executed reference deconv_block_3d (ConvTranspose3d stride 2 +
+    BatchNorm3d + LeakyReLU, submodules.py:536-552) vs Deconv3DBlock.
+    torch ConvTranspose3d weight [Cin, Cout, k,k,k] maps to the flax
+    ConvTranspose kernel by spatial transpose + FLIP (torch deconv is
+    gradient-of-conv; lax.conv_transpose applies the kernel unflipped)."""
+    torch, sm = _ref_submodules()
+    torch.manual_seed(22)
+    ref = sm.deconv_block_3d(3, 5, activation_type="LeakyReLU")
+    ref.train()
+
+    m = Deconv3DBlock(features=5, activation="leaky_relu")
+    x0 = np.random.default_rng(0).random((1, 3, 4, 5, 3)).astype(np.float32)
+    variables = m.init(jax.random.PRNGKey(0), jnp.asarray(x0))
+    params = jax.tree.map(np.asarray, variables["params"])
+    w = ref[0].weight.detach().numpy()  # [Cin, Cout, kD, kH, kW]
+    params["ConvTranspose_0"] = {
+        "kernel": w.transpose(2, 3, 4, 0, 1)[::-1, ::-1, ::-1].copy(),
+        "bias": ref[0].bias.detach().numpy(),
+    }
+    params["TorchBatchNorm_0"] = {
+        "scale": ref[1].weight.detach().numpy(),
+        "bias": ref[1].bias.detach().numpy(),
+    }
+    stats = variables["batch_stats"]
+
+    x = np.random.default_rng(2).random((1, 3, 4, 5, 3)).astype(np.float32)
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3))))
+    y_ours, mut = m.apply(
+        {"params": params, "batch_stats": stats},
+        jnp.asarray(x), train=True, mutable=["batch_stats"],
+    )
+    assert y_ours.shape[1:4] == (6, 8, 10)  # x2 upsampling
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 4, 1).numpy(),
+        atol=2e-5, rtol=1e-4,
+    )
+
+    ref.eval()
+    y_ref2 = ref(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))).detach()
+    y_ours2 = m.apply(
+        {"params": params, "batch_stats": mut["batch_stats"]},
+        jnp.asarray(x), train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours2), y_ref2.permute(0, 2, 3, 4, 1).numpy(),
+        atol=2e-5, rtol=1e-4,
+    )
