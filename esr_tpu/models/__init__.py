@@ -1,5 +1,6 @@
 from .layers import (
     ConvLayer,
+    ConvLayer1D,
     TorchBatchNorm,
     TorchInstanceNorm,
     TransposedConvLayer,

@@ -33,6 +33,7 @@ from flax import linen as nn
 from esr_tpu.models.layers import (
     TorchBatchNorm,
     get_activation,
+    torch_conv_bias_init,
     torch_uniform_init,
 )
 
@@ -145,9 +146,12 @@ class Conv3DBlock(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         k, s, p = self.kernel_size, self.stride, self.padding
+        cin = x.shape[-1]
         x = nn.Conv(
             self.features, (k, k, k), strides=(s, s, s),
             padding=((p, p),) * 3,
+            kernel_init=torch_uniform_init(),
+            bias_init=torch_conv_bias_init(cin * k**3),
         )(x)
         if self.norm == "BN":
             x = TorchBatchNorm()(x, train)
@@ -170,10 +174,17 @@ class Deconv3DBlock(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         k, p = self.kernel_size, self.padding
+        # torch ConvTranspose3d weight is (in, out, k,k,k): default init
+        # fan_in is out*k^3, NOT in*k^3 (same rule as TransposedConvLayer)
+        fan_in = self.features * k**3
         # torch ConvTranspose3d(stride=2, output_padding=1): out = 2*in
         x = nn.ConvTranspose(
             self.features, (k, k, k), strides=(2, 2, 2),
             padding=((k - 1 - p, k - p),) * 3,
+            kernel_init=lambda key, shape, dtype=jnp.float32: jax.random.uniform(
+                key, shape, dtype, -1.0 / fan_in**0.5, 1.0 / fan_in**0.5
+            ),
+            bias_init=torch_conv_bias_init(fan_in),
         )(x)
         if self.norm == "BN":
             x = TorchBatchNorm()(x, train)
@@ -181,6 +192,58 @@ class Deconv3DBlock(nn.Module):
             x = nn.GroupNorm(num_groups=None, group_size=1)(x)
         act = get_activation(self.activation)
         return act(x) if act is not None else x
+
+
+class Conv3DBlock2(nn.Module):
+    """``conv_block_2_3d`` (``submodules.py:554-559``): two conv blocks
+    (channel-preserving then projecting) followed by MaxPool3d."""
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 1
+    pool_kernel: int = 2
+    pool_stride: int = 2
+    pool_padding: int = 0
+    activation: Optional[str] = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        cin = x.shape[-1]
+        x = Conv3DBlock(
+            cin, self.kernel_size, self.stride, self.padding,
+            self.activation,
+        )(x, train)
+        x = Conv3DBlock(
+            self.features, self.kernel_size, self.stride, self.padding,
+            self.activation,
+        )(x, train)
+        pk, ps, pp = self.pool_kernel, self.pool_stride, self.pool_padding
+        return nn.max_pool(
+            x, (pk,) * 3, strides=(ps,) * 3, padding=((pp, pp),) * 3
+        )
+
+
+class Deconv3DBlock2(nn.Module):
+    """``deconv_block_2_3d`` (``submodules.py:561-565``): deconv block +
+    two LeakyReLU conv blocks (the reference hard-codes the trailing
+    blocks' activation)."""
+
+    features: int
+    kernel_size: int = 3
+    padding: int = 1
+    activation: Optional[str] = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        x = Deconv3DBlock(
+            self.features, self.kernel_size, self.padding, self.activation
+        )(x, train)
+        for _ in range(2):
+            x = Conv3DBlock(
+                self.features, 3, 1, 1, "leaky_relu"
+            )(x, train)
+        return x
 
 
 def batch_distance_matrix(a: Array, b: Array) -> Array:

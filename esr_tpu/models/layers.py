@@ -130,15 +130,18 @@ class TorchBatchNorm(nn.Module):
 
 
 class TorchInstanceNorm(nn.Module):
-    """``torch.nn.InstanceNorm2d(affine=False, track_running_stats=True)``
-    on NHWC — the exact variant the reference ConvLayer family constructs
-    (``models/submodules.py:189``).
+    """``torch.nn.InstanceNorm{1,2}d(affine=False, track_running_stats=True)``
+    on ``[B, *spatial, C]`` — the exact variant the reference ConvLayer
+    family constructs (``models/submodules.py:144,189``); the spatial axes
+    are everything between batch and channel, so the same module covers
+    ``[B, N, C]`` (1d) and ``[B, H, W, C]`` (2d).
 
     Train mode normalizes each instance with its own spatial moments;
     running stats blend the batch-mean of per-instance stats (variance
-    Bessel-corrected with n = H·W) and are what EVAL mode normalizes with —
-    semantics pinned empirically against torch and by the executed-reference
-    parity test. No affine parameters (torch's InstanceNorm default).
+    Bessel-corrected with n = prod(spatial)) and are what EVAL mode
+    normalizes with — semantics pinned empirically against torch and by the
+    executed-reference parity tests. No affine parameters (torch's
+    InstanceNorm default).
     """
 
     momentum: float = 0.1
@@ -154,22 +157,25 @@ class TorchInstanceNorm(nn.Module):
             "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
         )
         xf = x.astype(jnp.float32)
+        # spatial axes: everything between batch and channel, so the same
+        # module covers InstanceNorm1d ([B, N, C]) and 2d ([B, H, W, C])
+        red = tuple(range(1, x.ndim - 1))
         if train:
-            mean_i = jnp.mean(xf, axis=(1, 2), keepdims=True)  # [B,1,1,C]
+            mean_i = jnp.mean(xf, axis=red, keepdims=True)
             var_i = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=(1, 2), keepdims=True)
+                jnp.mean(jnp.square(xf), axis=red, keepdims=True)
                 - jnp.square(mean_i),
                 0.0,
             )
-            n = x.shape[1] * x.shape[2]
+            n = int(np.prod([x.shape[a] for a in red]))
             if not self.is_initializing():
                 m = self.momentum
                 bessel = n / (n - 1) if n > 1 else 1.0
                 ra_mean.value = (1.0 - m) * ra_mean.value + m * jnp.mean(
-                    mean_i[:, 0, 0, :], axis=0
+                    mean_i.reshape(x.shape[0], c), axis=0
                 )
                 ra_var.value = (1.0 - m) * ra_var.value + m * jnp.mean(
-                    var_i[:, 0, 0, :] * bessel, axis=0
+                    var_i.reshape(x.shape[0], c) * bessel, axis=0
                 )
             y = (xf - mean_i) * jax.lax.rsqrt(var_i + self.epsilon)
         else:
@@ -211,6 +217,29 @@ def apply_seq(layers: Sequence[Any], x: Array, train: bool = False) -> Array:
     return x
 
 
+def _conv_norm_act(mod, x: Array, train: bool, rank: int) -> Array:
+    """Shared conv + norm + activation body for ConvLayer (rank 2) and
+    ConvLayer1D (rank 1): torch default init, conv bias dropped under BN,
+    norm through _NormWrapper. Constructed inside the calling module's
+    compact scope, so param names (``Conv_0``, ``_NormWrapper_0``) are
+    unchanged."""
+    k = mod.kernel_size
+    cin = x.shape[-1]
+    use_bias = mod.norm != "BN"
+    x = nn.Conv(
+        mod.features,
+        (k,) * rank,
+        strides=(mod.stride,) * rank,
+        padding=((mod.padding, mod.padding),) * rank,
+        use_bias=use_bias,
+        kernel_init=torch_uniform_init(),
+        bias_init=torch_conv_bias_init(cin * k**rank),
+    )(x)
+    x = _NormWrapper(mod.norm, mod.bn_momentum)(x, train)
+    act = get_activation(mod.activation)
+    return act(x) if act is not None else x
+
+
 class ConvLayer(nn.Module):
     """Conv2d + optional norm + activation (reference ``submodules.py:158-199``)."""
 
@@ -224,21 +253,29 @@ class ConvLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
-        k = self.kernel_size
-        cin = x.shape[-1]
-        use_bias = self.norm != "BN"
-        x = nn.Conv(
-            self.features,
-            (k, k),
-            strides=(self.stride, self.stride),
-            padding=((self.padding, self.padding), (self.padding, self.padding)),
-            use_bias=use_bias,
-            kernel_init=torch_uniform_init(),
-            bias_init=torch_conv_bias_init(cin * k * k),
-        )(x)
-        x = _NormWrapper(self.norm, self.bn_momentum)(x, train)
-        act = get_activation(self.activation)
-        return act(x) if act is not None else x
+        return _conv_norm_act(self, x, train, rank=2)
+
+
+class ConvLayer1D(nn.Module):
+    """Conv1d + optional norm + activation on ``[B, N, C]``
+    (reference ``submodules.py:115-158``; torch layout ``[B, C, N]``).
+
+    Same norm contract as ConvLayer: ``'BN'`` == BatchNorm1d,
+    ``'IN'`` == InstanceNorm1d(track_running_stats=True), conv bias dropped
+    under BN.
+    """
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+    bn_momentum: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        return _conv_norm_act(self, x, train, rank=1)
 
 
 class TransposedConvLayer(nn.Module):

@@ -298,3 +298,151 @@ def test_deconv3d_block_matches_reference_executed():
         np.asarray(y_ours2), y_ref2.permute(0, 2, 3, 4, 1).numpy(),
         atol=2e-5, rtol=1e-4,
     )
+
+
+@pytest.mark.parametrize("norm", ["BN", "IN", None])
+def test_convlayer1d_matches_reference_executed(norm):
+    """Executed reference ConvLayer1D (submodules.py:115-158) for all three
+    norm options — BN==BatchNorm1d, IN==InstanceNorm1d(track_running_stats),
+    train + running stats + eval."""
+    torch, sm = _ref_submodules()
+    from esr_tpu.models.layers import ConvLayer1D
+
+    torch.manual_seed(31)
+    ref = sm.ConvLayer1D(
+        3, 6, kernel_size=3, stride=2, padding=1, activation="relu",
+        norm=norm,
+    )
+    ref.train()
+
+    ours = ConvLayer1D(6, 3, stride=2, padding=1, activation="relu",
+                       norm=norm)
+    x0 = np.random.default_rng(0).random((2, 9, 3)).astype(np.float32)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x0))
+    params = jax.tree.map(np.asarray, variables["params"])
+    conv = {"kernel": ref.conv1d.weight.detach().numpy().transpose(2, 1, 0)}
+    if ref.conv1d.bias is not None:
+        conv["bias"] = ref.conv1d.bias.detach().numpy()
+    params["Conv_0"] = conv
+    if norm == "BN":
+        wrapper = next(k for k in params if k.startswith("_NormWrapper"))
+        params[wrapper]["TorchBatchNorm_0"] = {
+            "scale": ref.norm_layer.weight.detach().numpy(),
+            "bias": ref.norm_layer.bias.detach().numpy(),
+        }
+    stats = variables.get("batch_stats")
+
+    rng = np.random.default_rng(1)
+    for step in range(2):
+        x = rng.random((2, 9, 3)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(np.transpose(x, (0, 2, 1))))
+        if stats is None:
+            y_ours = ours.apply({"params": params}, jnp.asarray(x))
+        else:
+            y_ours, mut = ours.apply(
+                {"params": params, "batch_stats": stats},
+                jnp.asarray(x), train=True, mutable=["batch_stats"],
+            )
+            stats = mut["batch_stats"]
+            wrapper = next(iter(stats))
+            norm_node = stats[wrapper][next(iter(stats[wrapper]))]
+            np.testing.assert_allclose(
+                np.asarray(norm_node["mean"]),
+                ref.norm_layer.running_mean.numpy(),
+                atol=1e-6, rtol=1e-5,
+            )
+            np.testing.assert_allclose(
+                np.asarray(norm_node["var"]),
+                ref.norm_layer.running_var.numpy(),
+                atol=1e-6, rtol=1e-5,
+            )
+        np.testing.assert_allclose(
+            np.asarray(y_ours), y_ref.permute(0, 2, 1).numpy(),
+            atol=2e-5, rtol=1e-4, err_msg=f"{norm} train fwd {step}",
+        )
+
+    if stats is not None:
+        ref.eval()
+        x = rng.random((2, 9, 3)).astype(np.float32)
+        with torch.no_grad():
+            y_ref = ref(torch.from_numpy(np.transpose(x, (0, 2, 1))))
+        y_ours = ours.apply(
+            {"params": params, "batch_stats": stats}, jnp.asarray(x),
+            train=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(y_ours), y_ref.permute(0, 2, 1).numpy(),
+            atol=2e-5, rtol=1e-4, err_msg=f"{norm} eval fwd",
+        )
+
+
+def test_conv3d_composites_match_reference_executed():
+    """conv_block_2_3d / deconv_block_2_3d (submodules.py:554-565): the
+    pooled double-conv and deconv+2conv composites, executed side-by-side
+    (train mode; BN stats thread through all sub-blocks)."""
+    torch, sm = _ref_submodules()
+    from esr_tpu.models.extended import Conv3DBlock2, Deconv3DBlock2
+
+    torch.manual_seed(41)
+    ref = sm.conv_block_2_3d(3, 6)
+    ref.train()
+    ours = Conv3DBlock2(features=6)
+    x = np.random.default_rng(5).random((1, 4, 8, 8, 3)).astype(np.float32)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    params = jax.tree.map(np.asarray, variables["params"])
+    for i, blk in enumerate([ref[0], ref[1]]):
+        params[f"Conv3DBlock_{i}"]["Conv_0"] = {
+            "kernel": blk[0].weight.detach().numpy().transpose(2, 3, 4, 1, 0),
+            "bias": blk[0].bias.detach().numpy(),
+        }
+        params[f"Conv3DBlock_{i}"]["TorchBatchNorm_0"] = {
+            "scale": blk[1].weight.detach().numpy(),
+            "bias": blk[1].bias.detach().numpy(),
+        }
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3))))
+    y_ours, _ = ours.apply(
+        {"params": params, "batch_stats": variables["batch_stats"]},
+        jnp.asarray(x), train=True, mutable=["batch_stats"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 4, 1).numpy(),
+        atol=2e-5, rtol=1e-4,
+    )
+
+    torch.manual_seed(42)
+    ref2 = sm.deconv_block_2_3d(3, 5)
+    ref2.train()
+    ours2 = Deconv3DBlock2(features=5)
+    x2 = np.random.default_rng(6).random((1, 3, 4, 4, 3)).astype(np.float32)
+    variables2 = ours2.init(jax.random.PRNGKey(0), jnp.asarray(x2))
+    params2 = jax.tree.map(np.asarray, variables2["params"])
+    w = ref2[0][0].weight.detach().numpy()
+    params2["Deconv3DBlock_0"]["ConvTranspose_0"] = {
+        "kernel": w.transpose(2, 3, 4, 0, 1)[::-1, ::-1, ::-1].copy(),
+        "bias": ref2[0][0].bias.detach().numpy(),
+    }
+    params2["Deconv3DBlock_0"]["TorchBatchNorm_0"] = {
+        "scale": ref2[0][1].weight.detach().numpy(),
+        "bias": ref2[0][1].bias.detach().numpy(),
+    }
+    for i, blk in enumerate([ref2[1], ref2[2]]):
+        params2[f"Conv3DBlock_{i}"]["Conv_0"] = {
+            "kernel": blk[0].weight.detach().numpy().transpose(2, 3, 4, 1, 0),
+            "bias": blk[0].bias.detach().numpy(),
+        }
+        params2[f"Conv3DBlock_{i}"]["TorchBatchNorm_0"] = {
+            "scale": blk[1].weight.detach().numpy(),
+            "bias": blk[1].bias.detach().numpy(),
+        }
+    with torch.no_grad():
+        y_ref2 = ref2(torch.from_numpy(np.transpose(x2, (0, 4, 1, 2, 3))))
+    y_ours2, _ = ours2.apply(
+        {"params": params2, "batch_stats": variables2["batch_stats"]},
+        jnp.asarray(x2), train=True, mutable=["batch_stats"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ours2), y_ref2.permute(0, 2, 3, 4, 1).numpy(),
+        atol=2e-5, rtol=1e-4,
+    )
