@@ -145,3 +145,50 @@ def test_mlp_layer_sizes():
     x = jnp.zeros((4, 16))
     y = m.apply(m.init(jax.random.PRNGKey(0), x), x)
     assert y.shape == (4, 32)
+
+
+def test_transposed_conv_layer_matches_reference_executed():
+    """Weight-level executed parity for TransposedConvLayer (reference
+    submodules.py:203-251, ConvTranspose2d stride=2 output_padding=1):
+    torch weight [Cin, Cout, kh, kw] -> flax kernel by spatial transpose +
+    FLIP (torch deconv is gradient-of-conv; lax.conv_transpose applies the
+    kernel unflipped). Odd input size exercises the asymmetric padding."""
+    import os
+
+    torch = pytest.importorskip("torch")
+    if not os.path.isdir("/root/reference"):
+        pytest.skip("reference checkout not mounted")
+    import sys
+
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    from conftest import shim_reference_imports
+
+    shim_reference_imports("/root/reference")
+    import models.submodules as sm
+
+    from esr_tpu.models.layers import TransposedConvLayer
+
+    torch.manual_seed(13)
+    ref = sm.TransposedConvLayer(3, 5, kernel_size=3, padding=1,
+                                 activation="relu", norm=None)
+    ref.eval()
+
+    ours = TransposedConvLayer(5, 3, padding=1, activation="relu")
+    x = np.random.default_rng(8).standard_normal((2, 7, 9, 3)).astype(
+        np.float32)
+    variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    params = jax.tree.map(np.asarray, variables["params"])
+    w = ref.transposed_conv2d.weight.detach().numpy()  # [Cin, Cout, kh, kw]
+    params["ConvTranspose_0"] = {
+        "kernel": w.transpose(2, 3, 0, 1)[::-1, ::-1].copy(),
+        "bias": ref.transposed_conv2d.bias.detach().numpy(),
+    }
+    with torch.no_grad():
+        y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+    y_ours = ours.apply({"params": params}, jnp.asarray(x))
+    assert y_ours.shape[1:3] == (14, 18)  # exact x2
+    np.testing.assert_allclose(
+        np.asarray(y_ours), y_ref.permute(0, 2, 3, 1).numpy(),
+        atol=2e-5, rtol=1e-4,
+    )
