@@ -69,6 +69,23 @@ def torch_conv_to_flax(w, b=None):
     return out
 
 
+def torch_deconv_to_flax(w, b=None, spatial_rank=2):
+    """torch ConvTranspose ``weight [Cin, Cout, *k]`` -> flax ConvTranspose
+    ``{kernel [*k, Cin, Cout], bias}``. Torch deconv is gradient-of-conv
+    (kernel implicitly flipped); ``lax.conv_transpose`` applies the kernel
+    unflipped, so the mapping is spatial transpose + FLIP."""
+    import numpy as np
+
+    arr = w.detach().numpy()
+    perm = tuple(range(2, 2 + spatial_rank)) + (0, 1)
+    k = arr.transpose(perm)
+    k = k[(slice(None, None, -1),) * spatial_rank].copy()
+    out = {"kernel": k}
+    if b is not None:
+        out["bias"] = b.detach().numpy()
+    return out
+
+
 def shim_model_imports(ref_root: str):
     """:func:`shim_reference_imports` + the stubs the reference's MODEL
     stack needs (``models/model.py`` star-import chain). Returns the

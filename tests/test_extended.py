@@ -264,11 +264,11 @@ def test_deconv3d_block_matches_reference_executed():
     x0 = np.random.default_rng(0).random((1, 3, 4, 5, 3)).astype(np.float32)
     variables = m.init(jax.random.PRNGKey(0), jnp.asarray(x0))
     params = jax.tree.map(np.asarray, variables["params"])
-    w = ref[0].weight.detach().numpy()  # [Cin, Cout, kD, kH, kW]
-    params["ConvTranspose_0"] = {
-        "kernel": w.transpose(2, 3, 4, 0, 1)[::-1, ::-1, ::-1].copy(),
-        "bias": ref[0].bias.detach().numpy(),
-    }
+    from conftest import torch_deconv_to_flax
+
+    params["ConvTranspose_0"] = torch_deconv_to_flax(
+        ref[0].weight, ref[0].bias, spatial_rank=3
+    )
     params["TorchBatchNorm_0"] = {
         "scale": ref[1].weight.detach().numpy(),
         "bias": ref[1].bias.detach().numpy(),
@@ -418,11 +418,11 @@ def test_conv3d_composites_match_reference_executed():
     x2 = np.random.default_rng(6).random((1, 3, 4, 4, 3)).astype(np.float32)
     variables2 = ours2.init(jax.random.PRNGKey(0), jnp.asarray(x2))
     params2 = jax.tree.map(np.asarray, variables2["params"])
-    w = ref2[0][0].weight.detach().numpy()
-    params2["Deconv3DBlock_0"]["ConvTranspose_0"] = {
-        "kernel": w.transpose(2, 3, 4, 0, 1)[::-1, ::-1, ::-1].copy(),
-        "bias": ref2[0][0].bias.detach().numpy(),
-    }
+    from conftest import torch_deconv_to_flax
+
+    params2["Deconv3DBlock_0"]["ConvTranspose_0"] = torch_deconv_to_flax(
+        ref2[0][0].weight, ref2[0][0].bias, spatial_rank=3
+    )
     params2["Deconv3DBlock_0"]["TorchBatchNorm_0"] = {
         "scale": ref2[0][1].weight.detach().numpy(),
         "bias": ref2[0][1].bias.detach().numpy(),

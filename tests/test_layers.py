@@ -158,11 +158,7 @@ def test_transposed_conv_layer_matches_reference_executed():
     torch = pytest.importorskip("torch")
     if not os.path.isdir("/root/reference"):
         pytest.skip("reference checkout not mounted")
-    import sys
-
-    if "/root/reference" not in sys.path:
-        sys.path.insert(0, "/root/reference")
-    from conftest import shim_reference_imports
+    from conftest import shim_reference_imports, torch_deconv_to_flax
 
     shim_reference_imports("/root/reference")
     import models.submodules as sm
@@ -179,11 +175,9 @@ def test_transposed_conv_layer_matches_reference_executed():
         np.float32)
     variables = ours.init(jax.random.PRNGKey(0), jnp.asarray(x))
     params = jax.tree.map(np.asarray, variables["params"])
-    w = ref.transposed_conv2d.weight.detach().numpy()  # [Cin, Cout, kh, kw]
-    params["ConvTranspose_0"] = {
-        "kernel": w.transpose(2, 3, 0, 1)[::-1, ::-1].copy(),
-        "bias": ref.transposed_conv2d.bias.detach().numpy(),
-    }
+    params["ConvTranspose_0"] = torch_deconv_to_flax(
+        ref.transposed_conv2d.weight, ref.transposed_conv2d.bias
+    )
     with torch.no_grad():
         y_ref = ref(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
     y_ours = ours.apply({"params": params}, jnp.asarray(x))
