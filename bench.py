@@ -1,24 +1,39 @@
 """Benchmark: train steps/sec + MFU + end-to-end loader throughput, one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+STAGED AND WEDGE-PROOF (VERDICT r3 item 1): every stage prints+flushes its
+own ``{"stage": ...}`` JSON line the moment it completes and appends it to
+``artifacts/BENCH_STAGES_r04.jsonl``, so a tunnel that lives for even two
+minutes leaves partial artifacts. A re-armable watchdog guards every stage;
+on timeout it emits the headline line with whatever extras already exist
+before exiting (the observed wedge — ``make_c_api_client`` blocking forever
+— releases the GIL, so a timer thread does fire).
 
-Three measurements (VERDICT round-1 item 6):
-- ``steps_per_sec``: the jit'd train step on device-resident batches — the
+The LAST line on stdout is always the single headline JSON the driver
+parses: ``{"metric", "value", "unit", "vs_baseline", "extra"}``.
+
+Stage order (most diagnostic value first):
+- ``backend_up``: device enumeration + one executed op — the wedge detector.
+- ``mosaic_dcn``: the fused Pallas DCNv2 forward+backward compiled with
+  ``interpret=False`` by REAL Mosaic at the flagship bottleneck shape,
+  numerically pinned against the jnp path on-chip (VERDICT r3 item 2 — this
+  kernel had only ever met the interpreter).
+- ``compute``: jit'd train step on device-resident batches — the
   pure-compute ceiling. Config mirrors the reference recipe (BASELINE.md):
   DeepRecurrNet inch=2 basech=8, seqn=3, batch=2/chip, seq_len=8 BPTT
   windows, 2x SR on the down16 NFS ladder (LR 45x80 -> HR 90x160), Adam +
-  gated exponential schedule.
-- ``mfu``: achieved FLOP/s from XLA's own cost model
-  (``compiled.cost_analysis()['flops']`` x steps/s) over the chip's peak.
-- ``e2e_steps_per_sec``: the same step fed by the REAL host pipeline
-  (synthetic HDF5 recording -> windowing -> rasterization -> collate ->
+  gated exponential schedule. Reports steps/s + MFU (XLA cost-model flops
+  x steps/s over chip peak).
+- ``bf16``: same step with bfloat16 compute (the MXU-native option).
+- ``dcn_ab``: fused Pallas DCNv2 vs jnp gather formulation, forward and
+  training direction (fwd + full VJP under grad).
+- ``e2e`` / ``e2e_device_raster``: the same step fed by the REAL host
+  pipeline (synthetic HDF5 -> windowing -> rasterization -> collate ->
   device), the input-starvation check SURVEY §7.3-6 calls the main
-  steps/sec risk.
-- ``dcn_pallas_speedup``: fused Pallas DCNv2 kernel vs the jnp gather
-  formulation at the model's bottleneck shape (forward-only, the round-2
-  meaning); ``dcn_pallas_train_speedup``: same A/B in the training
-  direction — forward + full VJP under ``jax.grad``, both directions fused
-  since round 3.
+  steps/sec risk; the device_raster variant ships raw padded events and
+  rasterizes inside the jit'd step.
+- ``scaling``: per-chip batch scaling curve b2/b8/b16 (is the small MFU
+  small-batch arithmetic intensity or a pipeline problem?).
+- ``breakdown``: fwd / fwd+bwd / optimizer cost centers in ms.
 
 vs_baseline stays null until a measured reference-GPU number exists
 (the reference repo publishes none — BASELINE.md).
@@ -26,12 +41,20 @@ vs_baseline stays null until a measured reference-GPU number exists
 
 import json
 import os
+import sys
 import tempfile
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+_STAGELOG = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "artifacts",
+    # smoke runs (plumbing checks on CPU) must never pollute the real artifact
+    "BENCH_STAGES_smoke.jsonl" if os.environ.get("ESR_BENCH_SMOKE")
+    else "BENCH_STAGES_r04.jsonl",
+)
 
 # peak dense f32-accumulated matmul throughput per chip (bf16 inputs)
 _PEAK_FLOPS = {
@@ -41,8 +64,100 @@ _PEAK_FLOPS = {
     "TPU v6 lite": 918e12,  # v6e
 }
 
+# accumulated across stages; the headline line is assembled from this and
+# printed last (including by the watchdog on a mid-run hang)
+EXTRA = {}
+HEADLINE = {"value": None}
 
-def _peak_flops() -> float:
+
+def _emit(rec):
+    from esr_tpu.utils.artifacts import emit_jsonl
+
+    emit_jsonl(_STAGELOG, rec)
+
+
+def _print_headline():
+    print(json.dumps({
+        "metric": "train_steps_per_sec_per_chip_seqlen8",
+        "value": HEADLINE["value"],
+        "unit": "steps/s",
+        "vs_baseline": None,
+        "extra": EXTRA,
+    }))
+    sys.stdout.flush()
+
+
+class _Watchdog:
+    """Re-armable per-stage timeout. On fire: record the stage timeout,
+    print the headline with all extras gathered so far, exit 2."""
+
+    def __init__(self):
+        self._timer = None
+
+    def arm(self, seconds, stage_name, done_flag):
+        self.disarm()
+
+        def _fire():
+            # the stage finished in the window between fn() returning and
+            # disarm(): not a timeout, don't kill a successful run
+            if done_flag[0]:
+                return
+            try:
+                EXTRA.setdefault("error", f"stage {stage_name!r} timed out "
+                                          f"after {seconds:.0f}s")
+                _emit({"stage": stage_name, "ok": False,
+                       "error": f"timed out after {seconds:.0f}s"})
+                _print_headline()
+            except Exception:  # noqa: BLE001 - e.g. EXTRA mutated mid-dumps
+                try:
+                    print(json.dumps({
+                        "metric": "train_steps_per_sec_per_chip_seqlen8",
+                        "value": HEADLINE["value"], "unit": "steps/s",
+                        "vs_baseline": None,
+                        "extra": {"error": f"stage {stage_name!r} timeout"},
+                    }))
+                    sys.stdout.flush()
+                except Exception:  # noqa: BLE001
+                    pass
+            os._exit(2)
+
+        self._timer = threading.Timer(seconds, _fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def disarm(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+_WD = _Watchdog()
+
+
+def _stage(name, fn, timeout):
+    """Run one stage under the watchdog; emit its record either way.
+    Returns the stage's dict (merged into the record) or None on error."""
+    done = [False]
+    _WD.arm(timeout, name, done)
+    t0 = time.perf_counter()
+    try:
+        out = fn() or {}
+        rec = {"stage": name, "ok": True,
+               "elapsed_s": round(time.perf_counter() - t0, 1), **out}
+    except Exception as e:  # noqa: BLE001 - a failed stage must not kill the run
+        out = None
+        rec = {"stage": name, "ok": False,
+               "elapsed_s": round(time.perf_counter() - t0, 1),
+               "error": repr(e)}
+    done[0] = True
+    _WD.disarm()
+    _emit(rec)
+    return out
+
+
+def _peak_flops():
+    import jax
+
     kind = jax.devices()[0].device_kind
     for prefix, peak in _PEAK_FLOPS.items():
         if kind.startswith(prefix):
@@ -58,6 +173,8 @@ def _best_of_reps(run_iters, reps=3):
 
 
 def _time_steps(step, state, batch, iters=20, reps=3):
+    import jax
+
     state, metrics = step(state, batch)  # warmup/compile
     jax.block_until_ready(metrics["loss"])
     carry = {"state": state}
@@ -77,6 +194,8 @@ def _time_steps(step, state, batch, iters=20, reps=3):
 
 def _recipe_batch(b, L=10, h=90, w=160, seed=0):
     """The deterministic reference-recipe-shaped batch every stage times."""
+    import jax.numpy as jnp
+
     rng = np.random.default_rng(seed)
     return {
         "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
@@ -84,9 +203,29 @@ def _recipe_batch(b, L=10, h=90, w=160, seed=0):
     }
 
 
+def _flagship_dcn_inputs():
+    """The one flagship-bottleneck-shaped DCN input set BOTH the Mosaic
+    parity stage and the A/B timing stage use — keeping 'numerically
+    pinned' and 'timed' the same shape by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    b, h, w, c, dg = 2, 12, 20, 64, 8
+    x = jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.float32)
+    off = jnp.asarray(rng.standard_normal((b, h, w, dg, 9, 2)) * 2,
+                      jnp.float32)
+    mask = jax.nn.sigmoid(
+        jnp.asarray(rng.standard_normal((b, h, w, dg, 9)), jnp.float32))
+    wt = jnp.asarray(rng.standard_normal((3, 3, c, c)) * 0.05, jnp.float32)
+    return x, off, mask, wt
+
+
 def _flops_of(step_fn, state, batch):
     """XLA cost-analysis flops of one compiled step (None when the backend
     does not report them)."""
+    import jax
+
     try:
         compiled = jax.jit(step_fn).lower(state, batch).compile()
         costs = compiled.cost_analysis()
@@ -97,57 +236,177 @@ def _flops_of(step_fn, state, batch):
         return None
 
 
-def bench_compute():
-    """Device-resident steps/s + MFU on the reference recipe shapes."""
-    from esr_tpu.models.esr import DeepRecurrNet
-    from esr_tpu.training.optim import make_reference_optimizer
-    from esr_tpu.training.train_step import TrainState, make_train_step
+# ---------------------------------------------------------------- stages
 
-    b, L, seqn = 2, 10, 3
-    h, w = 90, 160
 
-    model = DeepRecurrNet(inch=2, basech=8, num_frame=seqn)
-    batch = _recipe_batch(b, L, h, w)
-    states = model.init_states(b, h, w)
-    params = model.init(jax.random.PRNGKey(0), batch["inp"][:, :seqn], states)
-    opt = make_reference_optimizer()
-    step_fn = make_train_step(model, opt, seqn=seqn)
-    step = jax.jit(step_fn, donate_argnums=(0,))
+def stage_backend_up():
+    """Device enumeration plus ONE executed op — proves the chip answers,
+    not just that the client object exists."""
+    import jax
+    import jax.numpy as jnp
 
-    # fresh buffers for the bf16 run below: the f32 timing donates its state,
-    # which deletes the params leaves it shares
-    params16 = jax.tree.map(jnp.array, params)
-    state = TrainState.create(params, opt)
-    flops_per_step = _flops_of(step_fn, state, batch)
+    devs = jax.devices()
+    val = float(jnp.ones(8).sum())
+    return {
+        "n_devices": len(devs),
+        "device_kind": devs[0].device_kind,
+        "platform": devs[0].platform,
+        "backend": jax.default_backend(),
+        "sanity_sum": val,
+    }
 
-    steps_per_sec, state = _time_steps(step, state, batch)
-    mfu = (
-        flops_per_step * steps_per_sec / _peak_flops()
-        if flops_per_step
-        else None
+
+def stage_mosaic_dcn():
+    """Real-Mosaic compile + numeric parity of the fused Pallas DCNv2 at the
+    flagship bottleneck shape, forward and all five cotangents (VERDICT r3
+    item 2). Also runs the tiny memoized self-test that gates the production
+    ``auto`` dispatch (``ops/dcn.py``)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "cpu backend (no Mosaic)"}
+
+    from esr_tpu.ops.dcn_pallas import (
+        dcn_parity_errors,
+        dcn_parity_ok,
+        pallas_compiles,
     )
 
-    # bf16 mixed-precision variant (the MXU-native option)
-    bf16_steps = None
-    try:
-        step16 = jax.jit(
-            make_train_step(model, opt, seqn=seqn, compute_dtype=jnp.bfloat16),
-            donate_argnums=(0,),
-        )
-        s16 = TrainState.create(params16, opt)
-        bf16_steps, _ = _time_steps(step16, s16, batch)
-    except Exception as e:  # noqa: BLE001 - report, don't kill the line
-        import sys
-
-        print(f"bench: bf16 stage failed: {e!r}", file=sys.stderr)
-    return steps_per_sec, mfu, flops_per_step, bf16_steps, model, opt, state, seqn
+    gate_ok = pallas_compiles()
+    errs = dcn_parity_errors(*_flagship_dcn_inputs(), interpret=False)
+    result = {
+        "dcn_pallas_mosaic_ok": bool(dcn_parity_ok(errs) and gate_ok),
+        "auto_dispatch_gate": gate_ok,
+        **{k: round(v, 8) for k, v in errs.items()},
+    }
+    EXTRA["dcn_pallas_mosaic"] = result
+    return result
 
 
-def bench_scaling(seqn=3, batches=(8, 16), shape=(10, 90, 160), basech=8):
+class _Ctx:
+    """Model/optimizer/state shared by the compute-side stages.
+
+    ``ESR_BENCH_SMOKE=1`` shrinks the spatial shape so the staged plumbing
+    can be validated quickly on CPU; the artifact is marked ``smoke`` so a
+    smoke line can never be mistaken for a measurement."""
+
+    def __init__(self):
+        import jax
+
+        from esr_tpu.models.esr import DeepRecurrNet
+        from esr_tpu.training.optim import make_reference_optimizer
+        from esr_tpu.training.train_step import TrainState, make_train_step
+
+        self.smoke = bool(os.environ.get("ESR_BENCH_SMOKE"))
+        self.b, self.L, self.seqn = 2, 10, 3
+        self.h, self.w = (24, 40) if self.smoke else (90, 160)
+        if self.smoke:
+            EXTRA["smoke"] = True
+        self.model = DeepRecurrNet(inch=2, basech=8, num_frame=self.seqn)
+        self.batch = _recipe_batch(self.b, self.L, h=self.h, w=self.w)
+        states = self.model.init_states(self.b, self.h, self.w)
+        params = self.model.init(
+            jax.random.PRNGKey(0), self.batch["inp"][:, :self.seqn], states)
+        self.opt = make_reference_optimizer()
+        self.step_fn = make_train_step(self.model, self.opt, seqn=self.seqn)
+        self.step = jax.jit(self.step_fn, donate_argnums=(0,))
+        # fresh buffers for the bf16 stage: the f32 timing donates its
+        # state, which deletes the params leaves it shares
+        self.params16 = jax.tree.map(jax.numpy.array, params)
+        self.state = TrainState.create(params, self.opt)
+
+
+def stage_compute(ctx):
+    """Device-resident steps/s + MFU on the reference recipe shapes."""
+    flops = _flops_of(ctx.step_fn, ctx.state, ctx.batch)
+    steps_per_sec, ctx.state = _time_steps(ctx.step, ctx.state, ctx.batch)
+    mfu = flops * steps_per_sec / _peak_flops() if flops else None
+    HEADLINE["value"] = round(steps_per_sec, 3)
+    EXTRA["mfu"] = round(mfu, 4) if mfu is not None else None
+    EXTRA["flops_per_step"] = flops
+    import jax
+
+    EXTRA["device"] = jax.devices()[0].device_kind
+    return {"steps_per_sec": round(steps_per_sec, 3),
+            "mfu": EXTRA["mfu"], "flops_per_step": flops}
+
+
+def stage_bf16(ctx):
+    """bf16 mixed-precision variant of the same step."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    step16 = jax.jit(
+        make_train_step(ctx.model, ctx.opt, seqn=ctx.seqn,
+                        compute_dtype=jnp.bfloat16),
+        donate_argnums=(0,),
+    )
+    s16 = TrainState.create(ctx.params16, ctx.opt)
+    bf16_steps, _ = _time_steps(step16, s16, ctx.batch)
+    EXTRA["bf16_steps_per_sec"] = round(bf16_steps, 3)
+    return {"steps_per_sec": EXTRA["bf16_steps_per_sec"]}
+
+
+def stage_dcn_ab():
+    """Pallas vs jnp DCNv2 at the flagship bottleneck shape.
+
+    Measured on the TRAINING direction (forward + full VJP under grad) —
+    training is mostly backward, and the backward is fused too — plus the
+    forward-only direction (the round-2 meaning, kept commensurable)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "cpu backend (interpreter timing is meaningless)"}
+
+    from esr_tpu.ops import dcn_pallas as DP
+    from esr_tpu.ops.dcn import deform_conv2d
+    from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
+
+    x, off, mask, wt = _flagship_dcn_inputs()
+
+    def timed(f, iters=50, reps=3):
+        g = jax.jit(f)
+        jax.block_until_ready(g())
+
+        def run():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = g()
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / iters
+
+        return _best_of_reps(run, reps)
+
+    def grad_of(fn):
+        def loss(x_, o_, m_, w_):
+            return (fn(x_, o_, m_, w_) ** 2).sum()
+
+        return lambda: jax.grad(loss, argnums=(0, 1, 2, 3))(x, off, mask, wt)
+
+    t_jnp_f = timed(lambda: deform_conv2d(x, off, mask, wt))
+    t_pal_f = timed(lambda: deform_conv2d_pallas(x, off, mask, wt))
+    t_jnp_g = timed(grad_of(lambda *a: deform_conv2d(*a)))
+    DP.dcn_backward_impl("pallas")
+    t_pal_g = timed(grad_of(lambda *a: deform_conv2d_pallas(*a)))
+    EXTRA["dcn_pallas_speedup"] = round(t_jnp_f / t_pal_f, 3)
+    EXTRA["dcn_pallas_train_speedup"] = round(t_jnp_g / t_pal_g, 3)
+    return {"fwd_speedup": EXTRA["dcn_pallas_speedup"],
+            "train_speedup": EXTRA["dcn_pallas_train_speedup"],
+            "jnp_fwd_ms": round(t_jnp_f * 1e3, 3),
+            "pallas_fwd_ms": round(t_pal_f * 1e3, 3),
+            "jnp_train_ms": round(t_jnp_g * 1e3, 3),
+            "pallas_train_ms": round(t_pal_g * 1e3, 3)}
+
+
+def stage_scaling(seqn=3, batches=(2, 8, 16), shape=(10, 90, 160), basech=8):
     """Per-chip batch scaling curve (VERDICT r2: is the 6.6% MFU small-batch
-    arithmetic intensity or a pipeline problem?). Returns
-    ``{f"b{n}": {"steps_per_sec": ..., "mfu": ...}}`` — b2 is the headline
-    measurement itself."""
+    arithmetic intensity or a pipeline problem?). b2 re-measures the
+    headline config with the same one-compile method as the larger batches
+    so the curve is internally commensurable (ADVICE r3)."""
+    import jax
+
     from esr_tpu.models.esr import DeepRecurrNet
     from esr_tpu.training.optim import make_reference_optimizer
     from esr_tpu.training.train_step import TrainState, make_train_step
@@ -187,17 +446,22 @@ def bench_scaling(seqn=3, batches=(8, 16), shape=(10, 90, 160), basech=8):
                 round(flops * sps / _peak_flops(), 4) if flops else None
             ),
         }
-    return out
+    EXTRA["scaling"] = out
+    return {"scaling": out}
 
 
-def bench_breakdown(model, opt, seqn, state, batch):
+def stage_breakdown(ctx):
     """Empirical cost centers: time the pieces of the train step separately
     (forward-only loss, full fwd+bwd, optimizer update) so the top centers
     are named with numbers rather than guessed. All times in ms/step."""
+    import jax
+    import jax.numpy as jnp
     import optax
 
     from esr_tpu.training.train_step import _split_vars
 
+    state, batch = ctx.state, _recipe_batch(2, ctx.L, ctx.h, ctx.w)
+    model, opt, seqn = ctx.model, ctx.opt, ctx.seqn
     param_col, stats = _split_vars(state.params)
 
     def fwd_only(params, batch):
@@ -246,15 +510,19 @@ def bench_breakdown(model, opt, seqn, state, batch):
     out["bwd_minus_fwd_ms"] = round(
         out["train_step_ms"] - out["fwd_ms"] - out["optimizer_ms"], 3
     )
+    EXTRA["breakdown_ms"] = out
     return out
 
 
-def bench_e2e(model, opt, seqn, device_rasterize=False):
+def stage_e2e(ctx, device_rasterize=False):
     """Steps/s with the real HDF5 loader in the loop (starvation check).
 
     ``device_rasterize=True`` measures the raw-event feed: the host only
     pads event windows; scatter-add runs inside the jit'd step.
     """
+    import jax
+    import jax.numpy as jnp
+
     from esr_tpu.data.loader import ConcatSequenceDataset, SequenceLoader
     from esr_tpu.data.synthetic import write_synthetic_h5
     from esr_tpu.training.train_step import (
@@ -263,6 +531,7 @@ def bench_e2e(model, opt, seqn, device_rasterize=False):
         make_train_step,
     )
 
+    model, opt, seqn = ctx.model, ctx.opt, ctx.seqn
     cfg = {
         "scale": 2,
         "ori_scale": "down16",
@@ -315,7 +584,7 @@ def bench_e2e(model, opt, seqn, device_rasterize=False):
         it = batches()
 
         if device_rasterize:
-            def stage(bt):
+            def stage_batch(bt):
                 return {
                     "inp_events": jnp.asarray(bt["inp_norm_events"]),
                     "inp_valid": jnp.asarray(bt["inp_events_valid"]),
@@ -323,13 +592,13 @@ def bench_e2e(model, opt, seqn, device_rasterize=False):
                     "gt_valid": jnp.asarray(bt["gt_events_valid"]),
                 }
         else:
-            def stage(bt):
+            def stage_batch(bt):
                 return {
                     "inp": jnp.asarray(bt["inp_scaled_cnt"]),
                     "gt": jnp.asarray(bt["gt_cnt"]),
                 }
 
-        first = stage(next(it))
+        first = stage_batch(next(it))
         states = model.init_states(2, kh, kw)
         dummy = jnp.zeros((2, seqn, kh, kw, 2), jnp.float32)
         params = model.init(jax.random.PRNGKey(0), dummy, states)
@@ -340,151 +609,62 @@ def bench_e2e(model, opt, seqn, device_rasterize=False):
         iters = 12
         t0 = time.perf_counter()
         for _ in range(iters):
-            state, m = step(state, stage(next(it)))
+            state, m = step(state, stage_batch(next(it)))
         jax.block_until_ready(m["loss"])
-        return iters / (time.perf_counter() - t0)
-
-
-def bench_dcn():
-    """Pallas vs jnp DCNv2 at the flagship bottleneck shape.
-
-    Measured on the TRAINING direction (forward + full VJP under
-    value_and_grad) — training is mostly backward, and since round 3 the
-    backward is fused too (``dcn_pallas._pallas_backward``). Returns
-    ``(train_speedup, fwd_speedup)``.
-    """
-    from esr_tpu.ops import dcn_pallas as DP
-    from esr_tpu.ops.dcn import deform_conv2d
-    from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
-
-    if jax.default_backend() == "cpu":
-        return None
-    rng = np.random.default_rng(0)
-    b, h, w, c, dg = 2, 12, 20, 64, 8
-    x = jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.float32)
-    off = jnp.asarray(rng.standard_normal((b, h, w, dg, 9, 2)) * 2, jnp.float32)
-    mask = jax.nn.sigmoid(jnp.asarray(rng.standard_normal((b, h, w, dg, 9)), jnp.float32))
-    wt = jnp.asarray(rng.standard_normal((3, 3, c, c)) * 0.05, jnp.float32)
-
-    def timed(f, iters=50, reps=3):
-        g = jax.jit(f)
-        jax.block_until_ready(g())
-
-        def run():
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                r = g()
-            jax.block_until_ready(r)
-            return (time.perf_counter() - t0) / iters
-
-        return _best_of_reps(run, reps)
-
-    def grad_of(fn):
-        def loss(x_, o_, m_, w_):
-            return (fn(x_, o_, m_, w_) ** 2).sum()
-
-        return lambda: jax.grad(loss, argnums=(0, 1, 2, 3))(x, off, mask, wt)
-
-    t_jnp_f = timed(lambda: deform_conv2d(x, off, mask, wt))
-    t_pal_f = timed(lambda: deform_conv2d_pallas(x, off, mask, wt))
-    t_jnp_g = timed(grad_of(lambda *a: deform_conv2d(*a)))
-    DP.dcn_backward_impl("pallas")
-    t_pal_g = timed(grad_of(lambda *a: deform_conv2d_pallas(*a)))
-    return t_jnp_g / t_pal_g, t_jnp_f / t_pal_f
+        sps = iters / (time.perf_counter() - t0)
+        key = ("e2e_device_raster_steps_per_sec" if device_rasterize
+               else "e2e_steps_per_sec")
+        EXTRA[key] = round(sps, 3)
+        return {"steps_per_sec": EXTRA[key]}
 
 
 def main():
-    # If TPU client creation hangs (a wedged tunnel blocks make_c_api_client
-    # indefinitely), still emit one parseable JSON line before bailing — a
-    # silent hang records nothing. A python timer thread suffices for THIS
-    # hang: it blocks with the GIL released (observed: faulthandler's
-    # watchdog thread fires during it); a hang that held the GIL would need
-    # an external monitor.
-    import sys
-    import threading
-
-    def _watchdog():
-        print(
-            json.dumps(
-                {
-                    "metric": "train_steps_per_sec_per_chip_seqlen8",
-                    "value": None,
-                    "unit": "steps/s",
-                    "vs_baseline": None,
-                    "extra": {"error": "timed out (TPU backend init hang?)"},
-                }
-            )
-        )
-        sys.stdout.flush()
-        os._exit(2)
-
-    timer = threading.Timer(1500.0, _watchdog)  # 25 min >> normal ~8 min
-    timer.daemon = True
-    timer.start()
-
+    # The wedge can strike during `import jax` / PJRT plugin registration,
+    # BEFORE the first stage arms its timer — cover bootstrap too.
+    boot_done = [False]
+    _WD.arm(600, "bootstrap_imports", boot_done)
     from esr_tpu.parallel.mesh import honor_platform_env
 
     honor_platform_env()
-    steps_per_sec, mfu, flops, bf16_steps, model, opt, state, seqn = (
-        bench_compute()
-    )
-    # backend init + first compiles succeeded: the covered failure mode is
-    # past; disarm so a slow (contended) sub-bench is not mislabeled a hang
-    timer.cancel()
+    boot_done[0] = True
+    _WD.disarm()
 
-    # sub-benches are best-effort: one failing stage must not kill the line
-    def best_effort(name, fn):
-        try:
-            return fn()
-        except Exception as e:  # noqa: BLE001
-            print(f"bench: {name} stage failed: {e!r}", file=sys.stderr)
-            return None
+    # Backend contact: the covered failure mode is make_c_api_client
+    # hanging forever (wedged tunnel). 10 min is >> a healthy init.
+    up = _stage("backend_up", stage_backend_up, timeout=600)
+    if up is None:
+        _print_headline()
+        sys.exit(2)
 
-    e2e = best_effort("e2e", lambda: bench_e2e(model, opt, seqn))
-    e2e_dev = best_effort(
-        "e2e_device_raster",
-        lambda: bench_e2e(model, opt, seqn, device_rasterize=True),
-    )
-    dcn_speedups = best_effort("dcn", bench_dcn)
-    dcn_train, dcn_fwd = dcn_speedups if dcn_speedups else (None, None)
-    scaling = best_effort("scaling", bench_scaling)
-    breakdown = best_effort(
-        "breakdown",
-        lambda: bench_breakdown(model, opt, seqn, state, _recipe_batch(2)),
-    )
+    _stage("mosaic_dcn", stage_mosaic_dcn, timeout=600)
 
-    extra = {
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "flops_per_step": flops,
-        "bf16_steps_per_sec": round(bf16_steps, 3) if bf16_steps else None,
-        "e2e_steps_per_sec": round(e2e, 3) if e2e else None,
-        "e2e_device_raster_steps_per_sec": (
-            round(e2e_dev, 3) if e2e_dev else None
-        ),
-        # dcn_pallas_speedup keeps its round-2 meaning (forward-only) so
-        # BENCH history stays commensurable; the train direction (fwd+VJP
-        # under grad — the number that matters for training) is new
-        "dcn_pallas_speedup": round(dcn_fwd, 3) if dcn_fwd else None,
-        "dcn_pallas_train_speedup": (
-            round(dcn_train, 3) if dcn_train else None
-        ),
-        # batch-scaling curve + per-piece cost breakdown (the MFU question:
-        # small-batch arithmetic intensity vs pipeline problem)
-        "scaling": scaling,
-        "breakdown_ms": breakdown,
-        "device": jax.devices()[0].device_kind,
-    }
-    print(
-        json.dumps(
-            {
-                "metric": "train_steps_per_sec_per_chip_seqlen8",
-                "value": round(steps_per_sec, 3),
-                "unit": "steps/s",
-                "vs_baseline": None,
-                "extra": extra,
-            }
-        )
-    )
+    ctx_box = {}
+
+    def _build():
+        ctx_box["ctx"] = _Ctx()
+        return {}
+
+    if _stage("build_model", _build, timeout=900) is None:
+        _print_headline()
+        sys.exit(2)
+    ctx = ctx_box["ctx"]
+
+    _stage("compute", lambda: stage_compute(ctx), timeout=900)
+    _stage("bf16", lambda: stage_bf16(ctx), timeout=900)
+    _stage("dcn_ab", stage_dcn_ab, timeout=900)
+    if not ctx.smoke:  # smoke = plumbing check; skip the slow loader stages
+        _stage("e2e", lambda: stage_e2e(ctx), timeout=900)
+        _stage("e2e_device_raster",
+               lambda: stage_e2e(ctx, device_rasterize=True), timeout=900)
+        _stage("scaling", stage_scaling, timeout=1200)
+        _stage("breakdown", lambda: stage_breakdown(ctx), timeout=900)
+
+    _print_headline()
+    # A run that produced no headline measurement is a failure for
+    # automation even when it failed fast instead of hanging (the timeout
+    # path exits 2).
+    if HEADLINE["value"] is None:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -350,6 +350,13 @@ def load_lpips_params(
     if state is not None:
         _load_backbone(p[net], net, state)
 
+    if lin_npz_path is not None and not os.path.exists(lin_npz_path):
+        # An explicit path that doesn't resolve is a caller error (typo'd
+        # path), never a fallback case — silently degrading LPIPS here
+        # would hide the mistake even under allow_uncalibrated.
+        raise FileNotFoundError(
+            f"lin_npz_path={lin_npz_path!r} does not exist"
+        )
     path = lin_npz_path or (_LIN_WEIGHTS_FILE if net == "alex" else None)
     if path is not None and os.path.exists(path):
         lins = np.load(path)

@@ -144,16 +144,22 @@ def deform_conv2d_auto(
     ``impl``: ``'auto'`` uses Pallas on TPU backends (faster AND more
     accurate — the jnp einsum pays the MXU's default bf16 rounding) and the
     jnp path elsewhere (Pallas interpret mode is for tests, not speed);
-    ``'pallas'`` / ``'jnp'`` force a path.
+    ``'pallas'`` / ``'jnp'`` force a path. ``'auto'`` additionally requires
+    the kernel to pass a one-time real-Mosaic compile+exec self-test
+    (:func:`esr_tpu.ops.dcn_pallas.pallas_compiles`), so the default can
+    never silently depend on a kernel the resident compiler rejects.
     """
     if impl == "auto":
         # One-hot-matmul gather work scales as HW x No: the fused kernel wins
         # decisively at bottleneck-sized maps (measured 1.3-2.5x on v5e up to
         # 45x80) and loses to XLA's gather beyond ~4096 pixels.
         small = x.shape[1] * x.shape[2] <= 4096
-        impl = (
-            "pallas" if (jax.default_backend() == "tpu" and small) else "jnp"
-        )
+        use_pallas = False
+        if small:
+            from esr_tpu.ops.dcn_pallas import on_tpu_backend, pallas_compiles
+
+            use_pallas = on_tpu_backend() and pallas_compiles()
+        impl = "pallas" if use_pallas else "jnp"
     if impl == "pallas":
         from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
 

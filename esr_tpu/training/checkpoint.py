@@ -190,12 +190,18 @@ def resume_checkpoint(
 
     fmt = meta.get("format", 1)
     if fmt != CHECKPOINT_FORMAT:
-        raise ValueError(
-            f"Checkpoint {path} has state format {fmt}, this build writes "
-            f"{CHECKPOINT_FORMAT} (TrainState pytree layout changed); "
-            "restoring would fail with an opaque structure mismatch. "
-            "Re-train or convert the checkpoint offline."
+        # Warn-and-start-fresh, like the adjacent model-name-mismatch path:
+        # `-r auto` pointed at a directory holding an old-format run should
+        # begin training, not abort startup. load_for_inference keeps the
+        # hard error — there, silently ignoring the checkpoint would be
+        # wrong (ADVICE r3).
+        logger.warning(
+            "Checkpoint %s has state format %s, this build writes %s "
+            "(TrainState pytree layout changed) — not resuming; training "
+            "starts fresh.",
+            path, fmt, CHECKPOINT_FORMAT,
         )
+        return state, 0, None
 
     if meta["model"]["name"] != config["model"]["name"]:
         logger.warning(

@@ -1,0 +1,32 @@
+"""Incremental JSON-line artifact logging shared by the perf tooling.
+
+``bench.py`` and ``scripts/tpu_probe.py`` are wedge-proof artifact
+generators: every record must hit stdout (flushed) AND an append-only
+``.jsonl`` file the moment it exists, because the axon tunnel can hang a
+process at any point and an in-memory record would be lost. One shared
+helper keeps that contract in one place.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict
+
+
+def emit_jsonl(log_path: str, rec: Dict) -> Dict:
+    """UTC-stamp ``rec``, print it to stdout (flushed), append it to
+    ``log_path`` (creating parent dirs; I/O errors on the file never kill
+    the measurement). Returns the stamped record."""
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **rec}
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    try:
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    return rec

@@ -1,0 +1,78 @@
+"""Tunnel-health probe: is the axon TPU reachable right now?
+
+Prints exactly one JSON line and exits 0 (healthy) / 2 (wedged/timeout).
+The wedge failure mode is ``xla_client.make_c_api_client`` blocking forever
+with the GIL released, so an in-process timer thread is enough to break out
+(observed rounds 2-3); never SIGKILL a probe externally — killing a client
+mid-init is what wedges the tunnel in the first place.
+
+Every attempt (success, error, or timeout) is appended to
+``artifacts/PROBES_r04.jsonl`` with a UTC timestamp, so a round where the
+tunnel never heals still leaves evidence of every attempt.
+
+Usage: python scripts/tpu_probe.py [timeout_seconds]
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_LOG = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                    "PROBES_r04.jsonl")
+
+
+def _emit(rec):
+    from esr_tpu.utils.artifacts import emit_jsonl
+
+    emit_jsonl(_LOG, rec)
+
+
+def main():
+    # Default matches bench.py's backend-contact budget: exiting while a
+    # SLOW-but-healthy client init is still in flight is itself a wedge
+    # risk, so give a contended init the same 10 min bench would.
+    timeout = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
+    t0 = time.time()
+
+    def _watchdog():
+        _emit({
+            "probe": "tpu_backend",
+            "ok": False,
+            "error": f"timed out after {timeout:.0f}s (tunnel wedged?)",
+            "elapsed_s": round(time.time() - t0, 1),
+        })
+        os._exit(2)
+
+    timer = threading.Timer(timeout, _watchdog)
+    timer.daemon = True
+    timer.start()
+
+    try:
+        import jax
+        devs = jax.devices()
+        # one trivial executed op proves the chip answers, not just the client
+        val = float(jax.numpy.ones(8).sum())
+    except Exception as e:  # noqa: BLE001
+        timer.cancel()
+        _emit({
+            "probe": "tpu_backend", "ok": False, "error": repr(e),
+            "elapsed_s": round(time.time() - t0, 1),
+        })
+        sys.exit(2)
+    timer.cancel()
+    _emit({
+        "probe": "tpu_backend",
+        "ok": True,
+        "n_devices": len(devs),
+        "device_kind": devs[0].device_kind,
+        "platform": devs[0].platform,
+        "sanity_sum": val,
+        "elapsed_s": round(time.time() - t0, 1),
+    })
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,26 @@ def test_auto_dispatch_selects_jnp_on_cpu():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_mosaic_gate_false_on_cpu_and_parity_helper():
+    """The production auto-dispatch gate must refuse CPU (interpreter mode
+    proves nothing about Mosaic), and the shared parity helper — the SAME
+    comparison the gate and bench.py's mosaic_dcn stage run on TPU — must
+    pass in interpreter mode, with the backward impl global restored."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    assert DP.pallas_compiles() is False
+    assert DP.on_tpu_backend() is False
+
+    x, offsets, mask, weight, _ = _inputs(b=1, h=4, w=4, cin=4, cout=4, dg=1)
+    DP.dcn_backward_impl("jnp")  # the helper must pin 'pallas' itself
+    try:
+        errs = DP.dcn_parity_errors(x, offsets, mask, weight, interpret=True)
+        assert DP.dcn_parity_ok(errs), errs
+        assert DP._BACKWARD_IMPL == "jnp"  # restored after the pin
+    finally:
+        DP.dcn_backward_impl("pallas")
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "stride,padding,dilation", [(1, 1, 1), (2, 1, 1), (1, 2, 2)]

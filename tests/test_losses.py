@@ -118,6 +118,16 @@ def test_lpips_bundled_lin_weights_load():
     assert np.std(lin0) > 0
 
 
+def test_lpips_explicit_missing_lin_path_raises():
+    # ADVICE r3: a typo'd explicit lin_npz_path must fail loudly even with
+    # allow_uncalibrated=True — the silent fallback is only for the no-path
+    # case.
+    with pytest.raises(FileNotFoundError, match="lin_npz_path"):
+        load_lpips_params(
+            lin_npz_path="/nonexistent/lins.npz", allow_uncalibrated=True
+        )
+
+
 def test_lpips_multi_channel_replication():
     model = LPIPS()
     params = load_lpips_params(allow_uncalibrated=True)

@@ -226,8 +226,7 @@ def test_pnetlin_full_distance_parity(ref_networks, tmp_path, ref_net,
     convert_backbone_pth(str(pth), str(npz), net=our_net)
     params = load_lpips_params(
         backbone_state=load_backbone_npz(str(npz)), net=our_net,
-        lin_npz_path="/nonexistent",  # lins set explicitly below
-        allow_uncalibrated=True,
+        allow_uncalibrated=True,  # lins overwritten explicitly below
     )
     for i, w in enumerate(lin_ws):
         params["params"][f"lin{i}"] = w
@@ -285,8 +284,7 @@ def test_multi_channel_replication_parity(ref_networks):
 
     params = load_lpips_params(
         backbone_state={k: v.numpy() for k, v in state.items()},
-        lin_npz_path="/nonexistent",
-        allow_uncalibrated=True,
+        allow_uncalibrated=True,  # lins overwritten explicitly below
     )
     for i, w in enumerate(lin_ws):
         params["params"][f"lin{i}"] = w

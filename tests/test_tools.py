@@ -166,4 +166,228 @@ def test_rosbag_gate():
     from esr_tpu.tools.h5_tools import extract_rosbag_to_h5
 
     with pytest.raises(ImportError):
-        extract_rosbag_to_h5()
+        extract_rosbag_to_h5("in.bag", "out.h5")
+
+
+# --- rosbag converter against a synthetic rosbag module --------------------
+# extract_rosbag_to_h5 depends only on the reader duck-type (Bag(path, 'r')
+# context manager whose read_messages() yields (topic, msg, t)), so a fake
+# module exercises the full converter body without a ROS stack.
+
+
+class _Stamp:
+    def __init__(self, t):
+        self.secs = int(t)
+        self.nsecs = int(round((t - int(t)) * 1e9))
+
+
+class _Event:
+    def __init__(self, x, y, t, p):
+        self.x, self.y, self.ts, self.polarity = x, y, _Stamp(t), p
+
+
+class _Header:
+    def __init__(self, t):
+        self.stamp = _Stamp(t)
+
+
+class _Msg:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _fake_rosbag_module(messages):
+    import types
+
+    class _Bag:
+        def __init__(self, path, mode="r"):
+            assert os.path.exists(path)
+
+        def read_messages(self):
+            yield from messages
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    mod = types.ModuleType("rosbag")
+    mod.Bag = _Bag
+    return mod
+
+
+def _make_bag_messages(t_base=100.0):
+    rng = np.random.default_rng(7)
+    msgs = []
+    # 2 mono8 frames at t_base+0.05 / +0.25
+    for i, dt in enumerate((0.05, 0.25)):
+        img = rng.integers(0, 255, size=(8, 12), dtype=np.uint8)
+        msgs.append(("/cam/image", _Msg(
+            header=_Header(t_base + dt), height=8, width=12,
+            encoding="mono8", data=img.tobytes()), t_base + dt))
+    # 3 event packets, 40 events each, spread over [t_base, t_base+0.3]
+    for k in range(3):
+        evs = []
+        for j in range(40):
+            t = t_base + 0.1 * k + 0.1 * j / 40
+            evs.append(_Event(int(rng.integers(0, 12)),
+                              int(rng.integers(0, 8)), t, bool(j % 2)))
+        msgs.append(("/dvs/events", _Msg(events=evs), t_base + 0.1 * k))
+    # 1 flow frame
+    fx = rng.standard_normal(8 * 12).astype(np.float32)
+    fy = rng.standard_normal(8 * 12).astype(np.float32)
+    msgs.append(("/flow", _Msg(
+        header=_Header(t_base + 0.15), flow_x=fx, flow_y=fy,
+        height=8, width=12), t_base + 0.15))
+    msgs.sort(key=lambda m: m[2])
+    return msgs
+
+
+def test_rosbag_converter_full(tmp_path, monkeypatch):
+    import sys
+
+    from esr_tpu.tools.h5_tools import extract_rosbag_to_h5
+
+    monkeypatch.setitem(
+        sys.modules, "rosbag", _fake_rosbag_module(_make_bag_messages()))
+    bag = tmp_path / "rec.bag"
+    bag.write_bytes(b"fake")
+    out = tmp_path / "rec.h5"
+
+    stats = extract_rosbag_to_h5(
+        str(bag), str(out), event_topic="/dvs/events",
+        image_topic="/cam/image", flow_topic="/flow", zero_timestamps=True)
+    assert stats["num_pos"] == 60 and stats["num_neg"] == 60
+    assert stats["num_imgs"] == 2 and stats["num_flow"] == 1
+
+    import h5py
+
+    with h5py.File(out, "r") as f:
+        assert f.attrs["num_events"] == 120
+        assert tuple(f.attrs["sensor_resolution"]) == (8, 12)
+        ts = f["events/ts"][:]
+        assert len(ts) == 120
+        # zero_timestamps: the time base starts at the first message
+        assert 0.0 <= ts.min() < 0.06 and ts.max() < 0.35
+        assert np.all(np.diff(ts) >= 0)
+        assert f.attrs["t0"] == 0.0
+        imgs = sorted(f["images"])
+        assert len(imgs) == 2
+        assert f[f"images/{imgs[0]}"].shape == (8, 12)
+        # event_idx: index of the event preceding the image timestamp
+        assert "event_idx" in f[f"images/{imgs[0]}"].attrs
+        assert f["flow/flow000000000"].shape == (2, 8, 12)
+
+
+def test_rosbag_converter_window_and_batch(tmp_path, monkeypatch):
+    import sys
+
+    import h5py
+
+    from esr_tpu.tools.h5_tools import extract_rosbags_to_h5
+
+    monkeypatch.setitem(
+        sys.modules, "rosbag", _fake_rosbag_module(_make_bag_messages()))
+    for name in ("a.bag", "b.bag"):
+        (tmp_path / name).write_bytes(b"fake")
+
+    outs = extract_rosbags_to_h5(
+        [str(tmp_path / "a.bag"), str(tmp_path / "b.bag")],
+        str(tmp_path / "out"), event_topic="/dvs/events",
+        zero_timestamps=True, start_time=0.1, end_time=0.2)
+    assert [os.path.basename(p) for p in outs] == ["a.h5", "b.h5"]
+    with h5py.File(outs[0], "r") as f:
+        ts = f["events/ts"][:]
+        # only the middle packet's events fall in [0.1, 0.2]
+        assert len(ts) > 0
+        assert ts.min() >= 0.1 and ts.max() <= 0.2
+        # no image topic requested -> none written, sensor size from events
+        assert "images" not in f or len(f["images"]) == 0
+
+
+def test_rosbag_sensor_size_grows_per_dimension(tmp_path, monkeypatch):
+    # regression: inference must take a per-dimension max — a later packet
+    # with a big x but small y must not shrink the height
+    import sys
+
+    import h5py
+
+    from esr_tpu.tools.h5_tools import extract_rosbag_to_h5
+
+    msgs = [
+        ("/dvs/events", _Msg(events=[_Event(2, 99, 1.0, True)]), 1.0),
+        ("/dvs/events", _Msg(events=[_Event(99, 2, 1.1, False)]), 1.1),
+    ]
+    monkeypatch.setitem(sys.modules, "rosbag", _fake_rosbag_module(msgs))
+    bag = tmp_path / "g.bag"
+    bag.write_bytes(b"fake")
+    out = tmp_path / "g.h5"
+    extract_rosbag_to_h5(str(bag), str(out), event_topic="/dvs/events")
+    with h5py.File(out, "r") as f:
+        assert tuple(f.attrs["sensor_resolution"]) == (100, 100)
+
+    # an explicit sensor_size is authoritative: recorded as-is even when
+    # events exceed it
+    out2 = tmp_path / "g2.h5"
+    monkeypatch.setitem(sys.modules, "rosbag", _fake_rosbag_module(msgs))
+    extract_rosbag_to_h5(str(bag), str(out2), event_topic="/dvs/events",
+                         sensor_size=(260, 346))
+    with h5py.File(out2, "r") as f:
+        assert tuple(f.attrs["sensor_resolution"]) == (260, 346)
+
+
+def test_rosbag_row_stride_honored(tmp_path, monkeypatch):
+    # sensor_msgs/Image.step > width (alignment padding) must decode to the
+    # unpadded frame, as cv_bridge does
+    import sys
+
+    import h5py
+
+    from esr_tpu.tools.h5_tools import extract_rosbag_to_h5
+
+    rng = np.random.default_rng(11)
+    h, w, step = 4, 6, 8
+    padded = rng.integers(0, 255, size=(h, step), dtype=np.uint8)
+    msgs = [
+        ("/cam/image", _Msg(header=_Header(2.0), height=h, width=w,
+                            encoding="mono8", step=step,
+                            data=padded.tobytes()), 2.0),
+        ("/dvs/events", _Msg(events=[_Event(1, 1, 2.01, True)]), 2.01),
+    ]
+    monkeypatch.setitem(sys.modules, "rosbag", _fake_rosbag_module(msgs))
+    bag = tmp_path / "s.bag"
+    bag.write_bytes(b"fake")
+    out = tmp_path / "s.h5"
+    extract_rosbag_to_h5(str(bag), str(out), event_topic="/dvs/events",
+                         image_topic="/cam/image")
+    with h5py.File(out, "r") as f:
+        np.testing.assert_array_equal(
+            f["images/image000000000"][:], padded[:, :w])
+
+
+def test_rosbag_color_decoding(tmp_path, monkeypatch):
+    import sys
+
+    import h5py
+
+    from esr_tpu.tools.h5_tools import extract_rosbag_to_h5
+
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 255, size=(4, 6, 3), dtype=np.uint8)
+    msgs = [
+        ("/cam/image", _Msg(header=_Header(5.0), height=4, width=6,
+                            encoding="rgb8", data=img.tobytes()), 5.0),
+        ("/dvs/events", _Msg(events=[_Event(1, 1, 5.01, True)]), 5.01),
+    ]
+    monkeypatch.setitem(sys.modules, "rosbag", _fake_rosbag_module(msgs))
+    bag = tmp_path / "c.bag"
+    bag.write_bytes(b"fake")
+    out = tmp_path / "c.h5"
+    extract_rosbag_to_h5(
+        str(bag), str(out), event_topic="/dvs/events",
+        image_topic="/cam/image", is_color=True)
+    with h5py.File(out, "r") as f:
+        got = f["images/image000000000"][:]
+        # rgb8 stored as bgr8 (the reference's CvBridge output convention)
+        np.testing.assert_array_equal(got, img[..., ::-1])

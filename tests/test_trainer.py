@@ -293,6 +293,21 @@ def test_resume_reset_and_name_check(corpus, tmp_path):
     _, start, best = ckpt_lib.resume_checkpoint(path, state, bad)
     assert start == 0 and best is None
 
+    # old-format checkpoint: resume warns and starts fresh (ADVICE r3 —
+    # `-r auto` on a pre-existing old run directory must not abort
+    # startup), while load_for_inference keeps the hard error (silently
+    # ignoring the requested checkpoint there would be wrong)
+    meta_path = os.path.join(path, "meta.yml")
+    with open(meta_path) as f:
+        meta = yaml.safe_load(f)
+    meta["format"] = 1
+    with open(meta_path, "w") as f:
+        yaml.safe_dump(meta, f, sort_keys=False)
+    st, start, best = ckpt_lib.resume_checkpoint(path, state, config)
+    assert start == 0 and best is None
+    with pytest.raises(ValueError, match="format"):
+        ckpt_lib.load_for_inference(path)
+
 
 @pytest.mark.slow
 def test_load_for_inference_matches(corpus, tmp_path):
