@@ -240,20 +240,9 @@ def _flops_of(step_fn, state, batch):
 
 
 def stage_backend_up():
-    """Device enumeration plus ONE executed op — proves the chip answers,
-    not just that the client object exists."""
-    import jax
-    import jax.numpy as jnp
+    from esr_tpu.utils.artifacts import probe_backend
 
-    devs = jax.devices()
-    val = float(jnp.ones(8).sum())
-    return {
-        "n_devices": len(devs),
-        "device_kind": devs[0].device_kind,
-        "platform": devs[0].platform,
-        "backend": jax.default_backend(),
-        "sanity_sum": val,
-    }
+    return probe_backend()
 
 
 def stage_mosaic_dcn():

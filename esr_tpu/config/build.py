@@ -97,4 +97,7 @@ def build_train_loader(
         drop_last=bool(loader_cfg.get("drop_last", True)),
         seed=seed,
         prefetch=int(loader_cfg.get("prefetch", 2)),
+        # torch DataLoader's num_workers analogue: >0 spawns a process pool
+        # for the GIL-bound windowing/augment/collate work
+        num_workers=int(loader_cfg.get("num_workers", 0)),
     )

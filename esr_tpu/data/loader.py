@@ -48,6 +48,10 @@ class ConcatSequenceDataset:
     (``h5dataloader.py:20-34``)."""
 
     def __init__(self, recordings: Sequence, config: Dict):
+        # kept for worker-process reconstruction (multi-process loading
+        # cannot pickle live HDF5 handles; each worker rebuilds from these)
+        self.recordings = list(recordings)
+        self.config = config
         self.datasets = [SequenceDataset(r, config) for r in recordings]
         if not self.datasets:
             raise ValueError("empty datalist")
@@ -193,11 +197,50 @@ class InferenceSequenceLoader:
                 yield batch
 
 
+# ---- multi-process batch building -----------------------------------------
+# Module-level worker state: each spawned worker rebuilds the dataset ONCE
+# from (recordings, config) — live HDF5 handles cannot cross process
+# boundaries, and 'spawn' (not fork) is mandatory because the parent may
+# hold a live TPU client whose forked copy wedges the runtime.
+
+_WORKER_DATASET = None
+
+
+def _worker_init(recordings, config):
+    global _WORKER_DATASET
+    _WORKER_DATASET = ConcatSequenceDataset(recordings, config)
+
+
+def _worker_build(args):
+    indices, seeds = args
+    seqs = [
+        _WORKER_DATASET.get_item(int(i), seed=int(s))
+        for i, s in zip(indices, seeds)
+    ]
+    return collate_sequences(seqs)
+
+
 class SequenceLoader:
     """Iterable over collated ``(B, L, …)`` batches with epoch semantics.
 
     The training analogue of ``HDF5DataLoaderSequence``; construct one per
     host with its ``shard_id``/``num_shards``.
+
+    ``num_workers=0`` (default) builds batches in-process with a
+    thread-pool prefetch of depth ``prefetch`` — HDF5 reads and the native
+    rasterization kernels release the GIL, so threads overlap the device
+    step for typical configs. ``num_workers>0`` adds TRUE parallelism via a
+    spawned process pool (the torch ``num_workers`` analogue,
+    ``h5dataloader.py:180-268``): the python-side windowing/augment/collate
+    work is GIL-bound and profiles flat across threads, so heavy recipes
+    (large batch, device-rasterize event streams) need processes. Batch
+    order and augmentation seeds are IDENTICAL across all modes.
+
+    Spawn caveat (standard python semantics): worker startup re-imports the
+    parent's ``__main__``, so ``num_workers>0`` requires a real script/module
+    entry point (``train.py``, pytest) — a ``python -c``/stdin parent makes
+    the pool fail loudly with ``BrokenProcessPool`` at the first
+    ``.result()``.
     """
 
     def __init__(
@@ -210,15 +253,18 @@ class SequenceLoader:
         drop_last: bool = True,
         seed: int = 0,
         prefetch: int = 2,
+        num_workers: int = 0,
     ):
         self.dataset = dataset
         self.sampler = ShardedSampler(
             len(dataset), batch_size, shard_id, num_shards, shuffle, drop_last, seed
         )
         self.prefetch = prefetch
+        self.num_workers = num_workers
         self.seed = seed
         self.inp_resolution = dataset.inp_resolution
         self.gt_resolution = dataset.gt_resolution
+        self._pool = None
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -226,20 +272,78 @@ class SequenceLoader:
     def __len__(self) -> int:
         return len(self.sampler)
 
-    def _build(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
+    def _seeds(self, indices: np.ndarray) -> List[int]:
         # one shared derived seed per sequence keeps augmentation consistent
         # across its windows (reference: h5dataset.py:761-766)
         epoch = self.sampler.epoch
-        seqs = [
-            self.dataset.get_item(
-                int(i), seed=int(np.random.default_rng((self.seed, epoch, int(i))).integers(2**31))
-            )
+        return [
+            int(np.random.default_rng((self.seed, epoch, int(i))).integers(2**31))
             for i in indices
+        ]
+
+    def _build(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
+        seqs = [
+            self.dataset.get_item(int(i), seed=s)
+            for i, s in zip(indices, self._seeds(indices))
         ]
         return collate_sequences(seqs)
 
+    def _get_pool(self):
+        if self._pool is None:
+            if (self.dataset.config.get("hot_filter") or {}).get("enabled"):
+                # The hot-pixel filter accumulates observation statistics
+                # ACROSS get_item calls (data/hot_filter.py); splitting that
+                # state over isolated worker processes would silently change
+                # which pixels get masked, batch by batch. Refuse rather
+                # than break the identical-across-modes guarantee.
+                raise ValueError(
+                    "num_workers>0 is incompatible with the stateful "
+                    "hot_filter (per-worker datasets would each accumulate "
+                    "their own hot-pixel statistics); use num_workers=0"
+                )
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            # ProcessPoolExecutor (not mp.Pool): a worker killed mid-task
+            # (OOM, segfault) raises BrokenProcessPool at .result() instead
+            # of hanging the training loop forever on a result that will
+            # never arrive. spawn (not fork): the parent may hold a live
+            # TPU client whose forked copy wedges the runtime.
+            self._pool = ProcessPoolExecutor(
+                self.num_workers,
+                mp_context=mp.get_context("spawn"),
+                initializer=_worker_init,
+                initargs=(self.dataset.recordings, self.dataset.config),
+            )
+        return self._pool
+
+    def close(self) -> None:
+        """Tear down the worker pool (no-op for in-process modes)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         batches = list(self.sampler)
+        if self.num_workers > 0:
+            pool = self._get_pool()
+            depth = max(self.prefetch, self.num_workers)
+            pending = deque()
+            for idx in batches:
+                pending.append(
+                    pool.submit(_worker_build, (idx, self._seeds(idx)))
+                )
+                if len(pending) >= depth:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+            return
         if self.prefetch <= 0:
             for idx in batches:
                 yield self._build(idx)

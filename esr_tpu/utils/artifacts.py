@@ -16,6 +16,25 @@ import time
 from typing import Dict
 
 
+def probe_backend() -> Dict:
+    """One backend-contact probe: device enumeration plus ONE executed op —
+    proves the chip answers, not just that the client object exists. The
+    single definition behind ``scripts/tpu_probe.py`` and bench.py's
+    ``backend_up`` stage. Raises whatever the backend raises; hangs if the
+    tunnel is wedged (callers arm their own watchdog)."""
+    import jax
+
+    devs = jax.devices()
+    val = float(jax.numpy.ones(8).sum())
+    return {
+        "n_devices": len(devs),
+        "device_kind": devs[0].device_kind,
+        "platform": devs[0].platform,
+        "backend": jax.default_backend(),
+        "sanity_sum": val,
+    }
+
+
 def emit_jsonl(log_path: str, rec: Dict) -> Dict:
     """UTC-stamp ``rec``, print it to stdout (flushed), append it to
     ``log_path`` (creating parent dirs; I/O errors on the file never kill

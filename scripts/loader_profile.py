@@ -1,0 +1,105 @@
+"""Host-loader throughput profile: batches/s vs worker mode.
+
+The loader-vs-device headroom audit (VERDICT r3 item 7): measures the REAL
+recipe pipeline (synthetic NFS-ladder HDF5 -> windowing -> rasterization ->
+augment -> collate) at the training batch size across in-process threads
+(``num_workers=0``) and spawned process pools, emitting one JSON line per
+configuration to stdout and ``artifacts/LOADER_PROFILE.jsonl``.
+
+Interpretation: compare ``batches_per_sec`` against the device step rate
+from bench.py's scaling stage; if the loader cannot sustain ~the device
+rate at the production batch, raise ``num_workers`` (multi-core hosts) or
+switch the recipe to ``device_rasterize`` (ships raw event windows, scatter
+runs on-chip). On a single-core host process workers cannot help — the
+``cpu_count`` field records that context.
+
+Usage: python scripts/loader_profile.py [batch_size ...]
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_LOG = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                    "LOADER_PROFILE.jsonl")
+
+
+def profile(batch_size=8, num_workers=0, prefetch=2, device_rasterize=False,
+            n_batches=30):
+    from esr_tpu.data.loader import ConcatSequenceDataset, SequenceLoader
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    cfg = {
+        "scale": 2,
+        "ori_scale": "down16",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 2048,
+        "sliding_window": 1024,
+        "need_gt_events": True,
+        "need_gt_frame": False,
+        "data_augment": {"enabled": True,
+                         "augment": ["Horizontal", "Vertical", "Polarity"],
+                         "augment_prob": [0.5, 0.5, 0.5]},
+        "sequence": {"sequence_length": 10, "seqn": 3, "step_size": None,
+                     "pause": {"enabled": False}},
+        "item_keys": (
+            ["inp_norm_events", "inp_events_valid",
+             "gt_raw_events", "gt_events_valid"]
+            if device_rasterize
+            else ["inp_scaled_cnt", "gt_cnt"]
+        ),
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "p.h5")
+        write_synthetic_h5(path, (720, 1280), base_events=85_000,
+                           num_frames=4, rungs=("down8", "down16"), seed=0)
+        ds = ConcatSequenceDataset([path], cfg)
+        loader = SequenceLoader(ds, batch_size=batch_size, shuffle=True,
+                                drop_last=True, prefetch=prefetch,
+                                num_workers=num_workers)
+        try:
+            it = iter(_forever(loader))
+            next(it)  # warm (spawn startup, h5 open, first windows)
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                next(it)
+            dt = time.perf_counter() - t0
+        finally:
+            loader.close()
+    return n_batches / dt
+
+
+def _forever(loader):
+    epoch = 0
+    while True:
+        loader.set_epoch(epoch)
+        yield from loader
+        epoch += 1
+
+
+def main():
+    from esr_tpu.utils.artifacts import emit_jsonl
+
+    batches = [int(a) for a in sys.argv[1:]] or [8]
+    for b in batches:
+        for device_rasterize in (False, True):
+            for workers in (0, 2, 4):
+                bps = profile(batch_size=b, num_workers=workers,
+                              device_rasterize=device_rasterize)
+                emit_jsonl(_LOG, {
+                    "profile": "loader",
+                    "batch_size": b,
+                    "num_workers": workers,
+                    "device_rasterize": device_rasterize,
+                    "batches_per_sec": round(bps, 2),
+                    "sequences_per_sec": round(bps * b, 1),
+                    "cpu_count": os.cpu_count(),
+                })
+
+
+if __name__ == "__main__":
+    main()

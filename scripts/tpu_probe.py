@@ -51,10 +51,9 @@ def main():
     timer.start()
 
     try:
-        import jax
-        devs = jax.devices()
-        # one trivial executed op proves the chip answers, not just the client
-        val = float(jax.numpy.ones(8).sum())
+        from esr_tpu.utils.artifacts import probe_backend
+
+        info = probe_backend()
     except Exception as e:  # noqa: BLE001
         timer.cancel()
         _emit({
@@ -66,10 +65,7 @@ def main():
     _emit({
         "probe": "tpu_backend",
         "ok": True,
-        "n_devices": len(devs),
-        "device_kind": devs[0].device_kind,
-        "platform": devs[0].platform,
-        "sanity_sum": val,
+        **info,
         "elapsed_s": round(time.time() - t0, 1),
     })
 

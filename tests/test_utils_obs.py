@@ -156,6 +156,47 @@ def test_render_event_3d():
     assert both.shape[1] > img.shape[1]  # side-by-side panel is wider
 
 
+def test_animate_event_3d(tmp_path):
+    """The offline playback writer (reference PlotEvent3D,
+    matplotlib_plot_events.py:695-831): per-window input/GT 3D scatters +
+    frame inset -> animated gif on disk."""
+    from PIL import Image
+
+    from esr_tpu.utils.vis_events import VIEW_PRESETS, animate_event_3d
+
+    rng = np.random.default_rng(0)
+
+    def cloud(n, res, t0):
+        return np.stack([
+            rng.integers(0, res[1], n).astype(np.float32),
+            rng.integers(0, res[0], n).astype(np.float32),
+            np.sort(rng.uniform(t0, t0 + 0.1, n)).astype(np.float32),
+            rng.choice([-1.0, 1.0], n).astype(np.float32),
+        ], axis=1)
+
+    frame = (rng.random((16, 16)) * 255).astype(np.uint8)
+    windows = [
+        (cloud(50, (8, 8), 0.0), cloud(120, (16, 16), 0.0), frame),
+        (cloud(50, (8, 8), 0.1), cloud(120, (16, 16), 0.1), frame),
+        (cloud(50, (8, 8), 0.2), None, None),  # GT-less window allowed
+    ]
+    out = str(tmp_path / "anim.gif")
+    got = animate_event_3d(
+        windows, (8, 8), out, gt_resolution=(16, 16), fps=5, view=2)
+    assert got == out and os.path.getsize(out) > 0
+    with Image.open(out) as im:
+        assert im.is_animated and im.n_frames == 3
+
+    # .mp4 without ffmpeg (this image ships only pillow) falls back to gif
+    got2 = animate_event_3d(windows[:1], (8, 8), str(tmp_path / "a.mp4"))
+    assert got2.endswith(".gif") and os.path.exists(got2)
+
+    assert set(VIEW_PRESETS) == {1, 2, 3, 4, 5}
+
+    with pytest.raises(ValueError):
+        animate_event_3d([], (8, 8), str(tmp_path / "empty.gif"))
+
+
 def test_normalize_nonzero_numpy_and_jnp():
     import jax.numpy as jnp
 
