@@ -198,6 +198,11 @@ class H5Recording(Recording):
 
     def frame(self, index: int) -> np.ndarray:
         self._load_frames()
+        if not self._frame_names:
+            raise ValueError(
+                f"{self.path!r} has no packaged frames (ori_images); "
+                "disable need_gt_frame for frameless recordings"
+            )
         return self._file[f"ori_images/{self._frame_names[index]}"][:]
 
     def close(self) -> None:

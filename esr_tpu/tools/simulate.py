@@ -223,6 +223,66 @@ def simulate_ladder_recording(
     return cp, cn
 
 
+def render_scene_frames(
+    seed: int,
+    num_frames: int = 36,
+    h: int = 720,
+    w: int = 1280,
+    fps: float = 20.0,
+    disc_radius_scale: float = 1.0,
+) -> Tuple[list, np.ndarray]:
+    """Procedurally textured drifting scene -> (uint8 frames [H, W], ts).
+
+    The offline stand-in for the reference's NFS video frames
+    (``syn_nfs_rgb.py`` reads real footage; zero-egress images can't): four
+    drifting gratings at random orientation/frequency plus high-contrast
+    moving discs give the simulator dense brightness changes at every
+    ladder rung. Used by ``scripts/make_quality_demo_data.py`` and the
+    trained-quality margin test.
+
+    ``disc_radius_scale`` multiplies the disc radii (drawn for the 720p
+    default); small-frame callers pass ``min(h, w)/720 + 0.2``-style factors
+    explicitly. The default of 1.0 keeps generation bit-reproducible with
+    the committed demo corpora.
+    """
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+
+    n_g = 4
+    theta = rng.uniform(0, np.pi, n_g)
+    freq = rng.uniform(0.02, 0.12, n_g)  # cycles / pixel
+    amp = rng.uniform(0.3, 1.0, n_g)
+    vel = rng.uniform(-120, 120, (n_g, 2))  # px / s
+
+    n_b = 6
+    cy = rng.uniform(0, h, n_b)
+    cx = rng.uniform(0, w, n_b)
+    r = rng.uniform(30, 120, n_b) * disc_radius_scale
+    bvel = rng.uniform(-150, 150, (n_b, 2))
+    bsign = rng.choice([-1.0, 1.0], n_b)
+
+    frames, ts = [], []
+    for i in range(num_frames):
+        t = i / fps
+        img = np.zeros((h, w), np.float32)
+        for g in range(n_g):
+            ph = (
+                (xx - vel[g, 1] * t) * np.cos(theta[g])
+                + (yy - vel[g, 0] * t) * np.sin(theta[g])
+            ) * (2 * np.pi * freq[g])
+            img += amp[g] * np.sin(ph)
+        img = (img - img.min()) / (img.max() - img.min() + 1e-9)
+        for bi in range(n_b):
+            by = (cy[bi] + bvel[bi, 0] * t) % h
+            bx = (cx[bi] + bvel[bi, 1] * t) % w
+            d2 = (yy - by) ** 2 + (xx - bx) ** 2
+            img += bsign[bi] * 0.5 * np.exp(-d2 / (2 * (r[bi] / 2) ** 2))
+        img = np.clip(img, 0, 1)
+        frames.append((img * 255).astype(np.uint8))
+        ts.append(t)
+    return frames, np.asarray(ts)
+
+
 def read_txt_events(path: str) -> np.ndarray:
     """EventZoom txt (``t x y p``, p in {0,1}, one header row) ->
     ``[N, 4]`` (x, y, t, ±1) (reference ``convert_eventzoom.py:66-69,97-102``)."""

@@ -79,9 +79,12 @@ def save_checkpoint(
     host_state = _to_host(state)
     # Orbax saves are COLLECTIVE under jax.distributed (internal
     # sync_global_devices barriers): every process must call save(); Orbax
-    # itself writes array data from the primary host only.
+    # itself writes array data from the primary host only. force=True:
+    # re-saving an iteration that already has a directory (resume re-runs
+    # the iteration that was in flight at preemption; a torn dir without
+    # the meta.yml commit marker) must overwrite, not abort.
     for path in paths:
-        ckptr.save(os.path.join(path, "state"), host_state)
+        ckptr.save(os.path.join(path, "state"), host_state, force=True)
     # meta.yml is the COMMIT MARKER: it must only exist once the async Orbax
     # save has landed, so a preemption mid-save leaves a directory that
     # find_latest_checkpoint will ignore rather than a torn checkpoint.

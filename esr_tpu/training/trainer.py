@@ -377,6 +377,17 @@ class Trainer:
 
     def train(self) -> Dict[str, float]:
         """Run to ``iterations`` (or early stop). Returns final train log."""
+        if self.start_iteration >= self.iterations:
+            # Resuming an already-finished run (e.g. a `-r auto` requeue
+            # loop relaunching after completion) must be a no-op: training
+            # one extra step here would persist via the final-state save
+            # and compound one iteration per restart.
+            logger.info(
+                "Run already complete (resumed at iteration %d of %d); "
+                "nothing to train.",
+                self.start_iteration, self.iterations,
+            )
+            return {}
         epoch = 0
         iter_idx = self.start_iteration
         valid_stamp = 1
@@ -457,13 +468,20 @@ class Trainer:
                     if stop:
                         break
 
-                if (
+                saved_now = (
                     iter_idx % self.save_period == 0 and iter_idx != 0
-                ) or best:
+                ) or best
+                if saved_now:
                     self._save(iter_idx, best)
 
                 if iter_idx + 1 >= self.iterations:
                     logger.info("Training completes!")
+                    # Final-state checkpoint — deliberate deviation from the
+                    # reference, which saves only on save_period multiples
+                    # (train_ours_cnt_seq.py:316-319) and so loses up to
+                    # save_period-1 trailing iterations of a finished run.
+                    if not saved_now:
+                        self._save(iter_idx, False)
                     stop = True
                     break
                 iter_idx += 1

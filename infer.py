@@ -46,7 +46,12 @@ def get_flags():
     p.add_argument("--mode", type=str, default="events")
     p.add_argument("--window", type=int, default=2048)
     p.add_argument("--sliding_window", type=int, default=1024)
-    p.add_argument("--need_gt_frame", default=True, action="store_true")
+    p.add_argument("--need_gt_frame", dest="need_gt_frame",
+                   default=True, action="store_true")
+    p.add_argument("--no_need_gt_frame", dest="need_gt_frame",
+                   action="store_false",
+                   help="for recordings without packaged frames; GT frames "
+                        "are only used for the saved comparison images")
     p.add_argument("--need_gt_events", default=True, action="store_true")
     return p.parse_args()
 

@@ -17,66 +17,21 @@ Writes train/valid/test datalists alongside the h5 files.
 import os
 import sys
 
-import numpy as np
-
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def render_scene_frames(seed: int, num_frames: int = 36, h: int = None,
-                        w: int = None, fps: float = 20.0):
-    """Textured drifting scene -> (frames uint8 [H,W], timestamps).
-
-    Base resolution defaults to the NFS 720x1280; DEMO_BASE_H/W override it
-    (the committed demo corpus uses 360x640 so the single-core-CPU training
-    fallback completes in hours, not days — the LADDER rungs scale with it).
-    """
-    if h is None:
-        h = int(os.environ.get("DEMO_BASE_H", 720))
-    if w is None:
-        w = int(os.environ.get("DEMO_BASE_W", 1280))
-    rng = np.random.default_rng(seed)
-    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
-
-    # static texture field: 4 gratings at random orientation/frequency
-    # + low-frequency blob lighting, all drifting with distinct velocities
-    n_g = 4
-    theta = rng.uniform(0, np.pi, n_g)
-    freq = rng.uniform(0.02, 0.12, n_g)  # cycles / pixel
-    amp = rng.uniform(0.3, 1.0, n_g)
-    vel = rng.uniform(-120, 120, (n_g, 2))  # px / s
-
-    # high-contrast moving discs
-    n_b = 6
-    cy = rng.uniform(0, h, n_b)
-    cx = rng.uniform(0, w, n_b)
-    r = rng.uniform(30, 120, n_b)
-    bvel = rng.uniform(-150, 150, (n_b, 2))
-    bsign = rng.choice([-1.0, 1.0], n_b)
-
-    frames, ts = [], []
-    for i in range(num_frames):
-        t = i / fps
-        img = np.zeros((h, w), np.float32)
-        for g in range(n_g):
-            ph = (
-                (xx - vel[g, 1] * t) * np.cos(theta[g])
-                + (yy - vel[g, 0] * t) * np.sin(theta[g])
-            ) * (2 * np.pi * freq[g])
-            img += amp[g] * np.sin(ph)
-        img = (img - img.min()) / (img.max() - img.min() + 1e-9)
-        for bi in range(n_b):
-            by = (cy[bi] + bvel[bi, 0] * t) % h
-            bx = (cx[bi] + bvel[bi, 1] * t) % w
-            d2 = (yy - by) ** 2 + (xx - bx) ** 2
-            img += bsign[bi] * 0.5 * np.exp(-d2 / (2 * (r[bi] / 2) ** 2))
-        img = np.clip(img, 0, 1)
-        frames.append((img * 255).astype(np.uint8))
-        ts.append(t)
-    return frames, np.asarray(ts)
-
-
 def main():
-    from esr_tpu.tools.simulate import simulate_ladder_recording
+    from esr_tpu.tools.simulate import (
+        render_scene_frames,
+        simulate_ladder_recording,
+    )
+
+    # Base resolution defaults to the NFS 720x1280; DEMO_BASE_H/W override
+    # it (the committed demo corpus uses 360x640 so the single-core-CPU
+    # training fallback completes in hours, not days — the ladder rungs
+    # scale with it).
+    base_h = int(os.environ.get("DEMO_BASE_H", 720))
+    base_w = int(os.environ.get("DEMO_BASE_W", 1280))
 
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/esr_quality_demo"
     n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 6
@@ -91,7 +46,7 @@ def main():
     )
     for seed, (split, i) in enumerate(names):
         path = os.path.join(out_dir, f"{split}_{i}.h5")
-        frames, ts = render_scene_frames(seed=1000 + seed)
+        frames, ts = render_scene_frames(seed=1000 + seed, h=base_h, w=base_w)
         cp, cn = simulate_ladder_recording(
             frames, ts, path, rungs=("down8", "down16"), seed=2000 + seed
         )

@@ -1,0 +1,133 @@
+"""Trained-quality demonstration, tiny budget: ESR beats bicubic.
+
+The committed full-size artifact (``artifacts/quality_demo_*``, VERDICT r3
+item 3) trains the flagship for thousands of iterations on the ESIM corpus
+from ``scripts/make_quality_demo_data.py``; this test is the CI-budget
+replica of the same claim through the SAME surface: simulate a small ladder
+corpus with the real ESIM model (``tools/simulate.py``), train via the real
+``train.py`` CLI, evaluate via the real ``infer.py`` CLI on a held-out
+recording, and assert the trained model's count-map reconstruction beats
+the bicubic-upsampling baseline (reference semantics:
+``infer_ours_cnt.py:81-100,336-347``).
+
+Runs in 1-device subprocesses (batch 2 like the committed demo run; the
+parent test env forces an 8-device mesh that would demand batch 8).
+"""
+
+import ast
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+from esr_tpu.tools.simulate import render_scene_frames, simulate_ladder_recording
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def _make_corpus(tmp_path, n_train=2):
+    """Tiny ESIM ladder corpus: base 96x160 -> GT down4 (24x40),
+    input down8 (12x20)."""
+    paths = []
+    for i in range(n_train + 1):
+        frames, ts = render_scene_frames(
+            seed=500 + i, num_frames=24, h=96, w=160,
+            disc_radius_scale=96 / 720 + 0.2,
+        )
+        p = str(tmp_path / f"rec{i}.h5")
+        simulate_ladder_recording(
+            frames, ts, p, rungs=("down4", "down8"), seed=600 + i
+        )
+        paths.append(p)
+    train_dl = str(tmp_path / "train.txt")
+    with open(train_dl, "w") as f:
+        f.write("\n".join(paths[:n_train]) + "\n")
+    held_dl = str(tmp_path / "held.txt")
+    with open(held_dl, "w") as f:
+        f.write(paths[n_train] + "\n")
+    return train_dl, held_dl
+
+
+def test_trained_esr_beats_bicubic(tmp_path):
+    train_dl, held_dl = _make_corpus(tmp_path)
+    out = str(tmp_path / "run")
+    overrides = [
+        f"train_dataloader;path_to_datalist_txt={train_dl}",
+        f"valid_dataloader;path_to_datalist_txt={held_dl}",
+        "train_dataloader;batch_size=2",
+        "valid_dataloader;batch_size=2",
+        "train_dataloader;dataset;ori_scale=down8",
+        "valid_dataloader;dataset;ori_scale=down8",
+        "train_dataloader;dataset;window=128",
+        "train_dataloader;dataset;sliding_window=64",
+        "valid_dataloader;dataset;window=128",
+        "valid_dataloader;dataset;sliding_window=64",
+        "train_dataloader;dataset;need_gt_frame=false",
+        "valid_dataloader;dataset;need_gt_frame=false",
+        "train_dataloader;dataset;sequence;sequence_length=4",
+        "valid_dataloader;dataset;sequence;sequence_length=4",
+        f"trainer;output_path={out}",
+        "trainer;iteration_based_train;iterations=200",
+        "trainer;iteration_based_train;valid_step=1000",
+        "trainer;iteration_based_train;save_period=200",
+        "trainer;iteration_based_train;train_log_step=50",
+        "trainer;tensorboard=false",
+        "trainer;vis;enabled=false",
+    ]
+    cmd = [sys.executable, "train.py", "-c", "configs/train_esr_2x.yml",
+           "-id", "qtiny", "-seed", "7"]
+    for o in overrides:
+        cmd += ["-o", o]
+    r = subprocess.run(cmd, cwd=REPO, env=_env(), capture_output=True,
+                       text=True, timeout=3000)
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    ckpts = sorted(
+        glob.glob(f"{out}/models/*/qtiny/checkpoint-iteration*"),
+        key=lambda p: int(p.rsplit("iteration", 1)[1]),
+    )
+    assert ckpts, (r.stdout[-1500:], r.stderr[-1500:])
+    # the trainer saves the FINAL state when a run completes
+    assert ckpts[-1].endswith("checkpoint-iteration199"), ckpts
+
+    r2 = subprocess.run(
+        [sys.executable, "infer.py",
+         "--model_path", ckpts[-1], "--data_list", held_dl,
+         "--output_path", str(tmp_path / "eval"), "--scale", "2",
+         "--ori_scale", "down8", "--window", "128", "--sliding_window", "64",
+         "--seql", "4", "--no_need_gt_frame", "--no_save_images"],
+        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=1200,
+    )
+    assert r2.returncode == 0, r2.stderr[-3000:]
+
+    # stdout's last line is the datalist-mean metrics dict
+    means = ast.literal_eval(
+        [l for l in r2.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    # the trained model must beat bicubic upsampling on the held-out
+    # recording's count-map reconstruction (MSE and PSNR; SSIM on
+    # near-empty count maps is noise-dominated at this budget)
+    assert means["esr_mse"] < means["bicubic_mse"], means
+    assert means["esr_psnr"] > means["bicubic_psnr"], means
+
+    # relaunching the finished run via auto-resume is a no-op: no extra
+    # iteration is trained or persisted (requeue loops must not drift)
+    r3 = subprocess.run(cmd + ["-r", "auto"], cwd=REPO, env=_env(),
+                        capture_output=True, text=True, timeout=600)
+    assert r3.returncode == 0, r3.stderr[-3000:]
+    after = sorted(
+        glob.glob(f"{out}/models/*/qtiny/checkpoint-iteration*"),
+        key=lambda p: int(p.rsplit("iteration", 1)[1]),
+    )
+    assert after == ckpts, (ckpts, after)
