@@ -21,6 +21,7 @@ the training distribution):
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -165,6 +166,7 @@ class EventWindowDataset:
         return ev
 
     @staticmethod
+    @functools.lru_cache(maxsize=4096)
     def _flip_coin(seed: int, prob: float) -> bool:
         """The reference's exact draw — ``random.seed(s); random.random()``
         (``h5dataset.py:656-668``) — so a given (seed, mechanism) makes the
@@ -172,7 +174,8 @@ class EventWindowDataset:
         training batches, are bit-comparable across the two frameworks.
         ``random.Random(seed)`` produces the bit-identical Mersenne-Twister
         draw without touching the process-global RNG, which the loader's
-        threaded prefetch would otherwise race on."""
+        threaded prefetch would otherwise race on. Memoized: a sequence
+        re-asks the same (seed, prob) for every one of its L windows."""
         import random
 
         return random.Random(seed).random() < prob
@@ -521,18 +524,40 @@ class SequenceDataset:
         rng = np.random.default_rng(seed ^ 0x5EED)
 
         j = i * self.step_size
-        sequence = [self.dataset.get_item(j, seed=seed)]
-        k = 0
-        paused = False
-        for _ in range(self.L - 1):
-            if self.pause_enabled:
-                p = self.p_pause_paused if paused else self.p_pause_running
-                paused = rng.random() < p
-            if paused:
-                sequence.append(self.dataset.get_item(j + k, pause=True, seed=seed))
-            else:
-                k += 1
-                sequence.append(self.dataset.get_item(j + k, seed=seed))
+        self._prime_span(j)
+        try:
+            sequence = [self.dataset.get_item(j, seed=seed)]
+            k = 0
+            paused = False
+            for _ in range(self.L - 1):
+                if self.pause_enabled:
+                    p = self.p_pause_paused if paused else self.p_pause_running
+                    paused = rng.random() < p
+                if paused:
+                    sequence.append(
+                        self.dataset.get_item(j + k, pause=True, seed=seed)
+                    )
+                else:
+                    k += 1
+                    sequence.append(self.dataset.get_item(j + k, seed=seed))
+        finally:
+            self.dataset.inp_stream.unprime()
+            self.dataset.gt_stream.unprime()
         return sequence
+
+    def _prime_span(self, j: int) -> None:
+        """Bulk-read the event span covering windows ``[j, j+L)`` for both
+        streams, so the per-window ``EventStream.window`` calls below are
+        zero-copy views (sliding windows overlap; reading them one by one
+        re-fetches most events ``window/(window-sliding)`` times)."""
+        ds = self.dataset
+        j1 = min(j + self.L, len(ds))
+        inp_idx = ds.event_indices[j:j1]
+        ds.inp_stream.prime(int(inp_idx[:, 0].min()), int(inp_idx[:, 1].max()))
+        if ds.need_gt_events:
+            gt_idx = ds.gt_event_indices[j:j1]
+            ds.gt_stream.prime(
+                int(gt_idx[:, 0].min()), int(gt_idx[:, 1].max())
+            )
 
     __getitem__ = get_item

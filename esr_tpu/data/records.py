@@ -108,6 +108,12 @@ class EventStream:
 
     ``ts`` is cached in host memory; ``xs/ys/ps`` are sliced lazily from the
     backing store (HDF5 dataset or numpy array).
+
+    :meth:`prime` bulk-reads a span once so the L overlapping windows of a
+    sequence become zero-copy views instead of L separate HDF5 reads +
+    ``np.stack``s — the top cost center of batch building under profile.
+    The span is thread-local: prefetch threads building different sequences
+    share this object.
     """
 
     def __init__(self, xs, ys, ts: np.ndarray, ps):
@@ -115,8 +121,23 @@ class EventStream:
         self.ts = np.asarray(ts, np.float64)
         self.num_events = len(self.ts)
 
-    def window(self, idx0: int, idx1: int) -> np.ndarray:
-        """Events in ``[idx0, idx1)`` as a ``[4, N]`` float64 array (x,y,t,p)."""
+    @property
+    def _tls(self):
+        # lazy: threading.local is unpicklable, and MemoryRecording streams
+        # must survive pickling into spawned loader workers
+        tls = self.__dict__.get("_tls_obj")
+        if tls is None:
+            import threading
+
+            tls = self.__dict__["_tls_obj"] = threading.local()
+        return tls
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_tls_obj", None)
+        return d
+
+    def _fetch(self, idx0: int, idx1: int) -> np.ndarray:
         return np.stack(
             [
                 np.asarray(self._xs[idx0:idx1], np.float64),
@@ -125,6 +146,34 @@ class EventStream:
                 np.asarray(self._ps[idx0:idx1], np.float64),
             ]
         )
+
+    def prime(self, lo: int, hi: int) -> None:
+        """Materialize ``[lo, hi)`` so in-span :meth:`window` calls return
+        views. The previous span (this thread's) is replaced. The block is
+        marked read-only: every window view aliases it, so an in-place
+        write would silently corrupt all overlapping windows — better to
+        raise at the write site."""
+        lo = max(0, int(lo))
+        hi = min(int(hi), self.num_events)
+        block = self._fetch(lo, hi)
+        block.setflags(write=False)
+        self._tls.span = (lo, hi, block)
+
+    def unprime(self) -> None:
+        """Drop this thread's span (sequence finished — a retained block
+        would otherwise live until this thread re-primes this stream)."""
+        self._tls.span = None
+
+    def window(self, idx0: int, idx1: int) -> np.ndarray:
+        """Events in ``[idx0, idx1)`` as a ``[4, N]`` float64 array (x,y,t,p).
+
+        In-span requests return a VIEW of the primed block — callers treat
+        windows as read-only (every consumer copies via ``astype``)."""
+        span = getattr(self._tls, "span", None)
+        if span is not None and span[0] <= idx0 and idx1 <= span[1]:
+            lo = span[0]
+            return span[2][:, idx0 - lo: idx1 - lo]
+        return self._fetch(idx0, idx1)
 
     def search(self, t: float) -> int:
         """Index of the first event with timestamp >= ``t``."""
