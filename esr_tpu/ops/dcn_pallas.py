@@ -315,8 +315,21 @@ def dcn_parity_errors(x, off, mask, wt, interpret: bool = False) -> dict:
         _BACKWARD_IMPL = prev_impl
 
 
-def dcn_parity_ok(errs: dict, tol: float = 1e-3) -> bool:
-    """The pass criterion shared by the gate and the bench stage."""
+def dcn_parity_ok(errs: dict, tol: float | None = None) -> bool:
+    """The pass criterion shared by the gate and the bench stage.
+
+    Tolerance is backend-aware. In interpret mode on CPU both formulations
+    compute in exact f32 and must agree to 1e-3. On a real TPU the MXU
+    multiplies f32 operands in bf16 (jax default matmul precision), and the
+    two formulations round in *different* places — the kernel in its one-hot
+    contractions, the jnp path in its im2col einsum — so an O(1e-3) relative
+    disagreement is inherent MXU numerics, not a miscompile (measured
+    2-4e-3 on v5 lite at both gate and flagship shapes, r4 bench
+    ``mosaic_dcn`` stage). 2e-2 keeps ~5x headroom while still failing hard
+    on real indexing/accumulation bugs, which produce O(1) errors.
+    """
+    if tol is None:
+        tol = 2e-2 if on_tpu_backend() else 1e-3
     fwd_ok = errs["fwd_max_err"] <= tol * max(errs["fwd_scale"], 1.0)
     return fwd_ok and all(
         errs[f"{n}_rel_err"] <= tol for n in ("gx", "goff", "gmask", "gw")

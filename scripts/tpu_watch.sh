@@ -1,0 +1,26 @@
+#!/bin/bash
+# TPU heal watcher (r4). The axon tunnel wedges and heals unpredictably
+# (artifacts/PROBES_r04.jsonl); this loop probes every 5 min and fires the
+# full staged bench the moment a probe succeeds, so a heal window is never
+# wasted waiting for a human. One bench success (rc 0) is recorded in
+# artifacts/WATCHER_BENCH_DONE; later heals re-run only if that marker is
+# removed (drop it to queue another capture). The TPU is single-client —
+# while this watcher is running, nothing else should touch the chip.
+cd /root/repo || exit 1
+mkdir -p artifacts
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,)).sum()))" >/dev/null 2>&1; then
+    echo "{\"ts\": \"$ts\", \"probe\": \"tpu_backend\", \"ok\": true, \"source\": \"watcher\"}" >> artifacts/PROBES_r04.jsonl
+    if [ ! -f artifacts/WATCHER_BENCH_DONE ]; then
+      echo "{\"ts\": \"$ts\", \"watcher\": \"bench_start\"}" >> artifacts/PROBES_r04.jsonl
+      timeout 3000 python bench.py > artifacts/bench_r04_watch.log 2>&1
+      rc=$?
+      echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_bench_rc\": $rc}" >> artifacts/PROBES_r04.jsonl
+      [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_BENCH_DONE
+    fi
+  else
+    echo "{\"ts\": \"$ts\", \"probe\": \"tpu_backend\", \"ok\": false, \"source\": \"watcher\"}" >> artifacts/PROBES_r04.jsonl
+  fi
+  sleep 300
+done
