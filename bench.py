@@ -366,7 +366,15 @@ def _slope_time(make_run, arg, k_lo, k_hi, reps=3):
             return time.perf_counter() - t0
 
         out[k] = _best_of_reps(one, reps)
-    return (out[k_hi] - out[k_lo]) / (k_hi - k_lo), out
+    slope = (out[k_hi] - out[k_lo]) / (k_hi - k_lo)
+    if slope <= 0:
+        # sporadic tunnel contention hit the k_lo call harder than the
+        # k_hi call; a negative per-step time must fail the stage rather
+        # than silently become the headline
+        raise RuntimeError(
+            f"non-positive slope from timings {out} (contended run?)"
+        )
+    return slope, out
 
 
 def stage_scan_compute(ctx):

@@ -18,6 +18,13 @@ while true; do
       rc=$?
       echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_bench_rc\": $rc}" >> artifacts/PROBES_r04.jsonl
       [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_BENCH_DONE
+    elif [ ! -f artifacts/WATCHER_DEMO_DONE ]; then
+      # bench captured; next heal window goes to the on-chip e2e training demo
+      echo "{\"ts\": \"$ts\", \"watcher\": \"train_demo_start\"}" >> artifacts/PROBES_r04.jsonl
+      timeout 6000 python scripts/tpu_train_demo.py > artifacts/tpu_train_demo.log 2>&1
+      rc=$?
+      echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_demo_rc\": $rc}" >> artifacts/PROBES_r04.jsonl
+      [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_DEMO_DONE
     fi
   else
     echo "{\"ts\": \"$ts\", \"probe\": \"tpu_backend\", \"ok\": false, \"source\": \"watcher\"}" >> artifacts/PROBES_r04.jsonl
