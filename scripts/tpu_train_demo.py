@@ -160,6 +160,9 @@ def main():
 def _emit(rec):
     with open(os.path.join(OUT, "result.json"), "w") as f:
         json.dump(rec, f, indent=1)
+    # every attempt's record survives retries (result.json is latest-only)
+    with open(os.path.join(OUT, "results.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec))
 
 

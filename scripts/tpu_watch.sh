@@ -21,7 +21,8 @@ while true; do
     elif [ ! -f artifacts/WATCHER_DEMO_DONE ]; then
       # bench captured; next heal window goes to the on-chip e2e training demo
       echo "{\"ts\": \"$ts\", \"watcher\": \"train_demo_start\"}" >> artifacts/PROBES_r04.jsonl
-      timeout 6000 python scripts/tpu_train_demo.py > artifacts/tpu_train_demo.log 2>&1
+      echo "=== demo attempt $ts ===" >> artifacts/tpu_train_demo.log
+      timeout 6000 python scripts/tpu_train_demo.py >> artifacts/tpu_train_demo.log 2>&1
       rc=$?
       echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_demo_rc\": $rc}" >> artifacts/PROBES_r04.jsonl
       [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_DEMO_DONE
