@@ -27,19 +27,52 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def honor_platform_env() -> None:
+def honor_platform_env(infer_from_xla_flags: bool = False) -> None:
     """Make ``JAX_PLATFORMS=cpu <entry point>`` behave as documented.
 
     An installed TPU plugin ignores the env var, so apply it through
     ``jax.config`` (the authoritative path — see ``tests/conftest.py``)
-    before the backend initializes. Shared by ``train.py`` / ``infer.py`` /
-    ``bench.py``; no-op when the var is unset or the backend already
-    matches."""
+    before the backend initializes. Shared by ``train.py`` / ``infer.py``
+    / ``bench.py``; no-op when the var is unset.
+
+    ``infer_from_xla_flags=True`` (dryrun-only — ``__graft_entry__``)
+    additionally treats ``XLA_FLAGS=--xla_force_host_platform_device_count``
+    as a CPU request: virtual host devices exist only on the CPU platform,
+    and this must beat JAX_PLATFORMS — the image ships an ambient
+    ``JAX_PLATFORMS=axon,cpu`` that is indistinguishable from an explicit
+    setting, so deferring to the env var re-introduces the wedged-tunnel
+    hang. (To dryrun on the real backend, unset XLA_FLAGS.) Kept opt-in so
+    a leftover XLA_FLAGS export can never silently demote a real training /
+    bench run to CPU.
+
+    ``jax.config.update`` silently no-ops once a backend exists
+    (jax 0.9.0), so when one is ALREADY initialized this verifies the
+    active platform satisfies the request and raises on mismatch — never
+    a silent run on the wrong platform. Backend initialization itself is
+    never triggered here: ``train.py --multihost`` must reach
+    ``jax.distributed.initialize`` with the backend still down."""
     import os
 
-    plat = os.environ.get("JAX_PLATFORMS")
+    if infer_from_xla_flags and (
+        "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+    ):
+        plat = "cpu"
+    else:
+        plat = os.environ.get("JAX_PLATFORMS")
     if plat:
-        jax.config.update("jax_platforms", plat)
+        jax.config.update("jax_platforms", plat)  # silent no-op post-init
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            # a backend predates the update, so the update had no effect;
+            # acceptable only if the active one satisfies the request
+            active = jax.default_backend()
+            if active not in plat.split(","):
+                raise RuntimeError(
+                    f"backend already initialized as {active!r}; cannot "
+                    f"honor the platform request for {plat!r}"
+                )
 
 
 def make_mesh(

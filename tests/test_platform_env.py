@@ -1,0 +1,68 @@
+"""honor_platform_env contract (esr_tpu/parallel/mesh.py).
+
+The platform request must be *verified*, not just written:
+``jax.config.update("jax_platforms", ...)`` silently no-ops once a
+backend exists (jax 0.9.0), so the helper resolves the backend eagerly
+and raises on mismatch — never a silent run on the wrong platform. The
+XLA_FLAGS virtual-host-device inference (dryrun-only) must beat the
+image's ambient ``JAX_PLATFORMS=axon,cpu``, or the driver's
+``dryrun_multichip`` hangs on a wedged TPU tunnel (observed 2026-07-31).
+
+Runs in a subprocess: the contract is about process-global backend
+initialization order, which the test process (conftest already forced
+CPU) cannot represent.
+"""
+
+import os
+import subprocess
+import sys
+
+SCRIPT = """
+import jax
+from esr_tpu.parallel.mesh import honor_platform_env
+
+# 1) pre-init with ambient-style JAX_PLATFORMS present: the XLA_FLAGS
+#    virtual-host-device request must win and land on CPU — and the call
+#    itself must NOT initialize the backend (train.py --multihost needs
+#    jax.distributed.initialize to run with the backend still down)
+honor_platform_env(infer_from_xla_flags=True)
+from jax._src import xla_bridge
+assert not getattr(xla_bridge, "_backends", None), (
+    "honor_platform_env initialized the backend")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 4, jax.devices()
+
+# 2) post-init, request already satisfied: no-op
+honor_platform_env(infer_from_xla_flags=True)
+honor_platform_env()  # JAX_PLATFORMS lists cpu -> satisfied
+
+# 3) post-init, unsatisfiable request: must raise, not run on the wrong
+#    platform silently
+import os
+os.environ["JAX_PLATFORMS"] = "notaplatform"
+try:
+    honor_platform_env()
+except RuntimeError as e:
+    assert "cannot honor" in str(e), e
+else:
+    raise SystemExit("mismatch did not raise")
+print("CONTRACT_OK")
+"""
+
+
+def test_honor_platform_env_contract():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # mimic the image's ambient default that caused the original hang;
+    # 'cpu' listed so branch 2's env-var call is satisfiable post-init
+    env["JAX_PLATFORMS"] = "axon,cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "CONTRACT_OK" in out.stdout, out.stdout

@@ -1,0 +1,121 @@
+"""Tensor parallelism (channel-dim GSPMD sharding) vs replicated DP.
+
+The TP step must compute the SAME training step as the replicated one —
+GSPMD inserts the collectives, it must not change the math. Runs on the
+8-virtual-CPU-device mesh from conftest as a 2x4 ``(data, model)`` grid.
+Reference has no TP at all (NCCL DDP only, ``train_ours_cnt_seq.py:64-85``);
+this is a beyond-reference capability of the TPU-native runtime.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.parallel.mesh import make_mesh, make_parallel_train_step, replicate, shard_batch
+from esr_tpu.parallel.tensor import (
+    channel_shardings,
+    make_tp_mesh,
+    make_tp_train_step,
+    shard_state_tp,
+)
+from esr_tpu.training.optim import make_optimizer
+from esr_tpu.training.train_step import TrainState, make_train_step
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = DeepRecurrNet(inch=2, basech=8, num_frame=3)
+    b, L, h, w = 8, 4, 16, 16  # divides the 8-way DP mesh and TP's data=2
+    rng = np.random.default_rng(0)
+    batch = {
+        "inp": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+        "gt": jnp.array(rng.random((b, L, h, w, 2)), jnp.float32),
+    }
+    states = model.init_states(b, h, w)
+    params = model.init(jax.random.PRNGKey(0), batch["inp"][:, :3], states)
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    step_fn = make_train_step(model, opt, seqn=3)
+    return model, batch, params, opt, step_fn
+
+
+def _digest(tree):
+    return float(sum(jnp.sum(jnp.abs(lf)) for lf in jax.tree.leaves(tree)))
+
+
+def test_channel_shardings_rule(setup):
+    _, _, params, opt, _ = setup
+    mesh = make_tp_mesh(jax.devices(), data=2)
+    state = TrainState.create(params, opt)
+    sh = channel_shardings(state, mesh)
+    specs = [s.spec for s in jax.tree.leaves(sh)]
+    # at least the conv kernels (trailing O divisible by 4) must shard
+    assert any(spec and spec[-1] == "model" for spec in specs)
+    # and scalars/indivisible leaves must replicate
+    assert any(spec == () or all(e is None for e in spec) for spec in specs)
+    # a size-1 model axis must replicate everything, not trivially
+    # label every leaf 'model'-sharded (keeps degeneracy guards honest)
+    mesh1 = make_tp_mesh(jax.devices(), data=len(jax.devices()))
+    sh1 = channel_shardings(state, mesh1)
+    assert all(
+        s.spec == () or all(e is None for e in s.spec)
+        for s in jax.tree.leaves(sh1)
+    )
+
+
+def test_tp_step_matches_replicated(setup):
+    _, batch, params, opt, step_fn = setup
+    assert len(jax.devices()) == 8
+
+    # replicated DP over a 1-D mesh
+    dp_mesh = make_mesh(jax.devices())
+    dp_step = make_parallel_train_step(step_fn, dp_mesh, donate=False)
+    dp_state = replicate(TrainState.create(params, opt), dp_mesh)
+    dp_state2, dp_m = dp_step(dp_state, shard_batch(batch, dp_mesh))
+
+    # TP over a 2x4 (data, model) mesh from the SAME initial state
+    tp_mesh = make_tp_mesh(jax.devices(), data=2)
+    ts0 = TrainState.create(params, opt)
+    tp_step = make_tp_train_step(step_fn, tp_mesh, ts0, donate=False)
+    tp_state = shard_state_tp(ts0, tp_mesh)
+    tp_batch = shard_batch(batch, tp_mesh)
+    tp_state2, tp_m = tp_step(tp_state, tp_batch)
+
+    np.testing.assert_allclose(
+        float(tp_m["loss"]), float(dp_m["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        _digest(tp_state2.params), _digest(dp_state2.params), rtol=1e-5
+    )
+
+    # the updated state really is model-sharded (not silently replicated)
+    sharded = [
+        lf for lf in jax.tree.leaves(tp_state2.params)
+        if getattr(lf, "sharding", None) is not None
+        and lf.sharding.spec
+        and lf.sharding.spec[-1] == "model"
+    ]
+    assert sharded, "no leaf of the updated TP state is model-sharded"
+
+
+def test_tp_two_steps_stay_consistent(setup):
+    """Chained TP steps keep shardings stable (out spec == in spec) and the
+    loss stays finite — the donation-free path used by the dryrun."""
+    _, batch, params, opt, step_fn = setup
+    tp_mesh = make_tp_mesh(jax.devices(), data=2)
+    state0 = TrainState.create(params, opt)
+    tp_step = make_tp_train_step(step_fn, tp_mesh, state0, donate=False)
+    st = shard_state_tp(state0, tp_mesh)
+    tb = shard_batch(batch, tp_mesh)
+    losses = []
+    for _ in range(2):
+        st, m = tp_step(st, tb)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[1] < losses[0]  # it is actually training
+    # out spec == in spec: model sharding survives chained steps
+    assert any(
+        lf.sharding.spec and lf.sharding.spec[-1] == "model"
+        for lf in jax.tree.leaves(st.params)
+    ), "state decayed to replicated across chained TP steps"
