@@ -380,7 +380,7 @@ def _slope_time_flops(make_run, arg, k_lo, k_hi, reps=3):
     in the time slope exactly as in ``_slope_time``."""
     import jax
 
-    times, flops = {}, {}
+    times, flops, timers = {}, {}, {}
     for k in (k_lo, k_hi):
         fn = make_run(k)
         if not hasattr(fn, "lower"):  # accept jitted and plain callables
@@ -395,17 +395,41 @@ def _slope_time_flops(make_run, arg, k_lo, k_hi, reps=3):
             flops[k] = None
         _ = [float(x) for x in comp(arg)]  # warm (compile already done)
 
-        def one():
+        def one(comp=comp):  # bind: `comp` is reassigned next iteration
             t0 = time.perf_counter()
             _ = [float(x) for x in comp(arg)]
             return time.perf_counter() - t0
 
+        timers[k] = one
         times[k] = _best_of_reps(one, reps)
-    slope = (times[k_hi] - times[k_lo]) / (k_hi - k_lo)
-    if slope <= 0:
+    # A contended shared host (watcher probes, 1-core boxes) can invert the
+    # two points. Re-timing is cheap — no recompile, reps=1 is enough for
+    # a min-merge — and min() merging is sound because contention only
+    # ever ADDS time; don't let one noisy window torch a whole bench
+    # stage (seen: smoke breakdown 2026-07-31).
+    for _ in range(2):
+        if times[k_hi] > times[k_lo]:
+            break
+        for k in (k_lo, k_hi):
+            times[k] = min(times[k], _best_of_reps(timers[k], 1))
+    if times[k_hi] <= times[k_lo]:
         raise RuntimeError(
             f"non-positive slope from timings {times} (contended run?)"
         )
+    if times[k_hi] <= times[k_lo] * 1.05:
+        # Thin positive margin. LEGITIMATE when fixed per-call cost
+        # dominates — the whole contract of this method is to cancel it —
+        # but also exactly what pure noise looks like. Demand the
+        # ordering survive one independent confirmation round (min-merge
+        # can only shrink the gap, so surviving it is informative).
+        for k in (k_lo, k_hi):
+            times[k] = min(times[k], _best_of_reps(timers[k], 1))
+        if times[k_hi] <= times[k_lo]:
+            raise RuntimeError(
+                f"slope within noise: ordering flipped on confirmation, "
+                f"timings {times}"
+            )
+    slope = (times[k_hi] - times[k_lo]) / (k_hi - k_lo)
     fl = None
     if flops[k_lo] and flops[k_hi]:
         fl = (flops[k_hi] - flops[k_lo]) / (k_hi - k_lo)
@@ -687,7 +711,11 @@ def stage_breakdown(ctx):
     model, opt, seqn = ctx.model, ctx.opt, ctx.seqn
     state = TrainState.create(ctx.params_scan, ctx.opt)
     param_col, _stats = _split_vars(state.params)
-    k_lo, k_hi = (2, 4) if ctx.smoke else (4, 16)
+    # smoke spans 14 trip counts, not 2: the optimizer sub-measurement's
+    # slope (~2.7 ms/step on the 1-core box) is otherwise below the
+    # ~10 ms fixed-cost VARIATION between the two compiled executables,
+    # which systematically inverts the pair (smoke flake, 2026-07-31)
+    k_lo, k_hi = (2, 16) if ctx.smoke else (4, 16)
     ev = make_eval_step(model, seqn=seqn)
 
     def make_fwd(k):

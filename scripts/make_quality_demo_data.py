@@ -29,9 +29,24 @@ def main():
     # Base resolution defaults to the NFS 720x1280; DEMO_BASE_H/W override
     # it (the committed demo corpus uses 360x640 so the single-core-CPU
     # training fallback completes in hours, not days — the ladder rungs
-    # scale with it).
+    # scale with it). DEMO_RUNGS picks the ladder rungs: the 2x recipe
+    # consumes down16 input + down8 GT (default), the 4x recipe down16
+    # input + down4 GT (reference h5dataset.py:122-133).
     base_h = int(os.environ.get("DEMO_BASE_H", 720))
     base_w = int(os.environ.get("DEMO_BASE_W", 1280))
+    from esr_tpu.tools.simulate import _RUNG_FACTOR
+
+    rungs = tuple(
+        r.strip()
+        for r in os.environ.get("DEMO_RUNGS", "down8,down16").split(",")
+        if r.strip()
+    )
+    bad = [r for r in rungs if r not in _RUNG_FACTOR]
+    if bad or not rungs or len(set(rungs)) != len(rungs):
+        raise SystemExit(
+            f"DEMO_RUNGS must name distinct rungs from "
+            f"{sorted(_RUNG_FACTOR)}; got {list(rungs) or 'nothing'}"
+        )
 
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/esr_quality_demo"
     n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 6
@@ -48,15 +63,15 @@ def main():
         path = os.path.join(out_dir, f"{split}_{i}.h5")
         frames, ts = render_scene_frames(seed=1000 + seed, h=base_h, w=base_w)
         cp, cn = simulate_ladder_recording(
-            frames, ts, path, rungs=("down8", "down16"), seed=2000 + seed
+            frames, ts, path, rungs=rungs, seed=2000 + seed
         )
         import h5py
 
         with h5py.File(path) as f:
-            n8 = len(f["down8_events/ts"])
-            n16 = len(f["down16_events/ts"])
+            counts = {r: len(f[f"{r}_events/ts"]) for r in rungs}
         print(f"{path}: cp={cp:.3f} cn={cn:.3f} "
-              f"down8={n8} events, down16={n16} events", flush=True)
+              + " ".join(f"{r}={n} events" for r, n in counts.items()),
+              flush=True)
         split_paths[split].append(path)
 
     for split, paths in split_paths.items():
