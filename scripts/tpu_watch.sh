@@ -10,11 +10,15 @@ cd /root/repo || exit 1
 mkdir -p artifacts
 while true; do
   ts=$(date -u +%FT%TZ)
-  if timeout 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,)).sum()))" >/dev/null 2>&1; then
+  # -k: a tunnel-wedged python can block SIGTERM inside backend init
+  # (wedged init hangs ignore polite signals — r3 verdict observed 9+ min
+  # of silence); SIGKILL after a grace period guarantees one stuck probe
+  # can never freeze the whole loop
+  if timeout -k 15 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,)).sum()))" >/dev/null 2>&1; then
     echo "{\"ts\": \"$ts\", \"probe\": \"tpu_backend\", \"ok\": true, \"source\": \"watcher\"}" >> artifacts/PROBES_r04.jsonl
     if [ ! -f artifacts/WATCHER_BENCH_DONE ]; then
       echo "{\"ts\": \"$ts\", \"watcher\": \"bench_start\"}" >> artifacts/PROBES_r04.jsonl
-      timeout 3000 python bench.py > artifacts/bench_r04_watch.log 2>&1
+      timeout -k 30 3000 python bench.py > artifacts/bench_r04_watch.log 2>&1
       rc=$?
       echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_bench_rc\": $rc}" >> artifacts/PROBES_r04.jsonl
       [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_BENCH_DONE
@@ -22,7 +26,7 @@ while true; do
       # bench captured; next heal window goes to the on-chip e2e training demo
       echo "{\"ts\": \"$ts\", \"watcher\": \"train_demo_start\"}" >> artifacts/PROBES_r04.jsonl
       echo "=== demo attempt $ts ===" >> artifacts/tpu_train_demo.log
-      timeout 6000 python scripts/tpu_train_demo.py >> artifacts/tpu_train_demo.log 2>&1
+      timeout -k 30 6000 python scripts/tpu_train_demo.py >> artifacts/tpu_train_demo.log 2>&1
       rc=$?
       echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_demo_rc\": $rc}" >> artifacts/PROBES_r04.jsonl
       [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_DEMO_DONE
