@@ -23,6 +23,22 @@ import textwrap
 
 import pytest
 
+
+def _drain(procs, timeout=1800):
+    """communicate() every worker; if any hangs or raises, kill the whole
+    group first — a deadlocked peer must not leak 3 orphan jax processes
+    onto the single-core box (each would stall pytest up to ``timeout``)."""
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
 _WORKER = textwrap.dedent(
     """
     import sys
@@ -268,10 +284,8 @@ def test_four_process_preemption_drill(tmp_path):
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
     procs = _launch("A", port)
-    outs_a = []
-    for i, p in enumerate(procs):
-        out, _ = p.communicate(timeout=600)
-        outs_a.append(out)
+    outs_a = _drain(procs)
+    for i, (p, out) in enumerate(zip(procs, outs_a)):
         # phase A dies on purpose: preempted worker exits 17, the rest 1
         assert p.returncode == (17 if i == 3 else 1), (i, out[-3000:])
     for out in outs_a:
@@ -287,10 +301,8 @@ def test_four_process_preemption_drill(tmp_path):
         s.bind(("127.0.0.1", 0))
         port2 = str(s.getsockname()[1])
     procs = _launch("B", port2)
-    outs_b = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs_b.append(out)
+    outs_b = _drain(procs)
+    for p, out in zip(procs, outs_b):
         assert p.returncode == 0, out[-3000:]
 
     # all processes resume at the committed iteration with identical state
@@ -334,10 +346,8 @@ def test_two_process_flagship_train_valid_checkpoint_resume(tmp_path):
         )
         for i in range(2)
     ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
+    outs = _drain(procs)
+    for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-3000:]
 
     def grab(out, key):
