@@ -91,3 +91,51 @@ def test_thin_margin_rejected_when_confirmation_flips(
     )
     with pytest.raises(RuntimeError, match="ordering flipped"):
         bench._slope_time_flops(fake_runner, jnp.ones((4,)), k_lo=2, k_hi=8)
+
+
+def test_headline_attaches_last_known_good_only_when_valueless(
+    monkeypatch, tmp_path
+):
+    """A wedged run (headline value None, non-smoke) must carry the last
+    COMPLETE on-chip capture from the stage log — grouped per run, never a
+    stitch of stages from different runs — while a healthy run's headline
+    stays clean."""
+    import contextlib
+    import io
+    import json
+
+    log = tmp_path / "stages.jsonl"
+    records = [
+        # run 1: complete capture
+        {"stage": "backend_up", "ok": True, "ts": "t1"},
+        {"stage": "compute", "ok": True, "steps_per_sec": 1076.0, "ts": "t1"},
+        {"stage": "bf16", "ok": True, "steps_per_sec": 1133.0, "ts": "t1"},
+        # run 2: wedged after backend-up — bf16 here must NOT be stitched
+        # into run 1's capture, and this run has no timing stage
+        {"stage": "backend_up", "ok": True, "ts": "t2"},
+        {"stage": "bf16", "ok": True, "steps_per_sec": 1.0, "ts": "t2"},
+        {"stage": "compute", "ok": False, "error": "timeout", "ts": "t2"},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    monkeypatch.setattr(bench, "_REAL_STAGELOG", str(log))
+    monkeypatch.delenv("ESR_BENCH_SMOKE", raising=False)
+
+    monkeypatch.setattr(bench, "EXTRA", {})
+    monkeypatch.setattr(bench, "HEADLINE", {"value": None})
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._print_headline()
+    out = json.loads(buf.getvalue())
+    lkg = out["extra"]["last_known_good_capture"]
+    # run 1 selected wholesale; run 2's bf16 not stitched in
+    assert lkg["compute"]["steps_per_sec"] == 1076.0
+    assert lkg["bf16"]["ts"] == "t1"
+    assert all(rec["ok"] for rec in lkg.values())
+
+    monkeypatch.setattr(bench, "EXTRA", {})
+    monkeypatch.setattr(bench, "HEADLINE", {"value": 42.0})
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._print_headline()
+    out2 = json.loads(buf.getvalue())
+    assert "last_known_good_capture" not in out2["extra"]
