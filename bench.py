@@ -30,6 +30,11 @@ Stage order (most diagnostic value first):
 - ``scan_matmul``: known-flops chained-matmul anchor — an absolute
   achieved-TFLOPS calibration of the same timing method, and the ceiling
   on what fraction of peak this chip + tunnel can deliver on pure MXU work.
+- ``wide_model``: the same machinery on a basech=64 variant at b8 — if
+  MFU jumps ~an order of magnitude, the framework maps to the MXU fine
+  and the flagship MFU is bounded by the reference model's tiny channel
+  count, not by this stack. Third among the timing stages (r4 had it
+  last; it never produced data).
 - ``compute``: the same step timed as an async-dispatch loop — kept for
   cross-round comparability with r1's 1054.7 (same method); claims the
   headline only if scan_compute failed.
@@ -46,10 +51,6 @@ Stage order (most diagnostic value first):
   copied from ``scan_compute`` (identical method/shapes), b8/b16 measured.
 - ``breakdown``: fwd / fwd+bwd / optimizer cost centers in ms — scan-slope
   method, train_step_ms reused from ``scan_compute``.
-- ``wide_model``: the same machinery on a basech=64 variant at b8 — if
-  MFU jumps ~an order of magnitude, the framework maps to the MXU fine
-  and the flagship MFU is bounded by the reference model's tiny channel
-  count, not by this stack.
 
 vs_baseline stays null until a measured reference-GPU number exists
 (the reference repo publishes none — BASELINE.md).
@@ -66,8 +67,12 @@ import numpy as np
 
 _REAL_STAGELOG = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
-    "artifacts", "BENCH_STAGES_r04.jsonl",
+    "artifacts", "BENCH_STAGES_r05.jsonl",
 )
+# older rounds' capture logs, newest first — fallbacks for last-known-good
+_PRIOR_STAGELOGS = [
+    os.path.join(os.path.dirname(_REAL_STAGELOG), "BENCH_STAGES_r04.jsonl"),
+]
 _STAGELOG = (
     # smoke runs (plumbing checks on CPU) must never pollute the real artifact
     os.path.join(os.path.dirname(_REAL_STAGELOG), "BENCH_STAGES_smoke.jsonl")
@@ -106,27 +111,31 @@ def _last_known_good():
     a timing stage is returned — never a stitch of stages from different
     runs."""
     interest = ("backend_up", "scan_compute", "compute", "bf16",
-                "mosaic_dcn", "dcn_ab")
-    runs, cur = [], None
-    try:
-        with open(_REAL_STAGELOG) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if rec.get("stage") == "backend_up":
-                    cur = []
-                    runs.append(cur)
-                if (cur is not None and rec.get("ok")
-                        and rec.get("stage") in interest):
-                    cur.append(rec)
-    except OSError:
-        return None
-    for run in reversed(runs):
-        stages = {r["stage"]: r for r in run}
-        if "compute" in stages or "scan_compute" in stages:
-            return stages
+                "mosaic_dcn", "dcn_ab", "scan_matmul", "wide_model")
+    for log in [_REAL_STAGELOG, *_PRIOR_STAGELOGS]:
+        runs, cur = [], None
+        try:
+            with open(log) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("stage") == "backend_up":
+                        cur = []
+                        runs.append(cur)
+                    if (cur is not None and rec.get("ok")
+                            and rec.get("stage") in interest):
+                        cur.append(rec)
+        except OSError:
+            continue
+        for run in reversed(runs):
+            stages = {r["stage"]: r for r in run}
+            if "compute" in stages or "scan_compute" in stages:
+                # provenance nested one level down so the stage mapping
+                # itself stays homogeneous (stage name -> record)
+                return {"source_log": os.path.basename(log),
+                        "stages": stages}
     return None
 
 
@@ -152,11 +161,7 @@ class _Watchdog:
     def __init__(self):
         self._timer = None
 
-    def arm(self, seconds, stage_name, done_flag, soft=False):
-        """``soft``: the stage is an optional diagnostic appended after a
-        complete capture — on timeout, record it and exit 0 so automation
-        (tpu_watch.sh's WATCHER_BENCH_DONE) still counts the run as a
-        success instead of re-running everything next heal window."""
+    def arm(self, seconds, stage_name, done_flag):
         self.disarm()
 
         def _fire():
@@ -165,10 +170,9 @@ class _Watchdog:
             if done_flag[0]:
                 return
             try:
-                if not soft:
-                    EXTRA.setdefault(
-                        "error", f"stage {stage_name!r} timed out "
-                                 f"after {seconds:.0f}s")
+                EXTRA.setdefault(
+                    "error", f"stage {stage_name!r} timed out "
+                             f"after {seconds:.0f}s")
                 _emit({"stage": stage_name, "ok": False,
                        "error": f"timed out after {seconds:.0f}s"})
                 _print_headline()
@@ -183,7 +187,7 @@ class _Watchdog:
                     sys.stdout.flush()
                 except Exception:  # noqa: BLE001
                     pass
-            os._exit(0 if soft else 2)
+            os._exit(2)
 
         self._timer = threading.Timer(seconds, _fire)
         self._timer.daemon = True
@@ -198,13 +202,15 @@ class _Watchdog:
 _WD = _Watchdog()
 
 
-def _stage(name, fn, timeout, soft=False):
+def _stage(name, fn, timeout):
     """Run one stage under the watchdog; emit its record either way.
     Returns the stage's dict (merged into the record) or None on error.
-    ``soft`` marks an optional trailing diagnostic whose timeout must not
-    fail the whole run (see _Watchdog.arm)."""
+    A stage timeout means the tunnel wedged mid-run: the headline is
+    printed with whatever extras exist and the process exits 2, so the
+    watcher retries on the next heal (the persistent compilation cache
+    makes the retry cheap)."""
     done = [False]
-    _WD.arm(timeout, name, done, soft=soft)
+    _WD.arm(timeout, name, done)
     t0 = time.perf_counter()
     try:
         out = fn() or {}
@@ -315,24 +321,40 @@ def stage_mosaic_dcn():
     """Real-Mosaic compile + numeric parity of the fused Pallas DCNv2 at the
     flagship bottleneck shape, forward and all five cotangents (VERDICT r3
     item 2). Also runs the tiny memoized self-test that gates the production
-    ``auto`` dispatch (``ops/dcn.py``)."""
+    ``auto`` dispatch (``ops/dcn.py``) and records HOW it decided
+    (pinned-precision strict vs production-numerics fallback — ADVICE r4)
+    plus the impl ``'auto'`` resolves to at the flagship bottleneck map
+    (12x20 for 90x160 inputs at down_scale=8), so the artifact can no
+    longer show a passing kernel that silently never dispatches
+    (VERDICT r4 weak #2)."""
     import jax
 
     if jax.default_backend() == "cpu":
         return {"skipped": "cpu backend (no Mosaic)"}
 
+    from esr_tpu.ops.dcn import resolve_dcn_impl
     from esr_tpu.ops.dcn_pallas import (
         dcn_parity_errors,
         dcn_parity_ok,
+        gate_mode,
         pallas_compiles,
     )
 
     gate_ok = pallas_compiles()
+    # strict check: pinned 'highest' matmul precision, tol 1e-3 everywhere
     errs = dcn_parity_errors(*_flagship_dcn_inputs(), interpret=False)
+    # production numerics (default precision): expected O(1e-3) rel diff
+    # from the MXU rounding in different places; recorded for the artifact
+    errs_prod = dcn_parity_errors(
+        *_flagship_dcn_inputs(), interpret=False, matmul_precision=None
+    )
     result = {
         "dcn_pallas_mosaic_ok": bool(dcn_parity_ok(errs) and gate_ok),
         "auto_dispatch_gate": gate_ok,
+        "gate_mode": gate_mode(),
+        "resolved_impl_at_bottleneck": resolve_dcn_impl(12, 20),
         **{k: round(v, 8) for k, v in errs.items()},
+        **{f"prod_{k}": round(v, 8) for k, v in errs_prod.items()},
     }
     EXTRA["dcn_pallas_mosaic"] = result
     return result
@@ -511,9 +533,16 @@ def stage_scan_compute(ctx):
     EXTRA["mfu"] = round(mfu, 4) if mfu is not None else None
     if flops:
         EXTRA["flops_per_step"] = flops
+    # step-level dispatch proof: which impl each DCN call site in the
+    # just-compiled flagship step resolved to (VERDICT r4 weak #2 asked
+    # for exactly this — the r4 capture's step silently ran jnp)
+    from esr_tpu.ops.dcn import dispatch_log
+
+    EXTRA["dcn_dispatch_traced"] = dispatch_log()
     res = {"steps_per_sec": round(sps, 3),
            "ms_per_step": round(per_step * 1e3, 3),
            "mfu": EXTRA["mfu"], "flops_per_step": flops,
+           "dcn_dispatch_traced": dispatch_log(),
            "t_sync_call_s": {f"k{k}": round(t, 4) for k, t in raw.items()}}
     EXTRA["scan_b2"] = {"steps_per_sec": res["steps_per_sec"],
                         "sequences_per_sec": round(sps * ctx.b, 2),
@@ -704,9 +733,12 @@ def stage_scaling(ctx, batches=(8, 16)):
     loop measures the dispatch path, not the device; the slope cancels it.
     The b2 point is copied from scan_compute (identical method, shapes,
     and params), so the curve stays commensurable while compiling two
-    fewer programs (ADVICE r3 asked for an explicit b2 point). MFU scales
-    the compute stage's b2 cost-analysis flops linearly — exactly right
-    for this model, where no op mixes examples across the batch axis."""
+    fewer programs (ADVICE r3 asked for an explicit b2 point). MFU uses
+    each batch size's OWN measured cost-analysis flops slope — the
+    executables are compiled for timing anyway, so the flop count is free
+    and tracks whatever padding/fusion XLA does at that batch (ADVICE r4);
+    linear scaling of the b2 flops is only the fallback when the backend
+    reports no cost analysis."""
     from esr_tpu.training.train_step import TrainState
 
     out = {}
@@ -717,17 +749,25 @@ def stage_scaling(ctx, batches=(8, 16)):
     for b in batches:
         batch = _recipe_batch(b, ctx.L, ctx.h, ctx.w)
         state = TrainState.create(ctx.params_scan, ctx.opt)
-        per_step, _ = _slope_time(
+        per_step, flops, _ = _slope_time_flops(
             lambda k: _scan_steps_runner(ctx.step_fn, batch, k),
             state, k_lo, k_hi, reps=2)
         sps = 1.0 / per_step
-        flops = flops_b2 * b / ctx.b if flops_b2 else None
+        if flops:
+            flops_src = "cost_analysis_slope"
+        elif flops_b2:
+            flops = flops_b2 * b / ctx.b
+            flops_src = "linear_from_b2"
+        else:
+            flops_src = "unavailable"
         out[f"b{b}"] = {
             "steps_per_sec": round(sps, 3),
             "sequences_per_sec": round(sps * b, 2),
             "mfu": (
                 round(flops * sps / _peak_flops(), 4) if flops else None
             ),
+            "flops_per_step": flops,
+            "flops_source": flops_src,
         }
     EXTRA["scaling"] = out
     return {"scaling": out}
@@ -925,6 +965,21 @@ def main():
     from esr_tpu.parallel.mesh import honor_platform_env
 
     honor_platform_env()
+    # Persistent compilation cache: heal windows are ~25 min and the staged
+    # ladder is compile-heavy, so a watcher re-run after a mid-ladder wedge
+    # must not pay the same compiles twice. Platform is part of the cache
+    # key, so CPU smoke runs never collide with TPU entries.
+    import jax
+
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts", "xla_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        EXTRA["compile_cache"] = "persistent"
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        EXTRA["compile_cache"] = f"unavailable: {e!r}"
     boot_done[0] = True
     _WD.disarm()
 
@@ -950,6 +1005,10 @@ def main():
 
     _stage("scan_compute", lambda: stage_scan_compute(ctx), timeout=900)
     _stage("scan_matmul", lambda: stage_scan_matmul(ctx), timeout=900)
+    # wide_model runs THIRD among the timing stages (r4 had it last and it
+    # produced zero data): the MFU-ceiling attribution is VERDICT r5 task 3
+    # and must survive a short heal window.
+    _stage("wide_model", lambda: stage_wide_model(ctx), timeout=1200)
     _stage("compute", lambda: stage_compute(ctx), timeout=900)
     _stage("bf16", lambda: stage_bf16(ctx), timeout=900)
     _stage("dcn_ab", stage_dcn_ab, timeout=900)
@@ -964,8 +1023,6 @@ def main():
         _stage("scaling", lambda: stage_scaling(ctx, batches=(4,)),
                timeout=1200)
     _stage("breakdown", lambda: stage_breakdown(ctx), timeout=900)
-    _stage("wide_model", lambda: stage_wide_model(ctx), timeout=1200,
-           soft=True)
 
     _print_headline()
     # A run that produced no headline measurement is a failure for

@@ -127,6 +127,35 @@ def deform_conv2d(
     return out
 
 
+# ``'auto'`` dispatch decisions observed during tracing, keyed "HxW" -> impl.
+# One entry per distinct input map size per process; read via dispatch_log().
+_DISPATCH_LOG: dict = {}
+
+
+def dispatch_log() -> dict:
+    """Copy of the ``'auto'`` dispatch decisions traced so far (bench
+    evidence: which impl each DCN call site in a compiled step resolved to).
+    """
+    return dict(_DISPATCH_LOG)
+
+
+def resolve_dcn_impl(h: int, w: int) -> str:
+    """The impl ``'auto'`` dispatch chooses for an ``h x w`` input map.
+
+    One-hot-matmul gather work scales as HW x No: the fused kernel wins
+    decisively at bottleneck-sized maps (measured 1.3-2.5x on v5e up to
+    45x80) and loses to XLA's gather beyond ~4096 pixels. On top of the
+    size rule, Pallas requires the one-time real-Mosaic self-test
+    (:func:`esr_tpu.ops.dcn_pallas.pallas_compiles`) to have passed.
+    """
+    if h * w <= 4096:
+        from esr_tpu.ops.dcn_pallas import on_tpu_backend, pallas_compiles
+
+        if on_tpu_backend() and pallas_compiles():
+            return "pallas"
+    return "jnp"
+
+
 def deform_conv2d_auto(
     x: jax.Array,
     offsets: jax.Array,
@@ -150,16 +179,11 @@ def deform_conv2d_auto(
     never silently depend on a kernel the resident compiler rejects.
     """
     if impl == "auto":
-        # One-hot-matmul gather work scales as HW x No: the fused kernel wins
-        # decisively at bottleneck-sized maps (measured 1.3-2.5x on v5e up to
-        # 45x80) and loses to XLA's gather beyond ~4096 pixels.
-        small = x.shape[1] * x.shape[2] <= 4096
-        use_pallas = False
-        if small:
-            from esr_tpu.ops.dcn_pallas import on_tpu_backend, pallas_compiles
-
-            use_pallas = on_tpu_backend() and pallas_compiles()
-        impl = "pallas" if use_pallas else "jnp"
+        impl = resolve_dcn_impl(x.shape[1], x.shape[2])
+        # Traced once per compile; the log is what bench.py's on-chip
+        # artifact reports as step-level proof of which impl actually ran
+        # (VERDICT r4: the only real-TPU capture silently dispatched jnp).
+        _DISPATCH_LOG[f"{x.shape[1]}x{x.shape[2]}"] = impl
     if impl == "pallas":
         from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
 

@@ -272,7 +272,10 @@ def on_tpu_backend() -> bool:
         return False
 
 
-def dcn_parity_errors(x, off, mask, wt, interpret: bool = False) -> dict:
+def dcn_parity_errors(
+    x, off, mask, wt, interpret: bool = False,
+    matmul_precision: Optional[str] = "highest",
+) -> dict:
     """Forward + all-four-cotangent parity of the fused kernel against the
     jnp formulation at the given inputs. Used by BOTH the production
     ``pallas_compiles`` gate (tiny shape) and bench.py's ``mosaic_dcn``
@@ -280,13 +283,27 @@ def dcn_parity_errors(x, off, mask, wt, interpret: bool = False) -> dict:
     them. Pins the fused backward for the comparison (with ``'jnp'`` active
     the VJP check would be jnp-vs-jnp, vacuously true).
 
+    ``matmul_precision``: by default both formulations are traced under
+    ``jax.default_matmul_precision('highest')`` so the MXU's default-bf16
+    rounding — which the two formulations apply in *different* places —
+    drops out and the strict 1e-3 tolerance stays meaningful on TPU
+    (ADVICE r4: a loosened on-TPU tolerance would let a ~1% kernel defect
+    ship silently). ``None`` measures under production numerics instead.
+
     Returns ``{"fwd_max_err", "fwd_scale", "gx_rel_err", "goff_rel_err",
     "gmask_rel_err", "gw_rel_err"}`` (absolute fwd error; per-cotangent
     max-abs error over the jnp cotangent's max-abs scale).
     """
+    import contextlib
+
     global _BACKWARD_IMPL
     prev_impl = _BACKWARD_IMPL
     _BACKWARD_IMPL = "pallas"
+    prec_ctx = (
+        jax.default_matmul_precision(matmul_precision)
+        if matmul_precision else contextlib.nullcontext()
+    )
+    prec_ctx.__enter__()  # explicit: keeps the try/finally shape below flat
     try:
         def loss(fn):
             def f(x_, o_, m_, w_):
@@ -312,28 +329,52 @@ def dcn_parity_errors(x, off, mask, wt, interpret: bool = False) -> dict:
             errs[f"{name}_rel_err"] = float(jnp.max(jnp.abs(a - b_))) / gscale
         return errs
     finally:
+        prec_ctx.__exit__(None, None, None)
         _BACKWARD_IMPL = prev_impl
 
 
-def dcn_parity_ok(errs: dict, tol: float | None = None) -> bool:
+def dcn_parity_ok(
+    errs: dict, tol: float | None = None,
+    matmul_precision: Optional[str] = "highest",
+) -> bool:
     """The pass criterion shared by the gate and the bench stage.
 
-    Tolerance is backend-aware. In interpret mode on CPU both formulations
-    compute in exact f32 and must agree to 1e-3. On a real TPU the MXU
-    multiplies f32 operands in bf16 (jax default matmul precision), and the
-    two formulations round in *different* places — the kernel in its one-hot
-    contractions, the jnp path in its im2col einsum — so an O(1e-3) relative
-    disagreement is inherent MXU numerics, not a miscompile (measured
-    2-4e-3 on v5 lite at both gate and flagship shapes, r4 bench
-    ``mosaic_dcn`` stage). 2e-2 keeps ~5x headroom while still failing hard
-    on real indexing/accumulation bugs, which produce O(1) errors.
+    With ``matmul_precision`` pinned (the default, matching
+    :func:`dcn_parity_errors`) both formulations accumulate in full f32 on
+    every backend, so the strict 1e-3 tolerance applies everywhere — this
+    restores the pre-r4 tolerance ADVICE flagged: a ~1% kernel defect fails
+    the gate instead of hiding inside a loosened MXU-rounding allowance.
+
+    Only when comparing under production numerics (``matmul_precision=
+    None``) is the tolerance backend-aware: on TPU the MXU multiplies f32
+    operands in bf16 and the two formulations round in *different* places —
+    the kernel in its one-hot contractions, the jnp path in its im2col
+    einsum — so an O(1e-3) relative disagreement is inherent numerics, not
+    a miscompile (measured 2-4e-3 on v5 lite, r4 bench ``mosaic_dcn``).
+    2e-2 keeps ~5x headroom while still failing hard on real
+    indexing/accumulation bugs, which produce O(1) errors.
     """
     if tol is None:
-        tol = 2e-2 if on_tpu_backend() else 1e-3
+        if matmul_precision:
+            tol = 1e-3
+        else:
+            tol = 2e-2 if on_tpu_backend() else 1e-3
     fwd_ok = errs["fwd_max_err"] <= tol * max(errs["fwd_scale"], 1.0)
     return fwd_ok and all(
         errs[f"{n}_rel_err"] <= tol for n in ("gx", "goff", "gmask", "gw")
     )
+
+
+# How the last pallas_compiles() gate decision was reached — surfaced by
+# bench.py's mosaic_dcn stage so the on-chip artifact records whether the
+# strict pinned-precision tolerance held or the production-numerics
+# fallback was needed. None until the gate has run.
+_GATE_MODE: Optional[str] = None
+
+
+def gate_mode() -> Optional[str]:
+    """Which parity mode the production dispatch gate passed (or None)."""
+    return _GATE_MODE
 
 
 @functools.lru_cache(maxsize=None)
@@ -343,19 +384,34 @@ def pallas_compiles() -> bool:
     Compiles forward + full VJP with ``interpret=False`` at a tiny shape and
     cross-checks BOTH the output and all four cotangents against the jnp
     formulation (a backward that compiles-but-miscomputes must fail the gate
-    too). Memoized; returns False off-TPU — interpreter mode proves nothing
-    about Mosaic, and the kernel's one-hot-MXU formulation is TPU-designed,
-    not a GPU/Triton candidate. ``deform_conv2d_auto`` gates its Pallas
-    dispatch on this, so the production default can never route through a
-    kernel the resident compiler rejects — the concern VERDICT r3 raised
-    about accumulating output blocks / ``pl.ds`` group slicing / ``@pl.when``
+    too). The check runs under pinned ``'highest'`` matmul precision with
+    the strict 1e-3 tolerance (ADVICE r4 — a ~1% kernel defect must fail,
+    not hide inside an MXU-rounding allowance). The production-numerics
+    fallback (backend-aware 2e-2) is reachable ONLY with positive evidence
+    that the backend ignored the precision request *for the kernel* while
+    honoring it for the jnp reference — i.e. the kernel's output is
+    bit-identical across precision modes while the jnp path's is not, which
+    makes the pinned comparison apples-to-oranges by construction, not a
+    kernel defect. A strict-tolerance failure with pinning honored fails
+    the gate outright. :func:`gate_mode` records which branch decided.
+    Memoized; returns False off-TPU — interpreter mode proves nothing about
+    Mosaic, and the kernel's one-hot-MXU formulation is TPU-designed, not a
+    GPU/Triton candidate. ``deform_conv2d_auto`` gates its Pallas dispatch
+    on this, so the production default can never route through a kernel the
+    resident compiler rejects — the concern VERDICT r3 raised about
+    accumulating output blocks / ``pl.ds`` group slicing / ``@pl.when``
     init never having met Mosaic.
     """
+    global _GATE_MODE
     if not on_tpu_backend():
+        _GATE_MODE = "off-tpu (gate closed)"
         return False
-    try:
-        import numpy as np
+    import contextlib
+    import warnings
 
+    import numpy as np
+
+    try:
         rng = np.random.default_rng(0)
         b, h, w, c, dg = 1, 4, 6, 16, 2
         x = jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.float32)
@@ -365,15 +421,57 @@ def pallas_compiles() -> bool:
         mask = jax.nn.sigmoid(
             jnp.asarray(rng.standard_normal((b, h, w, dg, 9)), jnp.float32)
         )
-        wt = jnp.asarray(rng.standard_normal((3, 3, c, c)) * 0.1, jnp.float32)
+        wt = jnp.asarray(
+            rng.standard_normal((3, 3, c, c)) * 0.1, jnp.float32
+        )
 
         errs = dcn_parity_errors(x, off, mask, wt, interpret=False)
-        if not dcn_parity_ok(errs):
+        if dcn_parity_ok(errs):
+            _GATE_MODE = "matmul_precision=highest tol=1e-3"
+            return True
+
+        # Strict check failed. Fallback is legitimate only if the backend
+        # ignored the precision pin for the kernel: compare each path
+        # against ITSELF across precision modes. jnp sensitive + kernel
+        # insensitive => the pinned comparison mixed f32 against bf16
+        # numerics by construction; anything else => treat as a defect.
+        def _fwd(pin):
+            ctx = (jax.default_matmul_precision("highest") if pin
+                   else contextlib.nullcontext())
+            with ctx:
+                k = deform_conv2d_pallas(x, off, mask, wt, interpret=False)
+                j = _dcn_jnp.deform_conv2d(x, off, mask, wt)
+            return np.asarray(k), np.asarray(j)
+
+        k_hi, j_hi = _fwd(True)
+        k_def, j_def = _fwd(False)
+        scale = max(float(np.max(np.abs(j_hi))), 1e-6)
+        kernel_sens = float(np.max(np.abs(k_hi - k_def))) / scale
+        jnp_sens = float(np.max(np.abs(j_hi - j_def))) / scale
+        pin_ignored = kernel_sens < 1e-7 and jnp_sens > 1e-5
+        if not pin_ignored:
+            raise AssertionError(
+                f"mosaic parity mismatch under pinned precision (kernel "
+                f"precision-sensitivity {kernel_sens:.2e}, jnp "
+                f"{jnp_sens:.2e} — pin honored, so this is a kernel "
+                f"defect, not rounding): {errs}"
+            )
+        warnings.warn(
+            "Pallas DCN: backend ignored the matmul-precision pin for the "
+            "kernel (kernel bit-stable across modes, jnp reference not); "
+            "re-checking under matched production numerics",
+            stacklevel=2,
+        )
+        errs = dcn_parity_errors(
+            x, off, mask, wt, interpret=False, matmul_precision=None
+        )
+        if not dcn_parity_ok(errs, matmul_precision=None):
             raise AssertionError(f"mosaic parity mismatch: {errs}")
+        _GATE_MODE = ("default-precision fallback tol=2e-2 "
+                      "(precision pin ignored by kernel)")
         return True
     except Exception as e:  # noqa: BLE001 - any rejection means "don't use"
-        import warnings
-
+        _GATE_MODE = f"failed: {e!r}"
         warnings.warn(
             f"Pallas DCN failed the Mosaic self-test; auto dispatch falls "
             f"back to the jnp formulation: {e!r}",

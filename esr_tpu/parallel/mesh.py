@@ -64,7 +64,22 @@ def honor_platform_env(infer_from_xla_flags: bool = False) -> None:
         jax.config.update("jax_platforms", plat)  # silent no-op post-init
         from jax._src import xla_bridge
 
-        if getattr(xla_bridge, "_backends", None):
+        # Mismatch detection must never silently vanish on a jax upgrade
+        # (ADVICE r4): resolve an introspection point fail-loud, preferring
+        # the semi-public predicate over the private dict.
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            already = xla_bridge.backends_are_initialized()
+        elif hasattr(xla_bridge, "_backends"):
+            already = bool(xla_bridge._backends)
+        else:
+            raise RuntimeError(
+                "cannot determine whether a jax backend is already "
+                "initialized (xla_bridge lost both backends_are_initialized"
+                " and _backends on this jax version); refusing to continue "
+                "without the 'never a silent run on the wrong platform' "
+                "guarantee"
+            )
+        if already:
             # a backend predates the update, so the update had no effect;
             # acceptable only if the active one satisfies the request
             active = jax.default_backend()

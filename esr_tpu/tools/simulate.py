@@ -283,6 +283,141 @@ def render_scene_frames(
     return frames, np.asarray(ts)
 
 
+def _bilinear_sample(scene: np.ndarray, ys: np.ndarray, xs: np.ndarray) -> np.ndarray:
+    """Bilinear gather from ``scene [H, W]`` at float coords (clamped)."""
+    hh, ww = scene.shape
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    wy = (ys - y0).astype(np.float32)
+    wx = (xs - x0).astype(np.float32)
+    y0c = np.clip(y0, 0, hh - 1)
+    y1c = np.clip(y0 + 1, 0, hh - 1)
+    x0c = np.clip(x0, 0, ww - 1)
+    x1c = np.clip(x0 + 1, 0, ww - 1)
+    return (
+        scene[y0c, x0c] * (1 - wy) * (1 - wx)
+        + scene[y0c, x1c] * (1 - wy) * wx
+        + scene[y1c, x0c] * wy * (1 - wx)
+        + scene[y1c, x1c] * wy * wx
+    )
+
+
+def render_natural_frames(
+    seed: int,
+    num_frames: int = 36,
+    h: int = 360,
+    w: int = 640,
+    fps: float = 20.0,
+    n_leaves: int = 4000,
+) -> Tuple[list, np.ndarray]:
+    """Natural-statistics scene -> (uint8 frames [H, W], ts).
+
+    The gratings-and-discs renderer (:func:`render_scene_frames`) exercises
+    the pipeline but has periodic-texture statistics; the reference's
+    quality target is defined on real NFS footage
+    (``generate_dataset/syn_nfs_rgb.py:80-127``), which a zero-egress image
+    cannot fetch. This renderer synthesizes frames with *natural-image*
+    statistics instead (VERDICT r4 "next" item 7):
+
+    - **dead-leaves background**: opaque discs with a power-law radius
+      distribution (density ~ r^-3) occluding each other — the classical
+      model that reproduces natural images' ~1/f^2 power spectra,
+      scale-invariance, and T-junction/occlusion edge statistics (far
+      richer than gratings: broadband, aperiodic, edges at all scales);
+    - **1/f illumination field** multiplying the albedo (smooth shading);
+    - **smooth camera pan + zoom** sampling a margin-padded scene — the
+      global optical flow of handheld footage (NFS is hand-tracked video);
+    - **independently moving textured foreground objects** for local
+      motion/parallax against the camera flow.
+
+    Deterministic per seed. Drop-in for ``render_scene_frames`` in
+    ``scripts/make_quality_demo_data.py`` (``DEMO_SCENE=natural``).
+    """
+    rng = np.random.default_rng(seed)
+    margin = 0.25
+    hh = int(round(h * (1 + 2 * margin)))
+    ww = int(round(w * (1 + 2 * margin)))
+
+    # --- dead-leaves albedo: power-law radii via inverse CDF (p(r)~r^-3
+    # => CDF in r^-2), painted back-to-front so later leaves occlude
+    r_min, r_max = 2.0, min(hh, ww) / 3.0
+    u = rng.uniform(size=n_leaves)
+    radii = 1.0 / np.sqrt(u / r_min**2 + (1 - u) / r_max**2)
+    cys = rng.uniform(0, hh, n_leaves)
+    cxs = rng.uniform(0, ww, n_leaves)
+    grays = rng.uniform(0.05, 0.95, n_leaves)
+    # mild per-leaf linear gradient: leaves read as lit surfaces, and the
+    # interiors aren't piecewise-constant (natural images aren't)
+    gdir = rng.uniform(-1, 1, (n_leaves, 2))
+    scene = np.full((hh, ww), 0.5, np.float32)
+    for i in range(n_leaves):
+        ri = radii[i]
+        y0, y1 = int(max(0, cys[i] - ri)), int(min(hh, cys[i] + ri + 1))
+        x0, x1 = int(max(0, cxs[i] - ri)), int(min(ww, cxs[i] + ri + 1))
+        if y0 >= y1 or x0 >= x1:
+            continue
+        py, px = np.mgrid[y0:y1, x0:x1]
+        m = (py - cys[i]) ** 2 + (px - cxs[i]) ** 2 <= ri * ri
+        shade = (
+            gdir[i, 0] * (py - cys[i]) + gdir[i, 1] * (px - cxs[i])
+        ) / (ri + 1.0) * 0.15
+        patch = scene[y0:y1, x0:x1]
+        patch[m] = np.clip(grays[i] + shade, 0.02, 0.98)[m]
+
+    # --- 1/f illumination (pink noise via spectral shaping)
+    fy = np.fft.fftfreq(hh)[:, None]
+    fx = np.fft.fftfreq(ww)[None, :]
+    f = np.sqrt(fy * fy + fx * fx)
+    f[0, 0] = 1.0
+    spec = (rng.standard_normal((hh, ww)) + 1j * rng.standard_normal((hh, ww))) / f
+    illum = np.real(np.fft.ifft2(spec)).astype(np.float32)
+    illum = (illum - illum.mean()) / (illum.std() + 1e-9)
+    scene = scene * (1.0 + 0.15 * illum)
+
+    # --- foreground objects: textured discs on straight-line paths
+    n_obj = 2
+    obj_r = rng.uniform(0.06, 0.12, n_obj) * min(h, w)
+    obj_y0 = rng.uniform(0.2, 0.8, n_obj) * h
+    obj_x0 = rng.uniform(0.2, 0.8, n_obj) * w
+    obj_vel = rng.uniform(-0.22, 0.22, (n_obj, 2)) * min(h, w)  # px/s
+    obj_gray = rng.uniform(0.1, 0.9, n_obj)
+    obj_phase = rng.uniform(0, 2 * np.pi, n_obj)
+    obj_freq = rng.uniform(0.05, 0.15, n_obj)  # texture cycles/px
+
+    # --- camera path: smooth sinusoidal pan within the margin + slow zoom
+    pan_amp_y = rng.uniform(0.4, 0.9) * margin * h
+    pan_amp_x = rng.uniform(0.4, 0.9) * margin * w
+    pan_f = rng.uniform(0.1, 0.3, 2)          # Hz
+    pan_ph = rng.uniform(0, 2 * np.pi, 2)
+    zoom_amp = rng.uniform(0.02, 0.06)
+    zoom_f = rng.uniform(0.08, 0.2)
+    zoom_ph = rng.uniform(0, 2 * np.pi)
+
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    frames, ts = [], []
+    for i in range(num_frames):
+        t = i / fps
+        zoom = 1.0 + zoom_amp * np.sin(2 * np.pi * zoom_f * t + zoom_ph)
+        oy = hh / 2 + pan_amp_y * np.sin(2 * np.pi * pan_f[0] * t + pan_ph[0])
+        ox = ww / 2 + pan_amp_x * np.sin(2 * np.pi * pan_f[1] * t + pan_ph[1])
+        src_y = oy + (yy - h / 2) * zoom
+        src_x = ox + (xx - w / 2) * zoom
+        img = _bilinear_sample(scene, src_y, src_x)
+        for oi in range(n_obj):
+            cy = obj_y0[oi] + obj_vel[oi, 0] * t
+            cx = obj_x0[oi] + obj_vel[oi, 1] * t
+            d2 = (yy - cy) ** 2 + (xx - cx) ** 2
+            m = d2 <= obj_r[oi] ** 2
+            if m.any():
+                tex = obj_gray[oi] + 0.25 * np.sin(
+                    2 * np.pi * obj_freq[oi] * (xx + yy) + obj_phase[oi]
+                )
+                img = np.where(m, np.clip(tex, 0.02, 0.98), img)
+        frames.append((np.clip(img, 0, 1) * 255).astype(np.uint8))
+        ts.append(t)
+    return frames, np.asarray(ts)
+
+
 def read_txt_events(path: str) -> np.ndarray:
     """EventZoom txt (``t x y p``, p in {0,1}, one header row) ->
     ``[N, 4]`` (x, y, t, ±1) (reference ``convert_eventzoom.py:66-69,97-102``)."""

@@ -127,8 +127,10 @@ def render_event_3d(
 ) -> np.ndarray:
     """(x, t, y) 3D scatter of an event cloud, blue=positive red=negative —
     the reference's qualitative debugging view (``plot_event_3d``,
-    ``matplotlib_plot_events.py:283-323``; its open3d cloud export is not
-    ported — no open3d in this image). Returns an RGB uint8 image.
+    ``matplotlib_plot_events.py:283-323``; for its open3d point-cloud dump
+    — ``show_event_cloud``, ``:38-55`` — use :func:`export_event_cloud`,
+    which writes the same colored cloud as PLY without open3d). Returns an
+    RGB uint8 image.
 
     ``events``: ``[N, 4]`` (x, y, t, p); optional GT cloud side-by-side.
     """
@@ -155,6 +157,27 @@ def render_event_3d(
     img = np.asarray(fig.canvas.buffer_rgba())[..., :3].copy()
     plt.close(fig)
     return img
+
+
+def export_event_cloud(
+    events: np.ndarray,
+    resolution: Tuple[int, int],
+    output_path: str,
+) -> int:
+    """Dump an event cloud as a colored PLY point cloud for external 3D
+    viewers — the open3d-free analogue of the reference's
+    ``show_event_cloud`` (``matplotlib_plot_events.py:38-55``, which builds
+    an ``o3d.geometry.PointCloud`` and ``write_point_cloud``-s it; no
+    open3d in this image). Delegates to the dependency-free binary PLY
+    writer :func:`esr_tpu.tools.h5_tools.events_to_ply` (red=positive,
+    blue=negative, ``t`` normalized to the sensor height so the cloud is
+    roughly cubic).
+
+    ``events``: ``[N, 4]`` ``(x, y, t, p)``. Returns vertices written.
+    """
+    from esr_tpu.tools.h5_tools import events_to_ply
+
+    return events_to_ply(events, resolution, output_path)
 
 
 # The reference's interactive view presets (keys 1-5,

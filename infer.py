@@ -105,7 +105,12 @@ def main():
         lpips_net=flags.lpips_net,
         lpips_lin_npz=flags.lpips_lins,
     )
-    print({k: round(v, 6) for k, v in mean.items()})
+    # One machine-readable JSON line (ADVICE r4: consumers must not eval()
+    # a repr). json.dumps emits bare NaN/Infinity tokens for non-finite
+    # metrics (a perfect window's PSNR); json.loads round-trips them.
+    import json
+
+    print(json.dumps({k: round(v, 6) for k, v in mean.items()}))
 
 
 if __name__ == "__main__":

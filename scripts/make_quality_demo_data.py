@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main():
     from esr_tpu.tools.simulate import (
+        render_natural_frames,
         render_scene_frames,
         simulate_ladder_recording,
     )
@@ -47,6 +48,13 @@ def main():
             f"DEMO_RUNGS must name distinct rungs from "
             f"{sorted(_RUNG_FACTOR)}; got {list(rungs) or 'nothing'}"
         )
+    # DEMO_SCENE picks the frame renderer: 'gratings' (default, the r4
+    # committed corpora) or 'natural' (dead-leaves + 1/f shading + camera
+    # pan — natural-image statistics; VERDICT r4 item 7).
+    scene = os.environ.get("DEMO_SCENE", "gratings")
+    if scene not in ("gratings", "natural"):
+        raise SystemExit(f"DEMO_SCENE must be gratings|natural, got {scene!r}")
+    render = render_scene_frames if scene == "gratings" else render_natural_frames
 
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/esr_quality_demo"
     n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 6
@@ -61,7 +69,7 @@ def main():
     )
     for seed, (split, i) in enumerate(names):
         path = os.path.join(out_dir, f"{split}_{i}.h5")
-        frames, ts = render_scene_frames(seed=1000 + seed, h=base_h, w=base_w)
+        frames, ts = render(seed=1000 + seed, h=base_h, w=base_w)
         cp, cn = simulate_ladder_recording(
             frames, ts, path, rungs=rungs, seed=2000 + seed
         )

@@ -21,7 +21,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 _LOG = os.path.join(os.path.dirname(__file__), "..", "artifacts",
-                    "PROBES_r04.jsonl")
+                    "PROBES_r05.jsonl")
 
 
 def _emit(rec):

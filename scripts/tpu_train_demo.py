@@ -134,12 +134,9 @@ def main():
         try:
             line = [l for l in r2.stdout.splitlines()
                     if l.startswith("{")][-1]
-            # infer prints a python dict repr; nan/inf (psnr of a perfect
-            # window) are not literal_eval-able, so supply them
-            means = eval(  # noqa: S307 - our own CLI's output
-                line, {"__builtins__": {}},
-                {"nan": float("nan"), "inf": float("inf")},
-            )
+            # infer prints one JSON line; json.loads handles the bare
+            # NaN/Infinity tokens a perfect window's PSNR produces
+            means = json.loads(line)
             rec["held_out_means"] = means
             rec["esr_beats_bicubic_mse"] = (
                 means["esr_mse"] < means["bicubic_mse"]

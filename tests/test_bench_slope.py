@@ -126,7 +126,11 @@ def test_headline_attaches_last_known_good_only_when_valueless(
     with contextlib.redirect_stdout(buf):
         bench._print_headline()
     out = json.loads(buf.getvalue())
-    lkg = out["extra"]["last_known_good_capture"]
+    cap = out["extra"]["last_known_good_capture"]
+    # provenance names the file the capture came from (r5: the lookup also
+    # falls back to prior rounds' logs); stage records nest under "stages"
+    assert cap["source_log"] == "stages.jsonl"
+    lkg = cap["stages"]
     # run 1 selected wholesale; run 2's bf16 not stitched in
     assert lkg["compute"]["steps_per_sec"] == 1076.0
     assert lkg["bf16"]["ts"] == "t1"

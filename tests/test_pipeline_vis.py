@@ -250,3 +250,21 @@ def test_events_to_ply_binary_and_ascii(recording, tmp_path):
     lines = open(out_txt).read().splitlines()
     assert lines[1] == "format ascii 1.0"
     assert len(lines) == lines.index("end_header") + 1 + 5
+
+
+def test_export_event_cloud_vis_analogue(recording, tmp_path):
+    """utils.vis_events.export_event_cloud — the open3d-free analogue of the
+    reference's ``show_event_cloud`` point-cloud dump
+    (``matplotlib_plot_events.py:38-55``) — writes the same PLY the tools
+    writer produces (identical bytes: one implementation, two entry
+    points)."""
+    from esr_tpu.utils.vis_events import export_event_cloud
+
+    path, xs, ys, ts, ps = recording
+    ev = read_h5_events(path)
+    out_vis = str(tmp_path / "vis_cloud.ply")
+    out_ref = str(tmp_path / "tools_cloud.ply")
+    n = export_event_cloud(ev, (7, 9), out_vis)
+    assert n == len(ev)
+    events_to_ply(ev, (7, 9), out_ref)
+    assert open(out_vis, "rb").read() == open(out_ref, "rb").read()

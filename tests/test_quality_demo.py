@@ -14,8 +14,8 @@ Runs in 1-device subprocesses (batch 2 like the committed demo run; the
 parent test env forces an 8-device mesh that would demand batch 8).
 """
 
-import ast
 import glob
+import json
 import math
 import os
 import subprocess
@@ -37,16 +37,25 @@ def _env():
     return env
 
 
-def _make_corpus(tmp_path, n_train=2, rungs=("down4", "down8")):
+def _make_corpus(tmp_path, n_train=2, rungs=("down4", "down8"),
+                 scene="gratings"):
     """Tiny ESIM ladder corpus: base 96x160, input down8 (12x20), GT at
     the rung ``scale`` steps up (down4 = 24x40 for 2x, down2 = 48x80 for
-    4x)."""
+    4x). ``scene='natural'`` renders dead-leaves natural-statistics frames
+    instead of gratings (the full-size corpus script's DEMO_SCENE knob)."""
     paths = []
     for i in range(n_train + 1):
-        frames, ts = render_scene_frames(
-            seed=500 + i, num_frames=24, h=96, w=160,
-            disc_radius_scale=96 / 720 + 0.2,
-        )
+        if scene == "natural":
+            from esr_tpu.tools.simulate import render_natural_frames
+
+            frames, ts = render_natural_frames(
+                seed=500 + i, num_frames=24, h=96, w=160
+            )
+        else:
+            frames, ts = render_scene_frames(
+                seed=500 + i, num_frames=24, h=96, w=160,
+                disc_radius_scale=96 / 720 + 0.2,
+            )
         p = str(tmp_path / f"rec{i}.h5")
         simulate_ladder_recording(
             frames, ts, p, rungs=rungs, seed=600 + i
@@ -61,10 +70,11 @@ def _make_corpus(tmp_path, n_train=2, rungs=("down4", "down8")):
     return train_dl, held_dl
 
 
-def _train_and_eval(tmp_path, config, scale, rungs, runid, iterations=200):
+def _train_and_eval(tmp_path, config, scale, rungs, runid, iterations=200,
+                    scene="gratings"):
     """Train via train.py, eval the final checkpoint via infer.py on the
     held-out recording; returns (train cmd, checkpoints, mean metrics)."""
-    train_dl, held_dl = _make_corpus(tmp_path, rungs=rungs)
+    train_dl, held_dl = _make_corpus(tmp_path, rungs=rungs, scene=scene)
     out = str(tmp_path / "run")
     overrides = [
         f"train_dataloader;path_to_datalist_txt={train_dl}",
@@ -115,8 +125,9 @@ def _train_and_eval(tmp_path, config, scale, rungs, runid, iterations=200):
     )
     assert r2.returncode == 0, r2.stderr[-3000:]
 
-    # stdout's last line is the datalist-mean metrics dict
-    means = ast.literal_eval(
+    # stdout's last line is the datalist-mean metrics dict (one JSON line;
+    # json.loads accepts the bare NaN/Infinity tokens json.dumps emits)
+    means = json.loads(
         [l for l in r2.stdout.splitlines() if l.startswith("{")][-1]
     )
     return cmd, ckpts, means
@@ -154,6 +165,20 @@ def test_trained_esr_beats_bicubic_4x(tmp_path):
     training run completes)."""
     _, _, means = _train_and_eval(
         tmp_path, "configs/train_esr_4x.yml", 4, ("down2", "down8"), "qtiny4"
+    )
+    assert means["esr_mse"] < means["bicubic_mse"], means
+    assert means["esr_psnr"] > means["bicubic_psnr"], means
+
+
+def test_trained_esr_beats_bicubic_natural(tmp_path):
+    """The 2x recipe on the NATURAL-statistics corpus (dead-leaves + 1/f
+    shading + camera pan, ``render_natural_frames``) — the quality claim
+    must survive off gratings (VERDICT r4 item 7: 'it only works on
+    gratings' objection). Full-size artifact run:
+    ``artifacts/quality_demo_eval_natural*``."""
+    _, _, means = _train_and_eval(
+        tmp_path, "configs/train_esr_2x.yml", 2, ("down4", "down8"),
+        "qnat", scene="natural",
     )
     assert means["esr_mse"] < means["bicubic_mse"], means
     assert means["esr_psnr"] > means["bicubic_psnr"], means
