@@ -364,3 +364,113 @@ class SequenceLoader:
                     yield pending.popleft().result()
             while pending:
                 yield pending.popleft().result()
+
+
+class DevicePrefetcher:
+    """Overlap host->device staging with device compute (double-buffering).
+
+    Wraps a host-batch iterable: a daemon thread applies ``stage_fn`` (e.g.
+    ``Trainer._stage`` — stream selection + sharded ``device_put``) to up
+    to ``depth`` batches ahead of consumption and queues
+    ``(host_batch, staged_batch)`` pairs. JAX *dispatch* is async, but the
+    host->device transfer of a large batch can block the host thread —
+    severely so over a slow link (the axon tunnel measures ~60 MB/s) —
+    turning every step into transfer-then-compute. Staging from a side
+    thread makes the transfer a pipeline stage that runs while the device
+    executes the previous step. The reference's analogue is the
+    ``pin_memory`` + ``.cuda(non_blocking=True)`` H2D overlap idiom around
+    its DataLoader consumer (``train_ours_cnt_seq.py:186-341``).
+
+    The host batch is yielded alongside the staged one because consumers
+    need it for host-side work (vis logging). Source exhaustion ends
+    iteration; a producer exception re-raises at the consumer boundary;
+    ``close()`` (or context-manager exit) stops the thread early and is
+    idempotent.
+    """
+
+    def __init__(self, source, stage_fn, depth: int = 2):
+        import queue
+        import threading
+
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce,
+            args=(iter(source), stage_fn),
+            daemon=True,
+            name="device-prefetch",
+        )
+        self._thread.start()
+
+    def _produce(self, it, stage_fn):
+        import queue
+
+        def put(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for host_batch in it:
+                if self._stop.is_set():
+                    return
+                if not put(("item", (host_batch, stage_fn(host_batch)))):
+                    return
+            put(("end", None))
+        except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+            put(("error", e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        kind, payload = self._q.get()
+        if kind == "item":
+            return payload
+        if kind == "end":
+            self.close()
+            raise StopIteration
+        self.close()
+        raise payload
+
+    def close(self):
+        """Stop the producer and release queued staged batches."""
+        self._stop.set()
+
+        def drain():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except Exception:  # noqa: BLE001 - queue.Empty
+                pass
+
+        drain()
+        self._thread.join(timeout=5.0)
+        # a producer blocked in put() can land one more item the moment the
+        # first drain frees a slot — drain again after the join so no
+        # staged (device-resident) batch outlives close()
+        drain()
+        if self._thread.is_alive():
+            import warnings
+
+            warnings.warn(
+                "DevicePrefetcher producer thread did not stop within 5s "
+                "(stage_fn blocked in a device transfer?); it is daemonic "
+                "and holds at most one in-flight batch",
+                stacklevel=2,
+            )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

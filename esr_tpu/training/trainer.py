@@ -243,6 +243,15 @@ class Trainer:
             raise ValueError(
                 f"train_lookahead must be >= 0, got {self.train_lookahead}"
             )
+        # host->device staging pipelined ``device_prefetch`` batches ahead
+        # of the consuming step (DevicePrefetcher; 0 stages inline). The
+        # other half of the input-pipeline overlap story: train_lookahead
+        # defers the metrics READBACK, this overlaps the batch UPLOAD.
+        self.device_prefetch = int(trainer_cfg.get("device_prefetch", 2))
+        if self.device_prefetch < 0:
+            raise ValueError(
+                f"device_prefetch must be >= 0, got {self.device_prefetch}"
+            )
 
         self.profile_cfg = trainer_cfg.get("profile", {}) or {}
         self.start_iteration = 0
@@ -513,75 +522,95 @@ class Trainer:
             while pending:
                 consume(pending.popleft())
 
+        import contextlib
+
+        from esr_tpu.data.loader import DevicePrefetcher
+
         while not stop:
             self.train_loader.set_epoch(epoch)
-            for batch in self.train_loader:
-                best = False
-                self.state, metrics = self.train_step(
-                    self.state, self._stage(batch, for_train=True)
-                )
-                keep_vis = (
-                    self.writer is not None
-                    and self.vis_enabled
-                    and iter_idx % self.train_vis_step == 0
-                )
-                pending.append(
-                    (iter_idx, epoch, metrics, batch if keep_vis else None)
-                )
-                if len(pending) > self.train_lookahead:
-                    consume(pending.popleft())
-
-                valid_due = (
-                    self.valid_loader is not None
-                    and iter_idx % self.valid_step == 0
-                    and iter_idx != 0
-                )
-                save_due = (
-                    iter_idx % self.save_period == 0 and iter_idx != 0
-                )
-                final_due = iter_idx + 1 >= self.iterations
-                if valid_due or save_due or final_due:
-                    drain()
-
-                if valid_due:
-                    val_log = self._valid(valid_stamp)
-                    if self.writer is not None:
-                        # stamp-aligned train scalars (reference :304-305)
-                        self.writer.add_scalar(
-                            "stamp_train_mse_loss",
-                            last_scalars["mse"],
-                            step=valid_stamp,
-                        )
-                        self.writer.add_scalar(
-                            "stamp_train_loss",
-                            last_scalars["loss"],
-                            step=valid_stamp,
-                        )
-                    logger.info(
-                        "Valid stamp %d: %s",
-                        valid_stamp,
-                        {k: round(v, 6) for k, v in val_log.items()},
+            # host->device upload pipelined ahead of the consuming step;
+            # the ExitStack guarantees the producer thread stops even when
+            # the for-loop breaks mid-epoch (early stop, final iteration)
+            with contextlib.ExitStack() as stack:
+                if self.device_prefetch:
+                    batches = stack.enter_context(DevicePrefetcher(
+                        self.train_loader,
+                        lambda b: self._stage(b, for_train=True),
+                        depth=self.device_prefetch,
+                    ))
+                else:
+                    batches = (
+                        (b, self._stage(b, for_train=True))
+                        for b in self.train_loader
                     )
-                    stop, best = self.eval_model_performance(val_log)
-                    valid_stamp += 1
-                    if stop:
+                for batch, staged in batches:
+                    best = False
+                    self.state, metrics = self.train_step(self.state, staged)
+                    keep_vis = (
+                        self.writer is not None
+                        and self.vis_enabled
+                        and iter_idx % self.train_vis_step == 0
+                    )
+                    pending.append(
+                        (iter_idx, epoch, metrics,
+                         batch if keep_vis else None)
+                    )
+                    if len(pending) > self.train_lookahead:
+                        consume(pending.popleft())
+
+                    valid_due = (
+                        self.valid_loader is not None
+                        and iter_idx % self.valid_step == 0
+                        and iter_idx != 0
+                    )
+                    save_due = (
+                        iter_idx % self.save_period == 0 and iter_idx != 0
+                    )
+                    final_due = iter_idx + 1 >= self.iterations
+                    if valid_due or save_due or final_due:
+                        drain()
+
+                    if valid_due:
+                        val_log = self._valid(valid_stamp)
+                        if self.writer is not None:
+                            # stamp-aligned train scalars (reference
+                            # :304-305)
+                            self.writer.add_scalar(
+                                "stamp_train_mse_loss",
+                                last_scalars["mse"],
+                                step=valid_stamp,
+                            )
+                            self.writer.add_scalar(
+                                "stamp_train_loss",
+                                last_scalars["loss"],
+                                step=valid_stamp,
+                            )
+                        logger.info(
+                            "Valid stamp %d: %s",
+                            valid_stamp,
+                            {k: round(v, 6) for k, v in val_log.items()},
+                        )
+                        stop, best = self.eval_model_performance(val_log)
+                        valid_stamp += 1
+                        if stop:
+                            break
+
+                    saved_now = save_due or best
+                    if saved_now:
+                        self._save(iter_idx, best)
+
+                    if final_due:
+                        logger.info("Training completes!")
+                        # Final-state checkpoint — deliberate deviation from
+                        # the reference, which saves only on save_period
+                        # multiples (train_ours_cnt_seq.py:316-319) and so
+                        # loses up to save_period-1 trailing iterations of a
+                        # finished run.
+                        if not saved_now:
+                            self._save(iter_idx, False)
+                        stop = True
                         break
-
-                saved_now = save_due or best
-                if saved_now:
-                    self._save(iter_idx, best)
-
-                if final_due:
-                    logger.info("Training completes!")
-                    # Final-state checkpoint — deliberate deviation from the
-                    # reference, which saves only on save_period multiples
-                    # (train_ours_cnt_seq.py:316-319) and so loses up to
-                    # save_period-1 trailing iterations of a finished run.
-                    if not saved_now:
-                        self._save(iter_idx, False)
-                    stop = True
-                    break
-                iter_idx += 1
+                    iter_idx += 1
             epoch += 1
         drain()
 

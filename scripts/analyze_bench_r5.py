@@ -1,0 +1,190 @@
+"""Arbitrate the r4 67x timing contradiction from an r5 staged capture.
+
+Reads ``artifacts/BENCH_STAGES_r05.jsonl``, groups records into runs (each
+run opens with ``backend_up``), picks the newest run containing the
+``scan_compute`` arbiter stage, and prints a markdown summary answering the
+round-5 questions (VERDICT r4 "next" items 1-4):
+
+1. the defensible steps/s + MFU (scan-slope, dispatch-proof) and which of
+   the r4 methods — async-dispatch loop (1076 steps/s) vs AOT/slope
+   (~16 steps/s) — it sides with;
+2. whether the Pallas DCN gate passed on chip and whether the flagship
+   step actually dispatched Pallas (``dcn_dispatch_traced``);
+3. where the MFU ceiling lives (``wide_model`` vs flagship MFU, with the
+   ``scan_matmul`` achieved-TFLOPS anchor as the method calibration);
+4. input-pipeline supply vs demand: measured loader throughput
+   (``artifacts/LOADER_PROFILE.jsonl``) against the defensible step time,
+   plus the e2e stages.
+
+Usage: python scripts/analyze_bench_r5.py [stage_log]
+Exit 0 with the summary on stdout; exit 3 if no scan_compute capture
+exists yet (wedged all round).
+"""
+
+import json
+import os
+import sys
+
+
+def load_runs(path):
+    runs, cur = [], None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("stage") == "backend_up":
+                    cur = []
+                    runs.append(cur)
+                if cur is not None:
+                    cur.append(rec)
+    except OSError:
+        pass
+    return runs
+
+
+def newest_capture(runs):
+    for run in reversed(runs):
+        stages = {}
+        for r in run:
+            if r.get("ok"):
+                stages[r["stage"]] = r
+        if "scan_compute" in stages:
+            return stages
+    return None
+
+
+def loader_supply():
+    """Best measured single-process loader throughput (batches/s at b2)."""
+    best = None
+    try:
+        with open(os.path.join("artifacts", "LOADER_PROFILE.jsonl")) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("num_workers") == 0 and rec.get("batches_per_sec"):
+                    v = float(rec["batches_per_sec"])
+                    best = v if best is None else max(best, v)
+    except OSError:
+        pass
+    return best
+
+
+def main():
+    log = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        "artifacts", "BENCH_STAGES_r05.jsonl")
+    cap = newest_capture(load_runs(log))
+    if cap is None:
+        print(f"no scan_compute capture in {log} yet (tunnel never healed?)")
+        sys.exit(3)
+
+    sc = cap["scan_compute"]
+    out = []
+    out.append(f"## r5 on-chip arbitration ({cap['backend_up'].get('ts', '?')},"
+               f" {cap['backend_up'].get('device_kind', '?')})")
+    sps = sc["steps_per_sec"]
+    out.append(
+        f"- **Defensible headline: {sps} steps/s "
+        f"({sc['ms_per_step']} ms/step), MFU {sc.get('mfu')}** — scan-slope "
+        f"method: K steps chained in ONE executable, scalar sync readback, "
+        f"(k_hi-k_lo) slope cancels all per-call cost; immune to both r4 "
+        f"methods' failure modes."
+    )
+    comp = cap.get("compute")
+    if comp:
+        ratio = comp["steps_per_sec"] / sps
+        verdict = (
+            "the async-loop number was measuring the dispatch queue, not "
+            "the device" if ratio > 3 else
+            "the r4 slope-method numbers were the contaminated ones "
+            "(per-call re-staging over the tunnel)" if ratio < 1 / 3 else
+            "the two methods now agree — the r4 contradiction was a "
+            "tunnel-state artifact, not a method defect"
+        )
+        out.append(
+            f"- Async-dispatch loop on the same run: "
+            f"{comp['steps_per_sec']} steps/s ({ratio:.1f}x the slope "
+            f"number) => {verdict}."
+        )
+    mm = cap.get("scan_matmul")
+    if mm:
+        out.append(
+            f"- Method calibration: scan_matmul anchor achieved "
+            f"**{mm['tflops_bf16']} TFLOPS bf16 "
+            f"({mm['frac_of_peak']:.0%} of peak)** with known 2n^3 flops — "
+            f"the same timing machinery reads a near-peak number on pure "
+            f"MXU work, so the flagship figure is the model/pipeline, not "
+            f"the clock." if mm["frac_of_peak"] > 0.3 else
+            f"- Method calibration: scan_matmul anchor only "
+            f"{mm['tflops_bf16']} TFLOPS bf16 ({mm['frac_of_peak']:.0%} of "
+            f"peak) — the chip/tunnel itself underdelivers on pure MXU "
+            f"work; treat absolute MFU with that ceiling in mind."
+        )
+    wm = cap.get("wide_model")
+    if wm and wm.get("mfu") is not None and sc.get("mfu"):
+        lift = wm["mfu"] / max(sc["mfu"], 1e-9)
+        out.append(
+            f"- MFU ceiling attribution: wide model (basech={wm['basech']}, "
+            f"b={wm['batch']}) reaches MFU {wm['mfu']} — "
+            f"**{lift:.0f}x the flagship's {sc['mfu']}**. "
+            + ("The stack maps to the MXU fine; the flagship MFU is bounded "
+               "by the reference model's tiny channel count (basech 8 vs "
+               "128 MXU lanes)." if lift >= 5 else
+               "No order-of-magnitude jump: the ceiling is NOT just the "
+               "model — profile the stack.")
+        )
+    md = cap.get("mosaic_dcn")
+    if md:
+        out.append(
+            f"- Pallas DCN on chip: gate={md.get('auto_dispatch_gate')} "
+            f"({md.get('gate_mode')}), parity ok="
+            f"{md.get('dcn_pallas_mosaic_ok')}, resolved impl at the "
+            f"bottleneck map: {md.get('resolved_impl_at_bottleneck')}."
+        )
+    if sc.get("dcn_dispatch_traced"):
+        out.append(
+            f"- Step-level dispatch proof: the compiled flagship step "
+            f"traced DCN dispatch {sc['dcn_dispatch_traced']}."
+        )
+    ab = cap.get("dcn_ab")
+    if ab and "train_speedup" in ab:
+        out.append(
+            f"- Pallas vs jnp A/B at the bottleneck shape: "
+            f"{ab['fwd_speedup']}x fwd, {ab['train_speedup']}x training "
+            f"direction."
+        )
+    supply = loader_supply()
+    demand = sps  # b2 batches/s needed to feed b2 steps/s
+    if supply:
+        margin = supply / demand
+        out.append(
+            f"- Input pipeline supply/demand at b2: single-core loader "
+            f"supplies {supply:.1f} batches/s vs {demand:.1f} steps/s "
+            f"demanded => {margin:.1f}x margin "
+            + ("(the 1-core host already feeds this step rate; SURVEY "
+               "§7.3-6 closes at b2)." if margin >= 1.2 else
+               "(starved: the loader cannot feed the chip — device "
+               "prefetch + multi-core host required).")
+        )
+    for key in ("e2e", "e2e_device_raster"):
+        st = cap.get(key)
+        if st:
+            out.append(f"- {key}: {st['steps_per_sec']} steps/s with the "
+                       f"real HDF5 pipeline in the loop.")
+    sca = cap.get("scaling", {}).get("scaling")
+    if sca:
+        pts = ", ".join(
+            f"{b}: {v['steps_per_sec']} steps/s"
+            f" (seq/s {v['sequences_per_sec']}, MFU {v['mfu']})"
+            for b, v in sorted(sca.items())
+        )
+        out.append(f"- Batch scaling: {pts}.")
+    print("\n".join(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,7 @@ with the GIL released, so an in-process timer thread is enough to break out
 mid-init is what wedges the tunnel in the first place.
 
 Every attempt (success, error, or timeout) is appended to
-``artifacts/PROBES_r04.jsonl`` with a UTC timestamp, so a round where the
+``artifacts/PROBES_r05.jsonl`` with a UTC timestamp, so a round where the
 tunnel never heals still leaves evidence of every attempt.
 
 Usage: python scripts/tpu_probe.py [timeout_seconds]
@@ -62,6 +62,18 @@ def main():
         })
         sys.exit(2)
     timer.cancel()
+    # cpu-fallback trap: a downed axon backend can fail FAST (UNAVAILABLE)
+    # and the ambient JAX_PLATFORMS=axon,cpu then lands this probe on CPU;
+    # TPU health means the TPU answered, not that jax found *a* backend.
+    if not str(info.get("device_kind", "")).startswith("TPU"):
+        _emit({
+            "probe": "tpu_backend", "ok": False,
+            "error": f"fell back to {info.get('device_kind')!r} "
+                     f"(axon unavailable)",
+            **info,
+            "elapsed_s": round(time.time() - t0, 1),
+        })
+        sys.exit(2)
     _emit({
         "probe": "tpu_backend",
         "ok": True,

@@ -55,6 +55,29 @@ def main():
 
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # the point is the real backend
+
+    # Backend precheck: with JAX_PLATFORMS popped, a downed axon backend
+    # can fail FAST (UNAVAILABLE) and jax lands on CPU — this demo's whole
+    # claim is "on the real chip", so bail before burning the budget and
+    # record the device kind as evidence either way.
+    rec0 = {"ts": time.strftime("%FT%TZ", time.gmtime())}
+    try:
+        pre = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, jax; k = jax.devices()[0].device_kind; "
+             "print(k); sys.exit(0 if k.startswith('TPU') else 3)"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        rec0["backend_precheck"] = "timeout (tunnel wedged)"
+        _emit(rec0)
+        sys.exit(1)
+    if pre.returncode != 0:
+        rec0["backend_precheck"] = (pre.stdout + pre.stderr)[-300:].strip()
+        _emit(rec0)
+        sys.exit(1)
+    device_kind = pre.stdout.strip()
+
     run_dir = os.path.join(OUT, "run")
     overrides = [
         f"train_dataloader;path_to_datalist_txt={train_dl}",
@@ -84,7 +107,8 @@ def main():
            "-id", "tpu_demo", "-seed", "11", "-r", "auto"]
     for o in overrides:
         cmd += ["-o", o]
-    rec = {"ts": time.strftime("%FT%TZ", time.gmtime())}
+    rec = {"ts": time.strftime("%FT%TZ", time.gmtime()),
+           "device_kind": device_kind}
     try:
         r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                            text=True, timeout=2400)

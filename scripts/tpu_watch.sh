@@ -19,7 +19,10 @@ while true; do
   # (wedged init hangs ignore polite signals — r3 verdict observed 9+ min
   # of silence); SIGKILL after a grace period guarantees one stuck probe
   # can never freeze the whole loop
-  if timeout -k 15 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,)).sum()))" >/dev/null 2>&1; then
+  # The assert guards the cpu-fallback trap: a downed axon backend can fail
+  # FAST (UNAVAILABLE) and JAX_PLATFORMS=axon,cpu then lands the probe on
+  # CPU — a "heal" must mean the TPU itself answered.
+  if timeout -k 15 120 python -c "import jax, jax.numpy as jnp; assert jax.devices()[0].device_kind.startswith('TPU'), jax.devices(); print(float(jnp.ones((8,)).sum()))" >/dev/null 2>&1; then
     echo "{\"ts\": \"$ts\", \"probe\": \"tpu_backend\", \"ok\": true, \"source\": \"watcher\"}" >> "$PROBES"
     if [ ! -f artifacts/WATCHER_BENCH_DONE ]; then
       echo "{\"ts\": \"$ts\", \"watcher\": \"bench_start\"}" >> "$PROBES"

@@ -118,6 +118,10 @@ def test_headline_attaches_last_known_good_only_when_valueless(
     ]
     log.write_text("\n".join(json.dumps(r) for r in records) + "\n")
     monkeypatch.setattr(bench, "_REAL_STAGELOG", str(log))
+    # isolate from the repo's committed prior-round artifacts: without this
+    # the fallback list would read artifacts/BENCH_STAGES_r04.jsonl and the
+    # test would depend on repo history
+    monkeypatch.setattr(bench, "_PRIOR_STAGELOGS", [])
     monkeypatch.delenv("ESR_BENCH_SMOKE", raising=False)
 
     monkeypatch.setattr(bench, "EXTRA", {})

@@ -408,3 +408,54 @@ def test_concat_rejects_ragged_sequence_lengths():
     short_rec = make_synthetic_recording((64, 64), base_events=12, seed=2)
     with pytest.raises(ValueError, match="sequence length"):
         ConcatSequenceDataset([long_rec, short_rec], BASE_CFG)
+
+
+def test_device_prefetcher_order_values_and_errors():
+    """DevicePrefetcher: pairs every host batch with its staged form in
+    source order, propagates a producer exception at the consumer
+    boundary, and close() is idempotent (incl. mid-stream break — the
+    Trainer breaks out of its epoch loop on the final iteration)."""
+    from esr_tpu.data.loader import DevicePrefetcher
+
+    src = [{"x": np.full((2, 2), i)} for i in range(7)]
+    with DevicePrefetcher(src, lambda b: b["x"] + 1, depth=2) as pf:
+        got = list(pf)
+    assert len(got) == 7
+    for i, (host, staged) in enumerate(got):
+        assert host["x"][0, 0] == i
+        np.testing.assert_array_equal(staged, host["x"] + 1)
+
+    # mid-stream break: close() stops the producer without exhausting src
+    def counting():
+        for i in range(10**6):
+            yield {"x": np.array([i])}
+
+    pf2 = DevicePrefetcher(counting(), lambda b: b["x"], depth=2)
+    _ = next(pf2)
+    pf2.close()
+    pf2.close()  # idempotent
+    with pytest.raises(StopIteration):
+        next(pf2)
+
+    # producer exception re-raises at the consumer
+    def broken():
+        yield {"x": np.array([0])}
+        raise RuntimeError("stage blew up")
+
+    with DevicePrefetcher(broken(), lambda b: b["x"], depth=2) as pf3:
+        next(pf3)
+        with pytest.raises(RuntimeError, match="stage blew up"):
+            next(pf3)
+
+
+def test_device_prefetcher_stage_fn_exception():
+    """An exception raised by stage_fn itself (not the source iterator)
+    also surfaces at the consumer, not silently in the thread."""
+    from esr_tpu.data.loader import DevicePrefetcher
+
+    def bad_stage(b):
+        raise ValueError("device_put failed")
+
+    with DevicePrefetcher([{"x": 1}], bad_stage, depth=1) as pf:
+        with pytest.raises(ValueError, match="device_put failed"):
+            next(pf)

@@ -231,6 +231,30 @@ def test_trainer_end_to_end(corpus, tmp_path):
 
 
 @pytest.mark.slow
+def test_device_prefetch_bitwise_equals_inline_staging(corpus, tmp_path):
+    """The DevicePrefetcher path (trainer default, device_prefetch=2) must
+    be a pure pipelining change: final params bitwise-identical to inline
+    staging (device_prefetch=0) for the same seed/config."""
+    tmp, datalist = corpus
+
+    def final_digest(prefetch, runid):
+        config = _make_config(tmp_path, datalist, iterations=6,
+                              valid_step=100)
+        config["trainer"]["device_prefetch"] = prefetch
+        run = RunConfig(config, runid=runid, seed=3)
+        trainer = Trainer(run)
+        trainer.train()
+        return jax.tree.map(np.asarray, trainer.state.params)
+
+    a = final_digest(0, "pf0")
+    b = final_digest(2, "pf2")
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.slow
 def test_checkpoint_resume_bitwise(corpus, tmp_path):
     tmp, datalist = corpus
     config = _make_config(tmp_path, datalist, iterations=3, valid_step=100)
