@@ -1,0 +1,61 @@
+"""scripts/analyze_bench_r5.py: run grouping + newest-capture selection.
+
+The analyzer is the round-5 evidence formatter (VERDICT r4 items 1-4); a
+stitch of stages from different runs or picking a stale run would corrupt
+the judge-facing arbitration summary, so pin the selection contract.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "analyze_bench_r5",
+        os.path.join(REPO, "scripts", "analyze_bench_r5.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_newest_capture_groups_by_run_and_requires_scan(tmp_path):
+    mod = _load()
+    log = tmp_path / "stages.jsonl"
+    records = [
+        # run 1: has the arbiter stage
+        {"stage": "backend_up", "ok": True, "ts": "t1", "device_kind": "TPU"},
+        {"stage": "scan_compute", "ok": True, "ts": "t1",
+         "steps_per_sec": 10.0, "ms_per_step": 100.0, "mfu": 0.01},
+        # run 2 (newer): wedged before scan_compute — must NOT be chosen,
+        # and its stages must not stitch into run 1
+        {"stage": "backend_up", "ok": True, "ts": "t2", "device_kind": "TPU"},
+        {"stage": "mosaic_dcn", "ok": True, "ts": "t2",
+         "auto_dispatch_gate": True},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    cap = mod.newest_capture(mod.load_runs(str(log)))
+    assert cap["scan_compute"]["steps_per_sec"] == 10.0
+    assert cap["backend_up"]["ts"] == "t1"
+    assert "mosaic_dcn" not in cap  # run 2's stage not stitched in
+
+    # failed stages are excluded even inside the chosen run
+    records.insert(2, {"stage": "compute", "ok": False, "ts": "t1"})
+    log.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    cap = mod.newest_capture(mod.load_runs(str(log)))
+    assert "compute" not in cap
+
+
+def test_cli_exits_3_without_capture(tmp_path):
+    log = tmp_path / "empty.jsonl"
+    log.write_text("")
+    r = subprocess.run(
+        [sys.executable, "scripts/analyze_bench_r5.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 3, (r.stdout, r.stderr)
