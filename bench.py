@@ -35,6 +35,9 @@ Stage order (most diagnostic value first):
   and the flagship MFU is bounded by the reference model's tiny channel
   count, not by this stack. Third among the timing stages (r4 had it
   last; it never produced data).
+- ``conv_anchor``: known-flops chained-3x3-conv ceiling per channel
+  width (8 / 64 / 128) — what the MXU can possibly deliver at the
+  flagship's own channel count vs lane-filling widths.
 - ``compute``: the same step timed as an async-dispatch loop — kept for
   cross-round comparability with r1's 1054.7 (same method); claims the
   headline only if scan_compute failed.
@@ -640,6 +643,59 @@ def stage_scan_matmul(ctx):
             "t_sync_call_s": {f"k{k}": round(t, 4) for k, t in raw.items()}}
 
 
+def stage_conv_anchor(ctx):
+    """Known-flops conv ceiling per channel width: chained same-padded 3x3
+    convs inside one scan (loop-carried dependency — XLA can neither
+    compose nor elide them), 2*9*C^2*H*W flops each, bf16 inputs.
+
+    Interpretive companion to ``wide_model``: the C=8 row measures what
+    the MXU can possibly deliver at the flagship's own channel width (8
+    of 128 lanes occupied BY CONSTRUCTION), the wide rows what it
+    delivers once channels fill the lanes. If flagship MFU ~= the C=8
+    anchor's fraction-of-peak, no schedule could do better for this
+    model — the ceiling is the reference architecture, not the stack."""
+    import jax
+    import jax.numpy as jnp
+
+    shapes = ([(8, 24, 40)] if ctx.smoke
+              else [(8, 90, 160), (64, 45, 80), (128, 45, 80)])
+    k_lo, k_hi = (2, 6) if ctx.smoke else (4, 32)
+    out = {}
+    for c, h, w in shapes:
+        rng = np.random.default_rng(0)
+        # ~unit operator gain keeps a 32-deep linear conv chain bounded
+        wt = jnp.asarray(
+            rng.standard_normal((3, 3, c, c)) / np.sqrt(9 * c), jnp.bfloat16
+        )
+        x0 = jnp.asarray(rng.standard_normal((1, h, w, c)), jnp.bfloat16)
+
+        def make_run(k, wt=wt):
+            @jax.jit
+            def run(x):
+                def body(carry, _):
+                    y = jax.lax.conv_general_dilated(
+                        carry, wt, (1, 1), "SAME",
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    )
+                    return y, None
+
+                y, _ = jax.lax.scan(body, x, None, length=k)
+                return (jnp.sum(jnp.abs(y).astype(jnp.float32)),)
+
+            return run
+
+        per_conv, _ = _slope_time(make_run, x0, k_lo, k_hi, reps=2)
+        flops = 2 * 9 * c * c * h * w
+        tflops = flops / per_conv / 1e12
+        out[f"c{c}_{h}x{w}"] = {
+            "ms_per_conv": round(per_conv * 1e3, 4),
+            "tflops_bf16": round(tflops, 2),
+            "frac_of_peak": round(tflops * 1e12 / _peak_flops(), 4),
+        }
+    EXTRA["conv_anchor"] = out
+    return out
+
+
 def stage_compute(ctx):
     """Async-dispatch-loop steps/s on the reference recipe shapes.
 
@@ -1045,6 +1101,7 @@ def main():
     # produced zero data): the MFU-ceiling attribution is VERDICT r5 task 3
     # and must survive a short heal window.
     _stage("wide_model", lambda: stage_wide_model(ctx), timeout=1200)
+    _stage("conv_anchor", lambda: stage_conv_anchor(ctx), timeout=900)
     _stage("compute", lambda: stage_compute(ctx), timeout=900)
     _stage("bf16", lambda: stage_bf16(ctx), timeout=900)
     _stage("dcn_ab", stage_dcn_ab, timeout=900)

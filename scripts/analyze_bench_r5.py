@@ -56,11 +56,15 @@ def newest_capture(runs):
     return None
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def loader_supply():
     """Best measured single-process loader throughput (batches/s at b2)."""
     best = None
     try:
-        with open(os.path.join("artifacts", "LOADER_PROFILE.jsonl")) as f:
+        with open(os.path.join(_REPO, "artifacts",
+                               "LOADER_PROFILE.jsonl")) as f:
             for line in f:
                 try:
                     rec = json.loads(line)
@@ -76,7 +80,7 @@ def loader_supply():
 
 def main():
     log = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        "artifacts", "BENCH_STAGES_r05.jsonl")
+        _REPO, "artifacts", "BENCH_STAGES_r05.jsonl")
     cap = newest_capture(load_runs(log))
     if cap is None:
         print(f"no scan_compute capture in {log} yet (tunnel never healed?)")
@@ -137,6 +141,23 @@ def main():
                "No order-of-magnitude jump: the ceiling is NOT just the "
                "model — profile the stack.")
         )
+    ca = cap.get("conv_anchor")
+    if ca:
+        def width(kv):
+            return int(kv[0][1:].split("_")[0])  # "c8_90x160" -> 8
+
+        rows = ", ".join(
+            f"{k}: {v['tflops_bf16']} TFLOPS ({v['frac_of_peak']:.3%})"
+            for k, v in sorted(
+                ((k, v) for k, v in ca.items() if isinstance(v, dict)),
+                key=width,
+            )
+        )
+        out.append(
+            f"- Conv ceiling per channel width (chained 3x3, known flops): "
+            f"{rows} — the C=8 row is the hard upper bound any schedule "
+            f"could give the flagship's own convs."
+        )
     md = cap.get("mosaic_dcn")
     if md:
         out.append(
@@ -183,7 +204,13 @@ def main():
             for b, v in sorted(sca.items())
         )
         out.append(f"- Batch scaling: {pts}.")
-    print("\n".join(out))
+    try:
+        print("\n".join(out))
+    except BrokenPipeError:  # e.g. `| head` — not an analysis failure
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 if __name__ == "__main__":
