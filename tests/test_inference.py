@@ -60,9 +60,16 @@ def test_run_recording_metrics_and_images(recording, model_and_params, tmp_path)
     result = runner.run_recording(
         recording, DATASET_CFG, out_dir=out, save_images=True
     )
-    for k in ("esr_l1", "esr_mse", "esr_ssim", "esr_psnr",
-              "bicubic_l1", "bicubic_mse", "bicubic_ssim", "bicubic_psnr"):
+    for k in ("esr_l1", "esr_mse", "esr_rmse", "esr_ssim", "esr_psnr",
+              "bicubic_l1", "bicubic_mse", "bicubic_rmse",
+              "bicubic_ssim", "bicubic_psnr"):
         assert np.isfinite(result[k]), k
+    # rmse derives from the aggregated mse at the same boundary (sqrt of
+    # the recording-mean mse — the only form comparable to an RMSE built
+    # from the reference's reported mean MSE)
+    np.testing.assert_allclose(
+        result["esr_rmse"], np.sqrt(result["esr_mse"]), rtol=1e-6
+    )
     assert result["time"] > 0
     assert result["params"] > 0
     # lpips keys absent without calibrated weights
