@@ -129,6 +129,15 @@ class InferenceRunner:
         # state persists across the WHOLE recording (reference :54)
         states = self.model.init_states(1, kh, kw)
 
+        # per-window SSIM samples: count maps are sparse enough that the
+        # ESR-vs-bicubic SSIM gap can sit inside the sampling noise
+        # (r4 2x demo). The two series are PAIRED per window (same GT, same
+        # content), so the testable noise-floor statistic is the paired
+        # difference — its mean/std/sign-count — not the per-series stds
+        # (shared content variance dominates those but cancels in the
+        # delta); per-series stds are kept as descriptive context only.
+        ssim_samples = {"esr_ssim": [], "bicubic_ssim": []}
+
         for i, batch in enumerate(loader):
             window = {
                 k: v[:, : self.seqn] for k, v in batch.items()
@@ -149,6 +158,8 @@ class InferenceRunner:
 
             for k, v in self._metrics(pred0, bicubic, gt).items():
                 track.update(k, float(v))
+                if k in ssim_samples:
+                    ssim_samples[k].append(float(v))
             if self.lpips is not None:
                 track.update("esr_lpips", float(self.lpips(pred0, gt)))
                 track.update("bicubic_lpips", float(self.lpips(bicubic, gt)))
@@ -175,6 +186,17 @@ class InferenceRunner:
 
         result = track.result()
         _attach_rmse(result)
+        n_win = len(ssim_samples["esr_ssim"])
+        result["n_windows"] = float(n_win)
+        if n_win:
+            delta = (np.asarray(ssim_samples["esr_ssim"])
+                     - np.asarray(ssim_samples["bicubic_ssim"]))
+            result["ssim_delta_mean"] = float(delta.mean())
+            result["ssim_delta_pos_frac"] = float((delta > 0).mean())
+            if n_win > 1:
+                result["ssim_delta_std"] = float(delta.std(ddof=1))
+                for k, vals in ssim_samples.items():
+                    result[f"{k}_std"] = float(np.std(vals, ddof=1))
         if report and out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
             with YamlLogger(os.path.join(out_dir, "inference.yml")) as yl:
@@ -196,18 +218,62 @@ def _attach_rmse(metrics: Dict[str, float]) -> None:
             metrics[f"{side}_rmse"] = float(np.sqrt(metrics[f"{side}_mse"]))
 
 
+# Window-level diagnostic keys: excluded from the generic datalist mean
+# (a mean of per-recording stds is not a pooled spread, and a mean of
+# n_windows is meaningless); the delta family is pooled properly below.
+_WINDOW_DIAG_KEYS = frozenset({
+    "n_windows", "esr_ssim_std", "bicubic_ssim_std",
+    "ssim_delta_mean", "ssim_delta_std", "ssim_delta_pos_frac",
+})
+
+
 def aggregate_results(results: List[Dict[str, float]], names: List[str]):
-    """Per-recording breakdown + datalist means (reference ``:336-347``)."""
+    """Per-recording breakdown + datalist means (reference ``:336-347``).
+
+    Window-level diagnostics (``n_windows``, SSIM spreads, the paired
+    SSIM delta) are pooled across recordings weighted by window count —
+    recovering the all-windows statistics exactly from per-recording
+    (mean, std, n) — instead of being arithmetic-meaned like the metric
+    columns."""
     breakdown: Dict[str, Dict[str, float]] = defaultdict(dict)
     means: Dict[str, List[float]] = defaultdict(list)
     for name, entry in zip(names, results):
         for k, v in entry.items():
             breakdown[k][name] = v
-            means[k].append(v)
+            if k not in _WINDOW_DIAG_KEYS:
+                means[k].append(v)
     agg = {k: float(np.mean(v)) for k, v in means.items()}
     # datalist-level rmse re-derives from the datalist-mean mse (a mean of
     # per-recording rmse values would be Jensen-biased low again)
     _attach_rmse(agg)
+
+    # pooled paired-SSIM-delta statistics over all windows of all
+    # recordings: sum-of-squares reconstruction from per-recording
+    # (mean, std, n); a recording with n=1 contributes its mean with zero
+    # within-recording variance (exact)
+    ns = [r.get("n_windows", 0.0) for r in results]
+    total_n = float(sum(ns))
+    if total_n:
+        agg["n_windows"] = total_n
+        have = [r for r in results
+                if r.get("n_windows") and "ssim_delta_mean" in r]
+        if have:
+            pooled_mean = sum(
+                r["n_windows"] * r["ssim_delta_mean"] for r in have
+            ) / total_n
+            agg["ssim_delta_mean"] = float(pooled_mean)
+            agg["ssim_delta_pos_frac"] = float(sum(
+                r["n_windows"] * r.get("ssim_delta_pos_frac", 0.0)
+                for r in have
+            ) / total_n)
+            if total_n > 1:
+                ss = sum(
+                    (r["n_windows"] - 1) * r.get("ssim_delta_std", 0.0) ** 2
+                    + r["n_windows"] * r["ssim_delta_mean"] ** 2
+                    for r in have
+                )
+                var = (ss - total_n * pooled_mean ** 2) / (total_n - 1)
+                agg["ssim_delta_std"] = float(np.sqrt(max(var, 0.0)))
     return dict(breakdown), agg
 
 

@@ -70,6 +70,10 @@ def test_run_recording_metrics_and_images(recording, model_and_params, tmp_path)
     np.testing.assert_allclose(
         result["esr_rmse"], np.sqrt(result["esr_mse"]), rtol=1e-6
     )
+    # per-window SSIM spread for the noise-floor analysis
+    assert result["n_windows"] >= 2
+    assert result["esr_ssim_std"] >= 0
+    assert result["bicubic_ssim_std"] >= 0
     assert result["time"] > 0
     assert result["params"] > 0
     # lpips keys absent without calibrated weights
@@ -121,6 +125,35 @@ def test_aggregate_results():
     )
     assert br["a"] == {"r0": 1.0, "r1": 3.0}
     assert mean == {"a": 2.0, "b": 3.0}
+
+
+def test_aggregate_pools_window_diagnostics_exactly():
+    """Datalist-level paired-SSIM-delta stats must equal the stats of the
+    concatenated window samples (sum-of-squares pooling across recordings
+    of different sizes, incl. a 1-window recording), and per-series stds /
+    n_windows must NOT be arithmetic-meaned."""
+    rng = np.random.default_rng(0)
+    rec_samples = [rng.normal(0.02, 0.05, 7), rng.normal(-0.01, 0.03, 3),
+                   np.array([0.4])]
+    results = []
+    for d in rec_samples:
+        r = {"esr_mse": 1.0, "n_windows": float(len(d)),
+             "ssim_delta_mean": float(d.mean()),
+             "ssim_delta_pos_frac": float((d > 0).mean())}
+        if len(d) > 1:
+            r["ssim_delta_std"] = float(d.std(ddof=1))
+            r["esr_ssim_std"] = 0.123  # must not appear in the means
+        results.append(r)
+    _, mean = aggregate_results(results, ["r0", "r1", "r2"])
+    allw = np.concatenate(rec_samples)
+    assert mean["n_windows"] == len(allw)
+    np.testing.assert_allclose(mean["ssim_delta_mean"], allw.mean(),
+                               rtol=1e-12)
+    np.testing.assert_allclose(mean["ssim_delta_std"],
+                               allw.std(ddof=1), rtol=1e-12)
+    np.testing.assert_allclose(mean["ssim_delta_pos_frac"],
+                               (allw > 0).mean(), rtol=1e-12)
+    assert "esr_ssim_std" not in mean  # diagnostic, not arithmetic-meaned
 
 
 @pytest.mark.slow
