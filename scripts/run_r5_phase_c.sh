@@ -1,0 +1,71 @@
+#!/bin/bash
+# Round-5 phase C: chase the 2x SSIM crossing.
+#
+# The dense-rung 2x run's paired SSIM delta shrinks monotonically
+# (-0.090 @200 -> -0.028 @1199) and extrapolates to a zero crossing near
+# ~2k iterations. Waits for the phase-A/B orchestrator to finish (single
+# core), resumes the SAME run (-r auto) with the iteration budget raised
+# to 2000, and evals each new checkpoint AS IT APPEARS so a round-end
+# cutoff still leaves every completed checkpoint's evidence on disk.
+set -u
+cd /root/repo || exit 1
+export JAX_PLATFORMS=cpu
+N="nice -n 12"
+LOG=artifacts/r5_phase_c.log
+RUN=artifacts/quality_demo_run_2xdense/models/DeepRecurrentNetwork/qdemo2xd
+DATA=artifacts/quality_demo_data_360_2xdense
+echo "=== phase C start $(date -u +%FT%TZ)" >> "$LOG"
+
+# wait for the phase-A/B orchestrator (max ~6h)
+for i in $(seq 1 720); do
+  grep -q "orchestrator done" artifacts/r5_demos_orchestrator.log 2>/dev/null && break
+  sleep 30
+done
+echo "--- orchestrator done seen $(date -u +%FT%TZ)" >> "$LOG"
+
+# resume the dense-2x run with a raised budget (background)
+$N timeout -k 60 21600 python train.py -c configs/train_esr_2x.yml -id qdemo2xd -seed 0 -r auto \
+  -o "train_dataloader;path_to_datalist_txt=$DATA/train_datalist.txt" \
+  -o "valid_dataloader;path_to_datalist_txt=$DATA/valid_datalist.txt" \
+  -o "train_dataloader;batch_size=2" -o "valid_dataloader;batch_size=2" \
+  -o "train_dataloader;dataset;ori_scale=down8" -o "valid_dataloader;dataset;ori_scale=down8" \
+  -o "train_dataloader;dataset;window=1024" -o "train_dataloader;dataset;sliding_window=512" \
+  -o "valid_dataloader;dataset;window=1024" -o "valid_dataloader;dataset;sliding_window=512" \
+  -o "train_dataloader;dataset;need_gt_frame=false" -o "valid_dataloader;dataset;need_gt_frame=false" \
+  -o "train_dataloader;dataset;sequence;sequence_length=5" \
+  -o "valid_dataloader;dataset;sequence;sequence_length=5" \
+  -o "trainer;output_path=artifacts/quality_demo_run_2xdense" \
+  -o "trainer;iteration_based_train;iterations=2000" \
+  -o "trainer;iteration_based_train;valid_step=200" \
+  -o "trainer;iteration_based_train;save_period=200" \
+  -o "trainer;iteration_based_train;lr_change_rate=300" \
+  -o "trainer;tensorboard=false" -o "trainer;vis;enabled=false" \
+  > artifacts/quality_demo_logs_2xdense_ext.log 2>&1 &
+TRAIN_PID=$!
+
+# eval every new checkpoint as it lands (incremental evidence)
+DONE=""
+while true; do
+  for it in 1400 1600 1800 1999; do
+    ck="$RUN/checkpoint-iteration$it"
+    out="artifacts/quality_demo_eval_2xdense_iter$it"
+    case " $DONE " in *" $it "*) continue ;; esac
+    if [ -f "$ck/meta.yml" ]; then
+      sleep 5  # commit marker just landed; let the save settle
+      echo "--- eval 2xdense iter$it $(date -u +%FT%TZ)" >> "$LOG"
+      $N timeout -k 30 2400 python infer.py \
+        --model_path "$ck" \
+        --data_list "$DATA/test_datalist.txt" \
+        --output_path "$out" \
+        --scale 2 --ori_scale down8 --window 1024 --sliding_window 512 \
+        --seql 5 --no_need_gt_frame --no_save_images >> "$LOG" 2>&1
+      echo "rc=$?" >> "$LOG"
+      DONE="$DONE $it"
+    fi
+  done
+  kill -0 "$TRAIN_PID" 2>/dev/null || break
+  sleep 60
+done
+wait "$TRAIN_PID"
+echo "train rc=$?" >> "$LOG"
+echo "=== phase C done $(date -u +%FT%TZ)" >> "$LOG"
