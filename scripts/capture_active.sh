@@ -1,0 +1,8 @@
+# Shared predicate (sourced by core_yield.sh and the phase runners): is a
+# TPU on-chip capture currently running? The exact-cmdline match avoids
+# catching analyze_bench_r5.py; tpu_train_demo.py has no such neighbour.
+capture_active() {
+  pgrep -fx "python bench.py" >/dev/null 2>&1 && return 0
+  pgrep -f "tpu_train_demo.py" >/dev/null 2>&1 && return 0
+  return 1
+}

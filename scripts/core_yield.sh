@@ -2,35 +2,40 @@
 # Core yielder (r5). This box has one core; a TPU heal window is the
 # scarcest resource of the round. Whenever the watcher's on-chip capture
 # (bench.py or tpu_train_demo.py) is running, SIGSTOP every CPU-demo
-# process (the phase-D trainer and its checkpoint evals), and SIGCONT them
-# when the capture ends. Patterns are deliberately narrow so the demo's
-# OWN train.py/infer.py children (-id tpu_demo, output under
+# process (the phase-D/E trainers and their checkpoint evals), and
+# SIGCONT them when the capture ends. Patterns are deliberately narrow so
+# the demo's OWN train.py/infer.py children (-id tpu_demo, output under
 # artifacts/tpu_demo*) are never touched.
 #
-# Complements the pause logic inside run_r5_phase_d.sh, which cannot act
-# while it is blocked inside a checkpoint eval.
+# Complements the pause logic inside the phase runners, which cannot act
+# while blocked inside a checkpoint eval.
 set -u
 cd /root/repo || exit 1
+. scripts/capture_active.sh
 LOG=artifacts/r5_core_yield.log
 echo "=== core_yield start $(date -u +%FT%TZ)" >> "$LOG"
-PAUSED=0
-capture_active() {
-  pgrep -fx "python bench.py" >/dev/null 2>&1 && return 0
-  pgrep -f "tpu_train_demo.py" >/dev/null 2>&1 && return 0
-  return 1
+
+cont_all() {
+  pkill -CONT -f "python train\.py .*-id q" 2>/dev/null
+  pkill -CONT -f "python infer\.py .*quality_demo_eval_" 2>/dev/null
 }
+# never leave demos frozen: on any exit, resume them; and on startup,
+# clear any STOP a previous yielder instance may have left behind
+trap 'echo "--- CONT on exit $(date -u +%FT%TZ)" >> "$LOG"; cont_all' EXIT INT TERM
+if ! capture_active; then cont_all; fi
+
+PAUSED=0
 while true; do
   if capture_active; then
     if [ "$PAUSED" -eq 0 ]; then
       echo "--- STOP cpu demos $(date -u +%FT%TZ)" >> "$LOG"
       PAUSED=1
     fi
-    pkill -STOP -f "python train\.py .*-id qdemo" 2>/dev/null
+    pkill -STOP -f "python train\.py .*-id q" 2>/dev/null
     pkill -STOP -f "python infer\.py .*quality_demo_eval_" 2>/dev/null
   elif [ "$PAUSED" -eq 1 ]; then
     echo "--- CONT cpu demos $(date -u +%FT%TZ)" >> "$LOG"
-    pkill -CONT -f "python train\.py .*-id qdemo" 2>/dev/null
-    pkill -CONT -f "python infer\.py .*quality_demo_eval_" 2>/dev/null
+    cont_all
     PAUSED=0
   fi
   sleep 20

@@ -12,9 +12,13 @@
 # running an on-chip capture (bench.py or tpu_train_demo.py). This box has
 # one core (artifacts/LOADER_PROFILE.jsonl, nproc=1); a heal window is the
 # scarcest resource of the round and must not share the host with a CPU
-# training loop.
+# training loop. scripts/core_yield.sh additionally covers the intervals
+# where this loop is blocked inside an eval. A failed eval (e.g. killed by
+# its own wall-clock timeout after being paused across a long capture) is
+# retried once on a later sweep before the rung is given up.
 set -u
 cd /root/repo || exit 1
+. scripts/capture_active.sh
 export JAX_PLATFORMS=cpu
 N="nice -n 12"
 LOG=artifacts/r5_phase_d.log
@@ -42,20 +46,13 @@ $N timeout -k 60 28800 python train.py -c configs/train_esr_2x.yml -id qdemo2xd 
   > artifacts/quality_demo_logs_2xdense_ext2.log 2>&1 &
 TRAIN_PID=$!
 
-tpu_capture_active() {
-  # the watcher's on-chip phases: an exact-cmdline bench (avoids matching
-  # analyze_bench_r5.py) or the train demo
-  pgrep -fx "python bench.py" >/dev/null 2>&1 && return 0
-  pgrep -f "tpu_train_demo.py" >/dev/null 2>&1 && return 0
-  return 1
-}
-
 # eval every new checkpoint as it lands (incremental evidence); yield the
 # core to any on-chip capture the watcher starts
 DONE=""
+TRIED=""
 PAUSED=0
 while true; do
-  if tpu_capture_active; then
+  if capture_active; then
     if [ "$PAUSED" -eq 0 ]; then
       echo "--- pausing trainer for on-chip capture $(date -u +%FT%TZ)" >> "$LOG"
       pkill -STOP -P "$TRAIN_PID" 2>/dev/null
@@ -82,8 +79,18 @@ while true; do
         --output_path "$out" \
         --scale 2 --ori_scale down8 --window 1024 --sliding_window 512 \
         --seql 5 --no_need_gt_frame --no_save_images >> "$LOG" 2>&1
-      echo "rc=$?" >> "$LOG"
-      DONE="$DONE $it"
+      rc=$?
+      echo "rc=$rc" >> "$LOG"
+      if [ $rc -eq 0 ]; then
+        DONE="$DONE $it"
+      else
+        # retry once on a later sweep (a paused eval can be killed by its
+        # own wall-clock timeout); give up after the second failure
+        case " $TRIED " in
+          *" $it "*) DONE="$DONE $it" ;;
+          *) TRIED="$TRIED $it" ;;
+        esac
+      fi
     fi
   done
   kill -0 "$TRAIN_PID" 2>/dev/null || break

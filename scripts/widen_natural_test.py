@@ -1,0 +1,52 @@
+"""Widen the natural-statistics held-out test set (round-5 phase E).
+
+The committed natural corpus has ONE test recording, so the paired SSIM
+delta rests on n=4 windows. This generates extra held-out recordings
+with seeds disjoint from every committed corpus recording (the original
+``make_quality_demo_data.py`` run used name-index seeds 0..7 -> render
+1000+s / sim 2000+s; these continue at s=8+) and writes
+``test_datalist_wide.txt`` = original test recording + the new ones.
+
+Usage: python scripts/widen_natural_test.py <corpus_dir> [n_extra]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    from esr_tpu.tools.simulate import (
+        render_natural_frames,
+        simulate_ladder_recording,
+    )
+
+    out_dir = sys.argv[1]
+    n_extra = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    base_h = int(os.environ.get("DEMO_BASE_H", 360))
+    base_w = int(os.environ.get("DEMO_BASE_W", 640))
+    rungs = ("down8", "down16")
+
+    paths = [os.path.join(out_dir, "test_0.h5")]
+    if not os.path.exists(paths[0]):
+        raise SystemExit(f"{paths[0]} missing — not a generated corpus dir")
+    for i in range(n_extra):
+        s = 8 + i  # first seed index past the committed 6+1+1 recordings
+        path = os.path.join(out_dir, f"test_{1 + i}.h5")
+        if not os.path.exists(path):
+            frames, ts = render_natural_frames(seed=1000 + s, h=base_h, w=base_w)
+            cp, cn = simulate_ladder_recording(
+                frames, ts, path, rungs=rungs, seed=2000 + s
+            )
+            print(f"{path}: cp={cp:.3f} cn={cn:.3f}", flush=True)
+        paths.append(path)
+
+    dl = os.path.join(out_dir, "test_datalist_wide.txt")
+    with open(dl, "w") as f:
+        f.write("\n".join(paths) + "\n")
+    print(f"{dl}: {len(paths)} recordings")
+
+
+if __name__ == "__main__":
+    main()
