@@ -1,0 +1,156 @@
+"""Offline arbitration of the r4 67x timing contradiction (VERDICT r5 #1).
+
+The round-4 capture (``artifacts/BENCH_STAGES_r04.jsonl``) recorded three
+mutually inconsistent timings of the same b2 flagship train step:
+
+- ``compute``   (async donated-jit loop, block on final loss): 0.93 ms/step
+- ``breakdown`` (plain-jit loops per piece):                   57.7 ms/step
+- ``scaling``   (AOT per-call loop):                           62.1 ms/step
+
+The on-chip tiebreaker (``scan_compute``: K chained steps inside ONE
+executable) is armed but needs a healthy tunnel. This script extracts
+what the capture alone already decides, so the post-mortem does not have
+to wait for hardware:
+
+1. **Internal impossibility.** The async number claims the FULL step
+   (fwd+bwd+opt) runs 18x faster than the same capture's measured
+   forward-only time. A step cannot be faster than its own forward pass,
+   so at least the async number is wrong — independent of any theory
+   about why.
+2. **The forward number cannot be transfer-inflated.** The r4 builder's
+   transfer-contamination hypothesis (per-call re-staging of the batch
+   over the ~60 MB/s tunnel, ROUND4.md session 2) would put a floor of
+   ``batch_bytes / tunnel_bw`` =~ 77 ms under EVERY per-call timing of a
+   program consuming the batch. ``fwd_ms`` = 16.9 < 77 means the plain
+   jit path did NOT re-stage — so ``breakdown``'s train_step on that
+   same path is device time, not transfer.
+3. **Degeneracy of the scaling curve, made explicit.** Both "device time
+   linear in batch" and "transfer time linear in batch" fit the
+   measured b2/b8/b16 curve (implied staging bandwidth would be a
+   suspiciously clean 75-78 MB/s, but ABOVE the ~60 MB/s the tunnel
+   showed elsewhere). The curve alone cannot arbitrate — which is why
+   (1) and (2) matter, and why ``scan_compute`` exists.
+4. **Program-insensitivity of the async loop.** Switching the step to
+   bf16 moved the async number only ~5% — the signature of a loop
+   measuring dispatch overhead rather than the program it dispatches.
+
+Verdict encoded below: the defensible r4 figure is breakdown/scaling's
+~57.7 ms/step (17.3 steps/s, MFU ~0.15% of the bf16 peak bench uses),
+and ``compute``'s 1076 steps/s (with its bf16 sibling) is an artifact of
+`block_until_ready` semantics on the donated-executable dispatch path
+over the axon tunnel. ROUND4.md's transfer-re-staging reading of the
+AOT path is refuted by (2). Reference context: the reference's own
+headline loop is `train_ours_cnt_seq.py:186-341` (DDP per-step timing).
+
+Usage: python scripts/arbitrate_offline.py [capture.jsonl] [--json out]
+"""
+
+import json
+import sys
+
+# the bench recipe constants the capture ran with (bench.py _recipe_batch
+# at commit 5c9bc19: b x L x h x w x 2 f32 for inp and gt)
+L, H, W, CH, BYTES_F32 = 10, 90, 160, 2, 4
+TUNNEL_BW_OBSERVED = 60e6  # ~60 MB/s, ROUND4.md session-2 staging estimate
+
+
+def batch_bytes(b):
+    """Bytes staged if a per-call dispatch re-uploads inp+gt."""
+    return 2 * b * L * H * W * CH * BYTES_F32
+
+
+def load_capture(path):
+    stages = {}
+    for line in open(path):
+        d = json.loads(line)
+        s = d.get("stage")
+        if s and d.get("ok"):
+            stages[s] = d  # keep the last ok line per stage
+    return stages
+
+
+def arbitrate(stages):
+    compute = stages["compute"]
+    breakdown = stages["breakdown"]
+    scaling = stages["scaling"]["scaling"]
+    out = {}
+
+    # (1) full step vs its own forward
+    compute_ms = 1e3 / compute["steps_per_sec"]
+    fwd_ms = breakdown["fwd_ms"]
+    out["async_step_ms"] = round(compute_ms, 3)
+    out["fwd_only_ms"] = fwd_ms
+    out["async_claims_full_step_faster_than_fwd_by"] = round(
+        fwd_ms / compute_ms, 1)
+    out["async_internally_impossible"] = compute_ms < fwd_ms
+
+    # (2) transfer floor under the re-staging hypothesis, vs measured fwd
+    floor_ms = batch_bytes(2) / TUNNEL_BW_OBSERVED * 1e3
+    refuted = fwd_ms < floor_ms
+    out["restaging_floor_ms_at_b2"] = round(floor_ms, 1)
+    out["restaging_hypothesis_refuted"] = refuted
+
+    # (3) the scaling curve's degeneracy: implied staging bandwidth if
+    # transfer-bound (should be ~constant either way, so NOT decisive)
+    implied = {}
+    for key, row in scaling.items():
+        b = int(key[1:])
+        implied[key] = round(
+            batch_bytes(b) * row["steps_per_sec"] / 1e6, 1)  # MB/s
+    out["scaling_implied_bw_mb_s"] = implied
+    vals = list(implied.values())
+    out["scaling_implied_bw_spread"] = round(
+        (max(vals) - min(vals)) / min(vals), 3)
+    out["scaling_implied_bw_exceeds_observed_tunnel"] = (
+        min(vals) > TUNNEL_BW_OBSERVED / 1e6)
+
+    # (4) async loop's insensitivity to the program it dispatches
+    if "bf16" in stages:
+        f32, b16 = compute["steps_per_sec"], stages["bf16"]["steps_per_sec"]
+        out["async_bf16_over_f32"] = round(b16 / f32, 3)
+        out["async_program_insensitive"] = abs(b16 / f32 - 1.0) < 0.10
+
+    # the verdict
+    step_ms = breakdown["train_step_ms"]
+    flops = compute.get("flops_per_step")
+    out["defensible_step_ms_b2"] = step_ms
+    out["defensible_steps_per_sec_b2"] = round(1e3 / step_ms, 2)
+    if flops:
+        # same peak bench.py used (mfu 0.0995 at 1076 steps/s -> 197e12)
+        peak = flops * compute["steps_per_sec"] / compute["mfu"]
+        out["defensible_mfu"] = round(flops * (1e3 / step_ms) / peak, 5)
+    out["verdict"] = (
+        "async 'compute' (and its bf16 sibling) measured the donated-jit "
+        "dispatch path, not the device: it claims the full step runs "
+        f"{out['async_claims_full_step_faster_than_fwd_by']}x faster than "
+        "the same capture's forward-only pass and barely responds to a "
+        "bf16 program swap. The plain-jit/AOT numbers are device time "
+        f"(fwd at {fwd_ms} ms is {round(floor_ms / fwd_ms, 1)}x BELOW the "
+        f"{round(floor_ms, 1)} ms re-staging floor, so the transfer-"
+        "contamination reading of those paths is refuted). "
+        f"Defensible r4 figure: {step_ms} ms/step "
+        f"({out['defensible_steps_per_sec_b2']} steps/s) at b2 f32, to be "
+        "confirmed on-chip by scan_compute."
+    )
+    return out
+
+
+def main():
+    argv = sys.argv[1:]
+    if "--json" in argv:
+        i = argv.index("--json")
+        dst_args = argv[i:i + 2]
+        if len(dst_args) < 2:
+            raise SystemExit("usage: arbitrate_offline.py [capture.jsonl] "
+                             "[--json OUT]")
+        argv = argv[:i] + argv[i + 2:]
+    path = argv[0] if argv else "artifacts/BENCH_STAGES_r04.jsonl"
+    out = arbitrate(load_capture(path))
+    print(json.dumps(out, indent=2))
+    if "--json" in sys.argv[1:]:
+        with open(dst_args[1], "w") as f:
+            json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
