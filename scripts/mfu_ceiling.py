@@ -1,0 +1,186 @@
+"""Offline MXU-occupancy ceiling for the flagship model per channel width
+(VERDICT r5 #3: attribute the MFU ceiling to the model or the stack,
+without waiting for the tunnel).
+
+Method: trace the flagship forward with ``jax.eval_shape`` while
+intercepting ``jax.lax.conv_general_dilated`` / ``lax.dot_general`` to
+record every contraction's shape — no compile, no device. For each op,
+model its MXU tile packing on the 128x128 systolic array the way XLA
+lowers a conv (implicit GEMM): M = batch*spatial, K = kh*kw*Cin,
+N = Cout. Tile efficiency = (K / ceil128(K)) * (N / ceil128(N)) *
+(M / ceil8(M) rounding, negligible at these sizes). The flops-weighted
+mean over all ops is the **hard ceiling on MFU the model's own channel
+mix imposes** — a stack at 100% efficiency could not exceed it. The
+backward pass mirrors the forward contractions (dgrad/wgrad GEMMs share
+K/N structure), so the forward mix is representative.
+
+Output (artifacts/MFU_CEILING_r05.json): per-width ceilings +
+per-op table for the worst offenders. Read against the measured
+0.16% MFU (BASELINE.md offline arbitration) and, on the next heal,
+against the `wide_model` / `conv_anchor` stages: measured/ceiling is
+the stack's efficiency, ceiling is the model's fault. Reference
+context: the reference never reports MFU; its hot path is the cuDNN
+conv + DCNv2 CUDA kernel (`models/DCNv2/src/cuda/dcn_v2_cuda.cu`).
+
+Usage: python scripts/mfu_ceiling.py [--json OUT]
+"""
+
+import json
+import math
+import os
+import sys
+from contextlib import contextmanager
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _ceil(x, m):
+    return int(math.ceil(x / m) * m)
+
+
+def gemm_efficiency(m, k, n):
+    """Fraction of MXU lanes doing useful work for an MxKxN contraction."""
+    return (m / _ceil(m, 8)) * (k / _ceil(k, 128)) * (n / _ceil(n, 128))
+
+
+@contextmanager
+def record_contractions(ops):
+    """Intercept conv/dot primitives during tracing and log GEMM shapes."""
+    import jax
+    from jax import lax
+
+    real_conv = lax.conv_general_dilated
+    real_dot = lax.dot_general
+
+    def conv_spy(lhs, rhs, *args, **kw):
+        out = real_conv(lhs, rhs, *args, **kw)
+        dn = kw.get("dimension_numbers")
+        # the GEMM model below assumes flax's NHWC/HWIO/NHWC lowering and
+        # dense (ungrouped) convs; anything else would silently produce
+        # wrong M/K/N, so refuse loudly instead
+        assert kw.get("feature_group_count", 1) == 1, kw
+        # NHWC/HWIO/NHWC, either as the string spec or flax's canonical
+        # ConvDimensionNumbers (lhs (0,3,1,2) = batch,feature,H,W;
+        # rhs (3,2,0,1) = O,I,H,W)
+        assert dn is None or tuple(dn) in (
+            ("NHWC", "HWIO", "NHWC"),
+            ((0, 3, 1, 2), (3, 2, 0, 1), (0, 3, 1, 2)),
+        ), dn
+        b = lhs.shape[0]
+        kh, kw_, cin, cout = rhs.shape
+        ho, wo = out.shape[1], out.shape[2]
+        m, k, n = b * ho * wo, kh * kw_ * cin, cout
+        ops.append({"kind": "conv", "m": m, "k": k, "n": n,
+                    "flops": 2.0 * m * k * n,
+                    "shape": f"{kh}x{kw_}x{cin}->{cout} @ {b}x{ho}x{wo}",
+                    "dn": str(dn)})
+        return out
+
+    def dot_spy(lhs, rhs, dimension_numbers, *args, **kw):
+        out = real_dot(lhs, rhs, dimension_numbers, *args, **kw)
+        (lc, rc), (lb, rb) = dimension_numbers
+        k = int(math.prod(lhs.shape[d] for d in lc)) or 1
+        bsz = int(math.prod(lhs.shape[d] for d in lb)) or 1
+        m = int(max(1, math.prod(lhs.shape) // (k * bsz)))
+        n = int(max(1, math.prod(rhs.shape) // (k * bsz)))
+        ops.append({"kind": "dot", "m": m * bsz, "k": k, "n": n,
+                    "flops": 2.0 * m * bsz * k * n,
+                    "shape": f"{lhs.shape}.{rhs.shape}"})
+        return out
+
+    lax.conv_general_dilated = conv_spy
+    lax.dot_general = dot_spy
+    try:
+        yield ops
+    finally:
+        lax.conv_general_dilated = real_conv
+        lax.dot_general = real_dot
+
+
+def ceiling_for(basech, b=2, h=90, w=160, seqn=3):
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    model = DeepRecurrNet(inch=2, basech=basech, num_frame=seqn)
+    inp = jnp.zeros((b, seqn, h, w, 2), jnp.float32)
+    states = model.init_states(b, h, w)
+
+    # trace (abstract) only — records every contraction without compiling;
+    # params come from an uninstrumented shape-trace of init
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), inp, states))
+    ops2 = []
+    with record_contractions(ops2):
+        jax.eval_shape(lambda p: model.apply(p, inp, states), params)
+
+    total = sum(o["flops"] for o in ops2) or 1.0
+    for o in ops2:
+        o["eff"] = round(gemm_efficiency(o["m"], o["k"], o["n"]), 4)
+        o["flops_share"] = round(o["flops"] / total, 4)
+    ceiling = sum(o["eff"] * o["flops"] for o in ops2) / total
+    # aggregate identical shapes (the recurrent trunk repeats its convs)
+    agg = {}
+    for o in ops2:
+        key = (o["kind"], o["shape"])
+        a = agg.setdefault(key, dict(o, count=0, flops_share=0.0))
+        a["count"] += 1
+        a["flops_share"] += o["flops"] / total
+    for a in agg.values():
+        a["flops_share"] = round(a["flops_share"], 4)
+    worst = sorted(agg.values(),
+                   key=lambda o: (1 - o["eff"]) * o["flops"] * o["count"],
+                   reverse=True)[:6]
+    return {
+        "basech": basech,
+        "n_contractions": len(ops2),
+        "total_gflops_fwd": round(total / 1e9, 3),
+        "mean_mflops_per_contraction": round(total / len(ops2) / 1e6, 2),
+        "mxu_occupancy_ceiling": round(ceiling, 4),
+        "worst_ops": [
+            {k: o[k] for k in ("kind", "shape", "m", "k", "n", "eff",
+                               "flops_share", "count")}
+            for o in worst],
+    }
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"note": (
+        "flops-weighted MXU tile-packing ceiling from traced forward "
+        "contractions; backward mirrors these GEMMs. measured_mfu / "
+        "ceiling = stack efficiency; ceiling itself is model-imposed."),
+        "widths": [ceiling_for(bc) for bc in (8, 16, 32, 64)]}
+    flag, wide = out["widths"][0], out["widths"][-1]
+    fc, wc = flag["mxu_occupancy_ceiling"], wide["mxu_occupancy_ceiling"]
+    out["attribution"] = (
+        f"Lane packing is NOT the flagship's MFU cap: its flops-weighted "
+        f"occupancy ceiling is already {fc:.1%} (basech=64: {wc:.1%}), "
+        f"because the deep 12x20-bottleneck convs dominate flops. The cap "
+        f"is per-op arithmetic: the flagship averages "
+        f"{flag['mean_mflops_per_contraction']:.0f} MFLOP per contraction "
+        f"(~{flag['mean_mflops_per_contraction'] * 1e6 / 197e12 * 1e6:.1f}"
+        f" us at peak), so any us-scale per-op overhead (fusion "
+        f"boundaries, layout changes, scan step latency, HBM-bound "
+        f"elementwise between convs) dominates wall-clock. basech=64 "
+        f"raises per-op work "
+        f"{wide['mean_mflops_per_contraction'] / flag['mean_mflops_per_contraction']:.0f}x"
+        f" at the same op count, which is why wide_model on-chip should "
+        f"jump MFU by an order of magnitude+: measured r4 MFU 0.16% = "
+        f"{0.0016 / fc:.1%} of what the flagship's own packing permits, "
+        f"so the residual is size/overhead, not the stack's ability to "
+        f"feed the MXU with wide models.")
+    print(json.dumps(out, indent=2))
+    if "--json" in sys.argv[1:]:
+        i = sys.argv.index("--json")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("usage: mfu_ceiling.py [--json OUT]")
+        with open(sys.argv[i + 1], "w") as f:
+            json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
