@@ -59,6 +59,15 @@ def newest_capture(runs):
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def offline_json(name):
+    """Load an offline-analysis artifact if present (tolerates absence)."""
+    try:
+        with open(os.path.join(_REPO, "artifacts", name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def loader_supply():
     """Best measured single-process loader throughput (batches/s at b2)."""
     best = None
@@ -99,6 +108,7 @@ def main():
         f"methods' failure modes."
     )
     comp = cap.get("compute")
+    arb = offline_json("ARBITRATION_OFFLINE_r05.json")
     if comp:
         ratio = comp["steps_per_sec"] / sps
         verdict = (
@@ -114,6 +124,23 @@ def main():
             f"{comp['steps_per_sec']} steps/s ({ratio:.1f}x the slope "
             f"number) => {verdict}."
         )
+        if arb:
+            arb_sps = arb["defensible_steps_per_sec_b2"]
+            # confirmation needs BOTH: the scan sides against the async
+            # loop AND lands near the offline defensible figure itself
+            agrees = ratio > 3 and 0.5 < sps / arb_sps < 2.0
+            out.append(
+                f"- vs the offline arbitration (BASELINE.md, "
+                f"ARBITRATION_OFFLINE_r05.json: async refuted by its own "
+                f"capture — full step "
+                f"{arb['async_claims_full_step_faster_than_fwd_by']}x "
+                f"faster than its forward; defensible {arb_sps} steps/s): "
+                + ("the on-chip scan CONFIRMS it."
+                   if agrees else
+                   f"the on-chip scan ({sps} steps/s) DISAGREES with it — "
+                   "one of the capture's internal numbers (fwd_ms or the "
+                   "scan) must be re-examined before either is published.")
+            )
     mm = cap.get("scan_matmul")
     if mm:
         out.append(
@@ -131,16 +158,38 @@ def main():
     wm = cap.get("wide_model")
     if wm and wm.get("mfu") is not None and sc.get("mfu"):
         lift = wm["mfu"] / max(sc["mfu"], 1e-9)
+        ceil = offline_json("MFU_CEILING_r05.json")
+        flag8 = next((w for w in (ceil or {}).get("widths", [])
+                      if w.get("basech") == 8), None)
+        model_bound = (
+            "The stack maps to the MXU fine; the flagship MFU is bounded "
+            "by the reference model's size "
+            + (f"({flag8['mean_mflops_per_contraction']:.0f} MFLOP per "
+               f"contraction — µs-scale per-op work; see "
+               f"MFU_CEILING_r05.json: packing ceiling was already "
+               f"{flag8['mxu_occupancy_ceiling']:.0%} at basech 8)."
+               if flag8 else "(µs-scale per-op work).")
+        )
         out.append(
             f"- MFU ceiling attribution: wide model (basech={wm['basech']}, "
             f"b={wm['batch']}) reaches MFU {wm['mfu']} — "
             f"**{lift:.0f}x the flagship's {sc['mfu']}**. "
-            + ("The stack maps to the MXU fine; the flagship MFU is bounded "
-               "by the reference model's tiny channel count (basech 8 vs "
-               "128 MXU lanes)." if lift >= 5 else
+            + (model_bound if lift >= 5 else
                "No order-of-magnitude jump: the ceiling is NOT just the "
                "model — profile the stack.")
         )
+        if ceil:
+            by_w = {w["basech"]: w for w in ceil.get("widths", [])}
+            pred = by_w.get(wm.get("basech"))
+            if pred:
+                out.append(
+                    f"- vs the offline packing ceiling for basech="
+                    f"{wm['basech']}: predicted ≤{pred['mxu_occupancy_ceiling']:.0%}"
+                    f" (tile packing) with {pred['mean_mflops_per_contraction']:.0f}"
+                    f" MFLOP/op; measured {wm['mfu']} ⇒ the stack realizes "
+                    f"{wm['mfu'] / pred['mxu_occupancy_ceiling']:.1%} of the "
+                    f"model-permitted bound at this width."
+                )
     ca = cap.get("conv_anchor")
     if ca:
         def width(kv):
