@@ -122,6 +122,8 @@ def test_headline_attaches_last_known_good_only_when_valueless(
     # the fallback list would read artifacts/BENCH_STAGES_r04.jsonl and the
     # test would depend on repo history
     monkeypatch.setattr(bench, "_PRIOR_STAGELOGS", [])
+    monkeypatch.setattr(bench, "_ARBITRATION_JSON",
+                        str(tmp_path / "ARBITRATION_OFFLINE_r05.json"))
     monkeypatch.delenv("ESR_BENCH_SMOKE", raising=False)
 
     monkeypatch.setattr(bench, "EXTRA", {})
@@ -139,6 +141,28 @@ def test_headline_attaches_last_known_good_only_when_valueless(
     assert lkg["compute"]["steps_per_sec"] == 1076.0
     assert lkg["bf16"]["ts"] == "t1"
     assert all(rec["ok"] for rec in lkg.values())
+    # no ARBITRATION_OFFLINE_r05.json next to this stage log => no
+    # arbitration block (and no crash)
+    assert "offline_arbitration" not in out["extra"]
+
+    # with the offline-arbitration artifact present, a valueless headline
+    # must carry the defensible figure next to the raw capture — the raw
+    # 'compute' stage alone (1076) was refuted by that analysis
+    (tmp_path / "ARBITRATION_OFFLINE_r05.json").write_text(json.dumps({
+        "defensible_steps_per_sec_b2": 17.33,
+        "defensible_step_ms_b2": 57.705,
+        "defensible_mfu": 0.0016,
+        "async_internally_impossible": True,
+        "verdict": "async refuted",
+    }))
+    monkeypatch.setattr(bench, "EXTRA", {})
+    monkeypatch.setattr(bench, "HEADLINE", {"value": None})
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._print_headline()
+    arb = json.loads(buf.getvalue())["extra"]["offline_arbitration"]
+    assert arb["defensible_steps_per_sec_b2"] == 17.33
+    assert arb["async_internally_impossible"] is True
 
     monkeypatch.setattr(bench, "EXTRA", {})
     monkeypatch.setattr(bench, "HEADLINE", {"value": 42.0})
