@@ -1,0 +1,58 @@
+"""Print the paired SSIM-delta ladder across checkpoint eval dirs.
+
+Each ``artifacts/quality_demo_eval_<tag>_iter<N>/inference_all.yml``
+carries the pooled paired per-window statistics the inference harness
+emits (ssim_delta_mean/std/pos_frac over all windows of all recordings);
+this collects them into the trend table ROUND5.md tracks, plus the
+MSE/PSNR margin at each rung so the "margin holds while the deficit
+closes" claim stays checkable in one place.
+
+Usage: python scripts/ssim_ladder.py <prefix>   # e.g.
+       python scripts/ssim_ladder.py artifacts/quality_demo_eval_2xdense_iter
+"""
+
+import glob
+import sys
+
+import yaml
+
+
+def rows(prefix):
+    out = []
+    for d in glob.glob(prefix + "*"):
+        it = d[len(prefix):]
+        if not it.isdigit():
+            continue
+        try:
+            with open(f"{d}/inference_all.yml") as f:
+                y = yaml.safe_load(f)
+        except (OSError, yaml.YAMLError):
+            continue
+        if not isinstance(y, dict):  # zero-byte / mid-write eval dir
+            continue
+        m = y.get("mean results for the whole data", {})
+        if "ssim_delta_mean" not in m:
+            continue
+        out.append((int(it), m))
+    return sorted(out)
+
+
+def main():
+    prefix = sys.argv[1]
+    table = rows(prefix)
+    if not table:
+        raise SystemExit(f"no eval dirs with paired stats match {prefix}*")
+    print("| iter | ssim_delta_mean | ssim_delta_std | pos_frac | "
+          "n_windows | esr_mse | bicubic_mse | psnr_gain_db |")
+    print("|---|---|---|---|---|---|---|---|")
+    for it, m in table:
+        print(f"| {it} | {m['ssim_delta_mean']:+.4f} "
+              f"| {m.get('ssim_delta_std', float('nan')):.4f} "
+              f"| {m.get('ssim_delta_pos_frac', float('nan')):.2f} "
+              f"| {int(m.get('n_windows', 0))} "
+              f"| {m['esr_mse']:.3f} | {m['bicubic_mse']:.3f} "
+              f"| {m['esr_psnr'] - m['bicubic_psnr']:+.2f} |")
+
+
+if __name__ == "__main__":
+    main()
