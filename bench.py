@@ -1099,8 +1099,6 @@ def main():
         _print_headline()
         sys.exit(2)
 
-    _stage("mosaic_dcn", stage_mosaic_dcn, timeout=600)
-
     ctx_box = {}
 
     def _build():
@@ -1108,6 +1106,10 @@ def main():
         return {}
 
     if _stage("build_model", _build, timeout=900) is None:
+        # mosaic_dcn does not need ctx; don't let a failed model build
+        # cost the run its Pallas-gate evidence (it ran unconditionally
+        # before the 2026-08-02 reorder).
+        _stage("mosaic_dcn", stage_mosaic_dcn, timeout=1800)
         _print_headline()
         sys.exit(2)
     ctx = ctx_box["ctx"]
@@ -1118,6 +1120,13 @@ def main():
     # produced zero data): the MFU-ceiling attribution is VERDICT r5 task 3
     # and must survive a short heal window.
     _stage("wide_model", lambda: stage_wide_model(ctx), timeout=1200)
+    # mosaic_dcn runs AFTER the arbitration trio: on 2026-08-02 its r5
+    # pinned-precision gate (strict parity under three precision modes +
+    # the CPU-interpret defect screen — ~3x the compiles of the r4 stage
+    # that took 256s) blew the old 600s budget as the FIRST stage and the
+    # watchdog killed the run before a single timing stage had fired.
+    # The scan trio is VERDICT r5 task 1+3 — it must land first.
+    _stage("mosaic_dcn", stage_mosaic_dcn, timeout=1800)
     _stage("conv_anchor", lambda: stage_conv_anchor(ctx), timeout=900)
     _stage("compute", lambda: stage_compute(ctx), timeout=900)
     _stage("bf16", lambda: stage_bf16(ctx), timeout=900)

@@ -18,6 +18,7 @@ echo "=== core_yield start $(date -u +%FT%TZ)" >> "$LOG"
 cont_all() {
   pkill -CONT -f "python train\.py .*-id q" 2>/dev/null
   pkill -CONT -f "python infer\.py .*quality_demo_eval_" 2>/dev/null
+  pkill -CONT -f "make_quality_demo_data\.py" 2>/dev/null
 }
 # never leave demos frozen: on any exit, resume them; and on startup,
 # clear any STOP a previous yielder instance may have left behind
@@ -33,6 +34,7 @@ while true; do
     fi
     pkill -STOP -f "python train\.py .*-id q" 2>/dev/null
     pkill -STOP -f "python infer\.py .*quality_demo_eval_" 2>/dev/null
+    pkill -STOP -f "make_quality_demo_data\.py" 2>/dev/null
   elif [ "$PAUSED" -eq 1 ]; then
     echo "--- CONT cpu demos $(date -u +%FT%TZ)" >> "$LOG"
     cont_all

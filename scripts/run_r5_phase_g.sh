@@ -1,0 +1,125 @@
+#!/bin/bash
+# Round-5 phase G: the natural-statistics 4x demo — the "predicted next
+# quality cell" flagged at the end of session 2 (ROUND5.md).
+#
+# Motivation: the 4x recipe flipped SSIM in ESR's favour on gratings by
+# iter 800 (r4), while 2x-on-natural plateaus at a -0.03 deficit
+# (phase E). 4x-on-natural is therefore the cell where natural-SSIM most
+# plausibly crosses, completing the 2x2 recipe x corpus quality matrix.
+#
+# The session-2 VM recycle deleted every uncommitted corpus/checkpoint,
+# so this phase regenerates from scratch (the corpora are deterministic
+# from the generator seed):
+#   corpus: DEMO_SCENE=natural DEMO_RUNGS=down4,down16 at 360x640 base
+#           (input down16 = 22x40, GT down4 = 90x160, scale^2=16x GT
+#           windows) - 6 train / 2 valid / 2 test recordings
+#   train:  configs/train_esr_4x.yml, 1200 iterations (the budget that
+#           crossed on gratings), batch 2, seql 5, window 1024/512
+#   eval:   every 200-step checkpoint on the held-out test list,
+#           --scale 4 --ori_scale down16
+#
+# Runs forced-CPU and nice'd; self-pauses whenever an on-chip capture
+# owns the host (capture_active), same discipline as phases D/E/F.
+set -u
+cd /root/repo || exit 1
+. scripts/capture_active.sh
+export JAX_PLATFORMS=cpu
+N="nice -n 12"
+LOG=artifacts/r5_phase_g.log
+DATA=artifacts/quality_demo_data_360_natural4x
+RUN=artifacts/quality_demo_run_natural4x/models/DeepRecurrentNetwork4x/qnat4x
+ITERS="200 400 600 800 1000 1199"
+echo "=== phase G start $(date -u +%FT%TZ)" >> "$LOG"
+
+wait_capture_idle() {
+  while capture_active; do sleep 30; done
+}
+
+# --- corpus (skip if a previous attempt already finished the datalists)
+if [ ! -f "$DATA/test_datalist.txt" ]; then
+  wait_capture_idle
+  echo "--- corpus gen $(date -u +%FT%TZ)" >> "$LOG"
+  DEMO_SCENE=natural DEMO_RUNGS=down4,down16 DEMO_BASE_H=360 DEMO_BASE_W=640 \
+    $N timeout -k 30 7200 python scripts/make_quality_demo_data.py "$DATA" 6 4 \
+    > artifacts/quality_demo_logs_natural4x_gen.log 2>&1
+  rc=$?
+  echo "corpus rc=$rc" >> "$LOG"
+  [ $rc -eq 0 ] || exit 1
+fi
+
+run_eval() {  # $1 = iteration; skips work that already produced results
+  ck="$RUN/checkpoint-iteration$1"
+  out="artifacts/quality_demo_eval_natural4x_iter$1"
+  [ -f "$ck/meta.yml" ] || return 1
+  [ -f "$out/inference_all.yml" ] && return 0
+  sleep 5
+  echo "--- eval natural4x iter$1 $(date -u +%FT%TZ)" >> "$LOG"
+  $N timeout -k 30 2400 python infer.py \
+    --model_path "$ck" \
+    --data_list "$DATA/test_datalist.txt" \
+    --output_path "$out" \
+    --scale 4 --ori_scale down16 --window 1024 --sliding_window 512 \
+    --seql 5 --no_need_gt_frame --no_save_images >> "$LOG" 2>&1
+  rc=$?
+  echo "rc=$rc" >> "$LOG"
+  # a paused eval can be killed by its own wall-clock timeout; retry once
+  if [ $rc -ne 0 ] && [ ! -f "$out/inference_all.yml" ]; then
+    echo "--- retry eval iter$1 $(date -u +%FT%TZ)" >> "$LOG"
+    $N timeout -k 30 2400 python infer.py \
+      --model_path "$ck" \
+      --data_list "$DATA/test_datalist.txt" \
+      --output_path "$out" \
+      --scale 4 --ori_scale down16 --window 1024 --sliding_window 512 \
+      --seql 5 --no_need_gt_frame --no_save_images >> "$LOG" 2>&1
+    echo "retry rc=$?" >> "$LOG"
+  fi
+  return 0
+}
+
+wait_capture_idle
+$N timeout -k 60 43200 python train.py -c configs/train_esr_4x.yml -id qnat4x -seed 0 -r auto \
+  -o "train_dataloader;path_to_datalist_txt=$DATA/train_datalist.txt" \
+  -o "valid_dataloader;path_to_datalist_txt=$DATA/valid_datalist.txt" \
+  -o "train_dataloader;batch_size=2" -o "valid_dataloader;batch_size=2" \
+  -o "train_dataloader;dataset;window=1024" -o "train_dataloader;dataset;sliding_window=512" \
+  -o "valid_dataloader;dataset;window=1024" -o "valid_dataloader;dataset;sliding_window=512" \
+  -o "train_dataloader;dataset;need_gt_frame=false" -o "valid_dataloader;dataset;need_gt_frame=false" \
+  -o "train_dataloader;dataset;sequence;sequence_length=5" \
+  -o "valid_dataloader;dataset;sequence;sequence_length=5" \
+  -o "trainer;output_path=artifacts/quality_demo_run_natural4x" \
+  -o "trainer;iteration_based_train;iterations=1200" \
+  -o "trainer;iteration_based_train;valid_step=200" \
+  -o "trainer;iteration_based_train;save_period=200" \
+  -o "trainer;iteration_based_train;lr_change_rate=300" \
+  -o "trainer;tensorboard=false" -o "trainer;vis;enabled=false" \
+  > artifacts/quality_demo_logs_natural4x_train.log 2>&1 &
+TRAIN_PID=$!
+
+PAUSED=0
+while true; do
+  if capture_active; then
+    if [ "$PAUSED" -eq 0 ]; then
+      echo "--- pausing trainer for on-chip capture $(date -u +%FT%TZ)" >> "$LOG"
+      pkill -STOP -P "$TRAIN_PID" 2>/dev/null
+      kill -STOP "$TRAIN_PID" 2>/dev/null
+      PAUSED=1
+    fi
+    sleep 30
+    continue
+  fi
+  if [ "$PAUSED" -eq 1 ]; then
+    echo "--- resuming trainer $(date -u +%FT%TZ)" >> "$LOG"
+    kill -CONT "$TRAIN_PID" 2>/dev/null
+    pkill -CONT -P "$TRAIN_PID" 2>/dev/null
+    PAUSED=0
+  fi
+  for it in $ITERS; do run_eval "$it"; done
+  kill -0 "$TRAIN_PID" 2>/dev/null || break
+  sleep 60
+done
+wait "$TRAIN_PID"
+echo "train rc=$?" >> "$LOG"
+# final sweep: the last checkpoint can land between the last loop sweep
+# and the trainer exiting
+for it in $ITERS; do run_eval "$it"; done
+echo "=== phase G done $(date -u +%FT%TZ)" >> "$LOG"
