@@ -1,11 +1,26 @@
-"""Widen the natural-statistics held-out test set (round-5 phase E).
+"""Widen a generated quality-demo corpus's held-out test set.
 
-The committed natural corpus has ONE test recording, so the paired SSIM
-delta rests on n=4 windows. This generates extra held-out recordings
-with seeds disjoint from every committed corpus recording (the original
-``make_quality_demo_data.py`` run used name-index seeds 0..7 -> render
-1000+s / sim 2000+s; these continue at s=8+) and writes
-``test_datalist_wide.txt`` = original test recording + the new ones.
+The committed demo corpora carry only 1-2 test recordings, so paired
+per-window SSIM stats rest on few windows (n=4 for the r5 natural 2x
+demo). This appends extra held-out recordings whose seeds are disjoint
+from every committed recording and writes ``test_datalist_wide.txt`` =
+the original test datalist + the new recordings.
+
+Everything is derived from the corpus directory rather than hardcoded
+(2026-08-02 review: a forked 4x sibling with hardcoded seed arithmetic
+silently collided when generation args changed):
+
+- committed seed count = total lines across the three generator-written
+  datalists (``make_quality_demo_data.py`` assigns name-index seeds
+  0..N-1 in exactly that order), so extras start at s = N + i;
+- ladder rungs are read from the first test recording's h5 keys
+  (``<rung>_events`` groups), so the 2x (down8/down16) and 4x
+  (down4/down16) corpora both work unchanged;
+- extra files are named ``test_wide_s<seed>.h5`` (their own namespace —
+  re-running after a previous widen never miscounts them as committed);
+- each recording is simulated to a temp path and renamed only on
+  success, so a killed run (VM recycle, timeout) can never leave a
+  truncated h5 that a re-run would silently list.
 
 Usage: python scripts/widen_natural_test.py <corpus_dir> [n_extra]
 """
@@ -17,6 +32,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
+    import h5py
+
     from esr_tpu.tools.simulate import (
         render_natural_frames,
         simulate_ladder_recording,
@@ -26,26 +43,43 @@ def main():
     n_extra = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     base_h = int(os.environ.get("DEMO_BASE_H", 360))
     base_w = int(os.environ.get("DEMO_BASE_W", 640))
-    rungs = ("down8", "down16")
 
-    paths = [os.path.join(out_dir, "test_0.h5")]
-    if not os.path.exists(paths[0]):
-        raise SystemExit(f"{paths[0]} missing — not a generated corpus dir")
+    def datalist(name):
+        p = os.path.join(out_dir, name)
+        if not os.path.exists(p):
+            raise SystemExit(f"{p} missing — not a generated corpus dir")
+        with open(p) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+
+    committed = sum(
+        len(datalist(f"{split}_datalist.txt"))
+        for split in ("train", "valid", "test")
+    )
+    test_paths = datalist("test_datalist.txt")
+    with h5py.File(test_paths[0]) as f:
+        rungs = tuple(
+            sorted(k[: -len("_events")] for k in f if k.endswith("_events"))
+        )
+
+    paths = list(test_paths)
     for i in range(n_extra):
-        s = 8 + i  # first seed index past the committed 6+1+1 recordings
-        path = os.path.join(out_dir, f"test_{1 + i}.h5")
+        s = committed + i
+        path = os.path.join(out_dir, f"test_wide_s{s}.h5")
         if not os.path.exists(path):
+            tmp = path + ".tmp"
             frames, ts = render_natural_frames(seed=1000 + s, h=base_h, w=base_w)
             cp, cn = simulate_ladder_recording(
-                frames, ts, path, rungs=rungs, seed=2000 + s
+                frames, ts, tmp, rungs=rungs, seed=2000 + s
             )
+            os.replace(tmp, path)
             print(f"{path}: cp={cp:.3f} cn={cn:.3f}", flush=True)
         paths.append(path)
 
     dl = os.path.join(out_dir, "test_datalist_wide.txt")
     with open(dl, "w") as f:
         f.write("\n".join(paths) + "\n")
-    print(f"{dl}: {len(paths)} recordings")
+    print(f"{dl}: {len(paths)} recordings (rungs={','.join(rungs)}, "
+          f"extra seeds {committed}..{committed + n_extra - 1})")
 
 
 if __name__ == "__main__":
