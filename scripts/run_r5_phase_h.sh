@@ -1,0 +1,109 @@
+#!/bin/bash
+# Round-5 phase H: extend the natural-4x run 1200 -> 2400.
+#
+# At the 1200 budget the natural-4x SSIM deficit has halved
+# (-0.047 @200 -> -0.024 @1000) without crossing; every prior cell that
+# crossed did so with budget (gratings-2x: parity at 3.2k; gratings-4x:
+# 800; natural-2x plateaued -0.03 at 4k). This phase doubles the budget
+# with the same land-and-eval pattern; evals run on the ORIGINAL
+# 2-recording test list for ladder continuity (the wide 5-recording list
+# is evaluated separately at the final checkpoint).
+#
+# Same discipline as phases D-G: waits for the phase-G runner to release
+# the core, self-pauses during on-chip captures, retries a killed eval
+# once. (Sibling copy of the phase-G loop — phase G is live while this
+# is written; editing a running bash script corrupts it.)
+set -u
+cd /root/repo || exit 1
+. scripts/capture_active.sh
+export JAX_PLATFORMS=cpu
+N="nice -n 12"
+LOG=artifacts/r5_phase_h.log
+DATA=artifacts/quality_demo_data_360_natural4x
+RUN=artifacts/quality_demo_run_natural4x/models/DeepRecurrentNetwork4x/qnat4x
+ITERS="1400 1600 1800 2000 2200 2399"
+echo "=== phase H start $(date -u +%FT%TZ)" >> "$LOG"
+
+# wait for phase G to release the core: its completion marker, or the
+# phase-G runner disappearing (crash) — never run two trainers at once
+while true; do
+  grep -q "phase G done" artifacts/r5_phase_g.log 2>/dev/null && break
+  pgrep -fx "bash scripts/run_r5_phase_g.sh" >/dev/null 2>&1 || {
+    echo "--- phase G runner gone without marker $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  }
+  sleep 30
+done
+echo "--- phase G released the core $(date -u +%FT%TZ)" >> "$LOG"
+
+run_eval() {  # $1 = iteration; skips work that already produced results
+  ck="$RUN/checkpoint-iteration$1"
+  out="artifacts/quality_demo_eval_natural4x_iter$1"
+  [ -f "$ck/meta.yml" ] || return 1
+  [ -f "$out/inference_all.yml" ] && return 0
+  sleep 5
+  echo "--- eval natural4x iter$1 $(date -u +%FT%TZ)" >> "$LOG"
+  $N timeout -k 30 2400 python infer.py \
+    --model_path "$ck" \
+    --data_list "$DATA/test_datalist.txt" \
+    --output_path "$out" \
+    --scale 4 --ori_scale down16 --window 1024 --sliding_window 512 \
+    --seql 5 --no_need_gt_frame --no_save_images >> "$LOG" 2>&1
+  rc=$?
+  echo "rc=$rc" >> "$LOG"
+  if [ $rc -ne 0 ] && [ ! -f "$out/inference_all.yml" ]; then
+    echo "--- retry eval iter$1 $(date -u +%FT%TZ)" >> "$LOG"
+    $N timeout -k 30 2400 python infer.py \
+      --model_path "$ck" \
+      --data_list "$DATA/test_datalist.txt" \
+      --output_path "$out" \
+      --scale 4 --ori_scale down16 --window 1024 --sliding_window 512 \
+      --seql 5 --no_need_gt_frame --no_save_images >> "$LOG" 2>&1
+    echo "retry rc=$?" >> "$LOG"
+  fi
+  return 0
+}
+
+while capture_active; do sleep 30; done
+$N timeout -k 60 43200 python train.py -c configs/train_esr_4x.yml -id qnat4x -seed 0 -r auto \
+  -o "train_dataloader;path_to_datalist_txt=$DATA/train_datalist.txt" \
+  -o "valid_dataloader;path_to_datalist_txt=$DATA/valid_datalist.txt" \
+  -o "train_dataloader;batch_size=2" -o "valid_dataloader;batch_size=2" \
+  -o "train_dataloader;dataset;window=1024" -o "train_dataloader;dataset;sliding_window=512" \
+  -o "valid_dataloader;dataset;window=1024" -o "valid_dataloader;dataset;sliding_window=512" \
+  -o "train_dataloader;dataset;need_gt_frame=false" -o "valid_dataloader;dataset;need_gt_frame=false" \
+  -o "train_dataloader;dataset;sequence;sequence_length=5" \
+  -o "valid_dataloader;dataset;sequence;sequence_length=5" \
+  -o "trainer;output_path=artifacts/quality_demo_run_natural4x" \
+  -o "trainer;iteration_based_train;iterations=2400" \
+  -o "trainer;iteration_based_train;valid_step=200" \
+  -o "trainer;iteration_based_train;save_period=200" \
+  -o "trainer;iteration_based_train;lr_change_rate=300" \
+  -o "trainer;tensorboard=false" -o "trainer;vis;enabled=false" \
+  > artifacts/quality_demo_logs_natural4x_ext.log 2>&1 &
+TRAIN_PID=$!
+
+PAUSED=0
+while true; do
+  if capture_active; then
+    if [ "$PAUSED" -eq 0 ]; then
+      echo "--- pausing trainer for on-chip capture $(date -u +%FT%TZ)" >> "$LOG"
+      pkill -STOP -P "$TRAIN_PID" 2>/dev/null
+      PAUSED=1
+    fi
+    sleep 30
+    continue
+  fi
+  if [ "$PAUSED" -eq 1 ]; then
+    echo "--- resuming trainer $(date -u +%FT%TZ)" >> "$LOG"
+    pkill -CONT -P "$TRAIN_PID" 2>/dev/null
+    PAUSED=0
+  fi
+  for it in $ITERS; do run_eval "$it"; done
+  kill -0 "$TRAIN_PID" 2>/dev/null || break
+  sleep 60
+done
+wait "$TRAIN_PID"
+echo "train rc=$?" >> "$LOG"
+for it in $ITERS; do run_eval "$it"; done
+echo "=== phase H done $(date -u +%FT%TZ)" >> "$LOG"
