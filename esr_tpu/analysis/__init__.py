@@ -1,0 +1,68 @@
+"""esr_tpu.analysis — JAX-hazard static analysis + runtime retrace guard.
+
+Two halves of one contract (docs/ANALYSIS.md):
+
+- the **static pass** (``core`` + ``rules``): an AST lint over the source
+  for the silent JAX killers — traced-value control flow, host syncs in
+  jitted/scanned code, missing buffer donation on train steps, device code
+  in the NumPy-only data layer, stateful flax ``__call__``s, trace-frozen
+  nondeterminism. CLI: ``python -m esr_tpu.analysis esr_tpu/`` (or the
+  ``esr-analyze`` console script / ``scripts/lint.sh``), gated in tier-1 by
+  ``tests/test_analysis_selfcheck.py`` against ``analysis_baseline.json``.
+- the **runtime guard** (``retrace_guard.checked_jit``): ``jax.jit`` with a
+  trace budget, catching the recompilation storms no static pass can see.
+
+Deliberately dependency-free beyond the stdlib (+jax for the guard): the
+analyzer must run anywhere CI does, including hosts with no accelerator.
+"""
+
+from esr_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    new_findings,
+    register_rule,
+    write_baseline,
+)
+# The runtime guard needs jax; the lint CLI must not (it runs on bare CI
+# hosts and must start fast). PEP 562 lazy attributes keep `from
+# esr_tpu.analysis import checked_jit` working without making
+# `python -m esr_tpu.analysis` pay the jax import.
+_GUARD_EXPORTS = (
+    "DEFAULT_MAX_TRACES",
+    "RetraceBudgetError",
+    "TraceCounter",
+    "checked_jit",
+    "retrace_stats",
+)
+
+
+def __getattr__(name):
+    if name in _GUARD_EXPORTS:
+        from esr_tpu.analysis import retrace_guard
+
+        return getattr(retrace_guard, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "load_baseline",
+    "new_findings",
+    "register_rule",
+    "write_baseline",
+    "DEFAULT_MAX_TRACES",
+    "RetraceBudgetError",
+    "TraceCounter",
+    "checked_jit",
+    "retrace_stats",
+]

@@ -1,0 +1,145 @@
+"""CLI: ``python -m esr_tpu.analysis [options] <paths>`` (= ``esr-analyze``).
+
+Exit codes: 0 clean (no findings beyond the baseline), 1 new findings,
+2 usage error. ``--write-baseline`` regenerates the grandfather file from
+the current findings and exits 0 (review the diff before committing it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from esr_tpu.analysis.core import (
+    all_rules,
+    analyze_paths,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m esr_tpu.analysis",
+        description="JAX-hazard static analysis (rule catalog: docs/ANALYSIS.md)",
+    )
+    p.add_argument("paths", nargs="+", help="files and/or directories to lint")
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        default=None,
+        help="JSON baseline of grandfathered findings; only NEW findings fail",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite --baseline (or analysis_baseline.json) from current "
+        "findings and exit 0",
+    )
+    p.add_argument(
+        "--rules",
+        metavar="LIST",
+        default=None,
+        help="comma-separated rule names to run (default: all), e.g. "
+        "ESR002,ESR006",
+    )
+    p.add_argument(
+        "--relative-to",
+        metavar="DIR",
+        default=None,
+        help="base directory for finding paths (default: cwd); baselines "
+        "must be generated and checked with the same base",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # a typo'd path must NOT greenlight as "0 findings" — that would
+    # silently disable the gate while CI stays green
+    import os
+
+    bad_paths = [
+        p
+        for p in args.paths
+        if not (os.path.isdir(p) or (os.path.isfile(p) and p.endswith(".py")))
+    ]
+    if bad_paths:
+        print(
+            f"not a directory or .py file: {bad_paths} — nothing would be "
+            "linted",
+            file=sys.stderr,
+        )
+        return 2
+    from esr_tpu.analysis.core import iter_python_files
+
+    if not iter_python_files(args.paths):
+        print(
+            f"no python files found under {args.paths} — refusing to "
+            "report a clean run over nothing",
+            file=sys.stderr,
+        )
+        return 2
+
+    rules = all_rules()
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r.name for r in rules}
+        unknown = wanted - known
+        if unknown:
+            print(
+                f"unknown rule(s): {sorted(unknown)}; known: {sorted(known)}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    findings = analyze_paths(
+        args.paths, rules=rules, relative_to=args.relative_to
+    )
+
+    if args.write_baseline:
+        target = args.baseline or "analysis_baseline.json"
+        write_baseline(target, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {target}", file=sys.stderr
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    fresh = new_findings(findings, baseline) if baseline else findings
+    grandfathered = len(findings) - len(fresh)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in fresh],
+                    "grandfathered": grandfathered,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in fresh:
+            print(f.format())
+        summary = f"{len(fresh)} new finding(s)"
+        if grandfathered:
+            summary += f" ({grandfathered} grandfathered by baseline)"
+        print(summary, file=sys.stderr)
+
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

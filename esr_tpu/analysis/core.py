@@ -1,0 +1,559 @@
+"""AST lint framework for JAX hazards: rule registry, findings, baselines.
+
+The silent JAX performance/correctness killers — tracer leaks through python
+control flow, host syncs inside the hot loop, recompilation storms, missing
+buffer donation — are all *statically visible* in the source, yet nothing in
+the normal test pyramid catches them before they land (a host sync does not
+fail a test; it just makes every step 10x slower). This module is the
+machine-checkable contract at the framework boundary: a small AST visitor
+framework over which ``esr_tpu.analysis.rules`` registers ~6 concrete JAX
+hazard rules, with
+
+- findings carrying ``path:line:col`` + severity + a fix hint;
+- per-line suppression via ``# esr: noqa`` / ``# esr: noqa(ESR002)``;
+- a committed JSON baseline so intentionally-grandfathered findings do not
+  fail CI while any NEW finding does (ratchet semantics — the codebase can
+  only get cleaner);
+- a *traced-context* index shared by rules: which functions in a module are
+  (transitively, lexically) jitted or used as ``lax.scan``/``fori_loop``/
+  ``while_loop`` bodies. Rules about device-side hazards fire only inside
+  that context, which keeps the false-positive rate near zero without
+  whole-program dataflow.
+
+The framework is deliberately file-local (one module at a time, no imports
+resolved): cross-module jit wiring (e.g. ``mesh.make_parallel_train_step``
+jitting a function built in ``training/train_step.py``) is out of scope for
+a lint pass and covered at runtime by ``analysis.retrace_guard`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import tokenize
+from io import StringIO
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``code`` is the stripped source line — it anchors the
+    baseline fingerprint so findings survive unrelated line-number drift."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    severity: str
+    message: str
+    hint: str = ""
+    code: str = ""
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.path}::{self.code}"
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.rule} [{self.severity}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``severity``/``hint``, implement
+    :meth:`check`. Register with :func:`register_rule`."""
+
+    name: str = "ESR000"
+    slug: str = "base"
+    severity: str = "error"
+    hint: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self,
+        ctx: "ModuleContext",
+        node: ast.AST,
+        message: str,
+        hint: Optional[str] = None,
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.name,
+            path=ctx.path,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            severity=self.severity,
+            message=message,
+            hint=self.hint if hint is None else hint,
+            code=ctx.source_line(line),
+        )
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    if inst.name in _RULES:
+        raise ValueError(f"duplicate rule name {inst.name}")
+    _RULES[inst.name] = inst
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # import for side effect: rule registration happens on first use so
+    # `core` never depends on `rules` at module-import time
+    from esr_tpu.analysis import rules as _rules  # noqa: F401
+
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+# ---------------------------------------------------------------------------
+# traced-context index
+
+
+# callables whose function argument is traced. shard_map bodies trace like
+# jit bodies (they run under the SPMD trace), so they get the same rules.
+_JIT_NAMES = {"jit", "checked_jit", "pjit", "shard_map"}
+_LOOP_BODY_ARG = {  # callable-taking lax primitives: arg index of the body
+    "scan": 0,
+    "fori_loop": 2,
+    "while_loop": 1,  # and 0 (cond) — both trace
+    "cond": None,  # every callable arg traces
+    "switch": None,
+    "checkpoint": 0,
+    "remat": 0,
+    "vmap": 0,
+    "grad": 0,
+    "value_and_grad": 0,
+}
+
+
+def _call_name(func: ast.AST) -> str:
+    """Rightmost identifier of a call target: ``jax.lax.scan`` -> ``scan``."""
+    while isinstance(func, ast.Attribute):
+        func = func.attr  # type: ignore[assignment]
+        if isinstance(func, str):
+            return func
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _dotted(expr: ast.AST) -> str:
+    """Best-effort dotted-name text: ``np.random.rand`` (or "" if dynamic)."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit``, ``@jit``, ``@checked_jit(...)``,
+    ``@partial(jax.jit, ...)`` and friends."""
+    if isinstance(dec, ast.Call):
+        name = _call_name(dec.func)
+        if name in _JIT_NAMES:
+            return True
+        if name == "partial" and dec.args:
+            return _call_name(dec.args[0]) in _JIT_NAMES or _is_jit_decorator(
+                dec.args[0]
+            )
+        return False
+    return _call_name(dec) in _JIT_NAMES
+
+
+def _static_param_names(keywords, func_def) -> Set[str]:
+    """Parameter names a jit call/decorator marks static via
+    ``static_argnums``/``static_argnames`` — branching on those is
+    supported JAX, so ESR001 must not fire on them. Evaluated with
+    ``literal_eval`` so negative indices resolve like jax resolves them
+    (``-1`` = last parameter), and dynamic expressions are ignored rather
+    than mis-attributed."""
+    names: Set[str] = set()
+    args = func_def.args
+    pos = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+    for kw in keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        try:
+            value = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        items = value if isinstance(value, (tuple, list)) else (value,)
+        for item in items:
+            if kw.arg == "static_argnames" and isinstance(item, str):
+                names.add(item)
+            elif (
+                kw.arg == "static_argnums"
+                and isinstance(item, int)
+                and -len(pos) <= item < len(pos)
+            ):
+                names.add(pos[item])
+    return names
+
+
+def _jit_call_keywords(dec: ast.AST) -> list:
+    """Keywords of a jit-ish decorator: ``@jit(static_argnums=...)`` or
+    ``@partial(jax.jit, static_argnums=...)``."""
+    if isinstance(dec, ast.Call):
+        return list(dec.keywords)
+    return []
+
+
+class _TracedIndex(ast.NodeVisitor):
+    """Collect function-def nodes that execute under a JAX trace.
+
+    Roots: defs with a jit-ish decorator (incl. ``shard_map``), defs whose
+    NAME is passed to ``jax.jit(...)`` / ``checked_jit(...)`` /
+    ``shard_map(...)`` or used as the body of a ``lax.scan`` /
+    ``fori_loop`` / ``while_loop`` / ``cond`` / ``vmap`` / ``grad`` in the
+    same module, and — for the factory pattern
+    ``jit(make_step(...))`` — the defs lexically nested inside the factory
+    (the factory *returns* a traced function; its own body runs on host).
+    Every def nested inside a traced root is traced too (closures trace
+    with their parent). ``static_argnums``/``static_argnames`` visible at
+    the decorator or call site are recorded per def so rules can exempt
+    static parameters.
+    """
+
+    def __init__(self) -> None:
+        self.defs: Dict[str, List[ast.AST]] = {}
+        self.roots: Set[ast.AST] = set()
+        self.static_params: Dict[ast.AST, Set[str]] = {}
+        self._traced_names: Dict[str, List[list]] = {}
+        self._factory_names: Set[str] = set()
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self.defs.setdefault(node.name, []).append(node)
+        for d in node.decorator_list:
+            if _is_jit_decorator(d):
+                self.roots.add(node)
+                self.static_params.setdefault(node, set()).update(
+                    _static_param_names(_jit_call_keywords(d), node)
+                )
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):  # noqa: N802
+        name = _call_name(node.func)
+        candidates: List[ast.AST] = []
+        jitlike = name in _JIT_NAMES
+        if jitlike:
+            candidates = node.args[:1]
+        elif name in _LOOP_BODY_ARG:
+            idx = _LOOP_BODY_ARG[name]
+            if idx is None:
+                candidates = list(node.args)
+            else:
+                lo = 0 if name == "while_loop" else idx
+                candidates = node.args[lo : idx + 1]
+        for cand in candidates:
+            if isinstance(cand, ast.Name):
+                self._traced_names.setdefault(cand.id, []).append(
+                    list(node.keywords) if jitlike else []
+                )
+            elif isinstance(cand, ast.Lambda):
+                self.roots.add(cand)
+            elif jitlike and isinstance(cand, ast.Call):
+                factory = _call_name(cand.func)
+                if factory:
+                    self._factory_names.add(factory)
+        self.generic_visit(node)
+
+    def resolve(self) -> Set[ast.AST]:
+        for nm, kw_lists in self._traced_names.items():
+            for d in self.defs.get(nm, []):
+                self.roots.add(d)
+                for kws in kw_lists:
+                    self.static_params.setdefault(d, set()).update(
+                        _static_param_names(kws, d)
+                    )
+        # jit(make_step(...)): the returned closure — every def nested in
+        # the factory — is traced; the factory body itself stays host code
+        for nm in self._factory_names:
+            for d in self.defs.get(nm, []):
+                for sub in ast.walk(d):
+                    if sub is not d and isinstance(
+                        sub,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ):
+                        self.roots.add(sub)
+        return self.roots
+
+
+class ModuleContext:
+    """Everything a rule needs about one file: tree, source, traced index,
+    parent links, and the layer the file belongs to."""
+
+    def __init__(self, path: str, source: str, rel_path: Optional[str] = None):
+        self.abs_path = path
+        self.path = rel_path or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        idx = _TracedIndex()
+        idx.visit(self.tree)
+        roots = idx.resolve()
+        self.static_params: Dict[ast.AST, Set[str]] = idx.static_params
+        self.traced_defs: Set[ast.AST] = set()
+        for root in roots:
+            self.traced_defs.add(root)
+            for sub in ast.walk(root):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    self.traced_defs.add(sub)
+        self._noqa = _noqa_lines(source)
+
+    # -- helpers rules lean on ------------------------------------------
+
+    def source_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def in_traced_context(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced_defs:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def traced_params(self, node: ast.AST) -> Set[str]:
+        """Union of parameter names of every enclosing traced function —
+        the names most likely bound to tracers at runtime. Parameters
+        marked ``static_argnums``/``static_argnames`` at the jit site are
+        excluded: they are concrete python values during tracing."""
+        names: Set[str] = set()
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced_defs:
+                args = fn.args
+                for a in (
+                    list(args.posonlyargs)
+                    + list(args.args)
+                    + list(args.kwonlyargs)
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else [])
+                ):
+                    names.add(a.arg)
+                names -= self.static_params.get(fn, set())
+            fn = self.enclosing_function(fn)
+        return names
+
+    @property
+    def is_data_layer(self) -> bool:
+        """The NumPy-only host layer: any path segment named ``data``."""
+        parts = self.path.replace("\\", "/").split("/")
+        return "data" in parts[:-1]
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self._noqa.get(finding.line)
+        if rules is None:
+            return False
+        return not rules or finding.rule in rules
+
+
+_NOQA_RULE_RE = None  # compiled lazily (keeps `re` out of the hot import)
+
+
+def _noqa_lines(source: str) -> Dict[int, Set[str]]:
+    """``{line: set(rule_names)}`` for ``# esr: noqa(...)`` comments; an
+    empty set means blanket suppression for that line. Comment scanning
+    uses tokenize so strings containing the marker never suppress.
+
+    Parsing is lenient but fails CLOSED: ``noqa(ESR1)`` / ``noqa ESR1`` /
+    ``noqa: ESR1`` all scope to the named rules, and a directive with
+    trailing garbage that names no rule suppresses NOTHING — a typo must
+    never silently widen to blanket suppression."""
+    global _NOQA_RULE_RE
+    import re
+
+    if _NOQA_RULE_RE is None:
+        _NOQA_RULE_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith("esr:"):
+                continue
+            directive = text[len("esr:") :].strip()
+            if not directive.startswith("noqa"):
+                continue
+            rest = directive[len("noqa") :].strip()
+            if not rest:
+                out[tok.start[0]] = set()  # bare noqa: blanket
+            else:
+                names = set(_NOQA_RULE_RE.findall(rest))
+                # trailing garbage naming no rule suppresses nothing
+                out[tok.start[0]] = names or {"<malformed-noqa>"}
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in ("__pycache__", ".git")
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def analyze_source(
+    source: str,
+    path: str = "<memory>",
+    rules: Optional[Sequence[Rule]] = None,
+    rel_path: Optional[str] = None,
+) -> List[Finding]:
+    """Lint one source blob. Syntax errors yield a single ESR000 finding
+    (an unparseable file must fail the gate, not crash it)."""
+    try:
+        ctx = ModuleContext(path, source, rel_path=rel_path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="ESR000",
+                path=rel_path or path,
+                line=e.lineno or 1,
+                col=(e.offset or 0) + 1,
+                severity="error",
+                message=f"syntax error: {e.msg}",
+                code="",
+            )
+        ]
+    findings: List[Finding] = []
+    for rule in rules if rules is not None else all_rules():
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    relative_to: Optional[str] = None,
+) -> List[Finding]:
+    """Lint files/trees. Paths in findings are normalized relative to
+    ``relative_to`` (default: cwd) with ``/`` separators so baselines are
+    stable across machines and invocation directories."""
+    base = os.path.abspath(relative_to or os.getcwd())
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(
+                Finding(
+                    rule="ESR000",
+                    path=f,
+                    line=1,
+                    col=1,
+                    severity="error",
+                    message=f"unreadable file: {e}",
+                )
+            )
+            continue
+        rel = os.path.relpath(os.path.abspath(f), base).replace(os.sep, "/")
+        findings.extend(analyze_source(source, path=f, rules=rules, rel_path=rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline (ratchet)
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """``{fingerprint: count}`` from a baseline JSON (empty if missing)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    counts: Dict[str, int] = {}
+    for item in data.get("findings", []):
+        fp = f"{item['rule']}::{item['path']}::{item.get('code', '')}"
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "version": 1,
+        "comment": (
+            "Grandfathered esr_tpu.analysis findings. Regenerate with "
+            "`python -m esr_tpu.analysis --write-baseline ...` after "
+            "reviewing that every entry is intentional (docs/ANALYSIS.md)."
+        ),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "code": f.code}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def new_findings(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> List[Finding]:
+    """Findings beyond the baselined count per fingerprint (ratchet: moved
+    lines stay grandfathered, genuinely new hazards do not)."""
+    budget = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out

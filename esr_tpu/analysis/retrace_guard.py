@@ -1,0 +1,133 @@
+"""Runtime retrace guard: ``checked_jit`` — ``jax.jit`` with a trace budget.
+
+The static pass (``esr_tpu.analysis.rules``) catches hazards visible in the
+source; a *recompilation storm* usually is not — it emerges from the data
+(a loader yielding a new shape every batch, a python scalar riding a closure,
+a weak-typed literal flipping dtypes) and manifests only as mysteriously slow
+steps. XLA compiles are seconds each; a per-step retrace turns a
+1000-step/min TPU loop into a 5-step/min one with no error anywhere.
+
+``checked_jit`` is a drop-in ``jax.jit`` wrapper that counts how many times
+the wrapped function is actually *traced* (the counter bumps inside the
+function body, which only executes at trace time — cache hits never touch
+it) and raises :class:`RetraceBudgetError` the moment the count exceeds its
+budget, naming the function and the usual suspects. Adopted at the two hot
+jit sites (``parallel/mesh.make_parallel_train_step`` and the eval-step jit
+in ``training/train_step.jit_eval_step``), so a shape leak in the input
+pipeline fails loudly on step ~N_budget instead of burning a TPU reservation.
+
+The wrapper returns the genuine ``jax.jit`` object (``.lower()``,
+``.clear_cache()`` etc. intact) with a ``retrace_counter`` attribute for
+introspection; :func:`retrace_stats` snapshots every live counter.
+"""
+
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+DEFAULT_MAX_TRACES = 8
+
+_COUNTERS: List["weakref.ref[TraceCounter]"] = []
+
+
+class RetraceBudgetError(RuntimeError):
+    """Raised (at trace time) when a ``checked_jit`` function recompiles
+    more often than its budget allows."""
+
+
+class TraceCounter:
+    """Mutable trace count for one ``checked_jit`` site."""
+
+    __slots__ = ("name", "max_traces", "count", "__weakref__")
+
+    def __init__(self, name: str, max_traces: int):
+        self.name = name
+        self.max_traces = max_traces
+        self.count = 0
+
+    def bump(self) -> None:
+        # under jax.disable_jit() the "traced" body runs op-by-op on EVERY
+        # call — bumping there would fire the budget after max_traces steps
+        # of a perfectly normal debugging session. No trace, no count.
+        if jax.config.jax_disable_jit:
+            return
+        self.count += 1
+        if self.count > self.max_traces:
+            raise RetraceBudgetError(
+                f"{self.name!r} has been traced {self.count} times "
+                f"(budget: {self.max_traces}) — a recompilation storm. "
+                "Usual causes: input shapes/dtypes varying per call (pad "
+                "batches to a fixed capacity / drop the ragged tail), "
+                "python scalars or fresh closures in the arguments (hash "
+                "inequality retraces), or weak-typed literals flipping "
+                "dtypes. Raise max_traces only if every retrace is "
+                "intentional."
+            )
+
+    def reset(self) -> None:
+        self.count = 0
+
+    def __repr__(self) -> str:
+        return (
+            f"TraceCounter({self.name!r}, count={self.count}, "
+            f"max_traces={self.max_traces})"
+        )
+
+
+def checked_jit(
+    fun: Optional[Callable] = None,
+    *,
+    max_traces: int = DEFAULT_MAX_TRACES,
+    name: Optional[str] = None,
+    **jit_kwargs: Any,
+):
+    """``jax.jit`` with a retrace budget. Usable as ``checked_jit(f, ...)``
+    or ``@checked_jit(max_traces=4)``. Extra kwargs (``donate_argnums``,
+    ``in_shardings``, ``static_argnums``, ...) pass straight to ``jax.jit``.
+    """
+    if fun is None:
+        return functools.partial(
+            checked_jit, max_traces=max_traces, name=name, **jit_kwargs
+        )
+    if max_traces < 1:
+        raise ValueError(f"max_traces must be >= 1, got {max_traces}")
+    counter = TraceCounter(
+        name or getattr(fun, "__name__", repr(fun)), max_traces
+    )
+
+    @functools.wraps(fun)
+    def counted(*args: Any, **kwargs: Any):
+        counter.bump()  # body runs at trace time only; cache hits skip it
+        return fun(*args, **kwargs)
+
+    jitted = jax.jit(counted, **jit_kwargs)
+    try:
+        jitted.retrace_counter = counter
+    except AttributeError:  # future jit objects may reject attributes
+        pass
+    _COUNTERS.append(weakref.ref(counter))
+    return jitted
+
+
+def retrace_stats() -> Dict[str, Dict[str, int]]:
+    """``{site name: {count, max_traces}}`` for every live counter (dead
+    sites are pruned). Multiple sites sharing a name get ``name#k`` keys."""
+    out: Dict[str, Dict[str, int]] = {}
+    live: List["weakref.ref[TraceCounter]"] = []
+    for ref in _COUNTERS:
+        c = ref()
+        if c is None:
+            continue
+        live.append(ref)
+        key = c.name
+        k = 1
+        while key in out:
+            key = f"{c.name}#{k}"
+            k += 1
+        out[key] = {"count": c.count, "max_traces": c.max_traces}
+    _COUNTERS[:] = live
+    return out

@@ -1,0 +1,434 @@
+"""The concrete JAX-hazard rules (ESR001..ESR006).
+
+Each rule targets one class of silent performance/correctness defect named
+in SURVEY/ROADMAP post-mortems of jax_graft systems:
+
+- ESR001 traced-control-flow — python ``if``/``while``/``for`` on traced
+  values inside jitted code: either a ``ConcretizationTypeError`` at trace
+  time or, worse, a silent per-shape recompile storm.
+- ESR002 host-sync — ``.item()`` / ``np.asarray`` / ``float()`` /
+  ``block_until_ready`` inside jitted or scan-body code: a device→host
+  round-trip serialized into the hot loop (the r4 bench measured e2e at a
+  small fraction of device-resident steps/s for exactly this defect class).
+- ESR003 missing-donate — ``jax.jit`` of a train-step-shaped callable
+  without ``donate_argnums``: doubles optimizer+param HBM residency.
+- ESR004 data-layer-purity — ``jax``/``jnp`` in the NumPy-only data layer
+  (``esr_tpu/data/``): the host pipeline must stay importable and fast on
+  machines with no accelerator runtime, and jnp ops in loader workers
+  silently serialize on the device lock.
+- ESR005 mutable-state — mutable default args anywhere, and ``self.attr``
+  assignment inside a flax ``Module.__call__`` (state that silently resets
+  on every trace).
+- ESR006 traced-nondeterminism — ``time.time`` / bare ``np.random`` /
+  stdlib ``random`` inside traced code: baked in as a constant at trace
+  time, NOT re-evaluated per step.
+
+Every rule fires only where its hazard is real (traced context, data layer,
+flax ``__call__``), keeping the default run clean enough to gate CI.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from esr_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    _call_name,
+    _dotted,
+    register_rule,
+)
+
+# attribute accesses on a tracer that are static at trace time — branching
+# on these is supported JAX (shapes/dtypes are concrete during tracing)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _names_in(node: ast.AST, ctx: ModuleContext, skip_static: bool) -> Set[str]:
+    """Names referenced in an expression; with ``skip_static``, a name only
+    counts when NOT immediately under a static attribute access
+    (``x.ndim``), an ``isinstance``/``len``/``getattr`` call, or an
+    ``is (not) None`` comparison."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Name):
+            continue
+        if skip_static:
+            parent = ctx.parents.get(sub)
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.value is sub
+                and parent.attr in _STATIC_ATTRS
+            ):
+                continue
+            if isinstance(parent, ast.Call) and _call_name(parent.func) in (
+                "isinstance",
+                "len",
+                "getattr",
+                "hasattr",
+                "type",
+            ):
+                continue
+            if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+            ):
+                continue
+        out.add(sub.id)
+    return out
+
+
+@register_rule
+class TracedControlFlow(Rule):
+    name = "ESR001"
+    slug = "traced-control-flow"
+    severity = "error"
+    hint = (
+        "python control flow on a traced value fails (or retraces) at jit "
+        "time; use jnp.where / jax.lax.cond / jax.lax.scan, or mark the "
+        "argument static_argnums if it is genuinely configuration"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.If, ast.While)):
+                if not ctx.in_traced_context(node):
+                    continue
+                traced = ctx.traced_params(node)
+                hit = _names_in(node.test, ctx, skip_static=True) & traced
+                if hit:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"python `{kind}` on traced value(s) "
+                        f"{sorted(hit)} inside jitted code",
+                    )
+            elif isinstance(node, ast.For):
+                if not ctx.in_traced_context(node):
+                    continue
+                traced = ctx.traced_params(node)
+                if (
+                    isinstance(node.iter, ast.Name)
+                    and node.iter.id in traced
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"python `for` iterating traced value "
+                        f"{node.iter.id!r} inside jitted code",
+                        hint=(
+                            "iterating a tracer unrolls (or fails) at "
+                            "trace time; use jax.lax.scan / fori_loop"
+                        ),
+                    )
+
+
+_SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready", "to_py"}
+_SYNC_FN_CALLS = {
+    "asarray": ("np", "numpy", "onp"),
+    "array": ("np", "numpy", "onp"),
+    "device_get": ("jax", ""),
+}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+@register_rule
+class HostSync(Rule):
+    name = "ESR002"
+    slug = "host-sync"
+    severity = "error"
+    hint = (
+        "a device->host transfer inside jitted/scanned code serializes the "
+        "pipeline (or fails to trace); keep the value on device and read "
+        "it back outside the hot loop, behind a logging cadence"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.in_traced_context(node):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_ATTR_CALLS
+            ):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"host-sync call `.{func.attr}()` inside traced code",
+                )
+                continue
+            if isinstance(func, ast.Attribute):
+                base = _dotted(func.value)
+                roots = _SYNC_FN_CALLS.get(func.attr)
+                if roots is not None and base in roots:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"host-sync call `{base}.{func.attr}(...)` inside "
+                        "traced code (materializes the array on host)",
+                    )
+                    continue
+            if isinstance(func, ast.Name) and func.id in _CAST_BUILTINS:
+                traced = ctx.traced_params(node)
+                if node.args and (
+                    _names_in(node.args[0], ctx, skip_static=True) & traced
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"`{func.id}()` on a traced value inside jitted "
+                        "code forces a host sync (or a tracer leak)",
+                    )
+
+
+_TRAIN_SHAPED = ("train", "update")
+_TRAIN_EXEMPT = ("eval", "valid", "infer", "predict", "test")
+
+
+@register_rule
+class MissingDonate(Rule):
+    name = "ESR003"
+    slug = "missing-donate"
+    severity = "warning"
+    hint = (
+        "a train/update step rebuilds its entire (params, opt_state) "
+        "pytree every call; without donate_argnums the old buffers stay "
+        "live across the step and HBM residency doubles — pass "
+        "donate_argnums=(0,) (and drop the donated reference on the host)"
+    )
+
+    def _step_shaped(self, ident: str) -> bool:
+        low = ident.lower()
+        if any(t in low for t in _TRAIN_EXEMPT):
+            return False
+        return any(t in low for t in _TRAIN_SHAPED) and "step" in low or (
+            low in ("train_step", "update", "update_step")
+        )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            # call-site form: jax.jit(train_step, ...)
+            if isinstance(node, ast.Call) and _call_name(node.func) in (
+                "jit",
+                "checked_jit",
+                "pjit",
+            ):
+                if not node.args:
+                    continue
+                target = node.args[0]
+                ident = (
+                    _dotted(target)
+                    if not isinstance(target, ast.Call)
+                    else _call_name(target.func)
+                )
+                ident = ident.rsplit(".", 1)[-1] if ident else ""
+                if not ident or not self._step_shaped(ident):
+                    continue
+                kw = {k.arg for k in node.keywords}
+                if not kw & {"donate_argnums", "donate_argnames"}:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"`jit({ident}, ...)` looks train-step-shaped but "
+                        "donates no buffers",
+                    )
+            # decorator form: @jax.jit on def train_step(...)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not self._step_shaped(node.name):
+                    continue
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        if _call_name(dec.func) not in ("jit", "checked_jit"):
+                            continue
+                        kw = {k.arg for k in dec.keywords}
+                        if kw & {"donate_argnums", "donate_argnames"}:
+                            continue
+                    elif _call_name(dec) not in ("jit", "checked_jit"):
+                        continue
+                    yield self.finding(
+                        ctx,
+                        dec,
+                        f"`@jit` on train-step-shaped `{node.name}` "
+                        "donates no buffers",
+                    )
+                    break
+
+
+@register_rule
+class DataLayerPurity(Rule):
+    name = "ESR004"
+    slug = "data-layer-purity"
+    severity = "error"
+    hint = (
+        "the data layer is NumPy-only by contract (host pipeline must not "
+        "touch the device runtime; jnp in loader workers serializes on the "
+        "device lock) — move jit-able compute to esr_tpu/ops and keep the "
+        "numpy twin here (see data/np_encodings.py)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.is_data_layer:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root == "jax":
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"`import {alias.name}` in the NumPy-only "
+                            "data layer",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root == "jax" and node.level == 0:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"`from {node.module} import ...` in the "
+                        "NumPy-only data layer",
+                    )
+
+
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict"}
+_FLAX_MODULE_BASES = {"Module", "nn.Module", "flax.linen.Module", "linen.Module"}
+
+
+@register_rule
+class MutableState(Rule):
+    name = "ESR005"
+    slug = "mutable-state"
+    severity = "error"
+    hint = (
+        "mutable defaults are shared across calls; flax modules are "
+        "dataclasses whose __call__ runs under trace — instance state "
+        "silently resets every trace. Use None-defaults, and thread state "
+        "through the carry / self.sow / flax variables instead"
+    )
+
+    def _mutable_default(self, d: ast.AST) -> bool:
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return True
+        return (
+            isinstance(d, ast.Call)
+            and not d.args
+            and not d.keywords
+            and _call_name(d.func) in _MUTABLE_CTORS
+        )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                for d in list(args.defaults) + [
+                    kd for kd in args.kw_defaults if kd is not None
+                ]:
+                    if self._mutable_default(d):
+                        yield self.finding(
+                            ctx,
+                            d,
+                            f"mutable default argument in `{node.name}()`",
+                            hint=(
+                                "a mutable default is evaluated once and "
+                                "shared by every call — default to None "
+                                "and construct inside the function"
+                            ),
+                        )
+            elif isinstance(node, ast.ClassDef):
+                base_names = {_dotted(b) for b in node.bases}
+                if not base_names & _FLAX_MODULE_BASES:
+                    continue
+                for item in node.body:
+                    if (
+                        isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name == "__call__"
+                    ):
+                        yield from self._check_call_body(ctx, node, item)
+
+    def _check_call_body(self, ctx, cls, fn) -> Iterable[Finding]:
+        for sub in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    yield self.finding(
+                        ctx,
+                        sub,
+                        f"`self.{t.attr} = ...` inside "
+                        f"`{cls.name}.__call__` — flax modules are "
+                        "stateless under trace",
+                    )
+
+
+_NONDET_CALLS = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.time_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+_NONDET_PREFIXES = ("numpy.random.", "random.")
+
+
+def _import_aliases(tree: ast.AST) -> dict:
+    """``{local name: canonical dotted module}`` — resolves ``np`` →
+    ``numpy`` and keeps ``from jax import random`` distinct from the
+    stdlib ``random`` (a keyed jax RNG is exactly what the rule asks for)."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+@register_rule
+class TracedNondeterminism(Rule):
+    name = "ESR006"
+    slug = "traced-nondeterminism"
+    severity = "error"
+    hint = (
+        "traced code runs ONCE at trace time — a wall-clock or global-RNG "
+        "value is frozen into the compiled program as a constant, not "
+        "re-drawn per step; thread a jax.random key through the function "
+        "(or compute the value on host and pass it in)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        aliases = _import_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.in_traced_context(node):
+                continue
+            dotted = _dotted(node.func)
+            if not dotted:
+                continue
+            head, _, rest = dotted.partition(".")
+            resolved = aliases.get(head, head) + (f".{rest}" if rest else "")
+            if resolved in _NONDET_CALLS or any(
+                resolved.startswith(p) for p in _NONDET_PREFIXES
+            ):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"nondeterministic call `{dotted}(...)` inside traced "
+                    "code is frozen at trace time",
+                )

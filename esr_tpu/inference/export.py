@@ -25,6 +25,7 @@ import os
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import jax.export  # noqa: F401 - jax does not auto-import the submodule
 import jax.numpy as jnp
 import numpy as np
 

@@ -32,7 +32,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6: public API with the `check_vma` kwarg
+    from jax import shard_map
+except ImportError:  # jax 0.4/0.5: experimental API, kwarg named `check_rep`
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(f, **kwargs)
 
 Array = jax.Array
 

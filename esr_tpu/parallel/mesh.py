@@ -162,17 +162,31 @@ def stage_batch(batch: Any, mesh: Mesh, axis_name: str = "data") -> Any:
 
 
 def make_parallel_train_step(
-    train_step, mesh: Mesh, axis_name: str = "data", donate: bool = True
+    train_step,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+    max_traces: int = 8,
 ):
     """jit the train step with DP shardings pinned.
 
     ``state`` replicated, ``batch`` sharded on the leading (batch) axis,
     outputs replicated. XLA turns the gradient sum into an ICI all-reduce.
+
+    Jitted through :func:`esr_tpu.analysis.retrace_guard.checked_jit`: a
+    train step legitimately compiles a handful of times (shape families per
+    loader epoch, bf16 vs f32 variants); past ``max_traces`` it is a
+    recompilation storm from a shape/dtype leak in the input pipeline and
+    the guard raises instead of silently burning the reservation.
     """
+    from esr_tpu.analysis.retrace_guard import checked_jit
+
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P(axis_name))
-    return jax.jit(
+    return checked_jit(
         train_step,
+        name="parallel_train_step",
+        max_traces=max_traces,
         in_shardings=(repl, data),
         out_shardings=(repl, repl),
         donate_argnums=(0,) if donate else (),

@@ -283,3 +283,28 @@ def make_eval_step(
         return {"valid_loss": losses.sum(), "valid_mse_loss": losses[-1]}
 
     return eval_step
+
+
+def jit_eval_step(
+    model,
+    seqn: int = 3,
+    rasterize: Optional[Callable] = None,
+    max_traces: int = 8,
+    **jit_kwargs,
+) -> Callable:
+    """:func:`make_eval_step` jitted through the retrace guard.
+
+    The validation loader runs every ``valid_step`` iterations for the whole
+    training run — a shape leak there (ragged final batch, a resolution
+    drifting per recording) recompiles on every stamp and silently doubles
+    wall-clock. ``checked_jit`` raises past ``max_traces`` instead.
+    ``jit_kwargs`` (``in_shardings``/``out_shardings``/...) pass through.
+    """
+    from esr_tpu.analysis.retrace_guard import checked_jit
+
+    return checked_jit(
+        make_eval_step(model, seqn, rasterize=rasterize),
+        name="eval_step",
+        max_traces=max_traces,
+        **jit_kwargs,
+    )

@@ -58,7 +58,7 @@ from esr_tpu.parallel.mesh import (
 from esr_tpu.training.checkpoint import resume_checkpoint, save_checkpoint
 from esr_tpu.training.train_step import (
     TrainState,
-    make_eval_step,
+    jit_eval_step,
     make_train_step,
 )
 from esr_tpu.utils.trackers import MetricTracker
@@ -195,8 +195,10 @@ class Trainer:
         )
         repl = NamedSharding(self.mesh, P())
         data = NamedSharding(self.mesh, P("data"))
-        self.eval_step = jax.jit(
-            make_eval_step(self.model, self.seqn, rasterize=rasterize),
+        # retrace-guarded jit (analysis.retrace_guard): a validation-loader
+        # shape leak would otherwise recompile every stamp, silently
+        self.eval_step = jit_eval_step(
+            self.model, self.seqn, rasterize=rasterize,
             in_shardings=(repl, data),
             out_shardings=repl,
         )
@@ -277,6 +279,8 @@ class Trainer:
                 mine = np.frombuffer(
                     (resume_path or "").encode()[:512].ljust(512), np.uint8
                 ).copy()
+                # host-sync audit: a device->host readback, but one-shot at
+                # resume time (never inside the step loop) — intentional
                 all_choices = np.asarray(
                     multihost_utils.process_allgather(mine)
                 )
@@ -330,7 +334,9 @@ class Trainer:
             sel = {"inp": batch["inp_scaled_cnt"], "gt": batch["gt_cnt"]}
             if for_train and self.transfer_dtype is not None:
                 # cast on host so the wire carries half the bytes; numpy
-                # handles ml_dtypes.bfloat16 natively
+                # handles ml_dtypes.bfloat16 natively. Host-sync audit:
+                # `v` is the loader's host numpy array, so np.asarray is a
+                # free view here — NOT a device->host transfer.
                 sel = {
                     k: np.asarray(v).astype(self.transfer_dtype)
                     for k, v in sel.items()
@@ -497,9 +503,14 @@ class Trainer:
             self.train_metrics.update("train_mse_loss", mse_loss)
             self.train_metrics.update("train_loss", loss)
             if self.writer is not None:
-                lr = self._schedule_value(k)
-                self.writer.add_scalar("learning_rate", lr)
+                # lr behind the log cadence (host-sync audit, analysis
+                # ESR002 discipline): _schedule_value evaluates an optax
+                # jnp expression on host CPU every call — cheap, but it
+                # ran EVERY iteration for a scalar nobody reads between
+                # log points. train_log_step'd like the loss line.
                 if k % self.train_log_step == 0:
+                    lr = self._schedule_value(k)
+                    self.writer.add_scalar("learning_rate", lr)
                     logger.info(
                         "Train Epoch: %d Iteration: %d/%d "
                         "train_mse_loss: %.4e train_loss: %.4e lr: %.4e",
@@ -511,6 +522,10 @@ class Trainer:
                         lr,
                     )
                 if vis_batch is not None:
+                    # host-sync audit: a device->host transfer of one
+                    # predicted frame, already behind the vis cadence
+                    # (keep_vis gates every train_vis_step'th iteration,
+                    # after the lookahead drain) — never per-step
                     pred = np.asarray(
                         jax.device_get(metrics["last_pred"])[0]
                     )

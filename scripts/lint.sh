@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# JAX-hazard static analysis over the package, against the committed
+# baseline — the same gate tests/test_analysis_selfcheck.py enforces in
+# tier-1. Rule catalog + baseline workflow: docs/ANALYSIS.md.
+#
+# Usage: scripts/lint.sh [paths...]   (default: esr_tpu/)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [ "$#" -eq 0 ]; then
+  set -- esr_tpu/
+fi
+exec python -m esr_tpu.analysis \
+  --baseline analysis_baseline.json --relative-to . "$@"

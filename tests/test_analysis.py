@@ -1,0 +1,535 @@
+"""esr_tpu.analysis: every rule positive+negative, noqa, baseline ratchet,
+CLI exit codes, and the checked_jit retrace budget."""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from esr_tpu.analysis import (
+    RetraceBudgetError,
+    analyze_source,
+    checked_jit,
+    load_baseline,
+    new_findings,
+    retrace_stats,
+    write_baseline,
+)
+from esr_tpu.analysis.__main__ import main as cli_main
+
+
+def rules_hit(source, path="mod.py", rel_path=None):
+    return {
+        f.rule for f in analyze_source(source, path=path, rel_path=rel_path)
+    }
+
+
+# ---------------------------------------------------------------------------
+# ESR001 traced control flow
+
+
+def test_esr001_flags_if_on_traced_param():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    assert "ESR001" in rules_hit(src)
+
+
+def test_esr001_flags_for_over_traced_param_in_scan_body():
+    src = (
+        "import jax\n"
+        "def body(carry, xs):\n"
+        "    for v in xs:\n"
+        "        carry = carry + v\n"
+        "    return carry, xs\n"
+        "out = jax.lax.scan(body, 0.0, None)\n"
+    )
+    assert "ESR001" in rules_hit(src)
+
+
+def test_esr001_static_branches_are_clean():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, cfg=None):\n"
+        "    if cfg is None:\n"
+        "        x = x * 2\n"
+        "    if x.ndim == 3:\n"
+        "        x = x[None]\n"
+        "    if isinstance(cfg, dict):\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    assert "ESR001" not in rules_hit(src)
+
+
+def test_esr001_untr_context_is_clean():
+    src = "def f(x):\n    if x > 0:\n        return 1\n    return 0\n"
+    assert "ESR001" not in rules_hit(src)
+
+
+def test_esr001_static_argnums_params_are_exempt():
+    # the rule's own recommended fix must silence it — both decorator forms
+    dec = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, training):\n"
+        "    if training:\n"
+        "        x = x * 2\n"
+        "    if x > 0:\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    findings = [f for f in analyze_source(dec, "m.py") if f.rule == "ESR001"]
+    assert len(findings) == 1  # `if x > 0` still flagged, `if training` not
+    assert findings[0].line == 7
+    call_site = (
+        "import jax\n"
+        "def f(x, mode):\n"
+        "    if mode == 'fast':\n"
+        "        x = x * 2\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnames=('mode',))\n"
+    )
+    assert "ESR001" not in rules_hit(call_site)
+
+
+def test_traced_context_covers_shard_map_bodies():
+    src = (
+        "import functools\n"
+        "from jax import shard_map\n"
+        "@functools.partial(shard_map, mesh=None, in_specs=(), out_specs=())\n"
+        "def inner(x):\n"
+        "    return float(x)\n"
+    )
+    assert "ESR002" in rules_hit(src)
+
+
+def test_traced_context_covers_jit_of_factory_result():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "def make_step(cfg):\n"
+        "    host_cfg = np.asarray(cfg)\n"  # factory body = host code
+        "    def step(x):\n"
+        "        return np.asarray(x)\n"  # the returned closure IS traced
+        "    return step\n"
+        "f = jax.jit(make_step(None))\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR002"]
+    assert len(findings) == 1
+    assert findings[0].line == 6
+
+
+# ---------------------------------------------------------------------------
+# ESR002 host sync
+
+
+def test_esr002_flags_item_asarray_float_in_traced_code():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = np.asarray(x)\n"
+        "    b = x.item()\n"
+        "    c = float(x)\n"
+        "    return a, b, c\n"
+    )
+    findings = [
+        f for f in analyze_source(src, "m.py") if f.rule == "ESR002"
+    ]
+    assert len(findings) == 3
+
+
+def test_esr002_flags_block_until_ready_in_scan_body():
+    src = (
+        "import jax\n"
+        "def body(c, i):\n"
+        "    c.block_until_ready()\n"
+        "    return c, i\n"
+        "jax.lax.scan(body, 0.0, None)\n"
+    )
+    assert "ESR002" in rules_hit(src)
+
+
+def test_esr002_host_code_is_clean():
+    src = (
+        "import numpy as np\n"
+        "def load(batch):\n"
+        "    return np.asarray(batch['x']).astype('float32')\n"
+    )
+    assert "ESR002" not in rules_hit(src)
+
+
+def test_esr002_float_of_literal_in_jit_is_clean():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x * float(2)\n"
+    )
+    assert "ESR002" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# ESR003 missing donation
+
+
+def test_esr003_flags_undonated_train_step_jit():
+    src = (
+        "import jax\n"
+        "def train_step(state, batch):\n"
+        "    return state\n"
+        "step = jax.jit(train_step)\n"
+    )
+    assert "ESR003" in rules_hit(src)
+
+
+def test_esr003_flags_undonated_decorator_form():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def train_step(state, batch):\n"
+        "    return state\n"
+    )
+    assert "ESR003" in rules_hit(src)
+
+
+def test_esr003_donated_and_eval_steps_are_clean():
+    src = (
+        "import jax\n"
+        "def train_step(state, batch):\n"
+        "    return state\n"
+        "def eval_step(params, batch):\n"
+        "    return params\n"
+        "a = jax.jit(train_step, donate_argnums=(0,))\n"
+        "b = jax.jit(eval_step)\n"
+    )
+    assert "ESR003" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# ESR004 data-layer purity
+
+
+def test_esr004_flags_jax_import_in_data_layer():
+    src = "import jax.numpy as jnp\n"
+    hits = rules_hit(src, rel_path="esr_tpu/data/loader.py")
+    assert "ESR004" in hits
+    src2 = "from jax import device_put\n"
+    assert "ESR004" in rules_hit(src2, rel_path="esr_tpu/data/loader.py")
+
+
+def test_esr004_only_applies_to_data_layer():
+    src = "import jax.numpy as jnp\n"
+    assert "ESR004" not in rules_hit(src, rel_path="esr_tpu/ops/encodings.py")
+    # numpy in the data layer is the contract, not a violation
+    assert "ESR004" not in rules_hit(
+        "import numpy as np\n", rel_path="esr_tpu/data/loader.py"
+    )
+
+
+# ---------------------------------------------------------------------------
+# ESR005 mutable state
+
+
+def test_esr005_flags_mutable_default():
+    assert "ESR005" in rules_hit("def f(x, y=[]):\n    return x\n")
+    assert "ESR005" in rules_hit("def f(x, *, y={}):\n    return x\n")
+
+
+def test_esr005_flags_stateful_flax_call():
+    src = (
+        "import flax.linen as nn\n"
+        "class M(nn.Module):\n"
+        "    def __call__(self, x):\n"
+        "        self.cache = x\n"
+        "        return x\n"
+    )
+    assert "ESR005" in rules_hit(src)
+
+
+def test_esr005_clean_defaults_and_setup_assignment():
+    src = (
+        "import flax.linen as nn\n"
+        "def f(x, y=None):\n"
+        "    y = y or []\n"
+        "    return x\n"
+        "class M(nn.Module):\n"
+        "    def setup(self):\n"
+        "        self.conv = nn.Dense(4)\n"
+        "    def __call__(self, x):\n"
+        "        return self.conv(x)\n"
+        "class Plain:\n"
+        "    def __call__(self, x):\n"
+        "        self.count = 1\n"
+        "        return x\n"
+    )
+    assert "ESR005" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# ESR006 traced nondeterminism
+
+
+def test_esr006_flags_time_and_global_rng_in_traced_code():
+    src = (
+        "import jax\n"
+        "import time\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + time.time() + np.random.rand()\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR006"]
+    assert len(findings) == 2
+
+
+def test_esr006_keyed_jax_rng_and_host_rng_are_clean():
+    src = (
+        "import jax\n"
+        "import time\n"
+        "from jax import random\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x, key):\n"
+        "    return x + random.normal(key, x.shape)\n"
+        "def host_augment(rng):\n"
+        "    return np.random.rand(), time.time()\n"
+    )
+    assert "ESR006" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+
+
+def test_noqa_suppresses_named_rule_only():
+    base = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)  {noqa}\n"
+    )
+    assert "ESR002" not in rules_hit(base.format(noqa="# esr: noqa(ESR002)"))
+    assert "ESR002" not in rules_hit(base.format(noqa="# esr: noqa"))
+    assert "ESR002" in rules_hit(base.format(noqa="# esr: noqa(ESR001)"))
+    assert "ESR002" in rules_hit(base.format(noqa="# plain comment"))
+
+
+def test_noqa_malformed_directives_fail_closed():
+    base = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)  {noqa}\n"
+    )
+    # lenient forms still scope to the named rule...
+    assert "ESR002" not in rules_hit(base.format(noqa="# esr: noqa ESR002"))
+    assert "ESR002" not in rules_hit(base.format(noqa="# esr: noqa: ESR002"))
+    assert "ESR002" not in rules_hit(base.format(noqa="# esr: noqa(ESR002"))
+    # ...a typo'd OTHER rule must not widen to blanket suppression...
+    assert "ESR002" in rules_hit(base.format(noqa="# esr: noqa ESR001"))
+    # ...and garbage naming no rule suppresses nothing
+    assert "ESR002" in rules_hit(base.format(noqa="# esr: noqa ???"))
+
+
+def test_esr001_negative_static_argnums_resolve_like_jax():
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=-1)\n"
+        "def f(x, y, cfg):\n"
+        "    if cfg:\n"  # -1 = cfg: static, clean
+        "        x = x * 2\n"
+        "    if y > 0:\n"  # y stays traced: flagged
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR001"]
+    assert [f.line for f in findings] == [7]
+
+
+def test_baseline_ratchet(tmp_path):
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    findings = analyze_source(src, "m.py")
+    assert findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings)
+    counts = load_baseline(str(bl))
+    # grandfathered: nothing new
+    assert new_findings(findings, counts) == []
+    # a second identical hazard exceeds the grandfathered count
+    src2 = src + "\n@jax.jit\ndef g(x):\n    return np.asarray(x)\n"
+    findings2 = analyze_source(src2, "m.py")
+    fresh = new_findings(findings2, counts)
+    assert len(fresh) == 1 and fresh[0].rule == "ESR002"
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = analyze_source("def f(:\n", "broken.py")
+    assert [f.rule for f in findings] == ["ESR000"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+BAD_SRC = (
+    "import jax\n"
+    "import numpy as np\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    return np.asarray(x)\n"
+)
+CLEAN_SRC = "import numpy as np\n\ndef f(x):\n    return np.asarray(x)\n"
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SRC)
+    clean = tmp_path / "clean.py"
+    clean.write_text(CLEAN_SRC)
+
+    assert cli_main([str(clean)]) == 0
+    assert cli_main([str(bad)]) == 1
+    capsys.readouterr()
+
+    rc = cli_main(["--format", "json", str(bad)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"] and out["findings"][0]["rule"] == "ESR002"
+    assert out["findings"][0]["line"] == 5
+
+    assert cli_main(["--rules", "NOPE", str(bad)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_rejects_nonexistent_paths(tmp_path, capsys):
+    # a typo'd path must not greenlight as "0 findings"
+    assert cli_main([str(tmp_path / "no_such_dir")]) == 2
+    assert cli_main([str(tmp_path / "not_python.txt")]) == 2
+    # nor may an existing-but-python-free directory
+    empty = tmp_path / "assets"
+    empty.mkdir()
+    assert cli_main([str(empty)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SRC)
+    bl = tmp_path / "baseline.json"
+    # grandfather the current state, then the same findings pass
+    assert (
+        cli_main(
+            ["--write-baseline", "--baseline", str(bl),
+             "--relative-to", str(tmp_path), str(bad)]
+        )
+        == 0
+    )
+    assert (
+        cli_main(
+            ["--baseline", str(bl), "--relative-to", str(tmp_path), str(bad)]
+        )
+        == 0
+    )
+    # a new hazard in the same file still fails
+    bad.write_text(BAD_SRC + "\n@jax.jit\ndef g(x):\n    return x.item()\n")
+    assert (
+        cli_main(
+            ["--baseline", str(bl), "--relative-to", str(tmp_path), str(bad)]
+        )
+        == 1
+    )
+    capsys.readouterr()
+
+
+def test_cli_module_entrypoint(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text(CLEAN_SRC)
+    proc = subprocess.run(
+        [sys.executable, "-m", "esr_tpu.analysis", str(clean)],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# checked_jit retrace guard
+
+
+def test_checked_jit_trips_on_shape_polymorphic_calls():
+    calls = {"n": 0}
+
+    def f(x):
+        calls["n"] += 1
+        return x * 2
+
+    jf = checked_jit(f, max_traces=3, name="poly")
+    with pytest.raises(RetraceBudgetError, match="poly"):
+        for n in range(1, 10):  # every call a fresh shape -> fresh trace
+            jf(jnp.zeros((n,)))
+    # raised on the 4th trace, before the wrapped body ran again
+    assert jf.retrace_counter.count == 4
+    assert calls["n"] == 3
+
+
+def test_checked_jit_stable_shapes_do_not_trip():
+    jf = checked_jit(lambda x: x + 1, max_traces=1, name="stable")
+    for _ in range(10):
+        out = jf(jnp.zeros((4,)))
+    assert out.shape == (4,)
+    assert jf.retrace_counter.count == 1
+
+
+def test_checked_jit_decorator_form_and_kwargs_passthrough():
+    @checked_jit(max_traces=2, static_argnums=(1,))
+    def scale(x, k):
+        return x * k
+
+    assert float(scale(jnp.ones(()), 3)) == 3.0
+    stats = retrace_stats()
+    assert any(k.startswith("scale") for k in stats)
+
+
+def test_checked_jit_is_inert_under_disable_jit():
+    # disable_jit runs the body per CALL; that must not charge the budget
+    # (it is the canonical debugging mode for the train/eval steps)
+    jf = checked_jit(lambda x: x + 1, max_traces=2, name="dbg")
+    with jax.disable_jit():
+        for _ in range(10):
+            out = jf(jnp.zeros((2,)))
+    assert out.shape == (2,)
+    assert jf.retrace_counter.count == 0
+    # leaving the context restores normal counting
+    jf(jnp.zeros((2,)))
+    assert jf.retrace_counter.count == 1
+
+
+def test_checked_jit_result_parity_with_jax_jit():
+    def f(x):
+        return (x**2).sum()
+
+    a = jax.jit(f)(jnp.arange(4.0))
+    b = checked_jit(f)(jnp.arange(4.0))
+    assert float(a) == float(b)

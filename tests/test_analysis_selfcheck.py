@@ -1,0 +1,50 @@
+"""Self-lint gate: the analyzer over ``esr_tpu/`` must stay clean.
+
+Deliberately NOT marked slow: this is the tier-1 wiring the whole subsystem
+exists for — any PR that introduces a new JAX hazard (beyond the committed
+``analysis_baseline.json`` grandfather list) fails here, with the same
+fingerprints ``scripts/lint.sh`` / ``esr-analyze`` report on the command
+line. Pure-AST, no jax import, runs in well under a second.
+"""
+
+import os
+
+from esr_tpu.analysis import analyze_paths, load_baseline, new_findings
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "analysis_baseline.json")
+
+
+def test_analyzer_clean_against_committed_baseline():
+    findings = analyze_paths(
+        [os.path.join(REPO_ROOT, "esr_tpu")], relative_to=REPO_ROOT
+    )
+    fresh = new_findings(findings, load_baseline(BASELINE))
+    assert not fresh, (
+        "new esr_tpu.analysis findings (fix them, `# esr: noqa(RULE)` with "
+        "a justification, or regenerate the baseline per docs/ANALYSIS.md):"
+        "\n\n" + "\n".join(f.format() for f in fresh)
+    )
+
+
+def test_committed_baseline_has_no_stale_entries():
+    """Every baselined fingerprint must still exist — entries whose hazard
+    was fixed must be dropped so the ratchet cannot mask a regression."""
+    baseline = load_baseline(BASELINE)
+    if not baseline:
+        return
+    findings = analyze_paths(
+        [os.path.join(REPO_ROOT, "esr_tpu")], relative_to=REPO_ROOT
+    )
+    current = {}
+    for f in findings:
+        current[f.fingerprint()] = current.get(f.fingerprint(), 0) + 1
+    stale = {
+        fp: n - current.get(fp, 0)
+        for fp, n in baseline.items()
+        if current.get(fp, 0) < n
+    }
+    assert not stale, (
+        "baseline entries no longer matched by any finding — regenerate "
+        f"analysis_baseline.json (docs/ANALYSIS.md): {sorted(stale)}"
+    )
