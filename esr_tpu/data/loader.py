@@ -155,6 +155,43 @@ def collate_sequences(
     }
 
 
+def group_batches(source, k: int) -> Iterator[List]:
+    """Group an iterable of batches into lists of ``k`` consecutive batches.
+
+    The host half of K-step fused training: each group becomes ONE staged
+    megabatch consumed by one scanned super-step
+    (``esr_tpu.training.multistep.make_multi_step``). Order is preserved
+    exactly — the k=1 path and any k>1 path see the identical batch
+    sequence, just chunked. The epoch tail (``len(source) % k`` leftover
+    batches) is yielded as a final SHORTER group; the Trainer runs those
+    through the single-step executable so shapes stay static (no per-tail
+    recompile of the scanned program).
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    group: List = []
+    for batch in source:
+        group.append(batch)
+        if len(group) == k:
+            yield group
+            group = []
+    if group:
+        yield group
+
+
+def collate_megabatch(batches: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """``[k batch dicts of (B, L, ...)] -> {key: (k, B, L, ...)}``.
+
+    Pure numpy stack (data layer stays accelerator-free); the new leading
+    axis is the scan axis of the fused super-step. All k batches must share
+    static shapes — guaranteed by the loader's fixed ``(B, L, ...)``
+    collate; a ragged group here would mean the epoch tail leaked past
+    :func:`group_batches`'s shorter-final-group contract.
+    """
+    keys = batches[0].keys()
+    return {k_: np.stack([b[k_] for b in batches]) for k_ in keys}
+
+
 def overlapping_windows(batch: Dict[str, np.ndarray], seqn: int) -> List[Dict[str, np.ndarray]]:
     """Reference-shaped view: (B, L, …) → list of (L−seqn+1) dicts of
     (B, seqn, …) overlapping windows (``h5dataloader.py:229-233``)."""
@@ -385,15 +422,25 @@ class DevicePrefetcher:
     need it for host-side work (vis logging). Source exhaustion ends
     iteration; a producer exception re-raises at the consumer boundary;
     ``close()`` (or context-manager exit) stops the thread early and is
-    idempotent.
+    idempotent. ``join_timeout`` bounds how long ``close()`` waits for the
+    producer (a ``stage_fn`` blocked in a device transfer can exceed any
+    fixed wait); a missed join is downgraded to a warning — the thread is
+    daemonic, holds at most one in-flight source item (under K-step fused
+    training that item is a whole k-batch group/megabatch), and is reaped
+    with the process — and skipped entirely during interpreter teardown,
+    where joining/warning machinery is itself unreliable.
     """
 
-    def __init__(self, source, stage_fn, depth: int = 2):
+    def __init__(self, source, stage_fn, depth: int = 2,
+                 join_timeout: float = 5.0):
         import queue
         import threading
 
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if join_timeout <= 0:
+            raise ValueError(f"join_timeout must be > 0, got {join_timeout}")
+        self._join_timeout = float(join_timeout)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -443,6 +490,8 @@ class DevicePrefetcher:
 
     def close(self):
         """Stop the producer and release queued staged batches."""
+        import sys
+
         self._stop.set()
 
         def drain():
@@ -453,7 +502,13 @@ class DevicePrefetcher:
                 pass
 
         drain()
-        self._thread.join(timeout=5.0)
+        if sys.is_finalizing():
+            # Interpreter teardown (a Trainer dropped at process exit):
+            # joining is pointless — daemon threads are being killed by the
+            # runtime anyway — and warnings/join internals can themselves
+            # raise mid-teardown. The daemonic producer leaks harmlessly.
+            return
+        self._thread.join(timeout=self._join_timeout)
         # a producer blocked in put() can land one more item the moment the
         # first drain frees a slot — drain again after the join so no
         # staged (device-resident) batch outlives close()
@@ -462,9 +517,11 @@ class DevicePrefetcher:
             import warnings
 
             warnings.warn(
-                "DevicePrefetcher producer thread did not stop within 5s "
-                "(stage_fn blocked in a device transfer?); it is daemonic "
-                "and holds at most one in-flight batch",
+                f"DevicePrefetcher producer thread did not stop within "
+                f"{self._join_timeout:g}s (stage_fn blocked in a device "
+                "transfer?); it is daemonic, holds at most one in-flight "
+                "source item (a full k-batch megabatch under k_steps>1), "
+                "and leaks only until process exit",
                 stacklevel=2,
             )
 

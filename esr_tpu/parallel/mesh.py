@@ -161,6 +161,56 @@ def stage_batch(batch: Any, mesh: Mesh, axis_name: str = "data") -> Any:
     )
 
 
+def stage_megabatch(megabatch: Any, mesh: Mesh, axis_name: str = "data") -> Any:
+    """Host ``{key: (k, B, ...)}`` megabatch → global device arrays with the
+    BATCH axis (axis 1) sharded over ``axis_name``.
+
+    The k axis is the scan axis of :func:`esr_tpu.training.multistep.
+    make_multi_step` — it stays unsharded (every device runs all k chained
+    steps; the batch dim is what data-parallelism splits, exactly as in
+    :func:`stage_batch`). Multi-process follows the same per-host-rows
+    contract as ``stage_batch``, lifted one axis.
+    """
+    sharding = NamedSharding(mesh, P(None, axis_name))
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), megabatch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        megabatch,
+    )
+
+
+def make_parallel_multi_step(
+    multi_step,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+    max_traces: int = 8,
+):
+    """jit a :func:`~esr_tpu.training.multistep.make_multi_step` super-step
+    with DP shardings pinned: ``state`` (the donated scan carry — params,
+    optimizer and recurrent state keep single-copy HBM residency through
+    the k chained steps) replicated, the megabatch sharded on its BATCH
+    axis (axis 1, matching :func:`stage_megabatch`), outputs replicated.
+
+    Retrace-guarded like :func:`make_parallel_train_step`: the megabatch
+    shape is ``(k, B, L, ...)`` and fully static per (k, loader) config —
+    any retrace churn here is a shape leak in megabatch assembly.
+    """
+    from esr_tpu.analysis.retrace_guard import checked_jit
+
+    repl = NamedSharding(mesh, P())
+    mega = NamedSharding(mesh, P(None, axis_name))
+    return checked_jit(
+        multi_step,
+        name="parallel_multi_step",
+        max_traces=max_traces,
+        in_shardings=(repl, mega),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
 def make_parallel_train_step(
     train_step,
     mesh: Mesh,

@@ -1,3 +1,4 @@
 from .schedule import exponential_with_floor
 from .optim import make_optimizer
 from .train_step import make_train_step, TrainState, make_eval_step
+from .multistep import make_multi_step

@@ -1,0 +1,98 @@
+"""K-step fused training: chain k train steps inside ONE executable.
+
+The r4 bench arbitration (BASELINE.md) pinned device compute at ~57.7
+ms/step (b2, f32) against a ~76.8 ms per-call floor: per-step Python
+dispatch and host->device re-staging — not the TPU — bound the production
+training loop. The bench proved the fix by timing K steps chained inside a
+single ``lax.scan`` (the "scan-slope" method); this module promotes that
+method from measurement trick to the shipped training path.
+
+``make_multi_step(train_step, k)`` wraps any ``(state, batch) -> (state,
+metrics)`` train step into a super-step ``(state, megabatch) -> (state,
+metrics)`` where:
+
+- the **megabatch** is the k per-step batches stacked on a new leading
+  axis (``{key: (k, B, L, ...)}``, assembled host-side by
+  :func:`esr_tpu.data.loader.collate_megabatch` and staged once, ahead of
+  the consuming super-step, by the ``DevicePrefetcher``);
+- ``lax.scan`` carries the full training state (params / optimizer /
+  recurrent ``batch_stats``) through the k chained steps and
+  dynamic-slices each step's batch out of the megabatch **on device** —
+  one dispatch, one readback per k steps;
+- metrics come back with a leading ``k`` axis (``loss [k]``,
+  ``loss_per_window [k, Wc]``, ``grad_norm [k]``) so the host still sees
+  every per-step scalar, in one small readback per super-step; the only
+  non-scalar metric, ``last_pred``, is returned for the FINAL chained
+  step only (it exists for the vis cadence, which is snapped to
+  super-step boundaries by the Trainer).
+
+``reuse_batch=True`` is the bench-chaining mode: the SAME batch (no k
+axis) feeds every chained step. This is exactly what ``bench.py``'s
+scan-slope stages time — with the rewire in this module's PR, the
+headline benchmark and the production training path share this one
+implementation, so the measured number is the shipped code path.
+
+jit/donation/sharding live one level up
+(:func:`esr_tpu.parallel.mesh.make_parallel_multi_step`): the scan carry
+is the donated argument, so params/opt state keep single-copy HBM
+residency exactly as in the k=1 path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+
+def make_multi_step(
+    train_step: Callable, k: int, *, reuse_batch: bool = False
+) -> Callable:
+    """Fuse ``k`` applications of ``train_step`` into one scanned callable.
+
+    Args:
+      train_step: ``(state, batch) -> (state, metrics)``; any pytree state
+        and dict-of-arrays metrics (e.g. the output of
+        :func:`esr_tpu.training.train_step.make_train_step`).
+      k: number of chained steps per call (static; ``k=1`` is valid and
+        traces to a length-1 scan whose per-step numerics are identical to
+        one plain ``train_step`` call).
+      reuse_batch: when True, ``multi_step(state, batch)`` feeds the SAME
+        batch (no leading k axis) to every chained step — the bench
+        chaining mode. When False (production), ``multi_step(state,
+        megabatch)`` expects every megabatch leaf to carry a leading axis
+        of length ``k`` and scans over it.
+
+    Returns ``multi_step(state, megabatch) -> (state, metrics)`` with
+    metrics stacked on a leading ``k`` axis (``last_pred``, when present,
+    is the final step's only — carrying k full predictions to the host
+    would defeat the scalar-only readback this fusion exists for).
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+
+    def multi_step(state, megabatch):
+        if reuse_batch:
+
+            def body(s, _):
+                return train_step(s, megabatch)
+
+            state, metrics = jax.lax.scan(body, state, None, length=k)
+        else:
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                megabatch
+            )[0]:
+                shape = getattr(leaf, "shape", None)
+                if shape is None or tuple(shape[:1]) != (k,):
+                    raise ValueError(
+                        f"megabatch leaf {jax.tree_util.keystr(path)} has "
+                        f"shape {shape}; expected leading axis {k} "
+                        f"(one slice per chained step)"
+                    )
+            state, metrics = jax.lax.scan(train_step, state, megabatch)
+        if isinstance(metrics, dict) and "last_pred" in metrics:
+            metrics = dict(metrics)
+            metrics["last_pred"] = metrics["last_pred"][-1]
+        return state, metrics
+
+    return multi_step

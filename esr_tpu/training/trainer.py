@@ -7,6 +7,12 @@ the jit'd BPTT step, the TPU way:
   over the mesh by XLA) replaces the python BPTT loop + DDP backward
   (``:206-235``); per-host loaders feed the global batch
   (``stage_batch``, the ``DistributedSampler`` analogue);
+- optionally ONE compiled super-step per ``trainer.k_steps`` sequences:
+  K-step fused training (``training/multistep.py``, docs/PERF.md) chains
+  k train steps in a single ``lax.scan`` over a staged megabatch,
+  amortizing the per-call dispatch+staging floor the r4 bench measured;
+  logging/eval/checkpoint cadences snap to super-step boundaries and
+  epoch tails run the plain per-step program;
 - validation every ``valid_step`` iterations (``:296-314``) via the jit'd
   eval step; metrics from inside jit are already globally reduced, so the
   reference's explicit logging all-reduce (``reduce_tensor``) has no
@@ -185,14 +191,29 @@ class Trainer:
             from esr_tpu.training.train_step import make_device_rasterizer
 
             rasterize = make_device_rasterizer(self.train_loader.gt_resolution)
-        self.train_step = make_parallel_train_step(
-            make_train_step(
-                self.model, self.optimizer, self.seqn,
-                remat=remat, compute_dtype=compute_dtype,
-                rasterize=rasterize,
-            ),
-            self.mesh,
+        base_step = make_train_step(
+            self.model, self.optimizer, self.seqn,
+            remat=remat, compute_dtype=compute_dtype,
+            rasterize=rasterize,
         )
+        self.train_step = make_parallel_train_step(base_step, self.mesh)
+        # K-step fusion (the r4 dispatch-floor fix): chain k_steps train
+        # steps inside ONE executable via lax.scan over a staged megabatch,
+        # so per-step Python dispatch + re-staging (~76.8 ms/call over the
+        # tunnel vs 57.7 ms of device compute, BASELINE.md) amortizes 1/k.
+        # k_steps=1 keeps the plain per-step path — identical programs,
+        # identical numerics, identical cadence.
+        self.k_steps = int(trainer_cfg.get("k_steps", 1))
+        if self.k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {self.k_steps}")
+        self.multi_step = None
+        if self.k_steps > 1:
+            from esr_tpu.parallel.mesh import make_parallel_multi_step
+            from esr_tpu.training.multistep import make_multi_step
+
+            self.multi_step = make_parallel_multi_step(
+                make_multi_step(base_step, self.k_steps), self.mesh
+            )
         repl = NamedSharding(self.mesh, P())
         data = NamedSharding(self.mesh, P("data"))
         # retrace-guarded jit (analysis.retrace_guard): a validation-loader
@@ -253,6 +274,16 @@ class Trainer:
         if self.device_prefetch < 0:
             raise ValueError(
                 f"device_prefetch must be >= 0, got {self.device_prefetch}"
+            )
+        # how long DevicePrefetcher.close() waits for its producer thread
+        # before declaring the (daemonic, harmless) leak with a warning
+        self.prefetch_join_timeout = float(
+            trainer_cfg.get("prefetch_join_timeout", 5.0)
+        )
+        if self.prefetch_join_timeout <= 0:
+            raise ValueError(
+                "prefetch_join_timeout must be > 0, got "
+                f"{self.prefetch_join_timeout}"
             )
 
         self.profile_cfg = trainer_cfg.get("profile", {}) or {}
@@ -316,32 +347,60 @@ class Trainer:
         with jax.default_device(cpu):
             return float(self.schedule(i))
 
-    def _stage(
+    def _select(
         self, batch: Dict[str, np.ndarray], *, for_train: bool = False
-    ) -> Dict:
-        """Select the streams the step consumes and shard them.
+    ) -> Dict[str, np.ndarray]:
+        """Select the host streams the step consumes (no device transfer).
 
         ``for_train`` gates the optional bf16 transfer cast: validation
         always ships f32 so the monitored metrics are unaffected."""
         if self.device_rasterize:
-            sel = {
+            return {
                 "inp_events": batch["inp_norm_events"],
                 "inp_valid": batch["inp_events_valid"],
                 "gt_events": batch["gt_raw_events"],
                 "gt_valid": batch["gt_events_valid"],
             }
-        else:
-            sel = {"inp": batch["inp_scaled_cnt"], "gt": batch["gt_cnt"]}
-            if for_train and self.transfer_dtype is not None:
-                # cast on host so the wire carries half the bytes; numpy
-                # handles ml_dtypes.bfloat16 natively. Host-sync audit:
-                # `v` is the loader's host numpy array, so np.asarray is a
-                # free view here — NOT a device->host transfer.
-                sel = {
-                    k: np.asarray(v).astype(self.transfer_dtype)
-                    for k, v in sel.items()
-                }
-        return stage_batch(sel, self.mesh)
+        sel = {"inp": batch["inp_scaled_cnt"], "gt": batch["gt_cnt"]}
+        if for_train and self.transfer_dtype is not None:
+            # cast on host so the wire carries half the bytes; numpy
+            # handles ml_dtypes.bfloat16 natively. Host-sync audit:
+            # `v` is the loader's host numpy array, so np.asarray is a
+            # free view here — NOT a device->host transfer.
+            sel = {
+                k: np.asarray(v).astype(self.transfer_dtype)
+                for k, v in sel.items()
+            }
+        return sel
+
+    def _stage(
+        self, batch: Dict[str, np.ndarray], *, for_train: bool = False
+    ) -> Dict:
+        """Select the streams the step consumes and shard them."""
+        return stage_batch(
+            self._select(batch, for_train=for_train), self.mesh
+        )
+
+    def _stage_group(self, group) -> object:
+        """Stage one train super-step's worth of host batches.
+
+        A full group of ``k_steps`` batches is stacked into ONE
+        ``{key: (k, B, L, ...)}`` megabatch and staged with the batch axis
+        sharded (``stage_megabatch``) — a single upload the scanned
+        super-step indexes on device. A shorter group (``k_steps == 1``,
+        or the epoch-tail remainder) stages each batch individually for
+        the single-step executable, so megabatch shapes stay static and
+        the tail never forces a recompile of the scanned program.
+        """
+        from esr_tpu.data.loader import collate_megabatch
+        from esr_tpu.parallel.mesh import stage_megabatch
+
+        if self.k_steps > 1 and len(group) == self.k_steps:
+            mega = collate_megabatch(
+                [self._select(b, for_train=True) for b in group]
+            )
+            return stage_megabatch(mega, self.mesh)
+        return [self._stage(b, for_train=True) for b in group]
 
     def _log_images(self, batch: Dict[str, np.ndarray], pred: np.ndarray) -> None:
         """TensorBoard qualitative dump (reference :258-293)."""
@@ -495,20 +554,35 @@ class Trainer:
         last_scalars = {"loss": float("nan"), "mse": float("nan")}
 
         def consume(entry):
-            k, ep, metrics, vis_batch = entry
-            loss = float(metrics["loss"])
-            mse_loss = float(metrics["loss_per_window"][-1])
-            if self.writer is not None:
-                self.writer.set_step(k)
-            self.train_metrics.update("train_mse_loss", mse_loss)
-            self.train_metrics.update("train_loss", loss)
-            if self.writer is not None:
+            first, r, ep, metrics, vis_batch = entry
+            # One host readback per SUPER-step (scalars only): the fused
+            # path hands back {loss [r], loss_per_window [r, Wc], ...} in
+            # a single small transfer; the single-step path (k_steps=1 or
+            # the epoch-tail remainder) a list of r per-step dicts.
+            if isinstance(metrics, list):
+                losses = [float(m["loss"]) for m in metrics]
+                mses = [float(m["loss_per_window"][-1]) for m in metrics]
+                last_pred_dev = metrics[-1]["last_pred"]
+            else:
+                losses = [float(v) for v in np.asarray(metrics["loss"])]
+                mses = [
+                    float(v)
+                    for v in np.asarray(metrics["loss_per_window"])[:, -1]
+                ]
+                last_pred_dev = metrics["last_pred"]
+            for j in range(r):
+                k = first + j
+                loss, mse_loss = losses[j], mses[j]
+                if self.writer is not None:
+                    self.writer.set_step(k)
+                self.train_metrics.update("train_mse_loss", mse_loss)
+                self.train_metrics.update("train_loss", loss)
                 # lr behind the log cadence (host-sync audit, analysis
                 # ESR002 discipline): _schedule_value evaluates an optax
                 # jnp expression on host CPU every call — cheap, but it
                 # ran EVERY iteration for a scalar nobody reads between
                 # log points. train_log_step'd like the loss line.
-                if k % self.train_log_step == 0:
+                if self.writer is not None and k % self.train_log_step == 0:
                     lr = self._schedule_value(k)
                     self.writer.add_scalar("learning_rate", lr)
                     logger.info(
@@ -521,17 +595,17 @@ class Trainer:
                         loss,
                         lr,
                     )
-                if vis_batch is not None:
-                    # host-sync audit: a device->host transfer of one
-                    # predicted frame, already behind the vis cadence
-                    # (keep_vis gates every train_vis_step'th iteration,
-                    # after the lookahead drain) — never per-step
-                    pred = np.asarray(
-                        jax.device_get(metrics["last_pred"])[0]
-                    )
-                    self._log_images(vis_batch, pred)
-            last_scalars["loss"] = loss
-            last_scalars["mse"] = mse_loss
+            if self.writer is not None and vis_batch is not None:
+                # host-sync audit: a device->host transfer of one
+                # predicted frame, already behind the vis cadence
+                # (keep_vis gates every train_vis_step'th iteration,
+                # after the lookahead drain) — never per-step. Under
+                # k-step fusion the frame is the super-step's FINAL
+                # prediction (vis cadence snaps to super-step boundaries).
+                pred = np.asarray(jax.device_get(last_pred_dev)[0])
+                self._log_images(vis_batch, pred)
+            last_scalars["loss"] = losses[-1]
+            last_scalars["mse"] = mses[-1]
 
         def drain():
             while pending:
@@ -539,49 +613,75 @@ class Trainer:
 
         import contextlib
 
-        from esr_tpu.data.loader import DevicePrefetcher
+        from esr_tpu.data.loader import DevicePrefetcher, group_batches
 
         while not stop:
             self.train_loader.set_epoch(epoch)
             # host->device upload pipelined ahead of the consuming step;
             # the ExitStack guarantees the producer thread stops even when
-            # the for-loop breaks mid-epoch (early stop, final iteration)
+            # the for-loop breaks mid-epoch (early stop, final iteration).
+            # The source yields GROUPS of k_steps batches (k_steps=1:
+            # singleton groups — today's per-step pipeline exactly); a full
+            # group stages as one (k, B, L, ...) megabatch ahead of the
+            # consuming fused super-step.
             with contextlib.ExitStack() as stack:
+                source = group_batches(self.train_loader, self.k_steps)
                 if self.device_prefetch:
                     batches = stack.enter_context(DevicePrefetcher(
-                        self.train_loader,
-                        lambda b: self._stage(b, for_train=True),
+                        source,
+                        self._stage_group,
                         depth=self.device_prefetch,
+                        join_timeout=self.prefetch_join_timeout,
                     ))
                 else:
-                    batches = (
-                        (b, self._stage(b, for_train=True))
-                        for b in self.train_loader
-                    )
-                for batch, staged in batches:
+                    batches = ((g, self._stage_group(g)) for g in source)
+                for group, staged in batches:
                     best = False
-                    self.state, metrics = self.train_step(self.state, staged)
+                    r = len(group)
+                    if isinstance(staged, list):
+                        # k_steps=1, or the epoch-tail remainder (< k_steps
+                        # batches): r sequential single-step calls — static
+                        # shapes, no extra compile of the scanned program
+                        metrics = []
+                        for sb in staged:
+                            self.state, m = self.train_step(self.state, sb)
+                            metrics.append(m)
+                    else:
+                        # ONE dispatch for k_steps chained train steps
+                        self.state, metrics = self.multi_step(
+                            self.state, staged
+                        )
+                    first = iter_idx
+                    last = iter_idx + r - 1
+                    covered = range(first, last + 1)
+                    # cadences snap to super-step boundaries: due when ANY
+                    # covered iteration hits the configured multiple
                     keep_vis = (
                         self.writer is not None
                         and self.vis_enabled
-                        and iter_idx % self.train_vis_step == 0
+                        and any(
+                            i % self.train_vis_step == 0 for i in covered
+                        )
                     )
                     pending.append(
-                        (iter_idx, epoch, metrics,
-                         batch if keep_vis else None)
+                        (first, r, epoch, metrics,
+                         group[-1] if keep_vis else None)
                     )
                     if len(pending) > self.train_lookahead:
                         consume(pending.popleft())
 
                     valid_due = (
                         self.valid_loader is not None
-                        and iter_idx % self.valid_step == 0
-                        and iter_idx != 0
+                        and any(
+                            i % self.valid_step == 0 and i != 0
+                            for i in covered
+                        )
                     )
-                    save_due = (
-                        iter_idx % self.save_period == 0 and iter_idx != 0
+                    save_due = any(
+                        i % self.save_period == 0 and i != 0
+                        for i in covered
                     )
-                    final_due = iter_idx + 1 >= self.iterations
+                    final_due = last + 1 >= self.iterations
                     if valid_due or save_due or final_due:
                         drain()
 
@@ -612,7 +712,7 @@ class Trainer:
 
                     saved_now = save_due or best
                     if saved_now:
-                        self._save(iter_idx, best)
+                        self._save(last, best)
 
                     if final_due:
                         logger.info("Training completes!")
@@ -620,12 +720,16 @@ class Trainer:
                         # the reference, which saves only on save_period
                         # multiples (train_ours_cnt_seq.py:316-319) and so
                         # loses up to save_period-1 trailing iterations of a
-                        # finished run.
+                        # finished run. Under k_steps>1, when `iterations`
+                        # is not a super-step multiple the final fused
+                        # group trains up to k_steps-1 iterations past it;
+                        # the checkpoint records the TRUE last iteration so
+                        # resume stays consistent (docs/PERF.md).
                         if not saved_now:
-                            self._save(iter_idx, False)
+                            self._save(last, False)
                         stop = True
                         break
-                    iter_idx += 1
+                    iter_idx = last + 1
             epoch += 1
         drain()
 

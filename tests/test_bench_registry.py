@@ -1,0 +1,94 @@
+"""bench.py wiring guards that run off-TPU in tier-1.
+
+The bench only ever executes for real on a chip, so a wiring regression —
+a stage dropped from the ladder, the headline JSON schema drifting under
+the driver's parser, the scan stages silently forking from the production
+train path — would otherwise surface only after burning a TPU heal
+window. These tests pin:
+
+- the declarative ``STAGE_REGISTRY`` main() iterates (names, order,
+  timeouts, smoke participation);
+- the headline JSON contract (``HEADLINE_KEYS`` / ``HEADLINE_METRIC``);
+- that ``_scan_steps_runner`` — the executable behind the headline
+  ``scan_compute`` stage, ``scaling``, and ``breakdown`` — is the
+  PRODUCTION ``make_multi_step`` in ``reuse_batch`` mode, not a private
+  copy of the chaining logic.
+"""
+
+import contextlib
+import io
+import json
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+import pytest
+
+import bench
+
+
+def test_stage_registry_names_order_and_timeouts():
+    names = [e[0] for e in bench.STAGE_REGISTRY]
+    assert names == [
+        "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
+        "conv_anchor", "compute", "bf16", "dcn_ab", "e2e",
+        "e2e_device_raster", "scaling", "breakdown",
+    ]
+    for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
+        assert callable(runner), name
+        assert timeout > 0, name
+        assert isinstance(in_smoke, bool), name
+    # the headline owner must land first (short heal windows), and the
+    # async 'compute' fallback strictly after it
+    assert names.index("scan_compute") == 0
+    assert names.index("compute") > names.index("scan_compute")
+    # smoke (CPU plumbing) skips exactly the slow loader-driven stages
+    assert [n for n, _, _, s in bench.STAGE_REGISTRY if not s] == [
+        "e2e", "e2e_device_raster",
+    ]
+
+
+def test_headline_json_schema(monkeypatch):
+    monkeypatch.setattr(bench, "EXTRA", {"mfu": 0.0016})
+    monkeypatch.setattr(bench, "HEADLINE", {"value": 17.33})
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._print_headline()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert tuple(out.keys()) == bench.HEADLINE_KEYS
+    assert out["metric"] == bench.HEADLINE_METRIC
+    assert out["unit"] == "steps/s"
+    assert out["value"] == 17.33
+    assert out["vs_baseline"] is None
+    assert out["extra"] == {"mfu": 0.0016}
+
+
+class _TinyState(NamedTuple):
+    params: Any
+
+
+def test_scan_runner_consumes_production_multistep(monkeypatch):
+    """The headline executable is built by esr_tpu.training.multistep.
+    make_multi_step (reuse_batch=True): the benchmark measures the shipped
+    k-step fusion, and its chained-step semantics are checked end-to-end
+    through the runner's scalar outputs."""
+    import esr_tpu.training.multistep as ms
+
+    calls = []
+    real = ms.make_multi_step
+
+    def recording(step_fn, k, **kwargs):
+        calls.append((k, kwargs))
+        return real(step_fn, k, **kwargs)
+
+    monkeypatch.setattr(ms, "make_multi_step", recording)
+
+    def step(state, batch):
+        w = state.params["w"] + batch["x"].sum()
+        return _TinyState({"w": w}), {"loss": w}
+
+    run = bench._scan_steps_runner(step, {"x": jnp.ones((2,), jnp.float32)}, 3)
+    loss, digest = run(_TinyState({"w": jnp.float32(0.0)}))
+    assert calls == [(3, {"reuse_batch": True})]
+    # three chained +2 steps; loss is the FINAL step's, digest the params sum
+    assert float(loss) == pytest.approx(6.0)
+    assert float(digest) == pytest.approx(6.0)

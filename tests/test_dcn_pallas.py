@@ -131,6 +131,43 @@ def test_auto_dispatch_selects_jnp_on_cpu():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_parity_tolerance_calibration(monkeypatch):
+    """The dispatch-gate pass criterion, pinned at the r4 on-chip numbers.
+
+    The r4 flagship capture measured fwd_max_err 4.5e-3 at output scale
+    ~2.07 (2.2e-3 RELATIVE) and cotangent errors 1.4-3.1e-3 under the
+    precision pin — f32-accumulation scale — yet recorded
+    ``dcn_pallas_mosaic_ok: false`` and left ``auto_dispatch_gate``
+    closed, so the 3.17x-measured Pallas training path never shipped.
+    The recalibrated criterion must pass exactly those numerics on TPU
+    (scale-normalized, 5e-3), keep rejecting them under the off-TPU
+    f32-exact bound (1e-3), and keep failing hard on defect-scale errors
+    in either the forward or any cotangent."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    r4 = {
+        "fwd_max_err": 0.00447407, "fwd_scale": 2.06631136,
+        "gx_rel_err": 0.00179804, "goff_rel_err": 0.00208481,
+        "gmask_rel_err": 0.00137476, "gw_rel_err": 0.00306068,
+    }
+    # off-TPU (this CPU suite): both paths are f32-exact, strict 1e-3
+    # unchanged — r4's on-chip rounding envelope would be a defect here
+    assert not DP.dcn_parity_ok(r4)
+
+    monkeypatch.setattr(DP, "on_tpu_backend", lambda: True)
+    assert DP.dcn_parity_ok(r4)  # the gate now opens on r4's numerics
+    assert DP.dcn_parity_ok(r4, matmul_precision=None)  # prod-numerics 2e-2
+    # real defects (O(1) errors) still fail on every field
+    assert not DP.dcn_parity_ok(dict(r4, fwd_max_err=0.5))
+    assert not DP.dcn_parity_ok(dict(r4, gw_rel_err=0.5))
+    assert not DP.dcn_parity_ok(dict(r4, gx_rel_err=0.5))
+    # the forward criterion is normalized by output scale: the same abs
+    # error that is in-tolerance at r4's ~2.07 output scale must FAIL at
+    # unit scale (an absolute reading would pass both)
+    assert DP.dcn_parity_ok(dict(r4, fwd_max_err=0.008, fwd_scale=2.07))
+    assert not DP.dcn_parity_ok(dict(r4, fwd_max_err=0.008, fwd_scale=1.0))
+
+
 def test_mosaic_gate_false_on_cpu_and_parity_helper():
     """The production auto-dispatch gate must refuse CPU (interpreter mode
     proves nothing about Mosaic), and the shared parity helper — the SAME

@@ -1,0 +1,251 @@
+"""K-step fused training: the scanned super-step must be a pure batching
+change.
+
+``make_multi_step(train_step, k)`` chains k train steps inside one
+``lax.scan`` over a staged ``(k, B, L, ...)`` megabatch (the production
+promotion of bench.py's scan-slope method). Correctness contract, checked
+here on CPU:
+
+- k scan-chained steps == k sequential jitted steps: params, optimizer
+  state, step counter, and every per-step metric allclose, for
+  k ∈ {1, 2, 4} — including the recurrent carries (ConvGRU states across
+  window boundaries inside each step; BN ``batch_stats`` across the k
+  chained steps);
+- the epoch-tail remainder path (full groups through the fused step, the
+  shorter tail through the single-step executable) reproduces the plain
+  sequential run;
+- ``group_batches`` + ``collate_megabatch`` preserve the ShardedSampler's
+  example order exactly and keep megabatch shapes static;
+- ``reuse_batch=True`` (the bench chaining mode) equals feeding the same
+  batch k times.
+
+One module-scoped model/trajectory is shared across tests (the setup and
+the sequential-oracle compiles dominate wall-clock; tier-1 runs this
+file).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.data.loader import (
+    ShardedSampler,
+    collate_megabatch,
+    group_batches,
+)
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.training.multistep import make_multi_step
+from esr_tpu.training.optim import make_optimizer
+from esr_tpu.training.train_step import TrainState, make_train_step
+
+
+def _setup(n_batches, b=2, L=4, h=8, w=8, seqn=3, norm=None, seed=0):
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=seqn, norm=norm)
+    rng = np.random.default_rng(seed)
+    batches = [
+        {
+            "inp": jnp.asarray(rng.random((b, L, h, w, 2)), jnp.float32),
+            "gt": jnp.asarray(rng.random((b, L, h, w, 2)), jnp.float32),
+        }
+        for _ in range(n_batches)
+    ]
+    states = model.init_states(b, h, w)
+    params = model.init(
+        jax.random.PRNGKey(seed), batches[0]["inp"][:, :seqn], states
+    )
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    step_fn = make_train_step(model, opt, seqn=seqn)
+    return step_fn, TrainState.create(params, opt), batches
+
+
+def _stack(group):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *group)
+
+
+def _assert_states_close(a, b, atol=1e-6):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float64), np.asarray(y, np.float64), atol=atol
+        )
+
+
+@pytest.fixture(scope="module")
+def trajectory():
+    """Shared tiny model + the 5-step sequential oracle trajectory."""
+    step_fn, state0, batches = _setup(n_batches=5)
+    step = jax.jit(step_fn)
+    s = state0
+    seq_states, seq_metrics = [], []
+    for batch in batches:
+        s, m = step(s, batch)
+        seq_states.append(s)
+        seq_metrics.append(m)
+    return {
+        "step_fn": step_fn, "step": step, "state0": state0,
+        "batches": batches, "seq_states": seq_states,
+        "seq_metrics": seq_metrics, "multi_cache": {},
+    }
+
+
+def _multi(traj, k, **kwargs):
+    key = (k, tuple(sorted(kwargs.items())))
+    if key not in traj["multi_cache"]:
+        traj["multi_cache"][key] = jax.jit(
+            make_multi_step(traj["step_fn"], k, **kwargs)
+        )
+    return traj["multi_cache"][key]
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_multi_step_matches_sequential(k, trajectory):
+    n = 4  # covered by full groups for every k under test
+    batches = trajectory["batches"][:n]
+    seq_metrics = trajectory["seq_metrics"][:n]
+    multi = _multi(trajectory, k)
+
+    s_fused = trajectory["state0"]
+    fused_loss, fused_grad_norm, fused_lpw = [], [], []
+    last_pred = None
+    for g in range(0, n, k):
+        s_fused, m = multi(s_fused, _stack(batches[g : g + k]))
+        assert m["loss"].shape == (k,)
+        assert m["grad_norm"].shape == (k,)
+        fused_loss += [float(v) for v in np.asarray(m["loss"])]
+        fused_grad_norm += [float(v) for v in np.asarray(m["grad_norm"])]
+        fused_lpw.append(np.asarray(m["loss_per_window"]))
+        last_pred = m["last_pred"]
+
+    s_seq = trajectory["seq_states"][n - 1]
+    assert int(s_fused.step) == int(s_seq.step) == n
+    _assert_states_close(s_fused.params, s_seq.params)
+    _assert_states_close(s_fused.opt_state, s_seq.opt_state)
+    np.testing.assert_allclose(
+        fused_loss, [float(m["loss"]) for m in seq_metrics], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        fused_grad_norm,
+        [float(m["grad_norm"]) for m in seq_metrics],
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.concatenate(fused_lpw),
+        np.stack([np.asarray(m["loss_per_window"]) for m in seq_metrics]),
+        rtol=1e-6,
+    )
+    # last_pred is the FINAL chained step's prediction only
+    np.testing.assert_allclose(
+        np.asarray(last_pred),
+        np.asarray(seq_metrics[n - 1]["last_pred"]),
+        atol=1e-6,
+    )
+
+
+def test_multi_step_carries_batch_stats():
+    """BN models: running ``batch_stats`` must ride the scan carry across
+    the k chained steps exactly as across k sequential steps (the
+    cross-step recurrent state; the ConvGRU states reset per sequence
+    inside each step and are covered by the equivalence test above)."""
+    step_fn, state0, batches = _setup(n_batches=2, norm="BN", seed=3)
+    assert "batch_stats" in state0.params  # the model actually has BN
+
+    step = jax.jit(step_fn)
+    s_seq = state0
+    for batch in batches:
+        s_seq, _ = step(s_seq, batch)
+
+    multi = jax.jit(make_multi_step(step_fn, 2))
+    s_fused, _ = multi(state0, _stack(batches))
+
+    _assert_states_close(
+        s_fused.params["batch_stats"], s_seq.params["batch_stats"]
+    )
+    _assert_states_close(s_fused.params["params"], s_seq.params["params"])
+
+
+def test_remainder_tail_matches_sequential(trajectory):
+    """The Trainer's epoch-tail path: full groups through the fused step,
+    the < k leftover through the single-step executable — end state equal
+    to the plain sequential run over the same 5 batches."""
+    k = 2
+    batches = trajectory["batches"]
+    step = trajectory["step"]
+    multi = _multi(trajectory, k)
+
+    s_mix = trajectory["state0"]
+    groups = list(group_batches(batches, k))
+    assert [len(g) for g in groups] == [2, 2, 1]
+    for g in groups:
+        if len(g) == k:
+            s_mix, _ = multi(s_mix, _stack(g))
+        else:
+            for batch in g:
+                s_mix, _ = step(s_mix, batch)
+
+    s_seq = trajectory["seq_states"][-1]
+    assert int(s_mix.step) == len(batches)
+    _assert_states_close(s_mix.params, s_seq.params)
+    _assert_states_close(s_mix.opt_state, s_seq.opt_state)
+
+
+def test_reuse_batch_mode_matches_repeated_steps(trajectory):
+    """Bench chaining mode: the same batch (no k axis) feeds every chained
+    step; equals calling the step k times on that batch."""
+    batch = trajectory["batches"][0]
+    step = trajectory["step"]
+    s_seq = trajectory["state0"]
+    losses = []
+    for _ in range(3):
+        s_seq, m = step(s_seq, batch)
+        losses.append(float(m["loss"]))
+
+    multi = _multi(trajectory, 3, reuse_batch=True)
+    s_fused, m = multi(trajectory["state0"], batch)
+    np.testing.assert_allclose(
+        [float(v) for v in np.asarray(m["loss"])], losses, rtol=1e-6
+    )
+    _assert_states_close(s_fused.params, s_seq.params)
+
+
+def test_multi_step_validates_inputs(trajectory):
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        make_multi_step(lambda s, b: (s, {}), 0)
+    # a megabatch whose leaves lack the leading k axis fails loudly at
+    # trace time (shape confusion must not silently train on garbage)
+    multi = make_multi_step(trajectory["step_fn"], 4)
+    with pytest.raises(ValueError, match="leading axis 4"):
+        multi(trajectory["state0"], trajectory["batches"][0])
+
+
+def test_megabatch_grouping_preserves_sampler_order_and_shapes():
+    """ShardedSampler -> group_batches -> collate_megabatch yields the
+    SAME example order as the k=1 path, with static (k, B) shapes for
+    every full group and a shorter final tail."""
+    mk = lambda: ShardedSampler(
+        num_items=13, batch_size=2, shard_id=1, num_shards=2,
+        shuffle=True, seed=7,
+    )
+    ref, grp = mk(), mk()
+    ref.set_epoch(3)
+    grp.set_epoch(3)
+    singles = list(ref)
+
+    batches = [{"idx": b} for b in grp]
+    groups = list(group_batches(batches, 3))
+    assert [len(g) for g in groups] == [3, 1]  # 4 per-shard batches
+    flat = [b for g in groups for b in g]
+    assert len(flat) == len(singles)
+    for got, want in zip(flat, singles):
+        np.testing.assert_array_equal(got["idx"], want)
+
+    megas = [collate_megabatch(g) for g in groups if len(g) == 3]
+    assert {m["idx"].shape for m in megas} == {(3, 2)}
+    np.testing.assert_array_equal(
+        np.concatenate([m["idx"].reshape(-1) for m in megas]),
+        np.concatenate(singles[:3]),
+    )
+
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        list(group_batches(batches, 0))

@@ -255,6 +255,49 @@ def test_device_prefetch_bitwise_equals_inline_staging(corpus, tmp_path):
 
 
 @pytest.mark.slow
+def test_trainer_k_steps_matches_k1(corpus, tmp_path):
+    """trainer.k_steps (K-step fused training) is a pure batching change:
+    the same seed/config at k_steps=3 consumes the identical batch
+    sequence through fused super-steps and ends with params allclose to
+    the k_steps=1 run, with every per-iteration loss scalar reported.
+    iterations=6 is a super-step multiple so both runs train exactly 6
+    steps; the not-a-multiple overshoot and the epoch-tail remainder path
+    are covered at unit level in test_multistep.py."""
+    tmp, datalist = corpus
+
+    def run_with_k(k, runid):
+        config = _make_config(tmp_path, datalist, iterations=6,
+                              valid_step=100)
+        config["trainer"]["k_steps"] = k
+        run = RunConfig(config, runid=runid, seed=11)
+        trainer = Trainer(run)
+        assert trainer.k_steps == k
+        losses = []
+        orig = trainer.train_metrics.update
+
+        def spy(key, value, n=1):
+            if key == "train_loss":
+                losses.append(value)
+            orig(key, value, n)
+
+        trainer.train_metrics.update = spy
+        trainer.train()
+        return jax.tree.map(np.asarray, trainer.state.params), losses
+
+    p1, l1 = run_with_k(1, "k1")
+    p3, l3 = run_with_k(3, "k3")
+    assert len(l1) == 6 and len(l3) == 6
+    np.testing.assert_allclose(l3, l1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p3)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+    bad = _make_config(tmp_path, datalist)
+    bad["trainer"]["k_steps"] = 0
+    with pytest.raises(ValueError, match="k_steps"):
+        Trainer(RunConfig(bad, runid="kbad", seed=11))
+
+
+@pytest.mark.slow
 def test_checkpoint_resume_bitwise(corpus, tmp_path):
     tmp, datalist = corpus
     config = _make_config(tmp_path, datalist, iterations=3, valid_step=100)
