@@ -11,6 +11,13 @@ before exiting (the observed wedge — ``make_c_api_client`` blocking forever
 The LAST line on stdout is always the single headline JSON the driver
 parses: ``{"metric", "value", "unit", "vs_baseline", "extra"}``.
 
+Every stage record is emitted through the shared telemetry machinery
+(``utils/artifacts.emit_jsonl`` -> ``esr_tpu.obs.run_manifest``): each line
+carries ``schema_version`` and the run ``manifest`` (host, device kind, jax
+version), so a BENCH_STAGES line is attributable to its environment on its
+own and schema drift fails tier-1 off-TPU (``tests/test_bench_registry.py``,
+docs/OBSERVABILITY.md).
+
 Stage order (most diagnostic value first):
 - ``backend_up``: device enumeration + one executed op — the wedge detector.
 - ``mosaic_dcn``: the fused Pallas DCNv2 forward+backward compiled with

@@ -24,6 +24,7 @@ introspection; :func:`retrace_stats` snapshots every live counter.
 from __future__ import annotations
 
 import functools
+import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional
 
@@ -42,12 +43,13 @@ class RetraceBudgetError(RuntimeError):
 class TraceCounter:
     """Mutable trace count for one ``checked_jit`` site."""
 
-    __slots__ = ("name", "max_traces", "count", "__weakref__")
+    __slots__ = ("name", "max_traces", "count", "_trace_t0", "__weakref__")
 
     def __init__(self, name: str, max_traces: int):
         self.name = name
         self.max_traces = max_traces
         self.count = 0
+        self._trace_t0: Optional[float] = None
 
     def bump(self) -> None:
         # under jax.disable_jit() the "traced" body runs op-by-op on EVERY
@@ -56,6 +58,7 @@ class TraceCounter:
         if jax.config.jax_disable_jit:
             return
         self.count += 1
+        self._trace_t0 = time.perf_counter()
         if self.count > self.max_traces:
             raise RetraceBudgetError(
                 f"{self.name!r} has been traced {self.count} times "
@@ -68,8 +71,38 @@ class TraceCounter:
                 "intentional."
             )
 
+    def trace_done(self) -> None:
+        """Called by the wrapper once the body finished tracing: emits a
+        ``compile`` telemetry event (fn name, trace count, elapsed) into
+        the active obs sink — every (re)trace of a guarded jit site is now
+        an observable event, not just a budget tick. ``elapsed_s`` covers
+        the Python tracing of the body (XLA compilation proper happens
+        later inside jit internals and is not separable here); it is the
+        signal that matters for retrace storms either way. The sink call
+        lives in THIS host-side method, not in the traced wrapper body, so
+        telemetry stays out of traced code (ESR007) by construction."""
+        if jax.config.jax_disable_jit or self._trace_t0 is None:
+            return
+        elapsed = time.perf_counter() - self._trace_t0
+        self._trace_t0 = None
+        try:
+            from esr_tpu.obs import active_sink
+
+            sink = active_sink()
+            if sink is not None:
+                sink.event(
+                    "compile",
+                    fn=self.name,
+                    trace_count=self.count,
+                    max_traces=self.max_traces,
+                    elapsed_s=round(elapsed, 6),
+                )
+        except Exception:  # noqa: BLE001 - telemetry must never break a trace
+            pass
+
     def reset(self) -> None:
         self.count = 0
+        self._trace_t0 = None
 
     def __repr__(self) -> str:
         return (
@@ -102,7 +135,9 @@ def checked_jit(
     @functools.wraps(fun)
     def counted(*args: Any, **kwargs: Any):
         counter.bump()  # body runs at trace time only; cache hits skip it
-        return fun(*args, **kwargs)
+        out = fun(*args, **kwargs)
+        counter.trace_done()  # host-side: stamps the compile event
+        return out
 
     jitted = jax.jit(counted, **jit_kwargs)
     try:

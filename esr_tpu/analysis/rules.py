@@ -22,6 +22,10 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
 - ESR006 traced-nondeterminism — ``time.time`` / bare ``np.random`` /
   stdlib ``random`` inside traced code: baked in as a constant at trace
   time, NOT re-evaluated per step.
+- ESR007 telemetry-in-traced-code — ``esr_tpu.obs`` calls inside
+  jitted/scanned code: host-side telemetry under trace either leaks a
+  tracer or fires exactly once at trace time (never per step) — the
+  telemetry subsystem stays host-side by construction.
 
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
@@ -431,4 +435,72 @@ class TracedNondeterminism(Rule):
                     node,
                     f"nondeterministic call `{dotted}(...)` inside traced "
                     "code is frozen at trace time",
+                )
+
+
+_OBS_MODULE = "esr_tpu.obs"
+
+
+def _obs_aliases(tree: ast.AST) -> dict:
+    """``{local name: canonical dotted}`` for names bound INTO esr_tpu.obs.
+
+    Deliberately narrower than :func:`_import_aliases`: a plain ``import
+    esr_tpu.obs`` binds the name ``esr_tpu`` (the package root), and
+    mapping that name to ``esr_tpu.obs`` would make EVERY
+    ``esr_tpu.<anything>(...)`` call in the module resolve under the obs
+    prefix — dotted calls through a plain import are already fully
+    qualified and need no aliasing."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname and (
+                    a.name == _OBS_MODULE
+                    or a.name.startswith(_OBS_MODULE + ".")
+                ):
+                    out[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                full = f"{node.module}.{a.name}"
+                if full == _OBS_MODULE or full.startswith(_OBS_MODULE + "."):
+                    out[a.asname or a.name] = full
+    return out
+
+
+@register_rule
+class TelemetryInTracedCode(Rule):
+    name = "ESR007"
+    slug = "telemetry-in-traced-code"
+    severity = "error"
+    hint = (
+        "esr_tpu.obs is host-side telemetry by contract: under trace a "
+        "sink call either leaks a tracer or fires once at trace time, not "
+        "per step — record timestamps on the host around the dispatch "
+        "instead (obs.spans.StepAttribution / the instrumented step "
+        "wrappers)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        aliases = _obs_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.in_traced_context(node):
+                continue
+            dotted = _dotted(node.func)
+            if not dotted:
+                continue
+            head, _, rest = dotted.partition(".")
+            if head in aliases:
+                resolved = aliases[head] + (f".{rest}" if rest else "")
+            else:
+                resolved = dotted  # plain imports are already qualified
+            if resolved == _OBS_MODULE or resolved.startswith(
+                _OBS_MODULE + "."
+            ):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"host-telemetry call `{dotted}(...)` inside traced "
+                    "code",
                 )

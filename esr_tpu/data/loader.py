@@ -24,12 +24,15 @@ design:
 
 from __future__ import annotations
 
+import queue
+import time
 from collections import deque
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from esr_tpu.data.dataset import SequenceDataset
+from esr_tpu.obs import active_sink
 
 
 def read_datalist(path: str) -> List[str]:
@@ -424,23 +427,38 @@ class DevicePrefetcher:
     ``close()`` (or context-manager exit) stops the thread early and is
     idempotent. ``join_timeout`` bounds how long ``close()`` waits for the
     producer (a ``stage_fn`` blocked in a device transfer can exceed any
-    fixed wait); a missed join is downgraded to a warning — the thread is
-    daemonic, holds at most one in-flight source item (under K-step fused
-    training that item is a whole k-batch group/megabatch), and is reaped
-    with the process — and skipped entirely during interpreter teardown,
-    where joining/warning machinery is itself unreliable.
+    fixed wait); a missed join is downgraded to a warning AND a counted
+    ``prefetch_join_timeout`` telemetry event — the thread is daemonic,
+    holds at most one in-flight source item (under K-step fused training
+    that item is a whole k-batch group/megabatch), and is reaped with the
+    process — and skipped entirely during interpreter teardown, where
+    joining/warning/telemetry machinery is itself unreliable.
+
+    Health channel (docs/OBSERVABILITY.md): when a process-active telemetry
+    sink exists (``esr_tpu.obs``), the prefetcher reports a
+    ``prefetch_queue_depth`` gauge every ``gauge_every`` consumed items, a
+    ``prefetch_stall`` counter whenever the consumer outruns the producer
+    (the queue was empty — device idle, host feeding — with the blocked
+    wait recorded), and a ``prefetch_close`` summary event at teardown.
+    With no active sink every telemetry site is a no-op.
     """
 
     def __init__(self, source, stage_fn, depth: int = 2,
-                 join_timeout: float = 5.0):
-        import queue
+                 join_timeout: float = 5.0, gauge_every: int = 32):
         import threading
 
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if join_timeout <= 0:
             raise ValueError(f"join_timeout must be > 0, got {join_timeout}")
+        if gauge_every < 1:
+            raise ValueError(f"gauge_every must be >= 1, got {gauge_every}")
         self._join_timeout = float(join_timeout)
+        self._gauge_every = int(gauge_every)
+        self.gets = 0
+        self.stalls = 0
+        self.stall_s = 0.0
+        self._reported_close = False
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -452,8 +470,6 @@ class DevicePrefetcher:
         self._thread.start()
 
     def _produce(self, it, stage_fn):
-        import queue
-
         def put(item) -> bool:
             while not self._stop.is_set():
                 try:
@@ -479,7 +495,32 @@ class DevicePrefetcher:
     def __next__(self):
         if self._stop.is_set():
             raise StopIteration
-        kind, payload = self._q.get()
+        sink = None
+        try:
+            kind, payload = self._q.get_nowait()
+        except queue.Empty:
+            # the consumer outran the producer: a prefetch stall — the
+            # device sits idle while the host builds/stages the next group.
+            # Counted (+ blocked wall) so starvation is a measured series,
+            # not a guess. Includes the inevitable first-item warmup wait
+            # and the end-of-source wait for the "end" marker: both are
+            # genuine host-feed waits.
+            t0 = time.monotonic()
+            kind, payload = self._q.get()
+            waited = time.monotonic() - t0
+            self.stalls += 1
+            self.stall_s += waited
+            sink = active_sink()
+            if sink is not None:
+                sink.counter("prefetch_stall", waited_s=round(waited, 6))
+        self.gets += 1
+        if self.gets % self._gauge_every == 0:
+            sink = sink if sink is not None else active_sink()
+            if sink is not None:
+                sink.gauge(
+                    "prefetch_queue_depth", self._q.qsize(),
+                    gets=self.gets, stalls=self.stalls,
+                )
         if kind == "item":
             return payload
         if kind == "end":
@@ -513,9 +554,17 @@ class DevicePrefetcher:
         # first drain frees a slot — drain again after the join so no
         # staged (device-resident) batch outlives close()
         drain()
+        sink = active_sink()
         if self._thread.is_alive():
             import warnings
 
+            if sink is not None:
+                # a missed join was previously observable only via
+                # `warnings` — now it is a counted, timestamped event too
+                sink.counter(
+                    "prefetch_join_timeout",
+                    timeout_s=self._join_timeout,
+                )
             warnings.warn(
                 f"DevicePrefetcher producer thread did not stop within "
                 f"{self._join_timeout:g}s (stage_fn blocked in a device "
@@ -523,6 +572,15 @@ class DevicePrefetcher:
                 "source item (a full k-batch megabatch under k_steps>1), "
                 "and leaks only until process exit",
                 stacklevel=2,
+            )
+        if sink is not None and not self._reported_close:
+            self._reported_close = True
+            sink.event(
+                "prefetch_close",
+                gets=self.gets,
+                stalls=self.stalls,
+                stall_s=round(self.stall_s, 6),
+                joined=not self._thread.is_alive(),
             )
 
     def __enter__(self):

@@ -21,7 +21,11 @@ Rebuilds ``infer_ours_cnt.py`` (reference ``:22-115`` per-recording body,
   (``load_lpips_params(allow_uncalibrated=True)``);
 - optional PNG dumps in the reference's directory layout (``:44-49,104-109``);
 - per-forward latency (timed around ``block_until_ready``) and params count
-  (reference ``:65-67,71-74``).
+  (reference ``:65-67,71-74``); when a process-active telemetry sink exists
+  (``esr_tpu.obs``, docs/OBSERVABILITY.md) each sequence's forward latency
+  is also emitted as an ``infer_forward`` span tagged with the recording
+  and window index, so tail latency is a queryable series rather than one
+  averaged number in the YAML report.
 """
 
 from __future__ import annotations
@@ -37,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from esr_tpu.data.loader import InferenceSequenceLoader
+from esr_tpu.obs import active_sink
 from esr_tpu.losses.restore import (
     l1_metric,
     mse_metric,
@@ -116,7 +121,11 @@ class InferenceRunner:
                 "time", "params"]
         if self.lpips is not None:
             keys += ["esr_lpips", "bicubic_lpips"]
-        track = MetricTracker(keys)
+        # sink=False: this tracker is a local aggregator for the YAML
+        # report — with the default active-sink fallback every per-window
+        # metric (incl. latency) would double into the telemetry stream
+        # next to the authoritative infer_forward spans below
+        track = MetricTracker(keys, sink=False)
         track.update("params", _num_params(self.params))
 
         img_root = None
@@ -137,6 +146,8 @@ class InferenceRunner:
         # (shared content variance dominates those but cancels in the
         # delta); per-series stds are kept as descriptive context only.
         ssim_samples = {"esr_ssim": [], "bicubic_ssim": []}
+        sink = active_sink()
+        rec_name = os.path.basename(data_path)
 
         for i, batch in enumerate(loader):
             window = {
@@ -147,7 +158,14 @@ class InferenceRunner:
             t0 = time.perf_counter()
             pred, states = self._fwd(self.params, inp_scaled, states)
             pred = jax.block_until_ready(pred)
-            track.update("time", time.perf_counter() - t0)
+            latency = time.perf_counter() - t0
+            track.update("time", latency)
+            if sink is not None:
+                # per-sequence latency span: block_until_ready bounds the
+                # forward, so this is true dispatch->ready wall per window
+                sink.span(
+                    "infer_forward", latency, recording=rec_name, window=i
+                )
 
             gt = jnp.asarray(window["gt_cnt"][0, self.mid_idx])  # [kH,kW,2]
             inp_cnt = jnp.asarray(window["inp_cnt"][0, self.mid_idx])

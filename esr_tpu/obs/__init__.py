@@ -1,0 +1,45 @@
+"""esr_tpu.obs — structured host-side telemetry (docs/OBSERVABILITY.md).
+
+One subsystem, three pieces:
+
+- :mod:`esr_tpu.obs.sink` — the structured JSONL event/metric sink
+  (monotonic-clock records, counters, gauges, per-run manifest with config
+  fingerprint + jax version + device kind + schema version) and the
+  process-active sink registry every instrumented component checks;
+- :mod:`esr_tpu.obs.spans` — span-based step-time attribution: the Trainer
+  decomposes each super-step's wall-clock into ``data_wait`` /
+  ``stage_megabatch`` / ``dispatch`` / ``device_step`` (non-blocking) /
+  ``metric_readback`` / ``checkpoint`` + residual, with derived samples/s
+  and goodput;
+- instrumented producers elsewhere: ``checked_jit`` compile/retrace events
+  (analysis/retrace_guard.py), the ``DevicePrefetcher`` health channel
+  (data/loader.py), per-sequence inference latency spans
+  (inference/harness.py), and the metric writers (utils/writer.py,
+  utils/trackers.py).
+
+Design rules: stdlib-only (importable from the NumPy-only data layer and
+accelerator-free CI hosts), and host-side only — no ``obs`` call may appear
+inside jitted/scanned code (enforced by analysis rule ESR007 and the
+self-check in ``tests/test_obs.py``).
+"""
+
+from esr_tpu.obs.sink import (
+    SCHEMA_VERSION,
+    TelemetrySink,
+    active_sink,
+    config_fingerprint,
+    run_manifest,
+    set_active_sink,
+)
+from esr_tpu.obs.spans import StepAttribution, StepSpans
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "TelemetrySink",
+    "active_sink",
+    "config_fingerprint",
+    "run_manifest",
+    "set_active_sink",
+    "StepAttribution",
+    "StepSpans",
+]

@@ -1,0 +1,239 @@
+"""Structured JSONL telemetry sink: events, counters, gauges, metrics, spans.
+
+The single place host-side telemetry lands (docs/OBSERVABILITY.md).
+``MetricWriter`` (utils/writer.py), ``MetricTracker``/``YamlLogger``
+(utils/trackers.py), the ``DevicePrefetcher`` health channel
+(data/loader.py), the ``checked_jit`` compile events
+(analysis/retrace_guard.py), and the Trainer's per-super-step attribution
+records (obs/spans.py) all write through one :class:`TelemetrySink`.
+
+Contract:
+
+- **stdlib-only.** The sink is importable from the NumPy-only data layer
+  (ESR004) and from CI hosts with no accelerator runtime. ``jax`` is only
+  touched lazily, inside :func:`run_manifest`, and NEVER in a way that can
+  initialize a backend (the manifest probe must stay safe inside
+  wedge-proof artifact paths like ``bench.py``/``tpu_probe``).
+- **host-side only.** Nothing in this package may be called from
+  jitted/scanned code — a sink call under trace either leaks a tracer or
+  fires exactly once at trace time. Enforced statically by analysis rule
+  ESR007 and ``tests/test_obs.py``'s repo-wide self-check.
+- **monotonic clock.** Every record carries ``t`` — seconds since the sink
+  opened, from ``time.monotonic`` — so ordering and durations are immune to
+  wall-clock steps; wall-clock appears only in the manifest (``ts``).
+- **never raises into the hot loop.** I/O failures drop the record and
+  count it (``sink.dropped``); telemetry must not take training down.
+- **stable key order.** Records of the same type emit keys in a
+  deterministic order (fixed ``t``/``type``/``name`` prefix, payload keys
+  sorted) so downstream parsers and diffs are stable; attribution records
+  keep their curated field order (obs/spans.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+SCHEMA_VERSION = 1
+
+
+def config_fingerprint(config: Dict) -> str:
+    """Stable 16-hex digest of an effective run config (order-insensitive:
+    canonical JSON with sorted keys; non-JSON leaves stringified)."""
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _jax_version() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:  # noqa: BLE001 - jax-free hosts still get a manifest
+        return None
+
+
+def _device_info() -> Dict:
+    """Device kind/platform/count — ONLY if a backend is already live.
+
+    ``jax.devices()`` initializes (and can wedge on) the backend; the
+    manifest is stamped into wedge-proof artifact paths, so probe the
+    initialized-backends flag first and report nulls otherwise. Callers
+    that run after backend contact (Trainer, bench stages past
+    ``backend_up``) get real values.
+    """
+    info: Dict = {"device_kind": None, "platform": None, "device_count": None}
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return info
+        import jax
+
+        devs = jax.devices()
+        info["device_kind"] = devs[0].device_kind
+        info["platform"] = devs[0].platform
+        info["device_count"] = len(devs)
+    except Exception:  # noqa: BLE001 - best-effort; nulls are valid
+        pass
+    return info
+
+
+_STATIC_MANIFEST: Optional[Dict] = None
+
+
+def run_manifest(config_fingerprint: Optional[str] = None) -> Dict:
+    """The per-run environment manifest: host, pid, python, jax version,
+    device kind (when a backend is live), optional config fingerprint.
+
+    Static fields are computed once per process; the device fields are
+    re-probed each call until a backend exists (so records emitted after
+    backend contact pick up the real device kind)."""
+    global _STATIC_MANIFEST
+    if _STATIC_MANIFEST is None:
+        import platform
+
+        _STATIC_MANIFEST = {
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "python": platform.python_version(),
+            "jax_version": _jax_version(),
+        }
+    man = dict(_STATIC_MANIFEST)
+    man.update(_device_info())
+    if config_fingerprint is not None:
+        man["config_fingerprint"] = config_fingerprint
+    return man
+
+
+class TelemetrySink:
+    """Append-only JSONL event/metric sink with a manifest header record.
+
+    Thread-safe (the ``DevicePrefetcher`` producer thread and the training
+    loop write concurrently); every record is flushed the moment it exists,
+    matching the wedge-proof contract of ``utils/artifacts.emit_jsonl``.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        manifest: Optional[Dict] = None,
+        clock=time.monotonic,
+    ):
+        self.path = path
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.RLock()
+        self._counts: Dict[str, float] = {}
+        self.dropped = 0
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+        man = dict(manifest if manifest is not None else run_manifest())
+        man["schema_version"] = SCHEMA_VERSION
+        man["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        self._write("manifest", "run", man)
+
+    # -- record plumbing ---------------------------------------------------
+
+    def _write(self, type_: str, name: str, fields: Dict, sort: bool = True):
+        rec = {
+            "t": round(self._clock() - self._t0, 6),
+            "type": type_,
+            "name": name,
+        }
+        for k, v in sorted(fields.items()) if sort else fields.items():
+            rec[k] = v
+        try:
+            line = json.dumps(rec)
+        except (TypeError, ValueError):
+            line = json.dumps(
+                {**{k: rec[k] for k in ("t", "type", "name")},
+                 "unserializable": True}
+            )
+        with self._lock:
+            if self._f is None or self._f.closed:
+                self.dropped += 1
+                return
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                self.dropped += 1
+
+    # -- record kinds ------------------------------------------------------
+
+    def event(self, name: str, **fields) -> None:
+        """A point-in-time occurrence (``compile``, ``prefetch_close``, …)."""
+        self._write("event", name, fields)
+
+    def counter(self, name: str, inc: float = 1, **fields) -> None:
+        """A monotonically accumulating count; each record carries this
+        increment and the running total."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + inc
+            total = self._counts[name]
+        self._write("counter", name, {"inc": inc, "total": total, **fields})
+
+    def gauge(self, name: str, value, **fields) -> None:
+        """A sampled instantaneous value (queue depth, lookahead fill)."""
+        self._write("gauge", name, {"value": value, **fields})
+
+    def metric(self, name: str, value: float, step=None, **fields) -> None:
+        """A training metric scalar (the MetricWriter/MetricTracker path)."""
+        self._write("metric", name, {"value": float(value), "step": step,
+                                     **fields})
+
+    def span(self, name: str, seconds: float, **fields) -> None:
+        """A completed named duration (per-sequence inference latency, …)."""
+        self._write("span", name, {"seconds": round(float(seconds), 6),
+                                   **fields})
+
+    def attribution(self, fields: Dict) -> None:
+        """A per-super-step wall-clock attribution record (obs/spans.py);
+        field order is curated by the producer and preserved."""
+        self._write("attribution", "super_step", fields, sort=False)
+
+    def counter_total(self, name: str) -> float:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+
+    def __enter__(self) -> "TelemetrySink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# process-active sink: the one registry every instrumented component checks.
+# None (the default) makes every telemetry call site a no-op — telemetry is
+# strictly opt-in per process (the Trainer activates it on the main host).
+
+_ACTIVE: Optional[TelemetrySink] = None
+
+
+def set_active_sink(sink: Optional[TelemetrySink]) -> Optional[TelemetrySink]:
+    """Install ``sink`` as the process-active sink; returns the previous
+    one (restore it to scope activation, e.g. in tests)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = sink
+    return prev
+
+
+def active_sink() -> Optional[TelemetrySink]:
+    return _ACTIVE

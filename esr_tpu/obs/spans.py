@@ -1,0 +1,240 @@
+"""Span-based step-time attribution: where does a super-step's wall go?
+
+PR 2 shipped K-step fused training on the strength of one hand-timed bench
+number; this module makes the attribution permanent. The Trainer drives a
+:class:`StepAttribution` through its loop and every super-step produces one
+``attribution`` record decomposing host wall-clock into named spans:
+
+- ``data_wait``      blocked pulling the next batch group from the loader /
+                     prefetcher queue;
+- ``stage_megabatch`` host->device staging of the group. When the
+                     ``DevicePrefetcher`` stages on its producer thread the
+                     span is recorded as *overlapped* (it runs concurrently
+                     with earlier steps' device compute) and excluded from
+                     the wall-clock accounting identity below;
+- ``dispatch``       the jitted call itself — tracing + XLA compilation land
+                     here on (re)trace, microseconds on cache hits;
+- ``device_step``    NON-BLOCKING device-time estimate: timestamped at
+                     dispatch return, resolved when the existing
+                     cadence-gated scalar readback observes the metrics —
+                     no new host syncs enter the hot loop;
+- ``metric_readback`` the host-blocked portion of that readback (a tail
+                     *inside* ``device_step``, reported separately, never
+                     double-counted);
+- ``checkpoint`` / ``validate``  the cadence-gated save / validation pass;
+- ``residual``       ``wall − accounted`` — everything unattributed
+                     (cadence bookkeeping, logging, lr-schedule eval).
+
+Accounting identity (see docs/OBSERVABILITY.md for the full read-me):
+
+    wall ≈ data_wait + stage_megabatch(inline) + dispatch + device_step
+           + checkpoint + validate + residual
+
+Strict with ``train_lookahead: 0`` / ``device_prefetch: 0`` (the
+``scripts/obs_smoke.sh`` configuration asserts |residual| ≤ 5% of wall);
+under lookahead/prefetch the device span overlaps later iterations' host
+work by design, so ``residual`` can go negative and ``goodput`` is clamped.
+
+Derived per record: ``samples_per_sec`` (host-local sequences/s over the
+super-step) and ``goodput`` = device_step / wall ∈ (0, 1].
+
+Everything here is host-side and stdlib-only; nothing may be called from
+traced code (analysis rule ESR007).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class StepSpans:
+    """One super-step's span bucket.
+
+    Created by :meth:`StepAttribution.begin`, carried through the Trainer's
+    ``pending`` deque alongside the in-flight metrics, finalized when both
+    the loop body closed it (wall-clock end) AND the metrics readback
+    resolved it (device span end) — whichever happens last emits.
+    """
+
+    __slots__ = (
+        "first", "k", "t0", "t_close", "t_dispatch", "t_resolved",
+        "spans", "overlapped", "readback_s", "emitted",
+    )
+
+    def __init__(self, t0: float):
+        self.first: Optional[int] = None
+        self.k: int = 0
+        self.t0 = t0
+        self.t_close: Optional[float] = None
+        self.t_dispatch: Optional[float] = None
+        self.t_resolved: Optional[float] = None
+        self.spans: Dict[str, float] = {}
+        self.overlapped: set = set()
+        self.readback_s = 0.0
+        self.emitted = False
+
+    def add(self, name: str, seconds: float, overlapped: bool = False):
+        self.spans[name] = self.spans.get(name, 0.0) + float(seconds)
+        if overlapped:
+            self.overlapped.add(name)
+
+
+class StepAttribution:
+    """Per-super-step wall-clock attribution driver (host-side).
+
+    Every method is a no-op-safe cheap host operation: with no open bucket
+    (or no sink) instrumented call sites cost a ``None`` check, so wrapped
+    steps stay usable outside the training loop (tests, bench).
+    """
+
+    def __init__(
+        self,
+        sink=None,
+        batch_size: int = 1,
+        log_step: int = 1,
+        clock=time.monotonic,
+    ):
+        self.sink = sink
+        self.batch_size = max(int(batch_size), 1)
+        self.log_step = max(int(log_step), 1)
+        self._clock = clock
+        self.current: Optional[StepSpans] = None
+        self.emitted_records = 0
+
+    # -- super-step lifecycle ---------------------------------------------
+
+    def begin(self) -> StepSpans:
+        """Open a fresh bucket at the top of a loop iteration."""
+        self.current = StepSpans(self._clock())
+        return self.current
+
+    def discard(self) -> None:
+        """Drop an empty bucket (source exhausted before a group arrived)."""
+        self.current = None
+
+    def note(self, first: int, k: int) -> None:
+        """Record which iterations this super-step covers."""
+        if self.current is not None:
+            self.current.first = int(first)
+            self.current.k = int(k)
+
+    def close(self) -> None:
+        """Mark the wall-clock end of the loop body; detaches the bucket
+        (it lives on in the pending entry until the readback resolves it).
+        Idempotent."""
+        cur = self.current
+        if cur is None:
+            return
+        if cur.t_close is None:
+            cur.t_close = self._clock()
+        self.current = None
+        self._maybe_emit(cur)
+
+    # -- span recording ----------------------------------------------------
+
+    @contextmanager
+    def measure(self, name: str):
+        """Time a block into the current bucket (nested/overlapping blocks
+        each record their full duration under their own name)."""
+        cur = self.current
+        if cur is None:
+            yield
+            return
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            cur.add(name, self._clock() - t0)
+
+    def add(self, name: str, seconds: float, overlapped: bool = False):
+        if self.current is not None:
+            self.current.add(name, seconds, overlapped=overlapped)
+
+    def dispatched(self) -> None:
+        """Timestamp the (async) dispatch of this super-step's device work."""
+        if self.current is not None:
+            self.current.t_dispatch = self._clock()
+
+    @contextmanager
+    def resolving(self, bucket: Optional[StepSpans]):
+        """Wrap the cadence-gated scalar readback that forces the device
+        sync: the block duration is the host-blocked ``metric_readback``;
+        its end resolves the non-blocking ``device_step`` span."""
+        if bucket is None:
+            yield
+            return
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            now = self._clock()
+            bucket.readback_s += now - t0
+            bucket.t_resolved = now
+            self._maybe_emit(bucket)
+
+    # -- emission ----------------------------------------------------------
+
+    def record(self, bucket: StepSpans) -> Dict:
+        """The attribution record for a finalized bucket (field order is
+        the published schema — docs/OBSERVABILITY.md)."""
+        # wall is the loop-BODY's span (t_close); under lookahead the
+        # readback lands later and device work overlaps the next
+        # iterations by design — t_resolved never extends the wall
+        if bucket.t_close is not None:
+            end = bucket.t_close
+        elif bucket.t_resolved is not None:
+            end = bucket.t_resolved
+        else:
+            end = self._clock()
+        wall = max(end - bucket.t0, 1e-9)
+        device = 0.0
+        if bucket.t_dispatch is not None and bucket.t_resolved is not None:
+            device = max(bucket.t_resolved - bucket.t_dispatch, 0.0)
+        spans = bucket.spans
+        accounted = device + sum(
+            v for n, v in spans.items() if n not in bucket.overlapped
+        )
+        k = bucket.k or 1
+        return {
+            "first_iteration": bucket.first,
+            "k": k,
+            "wall_s": round(wall, 6),
+            "data_wait_s": round(spans.get("data_wait", 0.0), 6),
+            "stage_megabatch_s": round(spans.get("stage_megabatch", 0.0), 6),
+            "stage_overlapped": "stage_megabatch" in bucket.overlapped,
+            "dispatch_s": round(spans.get("dispatch", 0.0), 6),
+            "device_step_s": round(device, 6),
+            "metric_readback_s": round(bucket.readback_s, 6),
+            "checkpoint_s": round(spans.get("checkpoint", 0.0), 6),
+            "validate_s": round(spans.get("validate", 0.0), 6),
+            "residual_s": round(wall - accounted, 6),
+            "samples_per_sec": round(k * self.batch_size / wall, 3),
+            "goodput": round(min(max(device / wall, 1e-9), 1.0), 6),
+        }
+
+    def _due(self, bucket: StepSpans) -> bool:
+        """Emission snaps to the ``train_log_step`` cadence exactly like
+        the Trainer's loss line: due when ANY covered iteration hits it."""
+        if bucket.first is None:
+            return False
+        return any(
+            (bucket.first + j) % self.log_step == 0 for j in range(bucket.k)
+        )
+
+    def _maybe_emit(self, bucket: StepSpans) -> None:
+        # a bucket emits once, after BOTH wall end and readback are known:
+        # lookahead=0 resolves mid-body and emits at close; lookahead>0
+        # closes first and emits at the deferred readback.
+        if bucket.emitted:
+            return
+        if bucket.t_close is None or bucket.t_resolved is None:
+            return
+        bucket.emitted = True
+        if not self._due(bucket):
+            return
+        rec = self.record(bucket)
+        self.emitted_records += 1
+        if self.sink is not None:
+            self.sink.attribution(rec)

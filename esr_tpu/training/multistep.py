@@ -96,3 +96,41 @@ def make_multi_step(
         return state, metrics
 
     return multi_step
+
+
+class _InstrumentedStep:
+    """Host-side dispatch instrumentation around a COMPILED (super-)step.
+
+    Wraps the jitted callable one level OUTSIDE the jit boundary: the
+    ``dispatch`` span times the call itself (tracing + XLA compilation on a
+    (re)trace, microseconds on cache hits) and the post-call timestamp
+    opens the non-blocking ``device_step`` span that the Trainer's
+    cadence-gated metrics readback later resolves
+    (``esr_tpu.obs.spans.StepAttribution``) — telemetry never enters the
+    traced program. Attribute access (``retrace_counter``, ``lower``, …)
+    delegates to the wrapped step, and with no open attribution bucket the
+    wrapper is a plain pass-through, so instrumented steps stay usable
+    outside the training loop (tests, bench).
+    """
+
+    def __init__(self, step: Callable, attribution):
+        self._step = step
+        self._attribution = attribution
+
+    def __call__(self, *args, **kwargs):
+        attribution = self._attribution
+        with attribution.measure("dispatch"):
+            out = self._step(*args, **kwargs)
+        attribution.dispatched()
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._step, name)
+
+
+def instrument_dispatch(step: Callable, attribution) -> Callable:
+    """Span hooks around the scanned super-step (and the plain step): wrap
+    a compiled ``(state, batch) -> (state, metrics)`` callable so each call
+    records its host-side ``dispatch`` span and device-step dispatch
+    timestamp into ``attribution``."""
+    return _InstrumentedStep(step, attribution)

@@ -42,6 +42,7 @@ from __future__ import annotations
 import logging
 import math
 import os
+import time
 from typing import Dict, Optional
 
 import jax
@@ -244,18 +245,68 @@ class Trainer:
         self.early_stop = int(float(trainer_cfg.get("early_stop", 10**9)))
         self.not_improved_count = 0
 
-        # observability (main process only, reference :160-169)
+        # observability (main process only, reference :160-169). The
+        # structured telemetry sink (esr_tpu.obs, docs/OBSERVABILITY.md) is
+        # the unified stream every instrumented component writes through:
+        # writer/tracker scalars, per-super-step span attribution,
+        # prefetcher health, and checked_jit compile events. Activated
+        # process-wide so components with no Trainer reference (the
+        # retrace guard, the prefetcher) find it.
+        self.sink = None
+        if self.is_main and bool(trainer_cfg.get("telemetry", True)):
+            from esr_tpu.obs import (
+                TelemetrySink,
+                config_fingerprint,
+                run_manifest,
+            )
+
+            self.sink = TelemetrySink(
+                os.path.join(run.log_dir, "telemetry.jsonl"),
+                manifest=run_manifest(
+                    config_fingerprint=config_fingerprint(config)
+                ),
+            )
+            # NOT activated here: train() installs it (and its finally
+            # deactivates it), so a Trainer constructed but never trained
+            # can never leak the process-active sink to unrelated runs
+        # sink=False (not None) when telemetry is off: None would fall back
+        # to the process-active sink, letting a leftover sink from another
+        # run capture a trainer that explicitly opted out
+        own_sink = self.sink if self.sink is not None else False
         self.writer = None
         if self.is_main:
             self.writer = MetricWriter(
                 run.log_dir,
                 logger,
                 enable_tensorboard=bool(trainer_cfg.get("tensorboard", True)),
+                sink=own_sink,
             )
         self.train_metrics = MetricTracker(
-            ["train_mse_loss", "train_loss"], writer=self.writer
+            ["train_mse_loss", "train_loss"], writer=self.writer,
+            sink=own_sink,
         )
-        self.valid_metrics = MetricTracker(["valid_mse_loss", "valid_loss"])
+        # writerless by design (validation scalars only surface as stamp_*
+        # at valid cadence) — the sink hook makes every per-batch valid
+        # scalar observable without changing the writer contract
+        self.valid_metrics = MetricTracker(
+            ["valid_mse_loss", "valid_loss"], sink=own_sink
+        )
+        # span-based step-time attribution: one record per super-step at
+        # the train_log_step cadence, decomposing wall into data_wait /
+        # stage_megabatch / dispatch / device_step / metric_readback /
+        # checkpoint + residual (obs/spans.py). The step callables are
+        # wrapped OUTSIDE their jit boundary — telemetry never enters the
+        # traced program (analysis rule ESR007).
+        from esr_tpu.obs.spans import StepAttribution
+        from esr_tpu.training.multistep import instrument_dispatch
+
+        self._attr = StepAttribution(
+            sink=self.sink, batch_size=b, log_step=self.train_log_step
+        )
+        self._stage_spans: Dict[int, float] = {}
+        self.train_step = instrument_dispatch(self.train_step, self._attr)
+        if self.multi_step is not None:
+            self.multi_step = instrument_dispatch(self.multi_step, self._attr)
         vis_cfg = trainer_cfg.get("vis", {}) or {}
         self.vis_enabled = bool(vis_cfg.get("enabled", False))
         self.train_vis_step = int(vis_cfg.get("train_img_writer_num", 20))
@@ -402,6 +453,19 @@ class Trainer:
             return stage_megabatch(mega, self.mesh)
         return [self._stage(b, for_train=True) for b in group]
 
+    def _stage_group_timed(self, group) -> object:
+        """:meth:`_stage_group` + a stage span record for the attribution.
+
+        Runs on the DevicePrefetcher's PRODUCER thread: the elapsed staging
+        time is parked under the group's id and picked up when the training
+        loop consumes that group — reported as an *overlapped* span (it ran
+        concurrently with earlier steps' device compute, so it does not
+        count against the super-step's wall-clock identity)."""
+        t0 = time.monotonic()
+        staged = self._stage_group(group)
+        self._stage_spans[id(group)] = time.monotonic() - t0
+        return staged
+
     def _log_images(self, batch: Dict[str, np.ndarray], pred: np.ndarray) -> None:
         """TensorBoard qualitative dump (reference :258-293)."""
         mid = self.mid_idx
@@ -516,6 +580,8 @@ class Trainer:
                 "nothing to train.",
                 self.start_iteration, self.iterations,
             )
+            if self.sink is not None:
+                self.sink.close()  # never activated; just release the file
             return {}
         epoch = 0
         iter_idx = self.start_iteration
@@ -554,22 +620,26 @@ class Trainer:
         last_scalars = {"loss": float("nan"), "mse": float("nan")}
 
         def consume(entry):
-            first, r, ep, metrics, vis_batch = entry
+            first, r, ep, metrics, vis_batch, bucket = entry
             # One host readback per SUPER-step (scalars only): the fused
             # path hands back {loss [r], loss_per_window [r, Wc], ...} in
             # a single small transfer; the single-step path (k_steps=1 or
-            # the epoch-tail remainder) a list of r per-step dicts.
-            if isinstance(metrics, list):
-                losses = [float(m["loss"]) for m in metrics]
-                mses = [float(m["loss_per_window"][-1]) for m in metrics]
-                last_pred_dev = metrics[-1]["last_pred"]
-            else:
-                losses = [float(v) for v in np.asarray(metrics["loss"])]
-                mses = [
-                    float(v)
-                    for v in np.asarray(metrics["loss_per_window"])[:, -1]
-                ]
-                last_pred_dev = metrics["last_pred"]
+            # the epoch-tail remainder) a list of r per-step dicts. This
+            # block is THE cadence-gated sync the attribution resolves
+            # against: its duration is the metric_readback span and its end
+            # stamps the non-blocking device_step span — no new host syncs.
+            with self._attr.resolving(bucket):
+                if isinstance(metrics, list):
+                    losses = [float(m["loss"]) for m in metrics]
+                    mses = [float(m["loss_per_window"][-1]) for m in metrics]
+                    last_pred_dev = metrics[-1]["last_pred"]
+                else:
+                    losses = [float(v) for v in np.asarray(metrics["loss"])]
+                    mses = [
+                        float(v)
+                        for v in np.asarray(metrics["loss_per_window"])[:, -1]
+                    ]
+                    last_pred_dev = metrics["last_pred"]
             for j in range(r):
                 k = first + j
                 loss, mse_loss = losses[j], mses[j]
@@ -615,126 +685,200 @@ class Trainer:
 
         from esr_tpu.data.loader import DevicePrefetcher, group_batches
 
-        while not stop:
-            self.train_loader.set_epoch(epoch)
-            # host->device upload pipelined ahead of the consuming step;
-            # the ExitStack guarantees the producer thread stops even when
-            # the for-loop breaks mid-epoch (early stop, final iteration).
-            # The source yields GROUPS of k_steps batches (k_steps=1:
-            # singleton groups — today's per-step pipeline exactly); a full
-            # group stages as one (k, B, L, ...) megabatch ahead of the
-            # consuming fused super-step.
-            with contextlib.ExitStack() as stack:
-                source = group_batches(self.train_loader, self.k_steps)
-                if self.device_prefetch:
-                    batches = stack.enter_context(DevicePrefetcher(
-                        source,
-                        self._stage_group,
-                        depth=self.device_prefetch,
-                        join_timeout=self.prefetch_join_timeout,
-                    ))
-                else:
-                    batches = ((g, self._stage_group(g)) for g in source)
-                for group, staged in batches:
-                    best = False
-                    r = len(group)
-                    if isinstance(staged, list):
-                        # k_steps=1, or the epoch-tail remainder (< k_steps
-                        # batches): r sequential single-step calls — static
-                        # shapes, no extra compile of the scanned program
-                        metrics = []
-                        for sb in staged:
-                            self.state, m = self.train_step(self.state, sb)
-                            metrics.append(m)
+        _END = object()  # sentinel: (group, None) is a real inline item
+
+        completed = False
+        try:
+            if self.sink is not None:
+                from esr_tpu.obs import set_active_sink
+
+                # process-wide activation for the components with no
+                # Trainer reference (retrace guard, prefetcher) — INSIDE
+                # the try so the finally's deactivation is unconditional:
+                # nothing may raise between install and uninstall
+                set_active_sink(self.sink)
+            while not stop:
+                self.train_loader.set_epoch(epoch)
+                # host->device upload pipelined ahead of the consuming step;
+                # the ExitStack guarantees the producer thread stops even when
+                # the loop breaks mid-epoch (early stop, final iteration).
+                # The source yields GROUPS of k_steps batches (k_steps=1:
+                # singleton groups — today's per-step pipeline exactly); a full
+                # group stages as one (k, B, L, ...) megabatch ahead of the
+                # consuming fused super-step. The inline (device_prefetch=0)
+                # path yields (group, None) and stages in the loop body so the
+                # stage_megabatch span is measured on the consumer thread.
+                with contextlib.ExitStack() as stack:
+                    source = group_batches(self.train_loader, self.k_steps)
+                    if self.device_prefetch:
+                        batches = stack.enter_context(DevicePrefetcher(
+                            source,
+                            self._stage_group_timed,
+                            depth=self.device_prefetch,
+                            join_timeout=self.prefetch_join_timeout,
+                        ))
                     else:
-                        # ONE dispatch for k_steps chained train steps
-                        self.state, metrics = self.multi_step(
-                            self.state, staged
-                        )
-                    first = iter_idx
-                    last = iter_idx + r - 1
-                    covered = range(first, last + 1)
-                    # cadences snap to super-step boundaries: due when ANY
-                    # covered iteration hits the configured multiple
-                    keep_vis = (
-                        self.writer is not None
-                        and self.vis_enabled
-                        and any(
-                            i % self.train_vis_step == 0 for i in covered
-                        )
-                    )
-                    pending.append(
-                        (first, r, epoch, metrics,
-                         group[-1] if keep_vis else None)
-                    )
-                    if len(pending) > self.train_lookahead:
-                        consume(pending.popleft())
-
-                    valid_due = (
-                        self.valid_loader is not None
-                        and any(
-                            i % self.valid_step == 0 and i != 0
-                            for i in covered
-                        )
-                    )
-                    save_due = any(
-                        i % self.save_period == 0 and i != 0
-                        for i in covered
-                    )
-                    final_due = last + 1 >= self.iterations
-                    if valid_due or save_due or final_due:
-                        drain()
-
-                    if valid_due:
-                        val_log = self._valid(valid_stamp)
-                        if self.writer is not None:
-                            # stamp-aligned train scalars (reference
-                            # :304-305)
-                            self.writer.add_scalar(
-                                "stamp_train_mse_loss",
-                                last_scalars["mse"],
-                                step=valid_stamp,
-                            )
-                            self.writer.add_scalar(
-                                "stamp_train_loss",
-                                last_scalars["loss"],
-                                step=valid_stamp,
-                            )
-                        logger.info(
-                            "Valid stamp %d: %s",
-                            valid_stamp,
-                            {k: round(v, 6) for k, v in val_log.items()},
-                        )
-                        stop, best = self.eval_model_performance(val_log)
-                        valid_stamp += 1
-                        if stop:
+                        batches = ((g, None) for g in source)
+                    it = iter(batches)
+                    while True:
+                        # one attribution bucket per super-step, opened before
+                        # the pull so the blocked wait is its data_wait span
+                        self._attr.begin()
+                        with self._attr.measure("data_wait"):
+                            item = next(it, _END)
+                        if item is _END:
+                            self._attr.discard()
                             break
+                        group, staged = item
+                        try:
+                            if staged is None:
+                                with self._attr.measure("stage_megabatch"):
+                                    staged = self._stage_group(group)
+                            else:
+                                # staged on the prefetcher's producer thread —
+                                # overlapped with earlier device compute, so it
+                                # reports but is excluded from the wall identity
+                                self._attr.add(
+                                    "stage_megabatch",
+                                    self._stage_spans.pop(id(group), 0.0),
+                                    overlapped=True,
+                                )
+                            best = False
+                            r = len(group)
+                            if isinstance(staged, list):
+                                # k_steps=1, or the epoch-tail remainder
+                                # (< k_steps batches): r sequential single-step
+                                # calls — static shapes, no extra compile of
+                                # the scanned program
+                                metrics = []
+                                for sb in staged:
+                                    self.state, m = self.train_step(
+                                        self.state, sb
+                                    )
+                                    metrics.append(m)
+                            else:
+                                # ONE dispatch for k_steps chained train steps
+                                self.state, metrics = self.multi_step(
+                                    self.state, staged
+                                )
+                            first = iter_idx
+                            last = iter_idx + r - 1
+                            covered = range(first, last + 1)
+                            # advance NOW (nothing below reads the old
+                            # value): the early-stop/final-iteration breaks
+                            # skip the loop tail, and train_end must report
+                            # the true trained count, matching checkpoints
+                            iter_idx = last + 1
+                            self._attr.note(first, r)
+                            # cadences snap to super-step boundaries: due when
+                            # ANY covered iteration hits the configured multiple
+                            keep_vis = (
+                                self.writer is not None
+                                and self.vis_enabled
+                                and any(
+                                    i % self.train_vis_step == 0 for i in covered
+                                )
+                            )
+                            pending.append(
+                                (first, r, epoch, metrics,
+                                 group[-1] if keep_vis else None,
+                                 self._attr.current)
+                            )
+                            if len(pending) > self.train_lookahead:
+                                consume(pending.popleft())
 
-                    saved_now = save_due or best
-                    if saved_now:
-                        self._save(last, best)
+                            valid_due = (
+                                self.valid_loader is not None
+                                and any(
+                                    i % self.valid_step == 0 and i != 0
+                                    for i in covered
+                                )
+                            )
+                            save_due = any(
+                                i % self.save_period == 0 and i != 0
+                                for i in covered
+                            )
+                            final_due = last + 1 >= self.iterations
+                            if valid_due or save_due or final_due:
+                                drain()
 
-                    if final_due:
-                        logger.info("Training completes!")
-                        # Final-state checkpoint — deliberate deviation from
-                        # the reference, which saves only on save_period
-                        # multiples (train_ours_cnt_seq.py:316-319) and so
-                        # loses up to save_period-1 trailing iterations of a
-                        # finished run. Under k_steps>1, when `iterations`
-                        # is not a super-step multiple the final fused
-                        # group trains up to k_steps-1 iterations past it;
-                        # the checkpoint records the TRUE last iteration so
-                        # resume stays consistent (docs/PERF.md).
-                        if not saved_now:
-                            self._save(last, False)
-                        stop = True
-                        break
-                    iter_idx = last + 1
-            epoch += 1
-        drain()
+                            if valid_due:
+                                with self._attr.measure("validate"):
+                                    val_log = self._valid(valid_stamp)
+                                if self.writer is not None:
+                                    # stamp-aligned train scalars (reference
+                                    # :304-305)
+                                    self.writer.add_scalar(
+                                        "stamp_train_mse_loss",
+                                        last_scalars["mse"],
+                                        step=valid_stamp,
+                                    )
+                                    self.writer.add_scalar(
+                                        "stamp_train_loss",
+                                        last_scalars["loss"],
+                                        step=valid_stamp,
+                                    )
+                                logger.info(
+                                    "Valid stamp %d: %s",
+                                    valid_stamp,
+                                    {k: round(v, 6) for k, v in val_log.items()},
+                                )
+                                stop, best = self.eval_model_performance(val_log)
+                                valid_stamp += 1
+                                if stop:
+                                    break
 
-        if profiling:
-            jax.profiler.stop_trace()
-        if self.writer is not None:
-            self.writer.close()
+                            saved_now = save_due or best
+                            if saved_now:
+                                with self._attr.measure("checkpoint"):
+                                    self._save(last, best)
+
+                            if final_due:
+                                logger.info("Training completes!")
+                                # Final-state checkpoint — deliberate deviation
+                                # from the reference, which saves only on
+                                # save_period multiples
+                                # (train_ours_cnt_seq.py:316-319) and so loses
+                                # up to save_period-1 trailing iterations of a
+                                # finished run. Under k_steps>1, when
+                                # `iterations` is not a super-step multiple the
+                                # final fused group trains up to k_steps-1
+                                # iterations past it; the checkpoint records
+                                # the TRUE last iteration so resume stays
+                                # consistent (docs/PERF.md).
+                                if not saved_now:
+                                    with self._attr.measure("checkpoint"):
+                                        self._save(last, False)
+                                stop = True
+                                break
+                        finally:
+                            # wall-clock end of this super-step's loop body
+                            # (idempotent; the bucket lives on in `pending`
+                            # until the deferred readback resolves it)
+                            self._attr.close()
+                epoch += 1
+            drain()
+            completed = True
+        finally:
+            # teardown is exception-safe: a crash mid-run must still
+            # stop the profiler, close the writer, and deactivate +
+            # close the telemetry sink — a leaked active sink would
+            # capture every later component in this process into a
+            # dead run's telemetry file
+            self._stage_spans.clear()
+            if profiling:
+                jax.profiler.stop_trace()
+            if self.writer is not None:
+                self.writer.close()
+            if self.sink is not None:
+                from esr_tpu.obs import active_sink, set_active_sink
+
+                self.sink.event(
+                    "train_end", iterations=iter_idx, epochs=epoch,
+                    attribution_records=self._attr.emitted_records,
+                    completed=completed,
+                )
+                if active_sink() is self.sink:
+                    set_active_sink(None)
+                self.sink.close()
         return self.train_metrics.result()

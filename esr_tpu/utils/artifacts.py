@@ -36,10 +36,26 @@ def probe_backend() -> Dict:
 
 
 def emit_jsonl(log_path: str, rec: Dict) -> Dict:
-    """UTC-stamp ``rec``, print it to stdout (flushed), append it to
-    ``log_path`` (creating parent dirs; I/O errors on the file never kill
-    the measurement). Returns the stamped record."""
-    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **rec}
+    """UTC-stamp and manifest-stamp ``rec``, print it to stdout (flushed),
+    append it to ``log_path`` (creating parent dirs; I/O errors on the file
+    never kill the measurement). Returns the stamped record.
+
+    Every record carries ``schema_version`` and the run ``manifest`` (host,
+    device kind, jax version — ``esr_tpu.obs.run_manifest``), so a stage
+    line is attributable to its environment on its own, without the
+    surrounding run's context; ``tests/test_bench_registry.py`` pins the
+    keys off-TPU. The manifest probe NEVER initializes a backend (this
+    helper must stay safe inside wedge-proof paths): records emitted before
+    backend contact carry null device fields, records after (every bench
+    stage past ``backend_up``) the real device kind."""
+    from esr_tpu.obs import SCHEMA_VERSION, run_manifest
+
+    rec = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "schema_version": SCHEMA_VERSION,
+        **rec,
+        "manifest": run_manifest(),
+    }
     print(json.dumps(rec))
     sys.stdout.flush()
     try:

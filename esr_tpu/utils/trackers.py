@@ -13,6 +13,8 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Dict, Iterable, Optional
 
+from esr_tpu.obs import active_sink
+
 
 class MetricTracker:
     """Totals / counts / running averages per key.
@@ -22,10 +24,19 @@ class MetricTracker:
     Unknown keys are created on first update (the reference requires
     pre-declared keys; auto-creation removes a foot-gun without changing any
     observable averages).
+
+    Unified telemetry (docs/OBSERVABILITY.md): a WRITERLESS tracker (e.g.
+    the Trainer's validation tracker) reports each update into the
+    structured obs sink directly (explicit ``sink`` argument; ``None``
+    falls back to the process-active sink at construction; ``False``
+    disables the mirror); a tracker WITH a writer does not — the writer
+    itself mirrors every scalar into the sink, and double records would
+    corrupt downstream aggregation.
     """
 
-    def __init__(self, keys: Iterable[str] = (), writer=None):
+    def __init__(self, keys: Iterable[str] = (), writer=None, sink=None):
         self.writer = writer
+        self.sink = active_sink() if sink is None else (sink or None)
         self._total: Dict[str, float] = {}
         self._count: Dict[str, int] = {}
         for k in keys:
@@ -40,6 +51,10 @@ class MetricTracker:
     def update(self, key: str, value: float, n: int = 1) -> None:
         if self.writer is not None:
             self.writer.add_scalar(key, value)
+        elif self.sink is not None:
+            # carry the weight: avg() is n-weighted, so a downstream mean
+            # over the telemetry records must be able to weight identically
+            self.sink.metric(key, float(value), source="tracker", n=n)
         self._total[key] = self._total.get(key, 0.0) + float(value) * n
         self._count[key] = self._count.get(key, 0) + n
 
@@ -80,6 +95,16 @@ class YamlLogger:
         with open(self.path, "w") as f:
             yaml.safe_dump(dict(self._info), f, sort_keys=False)
         self._closed = True
+        # unified telemetry: every written report is announced (path +
+        # payload) through the structured sink so a run's YAML artifacts
+        # are discoverable from its telemetry stream alone
+        sink = active_sink()
+        if sink is not None:
+            sink.event(
+                "yaml_report",
+                path=self.path,
+                sections=sorted(str(k) for k in self._info),
+            )
 
     def __enter__(self) -> "YamlLogger":
         return self

@@ -4,11 +4,19 @@ Rebuilds the reference's ``TensorboardWriter`` facade
 (``logger/visualization.py:5-73``): step/mode tagging via :meth:`set_step`,
 ``steps_per_sec`` emitted on every step advance, scalar + image logging.
 
-Two sinks:
+Three sinks:
 - **JSONL** (``metrics.jsonl`` in the log dir): one line per scalar —
   machine-readable, zero dependencies, survives any environment;
 - **TensorBoard** via ``torch.utils.tensorboard`` when importable and
-  ``tensorboard=True`` (the torch CPU wheel is baked into this image).
+  ``tensorboard=True`` (the torch CPU wheel is baked into this image);
+- the **structured telemetry sink** (``esr_tpu.obs``, docs/OBSERVABILITY.md):
+  every scalar/image record is mirrored into the unified obs sink so
+  training metrics, span attribution, prefetcher health, and compile
+  events land in ONE stream with one clock. ``sink`` semantics: an
+  explicit sink wins; ``None`` (default) falls back to the process-active
+  sink; ``False`` disables the mirror outright (the Trainer passes it when
+  ``trainer.telemetry`` is off, so a leftover active sink from another
+  component can never capture a run that opted out).
 """
 
 from __future__ import annotations
@@ -18,6 +26,8 @@ import os
 import time
 from typing import Optional
 
+from esr_tpu.obs import active_sink
+
 
 class MetricWriter:
     def __init__(
@@ -25,6 +35,7 @@ class MetricWriter:
         log_dir: str,
         logger=None,
         enable_tensorboard: bool = True,
+        sink=None,
     ):
         os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
@@ -32,6 +43,10 @@ class MetricWriter:
         self.mode = ""
         self._timer = time.perf_counter()
         self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        # unified telemetry: never owned here — the writer mirrors records
+        # into it but close() leaves it open for the rest of the run.
+        # None -> process-active fallback; False -> explicitly disabled
+        self.sink = active_sink() if sink is None else (sink or None)
 
         self.tb = None
         if enable_tensorboard:
@@ -73,6 +88,10 @@ class MetricWriter:
             + "\n"
         )
         self._jsonl.flush()
+        if self.sink is not None:
+            self.sink.metric(
+                self._tag(key), float(value), step=step, source="writer"
+            )
         if self.tb is not None:
             self.tb.add_scalar(self._tag(key), float(value), global_step=step)
 
@@ -84,6 +103,8 @@ class MetricWriter:
             json.dumps({"step": step, "tag": self._tag(key), "image": True})
             + "\n"
         )
+        if self.sink is not None:
+            self.sink.event("image", tag=self._tag(key), step=step)
         if self.tb is not None:
             fmt = "HWC" if getattr(image, "ndim", 2) == 3 else "HW"
             self.tb.add_image(self._tag(key), image, global_step=step, dataformats=fmt)

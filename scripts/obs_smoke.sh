@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Telemetry smoke: a 2-super-step synthetic-data CPU train (k_steps=4,
+# strict accounting mode) must produce a well-formed telemetry JSONL —
+# manifest header, one attribution record per super-step whose spans sum
+# to measured wall-clock within 5%, goodput in (0,1], compile events.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_obs_smoke.py) as a
+# standalone gate; schema + span taxonomy: docs/OBSERVABILITY.md.
+#
+# Usage: scripts/obs_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_smoke.py -q "$@"

@@ -311,6 +311,76 @@ def test_esr006_keyed_jax_rng_and_host_rng_are_clean():
 
 
 # ---------------------------------------------------------------------------
+# ESR007 telemetry in traced code
+
+
+def test_esr007_flags_obs_calls_in_traced_code():
+    src = (
+        "import jax\n"
+        "from esr_tpu import obs\n"
+        "from esr_tpu.obs import active_sink\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    obs.active_sink()\n"
+        "    s = active_sink()\n"
+        "    return x\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR007"]
+    assert len(findings) == 2
+    assert [f.line for f in findings] == [6, 7]
+
+
+def test_esr007_flags_obs_in_scan_body_and_import_form():
+    src = (
+        "import jax\n"
+        "import esr_tpu.obs\n"
+        "def body(c, x):\n"
+        "    esr_tpu.obs.active_sink()\n"
+        "    return c, x\n"
+        "jax.lax.scan(body, 0.0, None)\n"
+    )
+    assert "ESR007" in rules_hit(src)
+
+
+def test_esr007_host_code_obs_is_clean():
+    src = (
+        "from esr_tpu.obs import active_sink\n"
+        "def log_it(v):\n"
+        "    sink = active_sink()\n"
+        "    if sink is not None:\n"
+        "        sink.metric('x', v)\n"
+    )
+    assert "ESR007" not in rules_hit(src)
+
+
+def test_esr007_plain_obs_import_does_not_taint_the_package_root():
+    """`import esr_tpu.obs` binds the name `esr_tpu`; other esr_tpu.*
+    calls in traced code must NOT resolve under the obs prefix (the alias
+    map that backs ESR006 would produce exactly that false positive)."""
+    src = (
+        "import jax\n"
+        "import esr_tpu.obs\n"
+        "import esr_tpu.models\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return esr_tpu.models.apply(x)\n"
+        "def host():\n"
+        "    esr_tpu.obs.active_sink()\n"
+    )
+    assert "ESR007" not in rules_hit(src)
+    # ...while an as-alias into obs is still resolved and flagged
+    src2 = (
+        "import jax\n"
+        "import esr_tpu.obs as obs\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    obs.active_sink()\n"
+        "    return x\n"
+    )
+    assert "ESR007" in rules_hit(src2)
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 
 

@@ -12,7 +12,11 @@ window. These tests pin:
 - that ``_scan_steps_runner`` — the executable behind the headline
   ``scan_compute`` stage, ``scaling``, and ``breakdown`` — is the
   PRODUCTION ``make_multi_step`` in ``reuse_batch`` mode, not a private
-  copy of the chaining logic.
+  copy of the chaining logic;
+- the stage-record schema: every ``emit_jsonl`` line (the
+  ``BENCH_STAGES_*.jsonl`` records) carries ``schema_version`` and the run
+  manifest (host, device kind, jax version — ``esr_tpu.obs``), so schema
+  drift fails tier-1 off-TPU.
 """
 
 import contextlib
@@ -60,6 +64,32 @@ def test_headline_json_schema(monkeypatch):
     assert out["value"] == 17.33
     assert out["vs_baseline"] is None
     assert out["extra"] == {"mfu": 0.0016}
+
+
+def test_emit_jsonl_stamps_schema_version_and_manifest(tmp_path, capsys):
+    """Every BENCH_STAGES record must be attributable to its environment on
+    its own: schema_version + run manifest (host, device kind, jax version)
+    are stamped into each line, and the file line is byte-identical to the
+    stdout line the watcher sees."""
+    from esr_tpu.obs import SCHEMA_VERSION
+    from esr_tpu.utils.artifacts import emit_jsonl
+
+    log = str(tmp_path / "stages.jsonl")
+    rec = emit_jsonl(log, {"stage": "unit_probe", "ok": True})
+    printed = capsys.readouterr().out.strip()
+
+    assert rec["schema_version"] == SCHEMA_VERSION
+    man = rec["manifest"]
+    for key in ("host", "jax_version", "device_kind", "platform"):
+        assert key in man, key
+    assert man["jax_version"]  # import-only probe, always available
+    # envelope order: ts + schema first, payload, manifest last
+    assert list(rec)[:3] == ["ts", "schema_version", "stage"]
+    assert list(rec)[-1] == "manifest"
+    with open(log) as f:
+        file_line = f.read().strip()
+    assert json.loads(file_line) == rec
+    assert json.loads(printed) == rec
 
 
 class _TinyState(NamedTuple):

@@ -1,0 +1,579 @@
+"""esr_tpu.obs: sink round-trip, span math, instrumented producers, and the
+host-side-by-construction self-check.
+
+Covers the unit-level contracts of the telemetry subsystem
+(docs/OBSERVABILITY.md):
+
+- JSONL records parse back with a stable key order and a manifest header;
+- nested/overlapping spans aggregate correctly, goodput ∈ (0, 1],
+  ``k_steps>1`` emits exactly one attribution record per super-step (the
+  k ∈ {1, 2, 4} grouping fixtures of test_multistep.py);
+- the DevicePrefetcher health channel (stall counters, queue-depth gauges,
+  close summary) and the checked_jit compile events reach the active sink;
+- ``esr_tpu/obs`` is hazard-clean and NO ``obs`` call site appears inside a
+  jitted/scanned body anywhere in ``esr_tpu/`` (ESR007) — telemetry stays
+  host-side by construction.
+"""
+
+import json
+import os
+
+import pytest
+
+from esr_tpu.data.loader import group_batches
+from esr_tpu.obs import (
+    SCHEMA_VERSION,
+    StepAttribution,
+    TelemetrySink,
+    active_sink,
+    config_fingerprint,
+    run_manifest,
+    set_active_sink,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def sink(tmp_path):
+    """A real sink installed as process-active; always restored."""
+    s = TelemetrySink(str(tmp_path / "telemetry.jsonl"))
+    prev = set_active_sink(s)
+    yield s
+    set_active_sink(prev)
+    s.close()
+
+
+def read_records(s):
+    s.close()
+    return [json.loads(line) for line in open(s.path)]
+
+
+# ---------------------------------------------------------------------------
+# sink round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_sink_manifest_header_and_roundtrip(tmp_path):
+    s = TelemetrySink(
+        str(tmp_path / "t.jsonl"),
+        manifest=run_manifest(config_fingerprint="abc123"),
+    )
+    s.event("compile", fn="step", trace_count=1, elapsed_s=0.25)
+    s.gauge("prefetch_queue_depth", 2, gets=32, stalls=0)
+    s.metric("train_loss", 1.5, step=7, source="writer")
+    s.span("infer_forward", 0.004, recording="rec.h5", window=3)
+    recs = read_records(s)
+
+    man = recs[0]
+    assert man["type"] == "manifest" and man["name"] == "run"
+    assert man["schema_version"] == SCHEMA_VERSION
+    assert man["config_fingerprint"] == "abc123"
+    for key in ("host", "pid", "python", "jax_version",
+                "device_kind", "platform", "ts"):
+        assert key in man
+    # monotonic t increases; every record carries the envelope
+    ts = [r["t"] for r in recs]
+    assert ts == sorted(ts)
+    assert all(list(r)[:3] == ["t", "type", "name"] for r in recs)
+    by_type = {r["type"]: r for r in recs}
+    assert by_type["metric"]["value"] == 1.5 and by_type["metric"]["step"] == 7
+    assert by_type["span"]["seconds"] == 0.004
+
+
+def test_sink_stable_key_order(tmp_path):
+    """Two records of the same shape serialize identical key sequences —
+    payload keys sorted behind the fixed t/type/name prefix."""
+    s = TelemetrySink(str(tmp_path / "t.jsonl"))
+    s.event("x", zebra=1, alpha=2, mid=3)
+    s.event("x", mid=6, alpha=5, zebra=4)  # different kwarg order
+    recs = read_records(s)
+    assert list(recs[1]) == list(recs[2])
+    assert list(recs[1]) == ["t", "type", "name", "alpha", "mid", "zebra"]
+
+
+def test_sink_counter_totals_accumulate(tmp_path):
+    s = TelemetrySink(str(tmp_path / "t.jsonl"))
+    s.counter("prefetch_stall", waited_s=0.1)
+    s.counter("prefetch_stall", inc=2)
+    assert s.counter_total("prefetch_stall") == 3
+    recs = [r for r in read_records(s) if r["type"] == "counter"]
+    assert [r["total"] for r in recs] == [1, 3]
+    assert [r["inc"] for r in recs] == [1, 2]
+
+
+def test_sink_never_raises_after_close(tmp_path):
+    s = TelemetrySink(str(tmp_path / "t.jsonl"))
+    s.close()
+    s.event("late")  # dropped, not raised — telemetry must not kill the loop
+    assert s.dropped == 1
+
+
+def test_active_sink_registry_restores(tmp_path):
+    assert active_sink() is None
+    s = TelemetrySink(str(tmp_path / "t.jsonl"))
+    prev = set_active_sink(s)
+    try:
+        assert active_sink() is s
+    finally:
+        set_active_sink(prev)
+        s.close()
+    assert active_sink() is None
+
+
+def test_config_fingerprint_stable_and_order_insensitive():
+    a = config_fingerprint({"x": 1, "y": {"z": [1, 2]}})
+    b = config_fingerprint({"y": {"z": [1, 2]}, "x": 1})
+    c = config_fingerprint({"x": 2, "y": {"z": [1, 2]}})
+    assert a == b and a != c and len(a) == 16
+
+
+def test_run_manifest_never_initializes_a_backend():
+    """The manifest probe must be wedge-proof: jax version via import only,
+    device fields ONLY from an already-initialized backend (else null) —
+    and re-probed per call, so manifests stamped after backend contact
+    carry the real device kind."""
+    man = run_manifest()
+    assert man["jax_version"]
+    # before any jax op this may be null; it must never be wrong
+    assert man["platform"] in (None, "cpu")
+
+    import jax.numpy as jnp
+
+    float(jnp.ones(2).sum())  # backend contact
+    man = run_manifest()
+    assert man["platform"] == "cpu"  # conftest forces the CPU mesh
+    assert man["device_count"] == 8
+    assert man["device_kind"]
+
+
+# ---------------------------------------------------------------------------
+# span math
+# ---------------------------------------------------------------------------
+
+
+class _RecSink:
+    def __init__(self):
+        self.records = []
+
+    def attribution(self, rec):
+        self.records.append(rec)
+
+
+def _fake_clock():
+    clk = {"t": 0.0}
+
+    def clock():
+        return clk["t"]
+
+    def advance(dt):
+        clk["t"] += dt
+
+    return clock, advance
+
+
+def test_span_attribution_accounting_identity():
+    clock, advance = _fake_clock()
+    out = _RecSink()
+    attr = StepAttribution(sink=out, batch_size=2, log_step=1, clock=clock)
+
+    bucket = attr.begin()
+    with attr.measure("data_wait"):
+        advance(0.10)
+    with attr.measure("stage_megabatch"):
+        advance(0.05)
+    with attr.measure("dispatch"):
+        advance(0.02)
+    attr.dispatched()
+    attr.note(0, 4)
+    with attr.resolving(bucket):
+        advance(0.50)
+    with attr.measure("checkpoint"):
+        advance(0.08)
+    advance(0.01)  # unattributed host bookkeeping -> residual
+    attr.close()
+
+    [rec] = out.records
+    assert rec["first_iteration"] == 0 and rec["k"] == 4
+    assert rec["wall_s"] == pytest.approx(0.76)
+    assert rec["data_wait_s"] == pytest.approx(0.10)
+    assert rec["stage_megabatch_s"] == pytest.approx(0.05)
+    assert rec["dispatch_s"] == pytest.approx(0.02)
+    assert rec["device_step_s"] == pytest.approx(0.50)
+    assert rec["metric_readback_s"] == pytest.approx(0.50)  # nested tail
+    assert rec["checkpoint_s"] == pytest.approx(0.08)
+    assert rec["residual_s"] == pytest.approx(0.01)
+    # the published identity: spans + residual == wall
+    accounted = (
+        rec["data_wait_s"] + rec["stage_megabatch_s"] + rec["dispatch_s"]
+        + rec["device_step_s"] + rec["checkpoint_s"] + rec["validate_s"]
+        + rec["residual_s"]
+    )
+    assert accounted == pytest.approx(rec["wall_s"], rel=1e-6)
+    assert rec["samples_per_sec"] == pytest.approx(4 * 2 / 0.76, rel=1e-3)
+    assert 0.0 < rec["goodput"] <= 1.0
+    assert rec["goodput"] == pytest.approx(0.50 / 0.76, rel=1e-3)
+
+
+def test_span_nested_and_overlapping_spans_aggregate():
+    clock, advance = _fake_clock()
+    attr = StepAttribution(clock=clock)
+    bucket = attr.begin()
+    with attr.measure("outer"):
+        advance(0.1)
+        with attr.measure("inner"):  # nested: both record their full span
+            advance(0.2)
+        advance(0.1)
+    with attr.measure("inner"):  # repeated name accumulates
+        advance(0.05)
+    assert bucket.spans["outer"] == pytest.approx(0.4)
+    assert bucket.spans["inner"] == pytest.approx(0.25)
+
+
+def test_span_overlapped_stage_excluded_from_identity():
+    """Producer-thread staging overlaps device compute: reported, flagged,
+    and excluded from the wall accounting (residual stays meaningful)."""
+    clock, advance = _fake_clock()
+    out = _RecSink()
+    attr = StepAttribution(sink=out, log_step=1, clock=clock)
+    bucket = attr.begin()
+    attr.add("stage_megabatch", 0.30, overlapped=True)
+    with attr.measure("data_wait"):
+        advance(0.01)
+    with attr.measure("dispatch"):
+        advance(0.01)
+    attr.dispatched()
+    attr.note(0, 1)
+    with attr.resolving(bucket):
+        advance(0.10)
+    attr.close()
+    [rec] = out.records
+    assert rec["stage_overlapped"] is True
+    assert rec["stage_megabatch_s"] == pytest.approx(0.30)
+    # residual ~0: the overlapped 0.30s did NOT count against wall
+    assert abs(rec["residual_s"]) < 1e-6
+
+
+def test_span_goodput_clamped_under_lookahead():
+    """With train_lookahead > 0 the readback resolves AFTER the body closed;
+    the device span exceeds the bucket's wall and goodput clamps to 1."""
+    clock, advance = _fake_clock()
+    out = _RecSink()
+    attr = StepAttribution(sink=out, log_step=1, clock=clock)
+    bucket = attr.begin()
+    with attr.measure("dispatch"):
+        advance(0.01)
+    attr.dispatched()
+    attr.note(0, 1)
+    attr.close()  # body ends; metrics still in flight
+    assert out.records == []  # not emitted until resolved
+    advance(0.5)  # later iterations run meanwhile
+    with attr.resolving(bucket):
+        advance(0.01)
+    [rec] = out.records
+    assert rec["device_step_s"] == pytest.approx(0.51)
+    assert rec["goodput"] == 1.0
+    assert rec["residual_s"] < 0  # documented: overlap makes it negative
+
+
+def test_span_cadence_gating_matches_log_step():
+    """Emission snaps to train_log_step exactly like the loss line: due
+    when ANY covered iteration hits the multiple."""
+    clock, advance = _fake_clock()
+    out = _RecSink()
+    attr = StepAttribution(sink=out, log_step=8, clock=clock)
+    emitted = []
+    for first in range(0, 24, 4):  # k=4 super-steps over 24 iterations
+        bucket = attr.begin()
+        with attr.measure("dispatch"):
+            advance(0.01)
+        attr.dispatched()
+        attr.note(first, 4)
+        with attr.resolving(bucket):
+            advance(0.01)
+        attr.close()
+        emitted.append(len(out.records))
+    # super-steps covering iterations {0..3}, {8..11}, {16..19} are due
+    assert emitted == [1, 1, 2, 2, 3, 3]
+    assert [r["first_iteration"] for r in out.records] == [0, 8, 16]
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_one_attribution_record_per_super_step(k):
+    """The test_multistep grouping fixtures: 8 batches through
+    group_batches(k) must yield exactly one record per super-step, covering
+    every iteration exactly once, whatever k."""
+    clock, advance = _fake_clock()
+    out = _RecSink()
+    attr = StepAttribution(sink=out, batch_size=2, log_step=1, clock=clock)
+    batches = [{"idx": i} for i in range(8)]
+    it = 0
+    for group in group_batches(batches, k):
+        bucket = attr.begin()
+        with attr.measure("data_wait"):
+            advance(0.01)
+        with attr.measure("dispatch"):
+            advance(0.02)
+        attr.dispatched()
+        attr.note(it, len(group))
+        with attr.resolving(bucket):
+            advance(0.05)
+        attr.close()
+        it += len(group)
+    assert len(out.records) == -(-8 // k)
+    covered = [
+        i for r in out.records
+        for i in range(r["first_iteration"], r["first_iteration"] + r["k"])
+    ]
+    assert covered == list(range(8))
+    for rec in out.records:
+        assert 0.0 < rec["goodput"] <= 1.0
+
+
+def test_attribution_noop_without_bucket():
+    """Instrumented steps run outside the loop (tests, bench): every hook
+    must be a silent no-op with no open bucket."""
+    attr = StepAttribution()
+    with attr.measure("dispatch"):
+        pass
+    attr.dispatched()
+    attr.note(0, 1)
+    attr.add("x", 1.0)
+    attr.close()
+    with attr.resolving(None):
+        pass
+    assert attr.emitted_records == 0
+
+
+def test_instrument_dispatch_wraps_and_delegates():
+    from esr_tpu.training.multistep import instrument_dispatch
+
+    clock, advance = _fake_clock()
+    attr = StepAttribution(clock=clock)
+
+    def step(state, batch):
+        advance(0.125)
+        return state + 1, {"loss": batch}
+
+    step.retrace_counter = "sentinel"
+    wrapped = instrument_dispatch(step, attr)
+    assert wrapped.retrace_counter == "sentinel"  # attribute delegation
+
+    bucket = attr.begin()
+    out = wrapped(0, "b")
+    assert out == (1, {"loss": "b"})
+    assert bucket.spans["dispatch"] == pytest.approx(0.125)
+    assert bucket.t_dispatch == pytest.approx(0.125)
+
+
+# ---------------------------------------------------------------------------
+# instrumented producers
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_health_channel(sink):
+    import time as _time
+
+    from esr_tpu.data.loader import DevicePrefetcher
+
+    def slow_source():
+        for i in range(4):
+            _time.sleep(0.05)  # producer slower than consumer -> stalls
+            yield {"x": i}
+
+    with DevicePrefetcher(
+        slow_source(), lambda b: b["x"] * 10, depth=2, gauge_every=2
+    ) as pf:
+        got = [staged for _, staged in pf]
+    assert got == [0, 10, 20, 30]
+    assert pf.stalls >= 1 and pf.stall_s > 0
+
+    recs = read_records(sink)
+    stalls = [r for r in recs if r["name"] == "prefetch_stall"]
+    assert stalls and all(r["type"] == "counter" for r in stalls)
+    assert stalls[-1]["total"] == pf.stalls
+    assert all(r["waited_s"] >= 0 for r in stalls)
+    gauges = [r for r in recs if r["name"] == "prefetch_queue_depth"]
+    assert gauges and all(r["type"] == "gauge" for r in gauges)
+    closes = [r for r in recs if r["name"] == "prefetch_close"]
+    assert len(closes) == 1  # close() is idempotent; summary emits once
+    assert closes[0]["gets"] == pf.gets
+    assert closes[0]["stalls"] == pf.stalls
+    assert closes[0]["joined"] is True
+
+
+def test_prefetcher_join_timeout_records_event(sink):
+    import threading
+
+    from esr_tpu.data.loader import DevicePrefetcher
+
+    release = threading.Event()
+
+    def blocking_stage(b):
+        release.wait(10)  # a stage_fn wedged in a device transfer
+        return b
+
+    pf = DevicePrefetcher([{"x": 1}], blocking_stage, depth=1,
+                          join_timeout=0.1)
+    with pytest.warns(UserWarning, match="did not stop"):
+        pf.close()
+    release.set()
+    recs = read_records(sink)
+    misses = [r for r in recs if r["name"] == "prefetch_join_timeout"]
+    assert len(misses) == 1 and misses[0]["timeout_s"] == 0.1
+    closes = [r for r in recs if r["name"] == "prefetch_close"]
+    assert len(closes) == 1 and closes[0]["joined"] is False
+
+
+def test_checked_jit_emits_compile_events(sink):
+    import jax.numpy as jnp
+
+    from esr_tpu.analysis import checked_jit
+
+    jf = checked_jit(lambda x: x * 2, max_traces=4, name="obs_probe")
+    jf(jnp.zeros((2,)))
+    jf(jnp.zeros((2,)))  # cache hit: no new trace, no new event
+    jf(jnp.zeros((3,)))  # fresh shape: retrace
+    recs = read_records(sink)
+    compiles = [
+        r for r in recs
+        if r["name"] == "compile" and r["fn"] == "obs_probe"
+    ]
+    assert [c["trace_count"] for c in compiles] == [1, 2]
+    assert all(c["elapsed_s"] >= 0 for c in compiles)
+    assert all(c["max_traces"] == 4 for c in compiles)
+
+
+def test_writer_tracker_sink_false_disables_fallback(sink, tmp_path):
+    """sink=False must mean DISABLED, not 'fall back to the active sink':
+    a run that opted out (trainer.telemetry: false) can never be captured
+    by a leftover process-active sink."""
+    from esr_tpu.utils.trackers import MetricTracker
+    from esr_tpu.utils.writer import MetricWriter
+
+    w = MetricWriter(str(tmp_path / "off"), enable_tensorboard=False,
+                     sink=False)
+    assert w.sink is None
+    w.add_scalar("loss", 1.0)
+    w.close()
+    mt = MetricTracker(["loss"], sink=False)
+    assert mt.sink is None
+    mt.update("loss", 2.0)
+    recs = read_records(sink)
+    assert not [r for r in recs if r["type"] == "metric"]
+
+
+def test_tracker_sink_mirror_carries_update_weight(sink):
+    """update(key, value, n) weights avg() by n; the mirrored record must
+    carry n so a downstream mean can weight identically."""
+    from esr_tpu.utils.trackers import MetricTracker
+
+    mt = MetricTracker(["loss"], sink=sink)
+    mt.update("loss", 0.5, n=9)
+    mt.update("loss", 1.0)
+    assert mt.avg("loss") == pytest.approx(0.55)
+    recs = [r for r in read_records(sink) if r["type"] == "metric"]
+    assert [(r["value"], r["n"]) for r in recs] == [(0.5, 9), (1.0, 1)]
+    weighted = sum(r["value"] * r["n"] for r in recs) / sum(
+        r["n"] for r in recs
+    )
+    assert weighted == pytest.approx(mt.avg("loss"))
+
+
+def test_inference_tracker_does_not_double_report(sink):
+    """InferenceRunner's aggregation tracker opts out of the sink: the
+    infer_forward span is the one authoritative latency series."""
+    import inspect
+
+    from esr_tpu.inference import harness
+
+    src = inspect.getsource(harness.InferenceRunner.run_recording)
+    assert "MetricTracker(keys, sink=False)" in src
+
+
+def test_writer_tracker_yaml_route_through_sink(sink, tmp_path):
+    from esr_tpu.utils.trackers import MetricTracker, YamlLogger
+    from esr_tpu.utils.writer import MetricWriter
+
+    w = MetricWriter(str(tmp_path / "w"), enable_tensorboard=False, sink=sink)
+    w.set_step(3)
+    w.add_scalar("train_loss", 1.25)
+    w.close()  # closes metrics.jsonl, NOT the shared sink
+
+    # writerless tracker -> sink directly; writer-backed tracker must NOT
+    # double-write (the writer already mirrored it)
+    mt = MetricTracker(["valid_loss"], sink=sink)
+    mt.update("valid_loss", 0.5)
+    mtw = MetricTracker(["train_loss"], writer=w, sink=sink)
+    w2 = MetricWriter(str(tmp_path / "w2"), enable_tensorboard=False,
+                      sink=None)  # falls back to the active sink
+    assert w2.sink is sink
+
+    with YamlLogger(str(tmp_path / "report.yml")) as yl:
+        yl.log_info("hello")
+        yl.log_dict({"esr_mse": 0.5}, "results")
+
+    recs = read_records(sink)
+    metrics = [r for r in recs if r["type"] == "metric"]
+    train = [r for r in metrics if r["name"] == "train_loss/train"]
+    assert len(train) == 1 and train[0]["source"] == "writer"
+    assert train[0]["step"] == 3 and train[0]["value"] == 1.25
+    valid = [r for r in metrics if r["name"] == "valid_loss"]
+    assert len(valid) == 1 and valid[0]["source"] == "tracker"
+    assert valid[0]["n"] == 1  # update weight rides along (avg() weights)
+    reports = [r for r in recs if r["name"] == "yaml_report"]
+    assert len(reports) == 1
+    assert reports[0]["sections"] == ["info", "results"]
+    assert mtw.sink is sink  # attached, but the writer path owns emission
+
+
+# ---------------------------------------------------------------------------
+# host-side by construction (the analysis self-check)
+# ---------------------------------------------------------------------------
+
+
+def test_obs_package_is_hazard_clean():
+    """esr_tpu/obs must be clean under EVERY analysis rule — in particular
+    ESR002 (it may never host-sync) and ESR004-adjacent purity (stdlib
+    only, so it stays importable from the data layer)."""
+    from esr_tpu.analysis import analyze_paths
+
+    findings = analyze_paths(
+        [os.path.join(REPO_ROOT, "esr_tpu", "obs")], relative_to=REPO_ROOT
+    )
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_no_obs_call_sites_in_traced_code_repo_wide():
+    """ESR007 over the whole package: no esr_tpu.obs call may appear inside
+    a jitted/scanned body anywhere in esr_tpu/ — telemetry is host-side by
+    construction, not by convention."""
+    from esr_tpu.analysis import analyze_paths
+
+    findings = [
+        f
+        for f in analyze_paths(
+            [os.path.join(REPO_ROOT, "esr_tpu")], relative_to=REPO_ROOT
+        )
+        if f.rule == "ESR007"
+    ]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_obs_package_is_stdlib_only():
+    """Import-graph purity: pulling esr_tpu.obs alone must not import jax
+    or numpy (CI hosts and loader workers depend on it)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import esr_tpu.obs\n"
+        "bad = [m for m in ('jax', 'numpy') if m in sys.modules]\n"
+        "assert not bad, bad\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
