@@ -1076,6 +1076,111 @@ def stage_e2e(ctx, device_rasterize=False):
                 "feed_method": "device_prefetcher_depth2"}
 
 
+# The infer_throughput stage record schema, pinned by test_bench_registry
+# so the inference perf trajectory stays machine-comparable across rounds.
+INFER_THROUGHPUT_KEYS = (
+    "seq_windows_per_sec", "engine_windows_per_sec", "speedup",
+    "windows", "recordings", "lanes", "chunk_windows",
+)
+
+
+def stage_infer_throughput(ctx):
+    """Inference throughput: batched StreamingEngine vs the sequential
+    harness (windows/s) on the same synthetic workload — the perf
+    trajectory's first inference-side series (ISSUE 4).
+
+    The workload is deliberately tiny and dispatch-bound (basech=2 at the
+    down8 rung): the sequential loop pays one forward dispatch + one
+    metrics dispatch + a latency-probe sync PER WINDOW, the engine one
+    dispatch + one readback per ``lanes x chunk_windows`` windows
+    (docs/INFERENCE.md). Dispatch amortization alone must clear ~2x even
+    on CPU; over the tunnel the per-call floor (docs/PERF.md) makes the
+    gap the whole story. Both paths consume identical recordings and
+    dataset config and both are timed warm (the first pass compiles)."""
+    import jax
+
+    from esr_tpu.data.synthetic import write_synthetic_h5
+    from esr_tpu.inference.engine import StreamingEngine
+    from esr_tpu.inference.harness import InferenceRunner
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    # lanes never exceed recordings: an idle lane is pure wasted compute
+    lanes = 2 if ctx.smoke else 4
+    chunk_windows = 4 if ctx.smoke else 8
+    base_events = (512, 768) if ctx.smoke else (2048, 3000, 1400, 2400)
+    cfg = {
+        "scale": 2,
+        "ori_scale": "down8",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 128,
+        "sliding_window": 64,
+        "need_gt_events": True,
+        "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {"sequence_length": 4, "seqn": 3, "step_size": None,
+                     "pause": {"enabled": False}},
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = []
+        for i, ev in enumerate(base_events):
+            p = os.path.join(tmp, f"rec{i}.h5")
+            write_synthetic_h5(p, (64, 64), base_events=ev, num_frames=6,
+                               seed=i)
+            paths.append(p)
+
+        model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+        states = model.init_states(1, 16, 16)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 3, 16, 16, 2), np.float32), states,
+        )
+
+        runner = InferenceRunner(model, params, seqn=3)
+        runner.run_recording(paths[0], cfg, report=False)  # warm/compile
+        windows_box = [0.0]
+
+        def run_seq():
+            t0 = time.perf_counter()
+            seq_results = [
+                runner.run_recording(p, cfg, report=False) for p in paths
+            ]
+            windows_box[0] = sum(r["n_windows"] for r in seq_results)
+            return time.perf_counter() - t0
+
+        engine = StreamingEngine(
+            model, params, seqn=3, lanes=lanes, chunk_windows=chunk_windows
+        )
+        engine.run_datalist(paths[:1], cfg)  # warm/compile (B/W static)
+
+        def run_engine():
+            t0 = time.perf_counter()
+            engine.run_datalist(paths, cfg)
+            return time.perf_counter() - t0
+
+        # best-of-reps, same rationale as every other timing stage: a
+        # shared/contended host only ever ADDS time, and one noisy window
+        # must not torch the round's inference series
+        t_seq = _best_of_reps(run_seq, reps=2)
+        t_eng = _best_of_reps(run_engine, reps=2)
+        windows = windows_box[0]
+
+    # built through the pinned schema so the record and the test contract
+    # cannot drift apart silently
+    res = dict(zip(INFER_THROUGHPUT_KEYS, (
+        round(windows / t_seq, 2),
+        round(windows / t_eng, 2),
+        round(t_seq / t_eng, 3),
+        int(windows),
+        len(paths),
+        lanes,
+        chunk_windows,
+    ), strict=True))
+    EXTRA["infer_throughput"] = dict(res)
+    return res
+
+
 # Declarative stage registry — the single source of truth main() iterates
 # (tier-1's test_bench_registry imports it to pin names/order/timeouts, so
 # a wiring regression — a stage dropped, renamed, or starved of timeout —
@@ -1108,6 +1213,9 @@ STAGE_REGISTRY = [
      lambda ctx: stage_e2e(ctx, device_rasterize=True), 900, False),
     ("scaling", stage_scaling, 1200, True),
     ("breakdown", stage_breakdown, 900, True),
+    # inference-side throughput: engine vs sequential harness on synthetic
+    # recordings (tiny + dispatch-bound by design, so it runs in smoke too)
+    ("infer_throughput", stage_infer_throughput, 900, True),
 ]
 
 

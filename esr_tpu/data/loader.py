@@ -24,6 +24,7 @@ design:
 
 from __future__ import annotations
 
+import os
 import queue
 import time
 from collections import deque
@@ -235,6 +236,178 @@ class InferenceSequenceLoader:
                 yield overlapping_windows(batch, self.seqn)
             else:
                 yield batch
+
+
+class LanePackedChunks:
+    """Lane-packed window chunks for batched streaming inference.
+
+    The host half of the :class:`esr_tpu.inference.engine.StreamingEngine`:
+    ``B = lanes`` recordings stream concurrently, one per batch lane, and
+    ``W = chunk_windows`` consecutive seqn-windows per lane are stacked into
+    ONE ``{key: (W, B, ...)}`` chunk — the scan-axis-leading megabatch the
+    engine's fused chunk program consumes in a single dispatch. Pure numpy
+    (data layer stays accelerator-free, ESR004); device staging belongs to
+    the consumer's ``DevicePrefetcher`` ``stage_fn``.
+
+    Scheduling contract (mirrored by the engine's accounting):
+
+    - each recording is assigned to exactly ONE lane and streamed in window
+      order, so per-recording metrics reassemble exactly;
+    - lane refill happens only at CHUNK boundaries: when a recording ends
+      mid-chunk its lane's remaining windows are zero-padded with
+      ``valid = 0`` (masked windows must contribute zero metric weight),
+      and the next chunk assigns the next pending recording to that lane
+      with ``reset_keep = 0`` (the engine zeroes that lane's recurrent
+      state — recurrent context must never leak across recordings);
+    - within one chunk a lane therefore carries windows of at most one
+      recording, which is what lets the engine accumulate metric SUMS per
+      lane on device and still attribute them per recording;
+    - idle lanes (fewer live recordings than lanes) are fully masked and
+      reset.
+
+    Every chunk dict carries:
+
+    - ``windows``: ``{"inp_scaled": (W, B, seqn, h, w, c), "gt":
+      (W, B, kh, kw, c), "inp_mid": (W, B, lh, lw, c), "valid": (W, B)}``
+      — the per-window model input, the GT count image of the middle
+      frame, the LR middle-frame counts (bicubic-baseline input), and the
+      float validity mask;
+    - ``reset_keep``: ``(B,)`` — 1 where the lane continues its recording,
+      0 where its recurrent state must be zeroed (refill / idle);
+    - ``meta``: per-lane ``{"recording", "path", "windows"}`` (or None for
+      idle lanes) — the host-side attribution map.
+    """
+
+    def __init__(
+        self,
+        recordings: Sequence[str],
+        config: Dict,
+        lanes: int = 4,
+        chunk_windows: int = 8,
+    ):
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if chunk_windows < 1:
+            raise ValueError(
+                f"chunk_windows must be >= 1, got {chunk_windows}"
+            )
+        if not recordings:
+            raise ValueError("empty recording list")
+        self.recordings = list(recordings)
+        # the engine consumes only these three streams; restricting
+        # item_keys skips building the unused encodings (values of the
+        # kept keys are identical — item_keys selects, never transforms)
+        self.config = dict(config)
+        self.config.setdefault(
+            "item_keys", ["inp_scaled_cnt", "gt_cnt", "inp_cnt"]
+        )
+        self.lanes = int(lanes)
+        self.chunk_windows = int(chunk_windows)
+        self.seqn = int(config["sequence"].get("seqn", 3))
+        self.mid_idx = (self.seqn - 1) // 2
+        # probe the shared ladder once; every lane loader must match it
+        # (ragged lanes cannot be stacked into one static-shape chunk)
+        probe = ConcatSequenceDataset([self.recordings[0]], self.config)
+        self.inp_resolution = probe.inp_resolution
+        self.gt_resolution = probe.gt_resolution
+
+    def _windows(self, path: str) -> Iterator[tuple]:
+        """One recording -> (inp_scaled, gt_mid, inp_mid) window tuples, in
+        stream order (the sequential harness's ``inputs_seq[0]`` slice)."""
+        loader = InferenceSequenceLoader(path, self.config)
+        if (tuple(loader.gt_resolution) != tuple(self.gt_resolution)
+                or tuple(loader.inp_resolution)
+                != tuple(self.inp_resolution)):
+            raise ValueError(
+                f"recording {path} resolution "
+                f"{loader.inp_resolution}->{loader.gt_resolution} does not "
+                f"match the pack's {self.inp_resolution}->"
+                f"{self.gt_resolution}; lane-packing needs a homogeneous "
+                "datalist (run ragged datalists in sequential mode)"
+            )
+        for batch in loader:
+            yield (
+                np.asarray(batch["inp_scaled_cnt"][0, : self.seqn],
+                           np.float32),
+                np.asarray(batch["gt_cnt"][0, self.mid_idx], np.float32),
+                np.asarray(batch["inp_cnt"][0, self.mid_idx], np.float32),
+            )
+
+    def __iter__(self) -> Iterator[Dict]:
+        W, B = self.chunk_windows, self.lanes
+        pending = deque(self.recordings)
+        lanes: List[Optional[Dict]] = [None] * B
+        shapes = None  # (inp_scaled, gt, inp_mid) per-window shapes
+        while True:
+            reset_keep = np.ones(B, np.float32)
+            for i in range(B):
+                if lanes[i] is None:
+                    reset_keep[i] = 0.0  # refill or idle: zero the state
+                    if pending:
+                        path = pending.popleft()
+                        lanes[i] = {
+                            "path": path,
+                            "name": os.path.basename(path),
+                            "it": self._windows(path),
+                        }
+            per_lane: List[List[tuple]] = [[] for _ in range(B)]
+            meta: List[Optional[Dict]] = [None] * B
+            for i in range(B):
+                lane = lanes[i]
+                if lane is None:
+                    continue
+                wins = per_lane[i]
+                while len(wins) < W:
+                    if "peek" in lane:
+                        wins.append(lane.pop("peek"))
+                        continue
+                    try:
+                        wins.append(next(lane["it"]))
+                    except StopIteration:
+                        lanes[i] = None  # refilled at the NEXT boundary
+                        break
+                else:
+                    # full chunk: probe one window ahead so a recording
+                    # whose length is an exact multiple of chunk_windows
+                    # frees its lane NOW — otherwise the exhaustion would
+                    # only surface next chunk, costing one fully-masked
+                    # (pure-padding-compute) chunk before refill
+                    try:
+                        lane["peek"] = next(lane["it"])
+                    except StopIteration:
+                        lanes[i] = None
+                meta[i] = {
+                    "recording": lane["name"],
+                    "path": lane["path"],
+                    "windows": len(wins),
+                }
+            total = sum(len(w) for w in per_lane)
+            if total == 0:
+                if not pending and all(lane is None for lane in lanes):
+                    return
+                continue  # all assigned recordings were empty; refill
+            if shapes is None:
+                first = next(w[0] for w in per_lane if w)
+                shapes = tuple(a.shape for a in first)
+            arrays = [
+                np.zeros((W, B) + s, np.float32) for s in shapes
+            ]
+            valid = np.zeros((W, B), np.float32)
+            for i, wins in enumerate(per_lane):
+                for t, win in enumerate(wins):
+                    for arr, a in zip(arrays, win):
+                        arr[t, i] = a
+                    valid[t, i] = 1.0
+            yield {
+                "windows": {
+                    "inp_scaled": arrays[0],
+                    "gt": arrays[1],
+                    "inp_mid": arrays[2],
+                    "valid": valid,
+                },
+                "reset_keep": reset_keep,
+                "meta": meta,
+            }
 
 
 # ---- multi-process batch building -----------------------------------------

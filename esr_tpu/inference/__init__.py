@@ -1,5 +1,6 @@
-"""Inference / evaluation harness."""
+"""Inference / evaluation: sequential harness + batched streaming engine."""
 
+from esr_tpu.inference.engine import StreamingEngine
 from esr_tpu.inference.harness import (
     InferenceRunner,
     aggregate_results,
@@ -13,6 +14,7 @@ from esr_tpu.inference.export import (
 
 __all__ = [
     "InferenceRunner",
+    "StreamingEngine",
     "aggregate_results",
     "run_inference",
     "export_checkpoint",

@@ -1,0 +1,316 @@
+"""Batched streaming inference: lane-packed recordings, scan-fused windows.
+
+The sequential harness (``esr_tpu.inference.harness``) is the
+reference-shaped loop: one python-dispatched forward per window per
+recording at batch 1, plus a second per-window ``_metrics`` jit — exactly
+the dispatch-bound regime the K-step fused training path (PR 2,
+docs/PERF.md) eliminated on the training side. This module is the
+inference counterpart:
+
+- **lane packing** — ``B = lanes`` recordings stream concurrently, one per
+  batch lane of a single ``(B, ...)`` forward, each lane carrying its own
+  recurrent state. Lanes refill from the pending datalist at chunk
+  boundaries (per-lane state reset on refill); a lane whose recording ends
+  mid-chunk is zero-padded with a validity mask so masked windows
+  contribute zero metric weight (``esr_tpu.data.loader.LanePackedChunks``
+  owns the host-side scheduling contract).
+- **scan fusion** — ``W = chunk_windows`` consecutive windows per lane run
+  inside ONE device program: the chunk program reuses the production
+  ``make_multi_step``/``lax.scan`` machinery from
+  ``esr_tpu.training.multistep`` with the recurrent state in the donated
+  scan carry, so the host pays one dispatch per ``B x W`` windows instead
+  of one (plus a metrics jit) per window.
+- **on-device metric accumulation** — per-window l1/mse/psnr/ssim (ESR and
+  the bicubic baseline) are computed per lane inside the scanned program
+  and accumulated into per-lane sums + valid-window counts riding the scan
+  carry; the host reads back one small pytree per CHUNK instead of eight
+  scalars per window. Per-window SSIM pairs additionally come back stacked
+  (``(W, B)``) because the report's paired-delta diagnostics
+  (``ssim_delta_*``, per-series stds — see the harness) are sample
+  statistics the host computes with the same numpy code as the sequential
+  path.
+- **host/device overlap** — the chunk iterator feeds the existing
+  ``DevicePrefetcher``: a producer thread rasterizes and stages chunk
+  ``i+1`` while the device runs chunk ``i``, and chunk readbacks resolve
+  one chunk behind dispatch (the same pending-deque idiom the sequential
+  harness uses per window).
+
+The engine is a drop-in producer for the report pipeline: per-recording
+results carry the exact schema of ``InferenceRunner.run_recording`` (metric
+means, ``time``/``params``, rmse at the aggregation boundary, window
+diagnostics) and feed the same ``aggregate_results``/YAML writers, with
+per-chunk ``infer_chunk`` telemetry spans (lanes, valid windows, windows/s)
+replacing the sequential path's per-window ``infer_forward`` span
+(docs/OBSERVABILITY.md, docs/INFERENCE.md).
+
+Not supported in engine mode (use the sequential harness): LPIPS (needs
+calibrated params and per-window host tensors) and per-window PNG dumps.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esr_tpu.analysis.retrace_guard import checked_jit
+from esr_tpu.data.loader import DevicePrefetcher, LanePackedChunks
+from esr_tpu.losses.restore import (
+    l1_metric,
+    mse_metric,
+    psnr_metric,
+    ssim_metric,
+)
+from esr_tpu.obs import active_sink
+from esr_tpu.ops.resize import interpolate
+
+logger = logging.getLogger(__name__)
+
+# per-lane sums accumulated on device, in the sequential tracker's key
+# order (the per-recording result dict must carry the identical schema)
+METRIC_KEYS = (
+    "esr_l1", "esr_mse", "esr_ssim", "esr_psnr",
+    "bicubic_l1", "bicubic_mse", "bicubic_ssim", "bicubic_psnr",
+)
+
+_METRIC_FNS = {
+    "l1": l1_metric, "mse": mse_metric,
+    "ssim": ssim_metric, "psnr": psnr_metric,
+}
+
+
+class StreamingEngine:
+    """Lane-packed, scan-fused streaming inference over a datalist.
+
+    One engine per trained model; ``run_datalist`` streams any number of
+    recordings through ``lanes`` batch lanes in chunks of ``chunk_windows``
+    fused windows. ``lanes=1, chunk_windows=1`` degenerates to the
+    sequential harness's schedule (one window per dispatch, batch 1) and
+    must produce the same metrics — pinned by ``tests/test_infer_engine.py``.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        seqn: int = 3,
+        lanes: int = 4,
+        chunk_windows: int = 8,
+        prefetch_depth: int = 2,
+    ):
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if chunk_windows < 1:
+            raise ValueError(
+                f"chunk_windows must be >= 1, got {chunk_windows}"
+            )
+        self.model = model
+        self.params = params
+        self.seqn = int(seqn)
+        self.lanes = int(lanes)
+        self.chunk_windows = int(chunk_windows)
+        self.prefetch_depth = int(prefetch_depth)
+        # chunk program cache, keyed by GT resolution: the resize target is
+        # baked into the traced program, so a datalist at a new resolution
+        # must rebuild (shape changes alone would retrace, but a stale
+        # (kh, kw) would silently resize to the WRONG grid)
+        self._run_chunk = None
+        self._chunk_key = None
+
+    # -- fused chunk program ------------------------------------------------
+
+    def _build_chunk_fn(self, kh: int, kw: int):
+        """The one-dispatch-per-chunk executable: reset masked lane states,
+        scan ``chunk_windows`` windows via the production ``make_multi_step``
+        machinery, accumulate per-lane metric sums in the carry."""
+        from esr_tpu.training.multistep import make_multi_step
+
+        model, lanes = self.model, self.lanes
+        sum_keys = METRIC_KEYS + ("count",)
+
+        def _to_gt_grid(imgs):
+            if imgs.shape[1:3] != (kh, kw):
+                return jax.vmap(
+                    lambda im: interpolate(im, (kh, kw), "bicubic")
+                )(imgs)
+            return imgs
+
+        # donate the recurrent-state carry: lane states keep single-copy
+        # HBM residency across chunks exactly like the training carry
+        @checked_jit(donate_argnums=(1,), name="infer_engine_chunk")
+        def run_chunk(params, states, reset_keep, windows):
+            def window_step(carry, win):
+                states, sums = carry
+                pred, states = model.apply(params, win["inp_scaled"], states)
+                pred = _to_gt_grid(pred)
+                bicubic = _to_gt_grid(win["inp_mid"])
+                per = {}
+                for name, fn in _METRIC_FNS.items():
+                    vfn = jax.vmap(fn)
+                    per[f"esr_{name}"] = vfn(pred, win["gt"])
+                    per[f"bicubic_{name}"] = vfn(bicubic, win["gt"])
+                valid = win["valid"]  # (B,) float mask
+                # where, not multiply: a masked (zero-padded) window can
+                # produce inf/nan metrics (e.g. psnr of a zero gt) and
+                # inf * 0 would poison the sum with NaN
+                sums = dict(sums)
+                for k in METRIC_KEYS:
+                    sums[k] = sums[k] + jnp.where(valid > 0, per[k], 0.0)
+                sums["count"] = sums["count"] + valid
+                # per-window SSIM pairs stacked by the scan: the report's
+                # paired-delta diagnostics are host-side sample statistics
+                stacked = {
+                    "esr_ssim": per["esr_ssim"],
+                    "bicubic_ssim": per["bicubic_ssim"],
+                }
+                return (states, sums), stacked
+
+            multi = make_multi_step(window_step, self.chunk_windows)
+            # where, not multiply, for the same reason as the metric sums:
+            # a lane state driven non-finite (overflow, padded-tail
+            # garbage) must reset to a CLEAN zero, and 0 * inf is NaN
+            states = jax.tree.map(
+                lambda z: jnp.where(
+                    reset_keep.reshape((-1,) + (1,) * (z.ndim - 1)) > 0,
+                    z, 0.0,
+                ),
+                states,
+            )
+            sums0 = {
+                k: jnp.zeros((lanes,), jnp.float32) for k in sum_keys
+            }
+            (states, sums), stacked = multi((states, sums0), windows)
+            return states, sums, stacked
+
+        return run_chunk
+
+    # -- host loop ----------------------------------------------------------
+
+    @staticmethod
+    def _stage(chunk: Dict) -> Dict:
+        """Host chunk -> device arrays (runs on the prefetcher thread, so
+        the upload overlaps the previous chunk's device compute)."""
+        return {
+            "windows": {
+                k: jnp.asarray(v) for k, v in chunk["windows"].items()
+            },
+            "reset_keep": jnp.asarray(chunk["reset_keep"]),
+        }
+
+    def run_datalist(
+        self,
+        data_list: Sequence[str],
+        dataset_config: Dict,
+    ) -> Tuple[List[Dict[str, float]], List[str]]:
+        """Stream every recording of ``data_list``; returns per-recording
+        result dicts (sequential-harness schema) in datalist order plus the
+        recording names — ready for ``aggregate_results``."""
+        from esr_tpu.inference.harness import (
+            _attach_rmse,
+            _attach_ssim_window_stats,
+            _num_params,
+        )
+
+        chunks = LanePackedChunks(
+            data_list, dataset_config,
+            lanes=self.lanes, chunk_windows=self.chunk_windows,
+        )
+        kh, kw = chunks.gt_resolution
+        if self._run_chunk is None or self._chunk_key != (kh, kw):
+            self._run_chunk = self._build_chunk_fn(kh, kw)
+            self._chunk_key = (kh, kw)
+
+        acc: Dict[str, Dict] = {}
+        for path in data_list:
+            acc[path] = {
+                "sums": {k: 0.0 for k in METRIC_KEYS},
+                "count": 0,
+                "time_s": 0.0,
+                "ssim": {"esr_ssim": [], "bicubic_ssim": []},
+            }
+
+        sink = active_sink()
+        params_m = _num_params(self.params)
+        # init_states aliases one zeros buffer across slots; the donated
+        # carry needs every leaf distinct (donating one buffer twice is an
+        # XLA error), so materialize each leaf as its own array
+        states = jax.tree.map(
+            jnp.array, self.model.init_states(self.lanes, kh, kw)
+        )
+
+        def _resolve(entry) -> None:
+            """Read back one chunk's device outputs and fold them into the
+            per-recording accumulators (blocks until the chunk is done)."""
+            idx, meta, sums_dev, stacked_dev, t_dispatch = entry
+            sums = {k: np.asarray(v) for k, v in sums_dev.items()}
+            stacked = {k: np.asarray(v) for k, v in stacked_dev.items()}
+            seconds = time.perf_counter() - t_dispatch
+            total_valid = int(round(float(sums["count"].sum())))
+            for lane, m in enumerate(meta):
+                if m is None or m["windows"] == 0:
+                    continue
+                a = acc[m["path"]]
+                for k in METRIC_KEYS:
+                    a["sums"][k] += float(sums[k][lane])
+                a["count"] += m["windows"]
+                # the chunk's wall-clock, amortized over its valid windows
+                a["time_s"] += seconds * m["windows"] / total_valid
+                for k in ("esr_ssim", "bicubic_ssim"):
+                    a["ssim"][k].extend(
+                        float(v) for v in stacked[k][: m["windows"], lane]
+                    )
+            if sink is not None:
+                sink.span(
+                    "infer_chunk", seconds,
+                    chunk=idx, lanes=self.lanes,
+                    chunk_windows=self.chunk_windows,
+                    windows=total_valid,
+                    windows_per_sec=round(total_valid / seconds, 3)
+                    if seconds > 0 else None,
+                )
+
+        pending: deque = deque()
+        with DevicePrefetcher(
+            chunks, self._stage, depth=self.prefetch_depth
+        ) as pf:
+            for idx, (host_chunk, staged) in enumerate(pf):
+                t0 = time.perf_counter()
+                states, sums, stacked = self._run_chunk(
+                    self.params, states,
+                    staged["reset_keep"], staged["windows"],
+                )
+                pending.append(
+                    (idx, host_chunk["meta"], sums, stacked, t0)
+                )
+                # resolve one chunk BEHIND dispatch so the readback of
+                # chunk i overlaps the device running chunk i+1
+                if len(pending) > 1:
+                    _resolve(pending.popleft())
+        while pending:
+            _resolve(pending.popleft())
+
+        results, names = [], []
+        for path in data_list:
+            a = acc[path]
+            n = a["count"]
+            if n == 0:
+                # mirror the sequential tracker's zero-count behavior
+                # (avg of no updates reports 0.0) so results stay aligned
+                # with the datalist even for a windowless recording
+                logger.warning("recording %s produced no windows", path)
+            result = {
+                k: (a["sums"][k] / n if n else 0.0) for k in METRIC_KEYS
+            }
+            result["time"] = a["time_s"] / n if n else 0.0
+            result["params"] = params_m
+            _attach_rmse(result)
+            _attach_ssim_window_stats(result, a["ssim"])
+            results.append(result)
+            names.append(os.path.basename(path))
+        return results, names

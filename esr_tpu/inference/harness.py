@@ -33,13 +33,14 @@ from __future__ import annotations
 import logging
 import os
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from esr_tpu.analysis.retrace_guard import checked_jit
 from esr_tpu.data.loader import InferenceSequenceLoader
 from esr_tpu.obs import active_sink
 from esr_tpu.losses.restore import (
@@ -81,15 +82,20 @@ class InferenceRunner:
         self.seqn = seqn
         self.mid_idx = (seqn - 1) // 2
 
-        self._fwd = jax.jit(model.apply)
+        # checked_jit (docs/ANALYSIS.md): inference retraces now surface as
+        # `compile` telemetry events exactly like the training jits'. The
+        # budget is above the default because one runner legitimately spans
+        # a multi-resolution datalist (one retrace per distinct shape).
+        self._fwd = checked_jit(model.apply, name="infer_fwd", max_traces=16)
 
         self.lpips = None
         if lpips_model is not None and lpips_params is not None:
-            self.lpips = jax.jit(
-                lambda a, b: lpips_model.multi_channel(lpips_params, a, b)
+            self.lpips = checked_jit(
+                lambda a, b: lpips_model.multi_channel(lpips_params, a, b),
+                name="infer_lpips", max_traces=16,
             )
 
-        @jax.jit
+        @checked_jit(name="infer_metrics", max_traces=16)
         def _metrics(pred, base, gt):
             return {
                 "esr_l1": l1_metric(pred, gt),
@@ -149,6 +155,24 @@ class InferenceRunner:
         sink = active_sink()
         rec_name = os.path.basename(data_path)
 
+        # Deferred metric readback: `float()`-ing the `_metrics` dict the
+        # moment it is dispatched serializes a device->host sync into every
+        # window. Instead the dispatched (still-device) scalars ride a
+        # 1-deep pending deque and resolve while the NEXT window's forward
+        # runs — same values, same order, one window of readback latency
+        # hidden behind device compute.
+        pending: "deque" = deque()
+
+        def _resolve(entry) -> None:
+            metrics, lpips_pair = entry
+            for k, v in metrics.items():
+                track.update(k, float(v))
+                if k in ssim_samples:
+                    ssim_samples[k].append(float(v))
+            if lpips_pair is not None:
+                track.update("esr_lpips", float(lpips_pair[0]))
+                track.update("bicubic_lpips", float(lpips_pair[1]))
+
         for i, batch in enumerate(loader):
             window = {
                 k: v[:, : self.seqn] for k, v in batch.items()
@@ -157,6 +181,10 @@ class InferenceRunner:
 
             t0 = time.perf_counter()
             pred, states = self._fwd(self.params, inp_scaled, states)
+            # intentional per-window latency probe (the one sequential-mode
+            # sync the deferred-readback audit keeps): bounding the forward
+            # here is what makes `time`/`infer_forward` true dispatch->ready
+            # wall per window  # esr: noqa(ESR002)
             pred = jax.block_until_ready(pred)
             latency = time.perf_counter() - t0
             track.update("time", latency)
@@ -174,13 +202,12 @@ class InferenceRunner:
                 pred0 = interpolate(pred0, (kh, kw), "bicubic")
             bicubic = interpolate(inp_cnt, (kh, kw), "bicubic")
 
-            for k, v in self._metrics(pred0, bicubic, gt).items():
-                track.update(k, float(v))
-                if k in ssim_samples:
-                    ssim_samples[k].append(float(v))
+            lpips_pair = None
             if self.lpips is not None:
-                track.update("esr_lpips", float(self.lpips(pred0, gt)))
-                track.update("bicubic_lpips", float(self.lpips(bicubic, gt)))
+                lpips_pair = (self.lpips(pred0, gt), self.lpips(bicubic, gt))
+            pending.append((self._metrics(pred0, bicubic, gt), lpips_pair))
+            if len(pending) > 1:
+                _resolve(pending.popleft())
 
             if img_root is not None:
                 pred_np = np.asarray(pred0)
@@ -202,25 +229,14 @@ class InferenceRunner:
                         render_frame(window["gt_img"][0, self.mid_idx]),
                     )
 
+        while pending:
+            _resolve(pending.popleft())
+
         result = track.result()
         _attach_rmse(result)
-        n_win = len(ssim_samples["esr_ssim"])
-        result["n_windows"] = float(n_win)
-        if n_win:
-            delta = (np.asarray(ssim_samples["esr_ssim"])
-                     - np.asarray(ssim_samples["bicubic_ssim"]))
-            result["ssim_delta_mean"] = float(delta.mean())
-            result["ssim_delta_pos_frac"] = float((delta > 0).mean())
-            if n_win > 1:
-                result["ssim_delta_std"] = float(delta.std(ddof=1))
-                for k, vals in ssim_samples.items():
-                    result[f"{k}_std"] = float(np.std(vals, ddof=1))
+        _attach_ssim_window_stats(result, ssim_samples)
         if report and out_dir is not None:
-            os.makedirs(out_dir, exist_ok=True)
-            with YamlLogger(os.path.join(out_dir, "inference.yml")) as yl:
-                yl.log_info(f"inference on {data_path}")
-                yl.log_dict(dataset_config, "eval_dataset_config")
-                yl.log_dict(result, "evaluation results")
+            _write_recording_report(out_dir, data_path, dataset_config, result)
         return result
 
 
@@ -234,6 +250,39 @@ def _attach_rmse(metrics: Dict[str, float]) -> None:
     for side in ("esr", "bicubic"):
         if f"{side}_mse" in metrics:
             metrics[f"{side}_rmse"] = float(np.sqrt(metrics[f"{side}_mse"]))
+
+
+def _attach_ssim_window_stats(
+    result: Dict[str, float], ssim_samples: Dict[str, List[float]]
+) -> None:
+    """Window-count + paired-SSIM-delta diagnostics IN PLACE from the
+    per-window SSIM samples (see the pairing rationale in
+    :meth:`InferenceRunner.run_recording`). Shared by the sequential
+    harness and the batched engine so both report byte-identical schema
+    computed by the same numpy code."""
+    n_win = len(ssim_samples["esr_ssim"])
+    result["n_windows"] = float(n_win)
+    if n_win:
+        delta = (np.asarray(ssim_samples["esr_ssim"])
+                 - np.asarray(ssim_samples["bicubic_ssim"]))
+        result["ssim_delta_mean"] = float(delta.mean())
+        result["ssim_delta_pos_frac"] = float((delta > 0).mean())
+        if n_win > 1:
+            result["ssim_delta_std"] = float(delta.std(ddof=1))
+            for k, vals in ssim_samples.items():
+                result[f"{k}_std"] = float(np.std(vals, ddof=1))
+
+
+def _write_recording_report(
+    out_dir: str, data_path: str, dataset_config: Dict, result: Dict
+) -> None:
+    """The per-recording ``inference.yml`` — one writer for both inference
+    modes, so the engine's reports stay byte-identical in schema."""
+    os.makedirs(out_dir, exist_ok=True)
+    with YamlLogger(os.path.join(out_dir, "inference.yml")) as yl:
+        yl.log_info(f"inference on {data_path}")
+        yl.log_dict(dataset_config, "eval_dataset_config")
+        yl.log_dict(result, "evaluation results")
 
 
 # Window-level diagnostic keys: excluded from the generic datalist mean
@@ -305,13 +354,35 @@ def run_inference(
     allow_uncalibrated_lpips: bool = False,
     lpips_net: str = "alex",
     lpips_lin_npz: Optional[str] = None,
+    engine: Optional[bool] = None,
+    lanes: Optional[int] = None,
+    chunk_windows: Optional[int] = None,
 ) -> Dict[str, float]:
     """Full driver: checkpoint -> model, datalist -> per-recording + mean
     reports under ``output_path`` (reference ``main`` mode 1, ``:295-347``).
-    Returns the datalist-mean metrics."""
+    Returns the datalist-mean metrics.
+
+    ``engine=True`` routes the datalist through the batched
+    :class:`esr_tpu.inference.engine.StreamingEngine` (``lanes`` recordings
+    per batch, ``chunk_windows`` scan-fused windows per dispatch,
+    docs/INFERENCE.md) instead of the sequential per-window loop. The
+    report files and their schema are identical; engine mode does not
+    support LPIPS or image dumps (both need per-window host tensors).
+
+    Each of the three knobs resolves explicit argument > the checkpoint
+    config's ``inference`` block (the flagship recipes opt in there) >
+    built-in default (sequential, 4 lanes, 8 fused windows)."""
     from esr_tpu.training.checkpoint import load_for_inference
 
     model, params, config = load_for_inference(checkpoint_path)
+    inf_cfg = config.get("inference") or {}
+    if engine is None:
+        engine = bool(inf_cfg.get("engine", False))
+    lanes = int(inf_cfg.get("lanes", 4) if lanes is None else lanes)
+    chunk_windows = int(
+        inf_cfg.get("chunk_windows", 8) if chunk_windows is None
+        else chunk_windows
+    )
     if dataset_config is None:
         dataset_config = config["valid_dataloader"]["dataset"]
     seqn = int(dataset_config["sequence"].get("seqn", 3))
@@ -319,6 +390,36 @@ def run_inference(
     assert ck_seqn == seqn, (
         f"checkpoint num_frame={ck_seqn} != dataloader seqn={seqn}"
     )  # reference infer_ours_cnt.py:125
+
+    if engine:
+        if lpips_backbone_npz is not None or allow_uncalibrated_lpips:
+            raise ValueError(
+                "engine mode does not support LPIPS (per-window host "
+                "tensors); run sequential mode for LPIPS reports"
+            )
+        if save_images:
+            logger.warning(
+                "engine mode does not dump per-window images; "
+                "--save_images ignored (use sequential mode for PNGs)"
+            )
+        from esr_tpu.inference.engine import StreamingEngine
+
+        eng = StreamingEngine(
+            model, params, seqn, lanes=lanes, chunk_windows=chunk_windows
+        )
+        os.makedirs(output_path, exist_ok=True)
+        results, names = eng.run_datalist(data_list, dataset_config)
+        for result, name, data_path in zip(results, names, data_list):
+            _write_recording_report(
+                os.path.join(output_path, name), data_path,
+                dataset_config, result,
+            )
+        breakdown, mean = aggregate_results(results, names)
+        with YamlLogger(os.path.join(output_path, "inference_all.yml")) as yl:
+            yl.log_info(f"inference {checkpoint_path} on {list(data_list)}")
+            yl.log_dict(breakdown, "breakdown results for each data")
+            yl.log_dict(mean, "mean results for the whole data")
+        return mean
 
     lpips_model = lpips_params = None
     if lpips_backbone_npz is not None or allow_uncalibrated_lpips:

@@ -32,6 +32,13 @@ scan-slope stages time — with the rewire in this module's PR, the
 headline benchmark and the production training path share this one
 implementation, so the measured number is the shipped code path.
 
+The step being fused does not have to be a TRAIN step: the streaming
+inference engine (``esr_tpu.inference.engine``) fuses ``chunk_windows``
+per-window eval steps the same way — its carry is ``(recurrent states,
+per-lane metric sums)`` and its "megabatch" a window chunk — so train-time
+and inference-time fusion share this one scan contract (and its leading-
+axis validation).
+
 jit/donation/sharding live one level up
 (:func:`esr_tpu.parallel.mesh.make_parallel_multi_step`): the scan carry
 is the donated argument, so params/opt state keep single-copy HBM

@@ -36,6 +36,25 @@ def get_flags():
                    help="converted lin-weights npz (required for non-alex)")
     p.add_argument("--allow_uncalibrated_lpips", action="store_true")
 
+    # batched streaming engine (docs/INFERENCE.md): lane-packed recordings,
+    # scan-fused windows, on-device metric accumulation — same reports,
+    # one dispatch per lanes x chunk_windows windows. Tri-state defaults:
+    # an omitted flag defers to the checkpoint config's `inference` block
+    # (the flagship recipes opt in), which is why default=None here.
+    p.add_argument("--engine", dest="engine", action="store_true",
+                   default=None,
+                   help="batched streaming engine instead of the "
+                        "sequential per-window loop (no LPIPS/PNG dumps)")
+    p.add_argument("--no_engine", dest="engine", action="store_false",
+                   help="force the sequential harness even when the "
+                        "checkpoint config enables the engine")
+    p.add_argument("--lanes", type=int, default=None,
+                   help="recordings streamed concurrently per batch "
+                        "(engine mode; default: checkpoint config, else 4)")
+    p.add_argument("--chunk_windows", type=int, default=None,
+                   help="windows scan-fused per dispatch (engine mode; "
+                        "default: checkpoint config, else 8)")
+
     # dataset overrides (reference get_flags, infer_ours_cnt.py:135-157)
     p.add_argument("--scale", type=int, default=4)
     p.add_argument("--seqn", type=int, default=3)
@@ -104,6 +123,9 @@ def main():
         allow_uncalibrated_lpips=flags.allow_uncalibrated_lpips,
         lpips_net=flags.lpips_net,
         lpips_lin_npz=flags.lpips_lins,
+        engine=flags.engine,
+        lanes=flags.lanes,
+        chunk_windows=flags.chunk_windows,
     )
     # One machine-readable JSON line (ADVICE r4: consumers must not eval()
     # a repr). json.dumps emits bare NaN/Infinity tokens for non-finite

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Engine-mode inference smoke: a tiny 2-lane, multi-chunk CPU
+# run_inference(engine=True) must produce the sequential-schema YAML
+# reports (per-recording + datalist mean) AND well-formed telemetry —
+# one infer_chunk span per chunk (lanes, fused windows, windows/s) and
+# the fused chunk program's checked_jit compile event.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_infer_smoke.py)
+# as a standalone gate; engine architecture + knobs: docs/INFERENCE.md.
+#
+# Usage: scripts/infer_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_infer_smoke.py -q "$@"

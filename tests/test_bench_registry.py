@@ -35,7 +35,7 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "e2e",
-        "e2e_device_raster", "scaling", "breakdown",
+        "e2e_device_raster", "scaling", "breakdown", "infer_throughput",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -90,6 +90,22 @@ def test_emit_jsonl_stamps_schema_version_and_manifest(tmp_path, capsys):
         file_line = f.read().strip()
     assert json.loads(file_line) == rec
     assert json.loads(printed) == rec
+
+
+def test_infer_throughput_stage_registered_and_schema_pinned():
+    """The inference-side perf series: the stage must run in smoke (CPU
+    plumbing check — it is tiny and dispatch-bound by design) and its
+    record schema must stay machine-comparable across rounds."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "infer_throughput"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_infer_throughput
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.INFER_THROUGHPUT_KEYS == (
+        "seq_windows_per_sec", "engine_windows_per_sec", "speedup",
+        "windows", "recordings", "lanes", "chunk_windows",
+    )
 
 
 class _TinyState(NamedTuple):

@@ -1,0 +1,226 @@
+"""StreamingEngine <-> sequential harness equivalence (tier-1, CPU).
+
+The batched engine must be a drop-in metric producer for the report
+pipeline (ISSUE 4): lane-packing with unequal recording lengths (refill +
+masking), recurrent-state carry across chunk boundaries, the
+``lanes=1, chunk_windows=1`` degenerate schedule, and per-recording metric
+parity with ``InferenceRunner.run_recording`` within float tolerance on
+CPU synthetic recordings.
+"""
+
+import numpy as np
+import pytest
+
+from esr_tpu.data.loader import InferenceSequenceLoader, LanePackedChunks
+from esr_tpu.data.synthetic import write_synthetic_h5
+from esr_tpu.inference.engine import METRIC_KEYS, StreamingEngine
+from esr_tpu.inference.harness import InferenceRunner
+from esr_tpu.models.esr import DeepRecurrNet
+
+# tiny + dispatch-light: down8 rung (8x8 LR -> 16x16 GT), few windows per
+# recording, UNEQUAL lengths so lane refill + tail masking are exercised
+DATASET_CFG = {
+    "scale": 2,
+    "ori_scale": "down8",
+    "time_bins": 1,
+    "mode": "events",
+    "window": 1024,
+    "sliding_window": 512,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def recordings(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("eng")
+    paths = []
+    for i, ev in enumerate([2048, 3600, 1100]):
+        p = str(tmp / f"rec{i}.h5")
+        write_synthetic_h5(p, (64, 64), base_events=ev, num_frames=6, seed=i)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    x = np.zeros((1, 3, 16, 16, 2), np.float32)
+    states = model.init_states(1, 16, 16)
+    params = model.init(jax.random.PRNGKey(0), x, states)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def seq_results(recordings, model_and_params):
+    model, params = model_and_params
+    runner = InferenceRunner(model, params, seqn=3)
+    return [
+        runner.run_recording(p, DATASET_CFG, report=False)
+        for p in recordings
+    ]
+
+
+def _window_counts(paths):
+    return [
+        len(InferenceSequenceLoader(p, DATASET_CFG)) for p in paths
+    ]
+
+
+def test_lane_packer_unequal_lengths(recordings):
+    """Every window of every recording lands in exactly one lane slot, in
+    stream order; tails are masked; refilled/idle lanes carry
+    ``reset_keep = 0``; within a chunk a lane holds one recording."""
+    counts = dict(zip(recordings, _window_counts(recordings)))
+    assert len(set(counts.values())) > 1  # genuinely unequal lengths
+
+    chunks = list(
+        LanePackedChunks(recordings, DATASET_CFG, lanes=2, chunk_windows=2)
+    )
+    seen = {p: 0 for p in recordings}
+    for c in chunks:
+        valid = c["windows"]["valid"]
+        assert valid.shape == (2, 2)
+        for lane, m in enumerate(c["meta"]):
+            lane_valid = valid[:, lane]
+            if m is None:
+                assert lane_valid.sum() == 0
+                assert c["reset_keep"][lane] == 0.0  # idle lane is zeroed
+                continue
+            # valid windows are a PREFIX (exhaustion truncates the tail)
+            assert list(lane_valid) == [1.0] * m["windows"] + [0.0] * (
+                2 - m["windows"]
+            )
+            seen[m["path"]] += m["windows"]
+        # masked windows are zero-padded
+        np.testing.assert_array_equal(
+            c["windows"]["inp_scaled"][valid == 0.0], 0.0
+        )
+    assert seen == counts  # full coverage, nothing duplicated
+
+    # first chunk: both lanes freshly assigned -> reset; a lane continuing
+    # its recording keeps state; the lane that exhausts its recording is
+    # reset exactly when the next recording refills it
+    assert list(chunks[0]["reset_keep"]) == [0.0, 0.0]
+    resets = 0
+    prev_rec = [m["recording"] if m else None for m in chunks[0]["meta"]]
+    for c in chunks[1:]:
+        for lane, m in enumerate(c["meta"]):
+            rec = m["recording"] if m else None
+            if rec is not None and rec == prev_rec[lane]:
+                assert c["reset_keep"][lane] == 1.0
+            else:
+                assert c["reset_keep"][lane] == 0.0
+                resets += 1
+            prev_rec[lane] = rec
+    assert resets >= 1  # the third recording refilled some lane
+
+
+def test_exact_multiple_length_frees_lane_without_idle_chunk(recordings):
+    """A recording whose window count is an exact multiple of
+    chunk_windows must free its lane at the SAME boundary (one-window
+    lookahead), not burn a fully-masked pure-padding chunk first."""
+    n0 = _window_counts(recordings[:1])[0]
+    chunks = list(
+        LanePackedChunks(
+            recordings[:2], DATASET_CFG, lanes=1, chunk_windows=n0
+        )
+    )
+    # chunk 0 is exactly recording 0; chunk 1 starts recording 1
+    # immediately (reset, valid windows > 0) — no idle chunk between
+    assert chunks[0]["meta"][0]["windows"] == n0
+    assert chunks[1]["meta"][0]["recording"] == "rec1.h5"
+    assert chunks[1]["reset_keep"][0] == 0.0
+    assert chunks[1]["windows"]["valid"][:, 0].sum() > 0
+    assert all(c["windows"]["valid"].sum() > 0 for c in chunks)
+
+
+def _assert_result_parity(seq, eng, rtol=1e-5):
+    """Engine result == sequential-harness result, schema and values.
+
+    ``time`` is schema-equal but semantically different (per-window
+    forward latency vs amortized chunk wall), so only its presence and
+    sign are checked."""
+    assert set(eng) == set(seq)
+    assert eng["n_windows"] == seq["n_windows"]
+    assert eng["time"] > 0 and eng["params"] == seq["params"]
+    for k in METRIC_KEYS + ("esr_rmse", "bicubic_rmse"):
+        np.testing.assert_allclose(eng[k], seq[k], rtol=rtol, err_msg=k)
+    for k in ("ssim_delta_mean", "ssim_delta_std", "ssim_delta_pos_frac",
+              "esr_ssim_std", "bicubic_ssim_std"):
+        if k in seq:
+            # delta statistics subtract nearly-equal samples — compare
+            # absolutely (float noise is amplified relative to the delta)
+            np.testing.assert_allclose(
+                eng[k], seq[k], rtol=1e-4, atol=1e-6, err_msg=k
+            )
+
+
+def test_engine_matches_harness_with_refill(
+    recordings, model_and_params, seq_results
+):
+    """2 lanes over 3 unequal recordings: exercises mid-chunk exhaustion,
+    chunk-boundary refill with state reset, and idle-lane masking — and
+    must still reproduce the sequential per-recording metrics."""
+    model, params = model_and_params
+    engine = StreamingEngine(model, params, seqn=3, lanes=2, chunk_windows=3)
+    results, names = engine.run_datalist(recordings, DATASET_CFG)
+    assert names == [f"rec{i}.h5" for i in range(3)]
+    for seq, eng in zip(seq_results, results):
+        _assert_result_parity(seq, eng)
+
+
+def test_state_carries_across_chunk_boundaries(
+    recordings, model_and_params
+):
+    """A recording spanning several chunks must see ONE continuous
+    recurrent stream: chunking the same recording differently cannot
+    change its metrics (it would if state reset at chunk boundaries —
+    the sequential harness pins that state changes predictions)."""
+    model, params = model_and_params
+    fine = StreamingEngine(model, params, seqn=3, lanes=1, chunk_windows=2)
+    coarse = StreamingEngine(model, params, seqn=3, lanes=1, chunk_windows=7)
+    r_fine, _ = fine.run_datalist(recordings[:1], DATASET_CFG)
+    r_coarse, _ = coarse.run_datalist(recordings[:1], DATASET_CFG)
+    assert r_fine[0]["n_windows"] > 2  # genuinely spans chunks
+    for k in METRIC_KEYS:
+        np.testing.assert_allclose(
+            r_fine[0][k], r_coarse[0][k], rtol=1e-5, err_msg=k
+        )
+
+
+def test_degenerate_single_lane_single_window_is_sequential(
+    recordings, model_and_params, seq_results
+):
+    """lanes=1, chunk_windows=1 is the sequential schedule (one window per
+    dispatch, batch 1) and must match the harness."""
+    model, params = model_and_params
+    engine = StreamingEngine(model, params, seqn=3, lanes=1, chunk_windows=1)
+    results, _ = engine.run_datalist(recordings[-1:], DATASET_CFG)
+    _assert_result_parity(seq_results[-1], results[0])
+
+
+def test_validation_errors(recordings, model_and_params, tmp_path):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="lanes"):
+        StreamingEngine(model, params, lanes=0)
+    with pytest.raises(ValueError, match="chunk_windows"):
+        StreamingEngine(model, params, chunk_windows=0)
+    # a ragged datalist (different ladder) must refuse lane-packing
+    odd = str(tmp_path / "odd.h5")
+    write_synthetic_h5(odd, (128, 128), base_events=1024, num_frames=6,
+                       seed=9)
+    packer = LanePackedChunks(
+        [recordings[0], odd], DATASET_CFG, lanes=2, chunk_windows=2
+    )
+    with pytest.raises(ValueError, match="resolution"):
+        list(packer)

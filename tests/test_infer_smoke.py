@@ -1,0 +1,236 @@
+"""Engine-mode inference smoke (tier-1, also driven by
+``scripts/infer_smoke.sh``): a tiny 2-lane, multi-chunk CPU
+``run_inference(engine=True)`` must work END TO END — checkpoint ->
+StreamingEngine -> YAML reports + telemetry spans.
+
+The acceptance contract (ISSUE 4 / docs/INFERENCE.md):
+
+- the datalist report (``inference_all.yml``) and per-recording reports
+  carry the sequential harness's exact schema (breakdown + means, rmse at
+  the aggregation boundary, window diagnostics);
+- one ``infer_chunk`` span per chunk (lanes, fused windows, windows/s)
+  replaces the sequential path's per-window ``infer_forward`` span;
+- the fused chunk program's ``checked_jit`` compile event is present
+  (inference retraces surface exactly like training's);
+- returned datalist means are finite and mirror the YAML.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import yaml
+
+from esr_tpu.data.synthetic import write_synthetic_h5
+from esr_tpu.inference.harness import run_inference
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.obs import TelemetrySink, set_active_sink
+
+LANES = 2
+CHUNK_WINDOWS = 4
+
+DATASET_CFG = {
+    "scale": 2,
+    "ori_scale": "down8",
+    "time_bins": 1,
+    "mode": "events",
+    "window": 1024,
+    "sliding_window": 512,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+def _save_ckpt(dirname, model_args, params, extra_config=None):
+    from esr_tpu.config.build import build_optimizer
+    from esr_tpu.training import checkpoint as ckpt_lib
+    from esr_tpu.training.train_step import TrainState
+
+    config = {
+        "experiment": "infer_smoke",
+        "model": {"name": "DeepRecurrNet", "args": dict(model_args)},
+        "optimizer": {
+            "name": "Adam",
+            "args": {"lr": 1e-3, "weight_decay": 1e-4, "amsgrad": True},
+        },
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": dirname,
+            "iteration_based_train": {"enabled": True, "iterations": 1,
+                                      "lr_change_rate": 4000},
+        },
+        **(extra_config or {}),
+    }
+    opt, _ = build_optimizer(config["optimizer"], config["lr_scheduler"], 4000)
+    return ckpt_lib.save_checkpoint(
+        dirname, TrainState.create(params, opt), config, 0, 0.0
+    )
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    x = np.zeros((1, 3, 16, 16, 2), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x, model.init_states(1, 16, 16))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def smoke_run(tmp_path_factory, model_and_params):
+    """One engine-mode run_inference: returns (mean, out_dir, telemetry
+    records, recording names)."""
+    tmp = tmp_path_factory.mktemp("infer_smoke")
+    paths = []
+    for i, ev in enumerate([2048, 3600]):
+        p = str(tmp / f"rec{i}.h5")
+        write_synthetic_h5(p, (64, 64), base_events=ev, num_frames=6, seed=i)
+        paths.append(p)
+
+    _, params = model_and_params
+    ckpt = _save_ckpt(
+        str(tmp / "ck"), {"inch": 2, "basech": 2, "num_frame": 3}, params
+    )
+
+    out = str(tmp / "report")
+    tel_path = str(tmp / "telemetry.jsonl")
+    sink = TelemetrySink(tel_path)
+    prev = set_active_sink(sink)
+    try:
+        mean = run_inference(
+            ckpt, paths, out, DATASET_CFG, save_images=False,
+            engine=True, lanes=LANES, chunk_windows=CHUNK_WINDOWS,
+        )
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    with open(tel_path) as f:
+        records = [json.loads(line) for line in f]
+    return mean, out, records, [os.path.basename(p) for p in paths]
+
+
+def test_engine_report_schema_and_values(smoke_run):
+    mean, out, _, names = smoke_run
+    for k in ("esr_l1", "esr_mse", "esr_rmse", "esr_ssim", "esr_psnr",
+              "bicubic_l1", "bicubic_mse", "bicubic_rmse",
+              "bicubic_ssim", "bicubic_psnr", "time", "params"):
+        assert np.isfinite(mean[k]), k
+    assert mean["n_windows"] >= 2 * CHUNK_WINDOWS  # genuinely multi-chunk
+    np.testing.assert_allclose(
+        mean["esr_rmse"], np.sqrt(mean["esr_mse"]), rtol=1e-6
+    )
+
+    rep = yaml.safe_load(open(os.path.join(out, "inference_all.yml")))
+    assert "breakdown results for each data" in rep
+    assert "mean results for the whole data" in rep
+    breakdown = rep["breakdown results for each data"]
+    assert set(breakdown["esr_mse"]) == set(names)
+    # per-recording reports in the sequential layout
+    for name in names:
+        per = yaml.safe_load(
+            open(os.path.join(out, name, "inference.yml"))
+        )
+        assert "evaluation results" in per
+        assert per["evaluation results"]["n_windows"] >= 1
+
+
+def test_engine_emits_per_chunk_spans(smoke_run):
+    mean, _, records, _ = smoke_run
+    spans = [r for r in records
+             if r["type"] == "span" and r["name"] == "infer_chunk"]
+    assert len(spans) >= 2  # the 2-lane datalist spans multiple chunks
+    total = 0
+    for s in spans:
+        assert s["seconds"] > 0
+        assert s["lanes"] == LANES
+        assert s["chunk_windows"] == CHUNK_WINDOWS
+        assert 1 <= s["windows"] <= LANES * CHUNK_WINDOWS
+        assert s["windows_per_sec"] > 0
+        total += s["windows"]
+    assert total == int(mean["n_windows"])
+    assert [s["chunk"] for s in spans] == list(range(len(spans)))
+    # engine mode replaces the per-window infer_forward span entirely
+    assert not any(
+        r["type"] == "span" and r["name"] == "infer_forward"
+        for r in records
+    )
+
+
+def test_engine_compile_event_captured(smoke_run):
+    _, _, records, _ = smoke_run
+    compiles = [r for r in records
+                if r["type"] == "event" and r["name"] == "compile"]
+    assert any(c["fn"] == "infer_engine_chunk" for c in compiles)
+    for c in compiles:
+        assert c["trace_count"] >= 1 and c["elapsed_s"] >= 0
+
+
+def test_checkpoint_config_inference_block_resolves_knobs(
+    tmp_path, model_and_params, monkeypatch
+):
+    """An omitted engine argument defers to the checkpoint config's
+    ``inference`` block (the flagship recipes opt in there), and explicit
+    arguments override it (docs/CONFIG.md resolution order)."""
+    import esr_tpu.inference.engine as engine_mod
+
+    _, params = model_and_params
+    ckpt = _save_ckpt(
+        str(tmp_path / "ck"), {"inch": 2, "basech": 2, "num_frame": 3},
+        params,
+        extra_config={
+            "inference": {"engine": True, "lanes": 2, "chunk_windows": 3}
+        },
+    )
+    calls = []
+
+    class _StubEngine:
+        def __init__(self, model, p, seqn, lanes, chunk_windows):
+            calls.append({"lanes": lanes, "chunk_windows": chunk_windows})
+
+        def run_datalist(self, data_list, dataset_config):
+            return (
+                [{"esr_mse": 1.0, "n_windows": 1.0}] * len(data_list),
+                [os.path.basename(p) for p in data_list],
+            )
+
+    monkeypatch.setattr(engine_mod, "StreamingEngine", _StubEngine)
+    out = str(tmp_path / "rep")
+    mean = run_inference(
+        ckpt, ["/fake/rec0.h5"], out, DATASET_CFG, save_images=False
+    )
+    assert calls == [{"lanes": 2, "chunk_windows": 3}]  # config block won
+    assert mean["esr_mse"] == 1.0
+    # explicit arguments override the config block
+    run_inference(
+        ckpt, ["/fake/rec0.h5"], out, DATASET_CFG, save_images=False,
+        lanes=5, chunk_windows=7,
+    )
+    assert calls[-1] == {"lanes": 5, "chunk_windows": 7}
+    # and engine=False overrides engine: true — the sequential path would
+    # open the (nonexistent) recording, which is exactly the proof the
+    # stub engine was bypassed
+    with pytest.raises((FileNotFoundError, OSError, ValueError)):
+        run_inference(
+            ckpt, ["/fake/rec0.h5"], out, DATASET_CFG,
+            save_images=False, engine=False,
+        )
+
+
+def test_engine_reports_announced_in_stream(smoke_run):
+    """YamlLogger announces every written report through the sink, so the
+    run's artifacts are discoverable from its telemetry alone."""
+    _, out, records, names = smoke_run
+    reported = {r["path"] for r in records
+                if r["type"] == "event" and r["name"] == "yaml_report"}
+    assert os.path.join(out, "inference_all.yml") in reported
+    for name in names:
+        assert os.path.join(out, name, "inference.yml") in reported
