@@ -26,6 +26,11 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   jitted/scanned code: host-side telemetry under trace either leaks a
   tracer or fires exactly once at trace time (never per step) — the
   telemetry subsystem stays host-side by construction.
+- ESR008 blocking-persistence-in-loop — synchronous ``save_checkpoint`` /
+  ``jax.device_get`` of full state trees inside a host loop body: the
+  accelerator idles for the full fetch+write on every pass (the
+  stop-the-world tail ISSUE 5 removed). Persist through a snapshot
+  barrier + background commit (``training/async_checkpoint``) instead.
 
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
@@ -436,6 +441,79 @@ class TracedNondeterminism(Rule):
                     f"nondeterministic call `{dotted}(...)` inside traced "
                     "code is frozen at trace time",
                 )
+
+
+# host-side persistence entry points that block on device fetch + filesystem
+_PERSIST_CALLS = {"save_checkpoint"}
+# function-name markers of the sanctioned pattern: a bounded snapshot (or
+# the background commit that consumes it) MAY sync — that is the design
+# (training/async_checkpoint.py); the hazard is the unbounded sync save on
+# the loop's critical path
+_SNAPSHOT_MARKERS = ("snapshot", "commit")
+
+
+@register_rule
+class BlockingPersistenceInLoop(Rule):
+    name = "ESR008"
+    slug = "blocking-persistence-in-loop"
+    severity = "warning"
+    hint = (
+        "a synchronous checkpoint save (or full-state device_get) inside "
+        "a loop stalls the accelerator for the whole fetch+write every "
+        "pass; snapshot device->host behind a barrier and commit on a "
+        "background writer (esr_tpu.training.async_checkpoint), or move "
+        "the call out of the loop / behind a cadence and justify with "
+        "`# esr: noqa(ESR008)`"
+    )
+
+    def _loop_enclosed(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """Lexically inside a ``while``/``for`` body of the SAME function
+        (a nested def runs when called, not per loop iteration)."""
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+                return True
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+            cur = ctx.parents.get(cur)
+        return False
+
+    def _snapshot_scoped(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        fn = ctx.enclosing_function(node)
+        while fn is not None:
+            name = getattr(fn, "name", "").lower()
+            if any(m in name for m in _SNAPSHOT_MARKERS):
+                return True
+            fn = ctx.enclosing_function(fn)
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.in_traced_context(node):
+                continue  # device-side syncs are ESR002's beat
+            name = _call_name(node.func)
+            if name in _PERSIST_CALLS:
+                what = f"`{name}(...)`"
+            elif name == "device_get" and _dotted(node.func) in (
+                "jax.device_get", "device_get"
+            ):
+                what = "`jax.device_get(...)`"
+            else:
+                continue
+            if not self._loop_enclosed(ctx, node):
+                continue
+            if self._snapshot_scoped(ctx, node):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"blocking persistence call {what} inside a host loop "
+                "body (outside a snapshot barrier)",
+            )
 
 
 _OBS_MODULE = "esr_tpu.obs"

@@ -357,6 +357,7 @@ def run_inference(
     engine: Optional[bool] = None,
     lanes: Optional[int] = None,
     chunk_windows: Optional[int] = None,
+    compile_cache: Optional[bool] = None,
 ) -> Dict[str, float]:
     """Full driver: checkpoint -> model, datalist -> per-recording + mean
     reports under ``output_path`` (reference ``main`` mode 1, ``:295-347``).
@@ -375,6 +376,20 @@ def run_inference(
     from esr_tpu.training.checkpoint import load_for_inference
 
     model, params, config = load_for_inference(checkpoint_path)
+    # persistent XLA compile cache, resolved like the engine knobs:
+    # explicit argument > the checkpoint config's trainer.compile_cache >
+    # off. Enabled BEFORE any jit runs, so the per-checkpoint eval loops
+    # the phase runners drive (one infer.py process per checkpoint, same
+    # programs every time) stop paying the same compiles per process
+    # (utils/xla_cache, docs/PERF.md "the serial tail").
+    cc = (
+        (config.get("trainer") or {}).get("compile_cache", False)
+        if compile_cache is None else compile_cache
+    )
+    if cc:
+        from esr_tpu.utils.xla_cache import enable_compile_cache
+
+        enable_compile_cache(cc)
     inf_cfg = config.get("inference") or {}
     if engine is None:
         engine = bool(inf_cfg.get("engine", False))

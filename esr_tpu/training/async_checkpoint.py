@@ -1,0 +1,167 @@
+"""Async checkpointing: snapshot on the critical path, commit off it.
+
+The sync save (``training/checkpoint.save_checkpoint``) blocks the training
+loop for the FULL persistence cost: device→host fetch, Orbax array write,
+``wait_until_finished``, ``meta.yml`` commit — a stop-the-world tail the
+PR 3 ``checkpoint`` span made visible on every ``save_period`` boundary.
+The accelerator never needs to wait on the filesystem; it only needs a
+consistent copy of the state before the next donated step deletes it.
+This module splits the save accordingly:
+
+- **snapshot** (blocking, small and bounded): device→host copy of the
+  state pytree (``checkpoint._to_host`` — the same fetch the sync path
+  does first). Must complete before the loop continues, because the next
+  train step DONATES the state buffers; a background thread reading them
+  later would read freed memory.
+- **commit** (background writer thread): the EXISTING atomic protocol —
+  Orbax arrays first, ``meta.yml`` last — run by
+  ``checkpoint.save_checkpoint`` on the host snapshot. A commit killed
+  between the array write and the ``meta.yml`` marker leaves a torn
+  directory that ``find_latest_checkpoint`` ignores by construction
+  (pinned by ``tests/test_async_checkpoint.py``).
+
+A **barrier** (:meth:`AsyncCheckpointer.wait`) joins the in-flight commit
+and re-raises its error. The Trainer barriers in exactly three places:
+before every new snapshot (:meth:`save` calls it first — at most ONE save
+in flight, so host memory holds at most one extra state copy), before the
+final-state save, and in ``train()``'s ``finally`` (so no commit outlives
+the run or its telemetry sink).
+
+Multi-process semantics are preserved: Orbax saves are COLLECTIVE under
+``jax.distributed`` — every process calls :meth:`save`, every process's
+writer thread runs the same commit (Orbax's internal barriers then
+rendezvous across the background threads; array/meta data is written by
+the primary host only, exactly as in the sync path). The commit ORDER is
+identical on every host because the save cadence is config-derived.
+
+Telemetry (docs/OBSERVABILITY.md): the Trainer emits the blocking
+``checkpoint_snapshot`` span; the writer thread emits ``checkpoint_commit``
+through the process-active sink (thread-safe, never-raising by contract).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from esr_tpu.training.checkpoint import _to_host, save_checkpoint
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background commit failed; raised at the NEXT barrier so the
+    training loop (not a daemon thread) owns the failure."""
+
+
+class AsyncCheckpointer:
+    """One background checkpoint writer with a single-slot pipeline.
+
+    ``save()`` = barrier(previous) + blocking snapshot + enqueue commit.
+    ``wait()`` = join the in-flight commit, re-raising its error.
+    At most one commit is ever in flight; the snapshot of save N+1 cannot
+    start until commit N finished (the double-writer exclusion the torn-
+    checkpoint tests pin — two commits racing into one directory is the
+    corruption mode this class exists to exclude).
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.last_commit_s: Optional[float] = None
+        self.commits = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def save(
+        self,
+        ckpt_dir: str,
+        state: Any,
+        config: Dict,
+        iteration: int,
+        monitor_best: float,
+        save_best: bool = False,
+    ) -> float:
+        """Barrier + snapshot + background commit.
+
+        Returns the seconds the call BLOCKED (barrier join + device→host
+        snapshot + thread start) — the only cost left on the super-step
+        critical path; the caller reports it as the ``checkpoint_snapshot``
+        span. Raises :class:`AsyncCheckpointError` if the PREVIOUS commit
+        failed (the barrier surfaces it before new work is queued).
+        """
+        t0 = time.monotonic()
+        self.wait()
+        # device->host fetch BEFORE the loop continues: the next train step
+        # donates these buffers, so the copy must be complete (numpy owns
+        # its memory) by the time save() returns
+        host_state = _to_host(state)
+        self._thread = threading.Thread(
+            target=self._commit,
+            args=(ckpt_dir, host_state, config, int(iteration),
+                  float(monitor_best), bool(save_best)),
+            name="ckpt-commit",
+            # daemonic: a crash elsewhere must not hang the process on a
+            # disk write; an interrupted commit leaves a torn (meta-less)
+            # directory that find_latest_checkpoint ignores
+            daemon=True,
+        )
+        self._thread.start()
+        return time.monotonic() - t0
+
+    def wait(self, raise_error: bool = True, timeout: Optional[float] = None):
+        """Join the in-flight commit (no-op when idle).
+
+        With ``raise_error`` the commit's exception re-raises here as
+        :class:`AsyncCheckpointError`; otherwise it is logged and cleared
+        (the ``finally``-path mode — a teardown barrier must not mask the
+        original exception).
+        """
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():  # timed out; keep the handle for a later wait
+                return
+            self._thread = None
+        err, self._error = self._error, None
+        if err is None:
+            return
+        if raise_error:
+            raise AsyncCheckpointError(
+                f"background checkpoint commit failed: {err!r}"
+            ) from err
+        logger.error("background checkpoint commit failed: %r", err)
+
+    # -- the writer thread -------------------------------------------------
+
+    def _commit(self, ckpt_dir, host_state, config, iteration,
+                monitor_best, save_best):
+        t0 = time.monotonic()
+        try:
+            path = save_checkpoint(
+                ckpt_dir, host_state, config, iteration, monitor_best,
+                save_best=save_best,
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced at the barrier
+            self._error = e
+            return
+        seconds = time.monotonic() - t0
+        self.last_commit_s = seconds
+        self.commits += 1
+        try:
+            from esr_tpu.obs import active_sink
+
+            sink = active_sink()
+            if sink is not None:
+                sink.span(
+                    "checkpoint_commit", seconds,
+                    iteration=iteration, best=save_best, path=path,
+                )
+        except Exception:  # noqa: BLE001 - telemetry never fails a commit
+            pass

@@ -88,11 +88,19 @@ def save_checkpoint(
     # meta.yml is the COMMIT MARKER: it must only exist once the async Orbax
     # save has landed, so a preemption mid-save leaves a directory that
     # find_latest_checkpoint will ignore rather than a torn checkpoint.
+    # Written temp-then-rename: `open(meta.yml, "w")` would CREATE the
+    # marker before a single byte of yaml landed, so a writer killed
+    # mid-dump (the async commit thread's exact preemption window,
+    # tests/test_async_checkpoint.py) would leave a present-but-torn
+    # marker; os.replace makes the marker appear atomically, complete.
     ckptr.wait_until_finished()
     if jax.process_index() == 0:
         for path in paths:
-            with open(os.path.join(path, "meta.yml"), "w") as f:
+            meta_path = os.path.join(path, "meta.yml")
+            tmp_path = meta_path + ".tmp"
+            with open(tmp_path, "w") as f:
                 yaml.safe_dump(meta, f, sort_keys=False)
+            os.replace(tmp_path, meta_path)
             logger.info("Saved checkpoint: %s", path)
     return paths[-1]
 

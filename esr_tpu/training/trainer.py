@@ -98,6 +98,55 @@ class Trainer:
         self.valid_step = int(it_cfg.get("valid_step", 1000))
         lr_change_rate = it_cfg.get("lr_change_rate")
 
+        # persistent XLA compile cache (trainer.compile_cache): enabled
+        # BEFORE any jit is built so every compile this run does is
+        # cache-eligible. The win is the `-r auto` preemption/requeue loop:
+        # a relaunched run skips recompiling programs an earlier process
+        # already lowered (platform-keyed — CPU smoke entries never collide
+        # with TPU entries). True = artifacts/xla_cache; a string = that
+        # directory. docs/PERF.md "the serial tail".
+        self.compile_cache_dir = None
+        cc = trainer_cfg.get("compile_cache", False)
+        if cc:
+            from esr_tpu.utils.xla_cache import enable_compile_cache
+
+            self.compile_cache_dir = enable_compile_cache(cc)
+
+        # async checkpointing (trainer.async_checkpoint): the save's
+        # blocking cost on the super-step critical path shrinks to the
+        # device->host snapshot; the Orbax-arrays-then-meta.yml commit runs
+        # on a background writer thread, barriered before the next
+        # snapshot, the final save, and train()'s finally
+        # (training/async_checkpoint.py, docs/PERF.md "the serial tail").
+        self.async_checkpoint = bool(
+            trainer_cfg.get("async_checkpoint", False)
+        )
+        self._async_ckpt = None
+        if self.async_checkpoint:
+            from esr_tpu.training.async_checkpoint import AsyncCheckpointer
+
+            self._async_ckpt = AsyncCheckpointer()
+
+        # scan-fused validation (trainer.validate): route _valid through
+        # the production make_multi_step/lax.scan machinery — chunk_windows
+        # eval steps fused per dispatch, metric sums accumulated ON DEVICE
+        # in the scan carry, ONE host readback per validation pass instead
+        # of one per batch. fused: false restores the per-batch path
+        # (numerics agree to f32 accumulation order, ~1e-7 rel).
+        vcfg = trainer_cfg.get("validate", {}) or {}
+        self.valid_fused = bool(vcfg.get("fused", True))
+        self.valid_chunk = int(vcfg.get("chunk_windows", 8))
+        if self.valid_chunk < 1:
+            raise ValueError(
+                f"validate.chunk_windows must be >= 1, got {self.valid_chunk}"
+            )
+        self._eval_chunk = None
+        self._eval_accum = None
+        # host sync points of the most recent validation pass (fused: 1;
+        # sequential: one per batch) — the bench ckpt_overlap stage and the
+        # one-readback acceptance test read this
+        self.last_valid_readbacks = 0
+
         # seeding policy
         self.shard_id, self.num_shards = process_shard_info()
         self.is_main = self.shard_id == 0
@@ -192,6 +241,7 @@ class Trainer:
             from esr_tpu.training.train_step import make_device_rasterizer
 
             rasterize = make_device_rasterizer(self.train_loader.gt_resolution)
+        self._rasterize = rasterize
         base_step = make_train_step(
             self.model, self.optimizer, self.seqn,
             remat=remat, compute_dtype=compute_dtype,
@@ -491,8 +541,28 @@ class Trainer:
 
     def _valid(self, stamp: int) -> Dict[str, float]:
         """Full pass over the validation loader (reference ``_valid``,
-        ``:541-633``). Metrics from jit are global; averaged over batches."""
+        ``:541-633``). Metrics from jit are global; averaged over batches.
+
+        Dispatches to the scan-fused path (``trainer.validate.fused``, the
+        default) or the legacy per-batch path; both produce the same
+        averages (identical math, f32 accumulation order differs by ~1e-7
+        rel — pinned at 1e-5 by ``tests/test_trainer.py``)."""
         assert self.valid_loader is not None
+        if self.valid_fused:
+            return self._valid_fused(stamp)
+        return self._valid_sequential(stamp)
+
+    def _stamp_valid(self, stamp: int) -> Dict[str, float]:
+        result = self.valid_metrics.result()
+        if self.writer is not None:
+            for k, v in result.items():
+                self.writer.add_scalar(f"stamp_{k}", v, step=stamp)
+        return result
+
+    def _valid_sequential(self, stamp: int) -> Dict[str, float]:
+        """The per-batch path: one eval dispatch + one host readback per
+        batch (kept for A/B parity and as the ``validate.fused: false``
+        fallback)."""
         self.valid_metrics.reset()
         # keep device metrics in flight: float() right after dispatch forces
         # a host round-trip per batch, serializing the pipeline. A bounded
@@ -502,12 +572,15 @@ class Trainer:
         from collections import deque
 
         pending: deque = deque()
+        readbacks = 0
 
         def drain(out):
+            nonlocal readbacks
             self.valid_metrics.update("valid_loss", float(out["valid_loss"]))
             self.valid_metrics.update(
                 "valid_mse_loss", float(out["valid_mse_loss"])
             )
+            readbacks += 1
 
         for batch in self.valid_loader:
             pending.append(
@@ -517,11 +590,140 @@ class Trainer:
                 drain(pending.popleft())
         while pending:
             drain(pending.popleft())
-        result = self.valid_metrics.result()
-        if self.writer is not None:
-            for k, v in result.items():
-                self.writer.add_scalar(f"stamp_{k}", v, step=stamp)
-        return result
+        self.last_valid_readbacks = readbacks
+        return self._stamp_valid(stamp)
+
+    def _build_fused_eval(self) -> None:
+        """Compile the fused validation programs (once per run).
+
+        ``eval_chunk`` is ``chunk_windows`` eval steps chained through the
+        production :func:`~esr_tpu.training.multistep.make_multi_step` /
+        ``lax.scan`` machinery (the exact pattern the streaming inference
+        engine ships): the carry is ``(params, metric sums)``, each scanned
+        step adds its globally-reduced scalars into the sums ON DEVICE.
+        ``eval_accum`` is the single-batch tail program (ragged final
+        batches / short tails stay off the scanned program's static
+        shapes). Neither performs a host readback; neither donates (the
+        carry aliases ``self.state.params``)."""
+        from esr_tpu.analysis.retrace_guard import checked_jit
+        from esr_tpu.training.multistep import make_multi_step
+        from esr_tpu.training.train_step import make_eval_step
+
+        eval_fn = make_eval_step(
+            self.model, self.seqn, rasterize=self._rasterize
+        )
+
+        def accum(carry, batch):
+            params, sums = carry
+            out = eval_fn(params, batch)
+            sums = {
+                "valid_loss": sums["valid_loss"] + out["valid_loss"],
+                "valid_mse_loss": (
+                    sums["valid_mse_loss"] + out["valid_mse_loss"]
+                ),
+                "count": sums["count"] + 1.0,
+            }
+            return (params, sums), {}
+
+        repl = NamedSharding(self.mesh, P())
+        data = NamedSharding(self.mesh, P("data"))
+        mega = NamedSharding(self.mesh, P(None, "data"))
+        self._eval_chunk = checked_jit(
+            make_multi_step(accum, self.valid_chunk),
+            name="eval_chunk",
+            in_shardings=((repl, repl), mega),
+            out_shardings=repl,
+        )
+        self._eval_accum = checked_jit(
+            lambda carry, batch: accum(carry, batch)[0],
+            name="eval_accum",
+            in_shardings=((repl, repl), data),
+            out_shardings=repl,
+        )
+
+    def _fused_readback(self, sums) -> Dict[str, float]:
+        """THE one device->host sync of a fused validation pass (counted by
+        the one-readback acceptance test; everything before it only
+        dispatches)."""
+        # host-sync audit: one jax.device_get of three scalars per
+        # validation PASS — the readback the fusion exists to amortize
+        host = jax.device_get(sums)
+        return {k: float(v) for k, v in host.items()}
+
+    def _valid_fused(self, stamp: int) -> Dict[str, float]:
+        """Scan-fused validation: ``chunk_windows`` eval batches per
+        dispatch, metric sums riding the scan carry, ONE readback per pass.
+
+        Batches are grouped host-side exactly like the train loop's
+        megabatches (``collate_megabatch``/``stage_megabatch``); a shape
+        change mid-stream (ragged final batch with ``drop_last: false``, a
+        resolution change across recordings) flushes the open group through
+        the single-batch tail program so the scanned program's shapes stay
+        static."""
+        from esr_tpu.data.loader import collate_megabatch
+        from esr_tpu.parallel.mesh import stage_megabatch
+
+        if self._eval_chunk is None:
+            self._build_fused_eval()
+        self.valid_metrics.reset()
+        t0 = time.monotonic()
+        zero = jnp.zeros((), jnp.float32)
+        carry = (
+            self.state.params,
+            {"valid_loss": zero, "valid_mse_loss": zero, "count": zero},
+        )
+        n_batches = 0
+        n_dispatches = 0
+        buf = []
+
+        def flush(group):
+            nonlocal carry, n_dispatches
+            if not group:
+                return
+            if len(group) == self.valid_chunk:
+                mega = stage_megabatch(collate_megabatch(group), self.mesh)
+                carry, _ = self._eval_chunk(carry, mega)
+                n_dispatches += 1
+            else:
+                for sel in group:
+                    carry = self._eval_accum(
+                        carry, stage_batch(sel, self.mesh)
+                    )
+                    n_dispatches += 1
+
+        for batch in self.valid_loader:
+            sel = self._select(batch)
+            if buf and any(
+                sel[k].shape != buf[0][k].shape for k in sel
+            ):
+                flush(buf)
+                buf = []
+            buf.append(sel)
+            n_batches += 1
+            if len(buf) == self.valid_chunk:
+                flush(buf)
+                buf = []
+        flush(buf)
+
+        sums = self._fused_readback(carry[1])
+        self.last_valid_readbacks = 1
+        n = int(round(sums["count"]))
+        if n:
+            # one n-weighted tracker update per key: avg() and the emitted
+            # sink record weight exactly like n per-batch updates would
+            self.valid_metrics.update(
+                "valid_loss", sums["valid_loss"] / n, n=n
+            )
+            self.valid_metrics.update(
+                "valid_mse_loss", sums["valid_mse_loss"] / n, n=n
+            )
+        if self.sink is not None:
+            self.sink.span(
+                "validate_fused", time.monotonic() - t0,
+                stamp=stamp, batches=n_batches, dispatches=n_dispatches,
+                chunk_windows=self.valid_chunk, readbacks=1,
+            )
+        return self._stamp_valid(stamp)
 
     def eval_model_performance(self, log: Dict[str, float]):
         """Early-stop / best bookkeeping (reference ``:383-424``)."""
@@ -556,7 +758,26 @@ class Trainer:
     def _save(self, iteration: int, best: bool) -> None:
         # EVERY process participates: Orbax saves are collective under
         # jax.distributed (save_checkpoint writes meta/arrays from the
-        # primary host only).
+        # primary host only; the async path preserves this — every
+        # process's writer thread runs the same collective commit).
+        if self._async_ckpt is not None:
+            # blocking cost = barrier(previous commit) + device->host
+            # snapshot; the arrays-then-meta.yml commit overlaps the next
+            # super-steps on the writer thread (training/async_checkpoint)
+            snap_s = self._async_ckpt.save(
+                self.run.save_dir,
+                self.state,
+                self.run.config,
+                iteration,
+                self.mnt_best,
+                save_best=best,
+            )
+            if self.sink is not None:
+                self.sink.span(
+                    "checkpoint_snapshot", snap_s,
+                    iteration=int(iteration), best=bool(best),
+                )
+            return
         save_checkpoint(
             self.run.save_dir,
             self.state,
@@ -697,6 +918,15 @@ class Trainer:
                 # the try so the finally's deactivation is unconditional:
                 # nothing may raise between install and uninstall
                 set_active_sink(self.sink)
+                # stamp the cache state next to the compile events it
+                # explains: on a warm cache the same `compile` records
+                # show near-zero XLA cost (the trace still runs; the
+                # lowering is served from disk)
+                self.sink.event(
+                    "compile_cache",
+                    enabled=self.compile_cache_dir is not None,
+                    dir=self.compile_cache_dir,
+                )
             while not stop:
                 self.train_loader.set_epoch(epoch)
                 # host->device upload pipelined ahead of the consuming step;
@@ -858,6 +1088,10 @@ class Trainer:
                             self._attr.close()
                 epoch += 1
             drain()
+            if self._async_ckpt is not None:
+                # barrier the final commit INSIDE the try: a failed
+                # background save must fail the run, not vanish with it
+                self._async_ckpt.wait()
             completed = True
         finally:
             # teardown is exception-safe: a crash mid-run must still
@@ -866,6 +1100,11 @@ class Trainer:
             # capture every later component in this process into a
             # dead run's telemetry file
             self._stage_spans.clear()
+            if self._async_ckpt is not None:
+                # exception path: join (and log, never re-raise — the
+                # original exception owns the traceback) so no commit
+                # outlives the run or writes after the sink closed
+                self._async_ckpt.wait(raise_error=False)
             if profiling:
                 jax.profiler.stop_trace()
             if self.writer is not None:

@@ -1,0 +1,58 @@
+"""Persistent XLA compile cache: one switch shared by bench + entry points.
+
+``bench.py`` has carried this since r4 (heal windows are ~25 min and the
+staged ladder is compile-heavy; a watcher re-run after a mid-ladder wedge
+must not pay the same compiles twice). The production entry points pay the
+same tax on every ``-r auto`` requeue: a preempted ``train.py`` relaunch
+recompiles the identical fused super-step / eval programs before the first
+resumed iteration, and the phase-runner ``infer.py`` evals recompile the
+identical forward per checkpoint. This module is the one place the cache
+gets turned on — ``trainer.compile_cache`` (train), the checkpoint
+config / ``--compile_cache`` (infer), and ``bench.py`` all route here.
+
+The cache key includes the platform, so CPU smoke entries never collide
+with TPU entries; the directory defaults to the same ``artifacts/xla_cache``
+bench always used (gitignored). Enabling is best-effort: the cache is an
+optimization only and must never take a run down.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Union
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, "artifacts", "xla_cache")
+
+
+def enable_compile_cache(
+    enabled: Union[bool, str, None] = True,
+    min_compile_time_secs: float = 0.5,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a directory.
+
+    ``enabled``: falsy → no-op (returns None); ``True`` → the repo default
+    ``artifacts/xla_cache``; a string → that directory. Returns the
+    directory on success, None when disabled or unavailable (logged,
+    never raised). Idempotent — later calls just re-point the config.
+    """
+    if not enabled:
+        return None
+    cache_dir = enabled if isinstance(enabled, str) else DEFAULT_CACHE_DIR
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_time_secs),
+        )
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        logger.warning("persistent compile cache unavailable: %r", e)
+        return None
+    return cache_dir

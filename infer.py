@@ -55,6 +55,19 @@ def get_flags():
                    help="windows scan-fused per dispatch (engine mode; "
                         "default: checkpoint config, else 8)")
 
+    # persistent XLA compile cache (docs/PERF.md "the serial tail"):
+    # tri-state like --engine — an omitted flag defers to the checkpoint
+    # config's trainer.compile_cache (the flagship recipes opt in), so
+    # per-checkpoint eval loops stop recompiling identical programs.
+    p.add_argument("--compile_cache", dest="compile_cache",
+                   action="store_true", default=None,
+                   help="persistent XLA compile cache (artifacts/xla_cache,"
+                        " platform-keyed)")
+    p.add_argument("--no_compile_cache", dest="compile_cache",
+                   action="store_false",
+                   help="disable the cache even when the checkpoint "
+                        "config enables it")
+
     # dataset overrides (reference get_flags, infer_ours_cnt.py:135-157)
     p.add_argument("--scale", type=int, default=4)
     p.add_argument("--seqn", type=int, default=3)
@@ -126,6 +139,7 @@ def main():
         engine=flags.engine,
         lanes=flags.lanes,
         chunk_windows=flags.chunk_windows,
+        compile_cache=flags.compile_cache,
     )
     # One machine-readable JSON line (ADVICE r4: consumers must not eval()
     # a repr). json.dumps emits bare NaN/Infinity tokens for non-finite

@@ -34,6 +34,16 @@ while true; do
   }
   sleep 30
 done
+# ADVICE r5 (medium): the runner gate above matches only the exact cmdline
+# 'bash scripts/run_r5_phase_g.sh' — a dead runner can orphan its
+# backgrounded trainer, and launching ours would put TWO 'train.py -id
+# qnat4x -r auto' writers into the same checkpoint directory (the
+# double-writer corruption the async-save commit barrier also excludes).
+# Gate on the trainer PROCESS itself before taking the core.
+while pgrep -f 'python train\.py .*-id qnat4x' >/dev/null 2>&1; do
+  echo "--- waiting for orphaned qnat4x trainer to exit $(date -u +%FT%TZ)" >> "$LOG"
+  sleep 30
+done
 echo "--- phase G released the core $(date -u +%FT%TZ)" >> "$LOG"
 
 run_eval() {  # $1 = iteration; skips work that already produced results

@@ -26,11 +26,16 @@ while true; do
     echo "{\"ts\": \"$ts\", \"probe\": \"tpu_backend\", \"ok\": true, \"source\": \"watcher\"}" >> "$PROBES"
     if [ ! -f artifacts/WATCHER_BENCH_DONE ]; then
       echo "{\"ts\": \"$ts\", \"watcher\": \"bench_start\"}" >> "$PROBES"
-      # 14400s outer backstop: the per-stage watchdogs already os._exit a
-      # wedged stage, so the wrapper only has to bound a watchdog escape;
-      # it must exceed the ~13.8ks sum of stage budgets or a slow-but-
-      # progressing cold run gets killed mid-ladder (2026-08-02 review).
-      timeout -k 30 14400 python bench.py > artifacts/bench_r05_watch.log 2>&1
+      # 17400s outer backstop = sum-of-budgets + margin: the per-stage
+      # watchdogs already os._exit a wedged stage, so the wrapper only has
+      # to bound a watchdog escape — but it must exceed the FULL watchdog
+      # budget (600s bootstrap_imports + 600s backend_up + 900s
+      # build_model + 14100s registry stage budgets = 16200s) with slack
+      # for interpreter startup and inter-stage code, or a slow-but-
+      # progressing cold run gets killed mid-ladder (the old 14400 equaled
+      # the pre-ckpt_overlap sum exactly, zero slack, and its comment
+      # omitted the boot watchdog — ADVICE r5).
+      timeout -k 30 17400 python bench.py > artifacts/bench_r05_watch.log 2>&1
       rc=$?
       echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_bench_rc\": $rc}" >> "$PROBES"
       [ $rc -eq 0 ] && date -u +%FT%TZ > artifacts/WATCHER_BENCH_DONE
@@ -52,7 +57,7 @@ while true; do
       [ -f artifacts/WATCHER_CONFIRM_LAST ] && last=$(stat -c %Y artifacts/WATCHER_CONFIRM_LAST)
       if [ $(( $(date +%s) - last )) -ge 7200 ]; then
         echo "{\"ts\": \"$ts\", \"watcher\": \"bench_confirm_start\"}" >> "$PROBES"
-        timeout -k 30 14400 python bench.py > artifacts/bench_r05_confirm.log 2>&1
+        timeout -k 30 17400 python bench.py > artifacts/bench_r05_confirm.log 2>&1
         rc=$?  # capture BEFORE the echo line's $(date) resets $?
         echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"watcher_bench_confirm_rc\": $rc}" >> "$PROBES"
         touch artifacts/WATCHER_CONFIRM_LAST

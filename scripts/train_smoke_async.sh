@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Async-checkpoint smoke: a 2-super-step synthetic-data CPU train
+# (k_steps=4) with trainer.async_checkpoint on must overlap persistence —
+# blocking checkpoint_snapshot + background checkpoint_commit spans, a
+# validate_fused span reporting exactly ONE host readback — and still end
+# with a committed final checkpoint that restores bit-identically.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_train_smoke_async.py)
+# as a standalone gate; span taxonomy: docs/OBSERVABILITY.md, design:
+# docs/PERF.md "the serial tail".
+#
+# Usage: scripts/train_smoke_async.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_train_smoke_async.py -q "$@"

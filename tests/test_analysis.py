@@ -381,6 +381,78 @@ def test_esr007_plain_obs_import_does_not_taint_the_package_root():
 
 
 # ---------------------------------------------------------------------------
+# ESR008 blocking persistence in loop
+
+
+def test_esr008_flags_sync_save_and_device_get_in_loop():
+    src = (
+        "import jax\n"
+        "from esr_tpu.training.checkpoint import save_checkpoint\n"
+        "def train(loader, state):\n"
+        "    for i, batch in enumerate(loader):\n"
+        "        state = step(state, batch)\n"
+        "        if i % 100 == 0:\n"
+        "            save_checkpoint('/ckpt', state, {}, i, 0.0)\n"
+        "    while True:\n"
+        "        host = jax.device_get(state)\n"
+        "        break\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR008"]
+    assert [f.line for f in findings] == [7, 9]
+
+
+def test_esr008_outside_loop_and_snapshot_scope_are_clean():
+    src = (
+        "import jax\n"
+        "from esr_tpu.training.checkpoint import save_checkpoint\n"
+        "def save_final(state):\n"
+        "    save_checkpoint('/ckpt', state, {}, 0, 0.0)\n"
+        "def _snapshot_state(states):\n"
+        "    out = []\n"
+        "    for s in states:\n"
+        "        out.append(jax.device_get(s))\n"
+        "    return out\n"
+        "def _commit(queue):\n"
+        "    for item in queue:\n"
+        "        save_checkpoint('/ckpt', item, {}, 0, 0.0)\n"
+    )
+    assert "ESR008" not in rules_hit(src)
+
+
+def test_esr008_nested_def_in_loop_and_noqa_are_clean():
+    """A def nested inside a loop runs when CALLED, not per iteration —
+    the loop ancestry stops at function boundaries; and the standard
+    noqa escape scopes to the rule."""
+    src = (
+        "from esr_tpu.training.checkpoint import save_checkpoint\n"
+        "def train(loader, state):\n"
+        "    for batch in loader:\n"
+        "        def flush():\n"
+        "            save_checkpoint('/ckpt', state, {}, 0, 0.0)\n"
+        "        register(flush)\n"
+        "    while running():\n"
+        "        save_checkpoint('/c', state, {}, 0, 0.0)  # esr: noqa(ESR008)\n"
+    )
+    assert "ESR008" not in rules_hit(src)
+
+
+def test_esr008_traced_context_is_esr002s_beat():
+    """device_get under trace is a (worse) ESR002 hazard; ESR008 stays out
+    of traced code so one call site never double-reports."""
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(xs):\n"
+        "    for i in range(3):\n"
+        "        y = jax.device_get(xs)\n"
+        "    return y\n"
+    )
+    hits = rules_hit(src)
+    assert "ESR008" not in hits
+    assert "ESR002" in hits
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 
 

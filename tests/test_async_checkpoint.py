@@ -1,0 +1,181 @@
+"""Async checkpointing (training/async_checkpoint): torn-save safety,
+sync/async restore parity, the single-slot barrier, and the blocked-time
+reduction the overlap exists for (ISSUE 5 acceptance criteria).
+
+The commit protocol under test is the EXISTING atomic one — Orbax arrays
+first, ``meta.yml`` last — so every property here is really about what the
+background writer may and may not change: a commit killed mid-write must
+leave a directory ``find_latest_checkpoint`` ignores, a completed async
+save must be byte-for-byte a sync save, and only the snapshot may bill the
+caller's clock.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.training import async_checkpoint as ac
+from esr_tpu.training import checkpoint as ckpt_lib
+from esr_tpu.training.async_checkpoint import (
+    AsyncCheckpointer,
+    AsyncCheckpointError,
+)
+from esr_tpu.training.checkpoint import (
+    find_latest_checkpoint,
+    restore_state,
+    resume_checkpoint,
+    save_checkpoint,
+)
+
+CONFIG = {"model": {"name": "m"}, "optimizer": {"name": "o"}}
+
+
+def _state(seed=0, n=4096):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.standard_normal(n).astype(np.float32)),
+        "b": jnp.asarray(rng.standard_normal(64).astype(np.float32)),
+    }
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_async_save_restores_bit_identical_to_sync(tmp_path):
+    state = _state(1)
+    sync_dir = str(tmp_path / "sync")
+    async_dir = str(tmp_path / "async")
+    save_checkpoint(sync_dir, state, CONFIG, 7, 0.25, save_best=True)
+
+    ck = AsyncCheckpointer()
+    blocked = ck.save(async_dir, state, CONFIG, 7, 0.25, save_best=True)
+    assert blocked >= 0.0
+    ck.wait()
+    assert ck.commits == 1 and ck.last_commit_s > 0.0
+
+    for name in ("checkpoint-iteration7", "model_best_until_iteration7"):
+        meta_s = ckpt_lib.read_meta(os.path.join(sync_dir, name))
+        meta_a = ckpt_lib.read_meta(os.path.join(async_dir, name))
+        assert meta_s == meta_a
+        _assert_tree_equal(
+            restore_state(os.path.join(sync_dir, name), state),
+            restore_state(os.path.join(async_dir, name), state),
+        )
+
+
+def test_torn_commit_is_invisible_and_prior_save_restores(tmp_path):
+    """Kill the background writer between the array write and the
+    ``meta.yml`` commit: the torn directory must be invisible to
+    ``find_latest_checkpoint`` and the PREVIOUS committed save must
+    restore bit-identically — the exact preemption window the commit-
+    marker protocol exists for."""
+    root = str(tmp_path / "ckpts")
+    state1, state2 = _state(1), _state(2)
+
+    ck = AsyncCheckpointer()
+    ck.save(root, state1, CONFIG, 1, 0.5)
+    ck.wait()
+
+    def die_before_meta(*args, **kwargs):
+        raise RuntimeError("killed between arrays and meta.yml")
+
+    # checkpoint.save_checkpoint writes meta via yaml.safe_dump AFTER the
+    # Orbax arrays landed; making it die simulates the writer being killed
+    # in exactly that window
+    orig = ckpt_lib.yaml.safe_dump
+    ckpt_lib.yaml.safe_dump = die_before_meta
+    try:
+        ck.save(root, state2, CONFIG, 2, 0.4)
+        with pytest.raises(AsyncCheckpointError, match="commit failed"):
+            ck.wait()
+    finally:
+        ckpt_lib.yaml.safe_dump = orig
+
+    torn = os.path.join(root, "checkpoint-iteration2")
+    assert os.path.isdir(os.path.join(torn, "state"))  # arrays landed
+    assert not os.path.exists(os.path.join(torn, "meta.yml"))  # no marker
+
+    latest = find_latest_checkpoint(root)
+    assert latest == os.path.join(root, "checkpoint-iteration1")
+    restored, start, best = resume_checkpoint(latest, _state(9), CONFIG)
+    assert start == 2 and best == 0.5
+    _assert_tree_equal(restored, state1)
+
+    # the barrier surfaced and CLEARED the failure; the writer retries
+    # into the same directory (force=True overwrite) and commits
+    ck.save(root, state2, CONFIG, 2, 0.4)
+    ck.wait()
+    assert find_latest_checkpoint(root) == torn
+    _assert_tree_equal(restore_state(torn, state2), state2)
+
+
+def test_single_slot_barrier_excludes_concurrent_commits(tmp_path, monkeypatch):
+    """At most one commit in flight: save N+1's snapshot may not start
+    until commit N finished — the double-writer exclusion that keeps two
+    writers from racing into one checkpoint directory."""
+    events = []
+    gate = threading.Event()
+
+    def slow_commit(ckpt_dir, state, config, iteration, best, save_best=False):
+        events.append(("start", iteration))
+        gate.wait(5.0)
+        events.append(("end", iteration))
+        return ckpt_dir
+
+    monkeypatch.setattr(ac, "save_checkpoint", slow_commit)
+    ck = AsyncCheckpointer()
+    ck.save(str(tmp_path), _state(1), CONFIG, 1, 0.0)
+    assert ck.in_flight
+
+    def release():
+        time.sleep(0.2)
+        gate.set()
+
+    threading.Thread(target=release, daemon=True).start()
+    ck.save(str(tmp_path), _state(2), CONFIG, 2, 0.0)  # barriers on commit 1
+    ck.wait()
+    assert events == [("start", 1), ("end", 1), ("start", 2), ("end", 2)]
+
+
+def test_blocked_time_reduced_at_least_5x(tmp_path):
+    """The acceptance number: blocked-ms per save drops >= 5x vs sync on a
+    CPU synthetic state (the bench ckpt_overlap stage records the same
+    measurement per round). Sync pays fetch + Orbax write +
+    wait_until_finished + meta; async pays barrier + host snapshot +
+    thread start. min-of-reps on both sides — contention only ADDS time."""
+    mb = 32
+    n = int(mb * 1e6 / 4 / 8)
+    rng = np.random.default_rng(0)
+    state = {
+        f"w{i}": jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        for i in range(8)
+    }
+
+    sync_dir = str(tmp_path / "sync")
+    sync_ms = []
+    for i in range(2):
+        t0 = time.perf_counter()
+        save_checkpoint(sync_dir, state, CONFIG, i, 0.0)
+        sync_ms.append((time.perf_counter() - t0) * 1e3)
+
+    ck = AsyncCheckpointer()
+    async_dir = str(tmp_path / "async")
+    async_ms = []
+    for i in range(2):
+        t0 = time.perf_counter()
+        ck.save(async_dir, state, CONFIG, i, 0.0)
+        async_ms.append((time.perf_counter() - t0) * 1e3)
+        # join OUTSIDE the blocked timer: in production the commit overlaps
+        # the next super-steps' device compute (save_period >> commit time)
+        ck.wait()
+
+    assert min(sync_ms) / min(async_ms) >= 5.0, (sync_ms, async_ms)

@@ -36,6 +36,7 @@ def test_stage_registry_names_order_and_timeouts():
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "e2e",
         "e2e_device_raster", "scaling", "breakdown", "infer_throughput",
+        "ckpt_overlap",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -105,6 +106,25 @@ def test_infer_throughput_stage_registered_and_schema_pinned():
     assert bench.INFER_THROUGHPUT_KEYS == (
         "seq_windows_per_sec", "engine_windows_per_sec", "speedup",
         "windows", "recordings", "lanes", "chunk_windows",
+    )
+
+
+def test_ckpt_overlap_stage_registered_and_schema_pinned():
+    """The serial-tail perf series (ISSUE 5): blocked-ms per save (sync vs
+    async checkpointing) and validation readbacks per pass must stay
+    machine-comparable across rounds, and the stage is host/filesystem-
+    bound by design so it runs in smoke (CPU) too."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "ckpt_overlap"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_ckpt_overlap
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.CKPT_OVERLAP_KEYS == (
+        "sync_blocked_ms", "async_blocked_ms", "blocked_speedup",
+        "commit_ms", "saves", "state_mb", "restore_bitwise",
+        "valid_readbacks_sequential", "valid_readbacks_fused",
+        "valid_batches",
     )
 
 

@@ -1,0 +1,182 @@
+"""Async-checkpoint smoke (tier-1, also driven by
+``scripts/train_smoke_async.sh``): a 2-super-step synthetic-data CPU train
+with ``trainer.async_checkpoint: true`` must overlap its persistence and
+still end fully committed.
+
+The acceptance contract (ISSUE 5 / docs/PERF.md "the serial tail"):
+
+- the telemetry stream carries the split checkpoint spans — a blocking
+  ``checkpoint_snapshot`` per save on the loop thread and a background
+  ``checkpoint_commit`` per save from the writer thread — plus one
+  ``validate_fused`` span per validation pass reporting exactly ONE host
+  readback;
+- the attribution records still resolve (one per super-step; the
+  ``checkpoint_s`` wall component is now snapshot-only);
+- the final checkpoint is COMMITTED (the end-of-run barrier joined the
+  writer before teardown): ``find_latest_checkpoint`` discovers it,
+  ``resume_checkpoint`` resumes past the final iteration, and the
+  restored state equals the trainer's final state bit-for-bit.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from esr_tpu.config.parser import RunConfig
+from esr_tpu.data.synthetic import write_synthetic_h5
+from esr_tpu.training.checkpoint import (
+    _to_host,
+    find_latest_checkpoint,
+    resume_checkpoint,
+)
+from esr_tpu.training.trainer import Trainer
+
+K_STEPS = 4
+SUPER_STEPS = 2
+
+
+def _smoke_config(tmp_path, datalist):
+    dataset = {
+        "scale": 2,
+        "ori_scale": "down4",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 128,
+        "sliding_window": 64,
+        "need_gt_events": True,
+        "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+        "sequence": {
+            "sequence_length": 4,
+            "seqn": 3,
+            "step_size": 2,
+            "pause": {"enabled": False},
+        },
+    }
+    loader = {
+        "path_to_datalist_txt": datalist,
+        "batch_size": 8,
+        "shuffle": True,
+        "drop_last": True,
+        "prefetch": 0,
+        "dataset": dataset,
+    }
+    return {
+        "experiment": "async_smoke",
+        "model": {
+            "name": "DeepRecurrNet",
+            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+        },
+        "optimizer": {
+            "name": "Adam",
+            "args": {"lr": 1e-3, "weight_decay": 1e-4, "amsgrad": True},
+        },
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": str(tmp_path / "out"),
+            "iteration_based_train": {
+                "enabled": True,
+                "iterations": K_STEPS * SUPER_STEPS,
+                # one cadence save (covered by super-step 2) + the final
+                # save fold into a single committed checkpoint-iteration7
+                "save_period": K_STEPS,
+                "train_log_step": K_STEPS,
+                "valid_step": K_STEPS,
+                "lr_change_rate": 4000,
+            },
+            "monitor": "off",
+            "tensorboard": False,
+            "vis": {"enabled": False},
+            "k_steps": K_STEPS,
+            "async_checkpoint": True,
+            "validate": {"fused": True, "chunk_windows": 2},
+        },
+        "train_dataloader": loader,
+        "valid_dataloader": dict(loader, shuffle=False),
+    }
+
+
+@pytest.fixture(scope="module")
+def smoke(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("async_smoke")
+    paths = []
+    for i in range(2):
+        p = str(tmp / f"rec{i}.h5")
+        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6,
+                           seed=i)
+        paths.append(p)
+    datalist = str(tmp / "datalist.txt")
+    with open(datalist, "w") as f:
+        f.write("\n".join(paths) + "\n")
+
+    run = RunConfig(_smoke_config(tmp, datalist), runid="async", seed=0)
+    trainer = Trainer(run)
+    result = trainer.train()
+
+    tel_path = os.path.join(run.log_dir, "telemetry.jsonl")
+    with open(tel_path) as f:
+        records = [json.loads(line) for line in f]
+    return run, trainer, result, records
+
+
+def test_train_completes_with_finite_loss(smoke):
+    _, trainer, result, _ = smoke
+    assert np.isfinite(result["train_loss"])
+    # the end-of-run barrier left nothing in flight
+    assert not trainer._async_ckpt.in_flight
+    assert trainer._async_ckpt.commits == 1
+
+
+def test_checkpoint_spans_split_into_snapshot_and_commit(smoke):
+    _, _, _, records = smoke
+    spans = [r for r in records if r["type"] == "span"]
+    snaps = [s for s in spans if s["name"] == "checkpoint_snapshot"]
+    commits = [s for s in spans if s["name"] == "checkpoint_commit"]
+    assert len(snaps) == 1 and len(commits) == 1
+    assert snaps[0]["iteration"] == commits[0]["iteration"] == 7
+    assert snaps[0]["seconds"] >= 0 and commits[0]["seconds"] > 0
+    assert commits[0]["path"].endswith("checkpoint-iteration7")
+    # the commit resolves AFTER its snapshot (background writer)
+    assert commits[0]["t"] >= snaps[0]["t"]
+
+
+def test_validate_fused_span_reports_one_readback(smoke):
+    _, _, _, records = smoke
+    vf = [
+        r for r in records
+        if r["type"] == "span" and r["name"] == "validate_fused"
+    ]
+    assert len(vf) == 1
+    assert vf[0]["readbacks"] == 1
+    assert vf[0]["batches"] >= 2
+    assert vf[0]["chunk_windows"] == 2
+
+
+def test_attribution_records_still_resolve(smoke):
+    _, _, _, records = smoke
+    attrs = [r for r in records if r["type"] == "attribution"]
+    assert len(attrs) == SUPER_STEPS
+    assert [a["first_iteration"] for a in attrs] == [0, K_STEPS]
+    # the save's critical-path cost is now snapshot-only but non-zero,
+    # and the fused validation still bills the validate span
+    assert attrs[1]["checkpoint_s"] > 0
+    assert attrs[1]["validate_s"] > 0
+    # cache state is stamped next to the compile events it explains
+    cc = [r for r in records if r["name"] == "compile_cache"]
+    assert len(cc) == 1 and cc[0]["enabled"] is False
+
+
+def test_final_checkpoint_committed_and_restores(smoke):
+    run, trainer, _, _ = smoke
+    exp_root = os.path.dirname(run.save_dir)
+    latest = find_latest_checkpoint(exp_root)
+    assert latest is not None and latest.endswith("checkpoint-iteration7")
+    template = trainer.state
+    restored, start, _ = resume_checkpoint(latest, template, run.config)
+    assert start == K_STEPS * SUPER_STEPS
+    final = _to_host(trainer.state)
+    for x, y in zip(jax.tree.leaves(final), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

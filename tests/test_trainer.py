@@ -254,6 +254,84 @@ def test_device_prefetch_bitwise_equals_inline_staging(corpus, tmp_path):
         np.testing.assert_array_equal(x, y)
 
 
+def test_valid_fused_one_readback_and_parity(corpus, tmp_path):
+    """Scan-fused validation (trainer.validate, the default): exactly ONE
+    host readback per validation pass — counted on the `_fused_readback`
+    choke point every fused sync must route through — with metrics
+    numerically identical to the per-batch path at 1e-5 rel (acceptance
+    criteria, ISSUE 5). chunk_windows=3 over the 64-batch corpus pass
+    exercises the scanned program (21 full chunks) AND the short-tail
+    fallback (the 64th batch)."""
+    tmp, datalist = corpus
+    config = _make_config(tmp_path, datalist, iterations=4, valid_step=100)
+    config["trainer"]["validate"] = {"fused": True, "chunk_windows": 3}
+    run = RunConfig(config, runid="vfused", seed=0)
+    trainer = Trainer(run)
+    assert trainer.valid_fused and trainer.valid_chunk == 3
+
+    calls = []
+    orig = trainer._fused_readback
+
+    def spy(sums):
+        calls.append(1)
+        return orig(sums)
+
+    trainer._fused_readback = spy
+    fused = trainer._valid(1)
+    assert len(calls) == 1
+    assert trainer.last_valid_readbacks == 1
+
+    trainer.valid_fused = False
+    seq = trainer._valid(2)
+    # per-batch path syncs once per batch — the cost the fusion removes
+    assert trainer.last_valid_readbacks >= 2
+    assert set(fused) == set(seq) == {"valid_loss", "valid_mse_loss"}
+    for k in fused:
+        np.testing.assert_allclose(fused[k], seq[k], rtol=1e-5)
+
+    bad = _make_config(tmp_path, datalist)
+    bad["trainer"]["validate"] = {"chunk_windows": 0}
+    with pytest.raises(ValueError, match="chunk_windows"):
+        Trainer(RunConfig(bad, runid="vbad", seed=0))
+
+
+def test_async_checkpoint_trainer_bit_identical_to_sync(corpus, tmp_path):
+    """trainer.async_checkpoint is a pure overlap change: the same
+    seed/config trains identically and the async-saved checkpoint restores
+    bit-identically to the sync-saved one (acceptance criteria, ISSUE 5).
+    The cadence save (iteration 2) and the final-state save (iteration 3,
+    via the end-of-run barrier) both land committed."""
+    tmp, datalist = corpus
+
+    def run_mode(async_on, runid):
+        config = _make_config(tmp_path, datalist, iterations=4,
+                              valid_step=100, save_period=2)
+        config["trainer"]["async_checkpoint"] = async_on
+        run = RunConfig(config, runid=runid, seed=5)
+        trainer = Trainer(run)
+        assert (trainer._async_ckpt is not None) == async_on
+        trainer.train()
+        return run, trainer
+
+    run_s, t_s = run_mode(False, "cksync")
+    run_a, t_a = run_mode(True, "ckasync")
+    assert t_a._async_ckpt.commits == 2  # iteration-2 cadence + final
+
+    for it in (2, 3):
+        name = f"checkpoint-iteration{it}"
+        meta_s = ckpt_lib.read_meta(os.path.join(run_s.save_dir, name))
+        meta_a = ckpt_lib.read_meta(os.path.join(run_a.save_dir, name))
+        assert meta_s["trainer"] == meta_a["trainer"]
+        rs = ckpt_lib.restore_state(
+            os.path.join(run_s.save_dir, name), t_s.state
+        )
+        ra = ckpt_lib.restore_state(
+            os.path.join(run_a.save_dir, name), t_a.state
+        )
+        for x, y in zip(jax.tree.leaves(rs), jax.tree.leaves(ra)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
 @pytest.mark.slow
 def test_trainer_k_steps_matches_k1(corpus, tmp_path):
     """trainer.k_steps (K-step fused training) is a pure batching change:
