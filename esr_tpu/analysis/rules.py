@@ -31,6 +31,13 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   accelerator idles for the full fetch+write on every pass (the
   stop-the-world tail ISSUE 5 removed). Persist through a snapshot
   barrier + background commit (``training/async_checkpoint``) instead.
+- ESR009 unbounded-queue-wait — ``queue.Queue`` ``.get()``/``.put(...)``
+  with no ``timeout`` (and not ``block=False``) inside a host loop body:
+  a serving/producer loop parked on an unbounded wait can never observe
+  shutdown, backpressure, or a died peer — the loop wedges exactly like
+  the ``backend_up`` hang this repo's bench guards against. Bound every
+  wait and handle ``queue.Empty``/``queue.Full`` (the
+  ``DevicePrefetcher`` producer's 0.2s-timeout put is the house pattern).
 
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
@@ -39,7 +46,7 @@ flax ``__call__``), keeping the default run clean enough to gate CI.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Set
+from typing import Dict, Iterable, List, Set
 
 from esr_tpu.analysis.core import (
     Finding,
@@ -513,6 +520,105 @@ class BlockingPersistenceInLoop(Rule):
                 node,
                 f"blocking persistence call {what} inside a host loop "
                 "body (outside a snapshot barrier)",
+            )
+
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+
+def _queue_names(tree: ast.AST) -> Dict[str, str]:
+    """``{dotted receiver name: ctor}`` for names assigned from a
+    ``queue``-class constructor in this module (``self._q =
+    queue.Queue(...)`` -> ``{"self._q": "Queue"}``; ``q = Queue()`` ->
+    ``{"q": "Queue"}``). File-local on purpose, like every rule here — a
+    queue passed across modules is out of lint scope."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        ctor = _call_name(value.func)
+        if ctor not in _QUEUE_CTORS:
+            continue
+        for t in targets:
+            dotted = _dotted(t)
+            if dotted:
+                out[dotted] = ctor
+    return out
+
+
+@register_rule
+class UnboundedQueueWait(Rule):
+    name = "ESR009"
+    slug = "unbounded-queue-wait"
+    severity = "warning"
+    hint = (
+        "a queue get()/put() with no timeout inside a loop can park the "
+        "serving/producer loop forever — it never observes shutdown, "
+        "backpressure, or a died peer. Pass timeout= and handle "
+        "queue.Empty/queue.Full (re-checking the stop flag each lap, as "
+        "DevicePrefetcher._produce does), use the _nowait variants, or "
+        "justify with `# esr: noqa(ESR009)`"
+    )
+
+    def _loop_enclosed(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """Lexically inside a ``while``/``for`` body of the SAME function
+        (a nested def runs when called, not per loop iteration) — the
+        ESR008 ancestry walk."""
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+                return True
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+            cur = ctx.parents.get(cur)
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        queues = _queue_names(ctx.tree)
+        if not queues:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in ("get", "put"):
+                continue
+            recv = _dotted(func.value)
+            if recv not in queues:
+                continue
+            if func.attr == "put" and queues[recv] == "SimpleQueue":
+                continue  # SimpleQueue is unbounded; its put never blocks
+            if ctx.in_traced_context(node):
+                continue  # a queue under trace is a different disaster
+            if not self._loop_enclosed(ctx, node):
+                continue
+            kw = {k.arg: k.value for k in node.keywords}
+            # block/timeout are accepted positionally too:
+            # get(block, timeout) / put(item, block, timeout)
+            pos = node.args[1:] if func.attr == "put" else list(node.args)
+            if "timeout" in kw or len(pos) >= 2:
+                continue
+            block = kw.get("block", pos[0] if pos else None)
+            if (isinstance(block, ast.Constant)
+                    and block.value is False):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"unbounded blocking `{recv}.{func.attr}(...)` inside a "
+                "host loop body (no timeout)",
             )
 
 

@@ -85,6 +85,111 @@ _METRIC_FNS = {
 }
 
 
+def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int):
+    """Build the PURE fused-chunk program: ``(params, states, reset_keep,
+    windows) -> (states, sums, stacked)``.
+
+    One dispatch processes ``chunk_windows`` consecutive seqn-windows for
+    each of ``lanes`` batch lanes: masked lane states are reset, the
+    windows are scanned via the production ``make_multi_step`` machinery,
+    and per-lane metric sums accumulate in the carry (module docstring).
+    Returned UNJITTED so every consumer shares one definition:
+
+    - :class:`StreamingEngine` wraps it in ``checked_jit`` with the
+      recurrent-state carry donated (the traced path);
+    - ``inference/export.py:export_chunk_program`` lowers it through
+      ``jax.export`` into the AOT artifact the serving tier
+      (``esr_tpu.serving``) loads so the serving process never traces;
+    - the serving tier's per-request-class chunk sizing builds one program
+      per distinct ``chunk_windows`` (docs/SERVING.md).
+
+    ``(kh, kw)`` is the GT grid: the resize target is baked into the traced
+    program, so a datalist at a new resolution needs a new program (shape
+    changes alone would retrace, but a stale target would silently resize
+    to the WRONG grid).
+    """
+    from esr_tpu.training.multistep import make_multi_step
+
+    sum_keys = METRIC_KEYS + ("count",)
+
+    def _to_gt_grid(imgs):
+        if imgs.shape[1:3] != (kh, kw):
+            return jax.vmap(
+                lambda im: interpolate(im, (kh, kw), "bicubic")
+            )(imgs)
+        return imgs
+
+    def run_chunk(params, states, reset_keep, windows):
+        def window_step(carry, win):
+            states, sums = carry
+            pred, states = model.apply(params, win["inp_scaled"], states)
+            pred = _to_gt_grid(pred)
+            bicubic = _to_gt_grid(win["inp_mid"])
+            per = {}
+            for name, fn in _METRIC_FNS.items():
+                vfn = jax.vmap(fn)
+                per[f"esr_{name}"] = vfn(pred, win["gt"])
+                per[f"bicubic_{name}"] = vfn(bicubic, win["gt"])
+            valid = win["valid"]  # (B,) float mask
+            # where, not multiply: a masked (zero-padded) window can
+            # produce inf/nan metrics (e.g. psnr of a zero gt) and
+            # inf * 0 would poison the sum with NaN
+            sums = dict(sums)
+            for k in METRIC_KEYS:
+                sums[k] = sums[k] + jnp.where(valid > 0, per[k], 0.0)
+            sums["count"] = sums["count"] + valid
+            # per-window SSIM pairs stacked by the scan: the report's
+            # paired-delta diagnostics are host-side sample statistics
+            stacked = {
+                "esr_ssim": per["esr_ssim"],
+                "bicubic_ssim": per["bicubic_ssim"],
+            }
+            return (states, sums), stacked
+
+        multi = make_multi_step(window_step, chunk_windows)
+        # where, not multiply, for the same reason as the metric sums:
+        # a lane state driven non-finite (overflow, padded-tail
+        # garbage) must reset to a CLEAN zero, and 0 * inf is NaN
+        states = jax.tree.map(
+            lambda z: jnp.where(
+                reset_keep.reshape((-1,) + (1,) * (z.ndim - 1)) > 0,
+                z, 0.0,
+            ),
+            states,
+        )
+        sums0 = {
+            k: jnp.zeros((lanes,), jnp.float32) for k in sum_keys
+        }
+        (states, sums), stacked = multi((states, sums0), windows)
+        return states, sums, stacked
+
+    return run_chunk
+
+
+# -- per-lane recurrent-state save / restore ---------------------------------
+# The serving tier's preemption contract (docs/SERVING.md): a stream evicted
+# from its lane must resume BIT-IDENTICALLY later, possibly in a different
+# lane or a different process. Extraction pulls one lane's slice of every
+# state leaf to host numpy (float32 round-trips device -> numpy -> device
+# bit-exactly); injection scatters it back into a lane slot. Both are
+# host-side array ops OUTSIDE any trace — extraction blocks until the
+# lane's last chunk resolved, which is exactly the barrier eviction needs.
+
+
+def extract_lane_state(states, lane: int):
+    """One lane's recurrent state -> host numpy pytree (bit-exact)."""
+    return jax.tree.map(lambda z: np.asarray(z[lane]), states)
+
+
+def inject_lane_state(states, lane: int, host_state):
+    """Write a saved lane state (from :func:`extract_lane_state`) into lane
+    ``lane`` of the batched device state; other lanes are untouched."""
+    return jax.tree.map(
+        lambda z, h: z.at[lane].set(jnp.asarray(h, z.dtype)),
+        states, host_state,
+    )
+
+
 class StreamingEngine:
     """Lane-packed, scan-fused streaming inference over a datalist.
 
@@ -126,69 +231,15 @@ class StreamingEngine:
     # -- fused chunk program ------------------------------------------------
 
     def _build_chunk_fn(self, kh: int, kw: int):
-        """The one-dispatch-per-chunk executable: reset masked lane states,
-        scan ``chunk_windows`` windows via the production ``make_multi_step``
-        machinery, accumulate per-lane metric sums in the carry."""
-        from esr_tpu.training.multistep import make_multi_step
-
-        model, lanes = self.model, self.lanes
-        sum_keys = METRIC_KEYS + ("count",)
-
-        def _to_gt_grid(imgs):
-            if imgs.shape[1:3] != (kh, kw):
-                return jax.vmap(
-                    lambda im: interpolate(im, (kh, kw), "bicubic")
-                )(imgs)
-            return imgs
-
-        # donate the recurrent-state carry: lane states keep single-copy
-        # HBM residency across chunks exactly like the training carry
-        @checked_jit(donate_argnums=(1,), name="infer_engine_chunk")
-        def run_chunk(params, states, reset_keep, windows):
-            def window_step(carry, win):
-                states, sums = carry
-                pred, states = model.apply(params, win["inp_scaled"], states)
-                pred = _to_gt_grid(pred)
-                bicubic = _to_gt_grid(win["inp_mid"])
-                per = {}
-                for name, fn in _METRIC_FNS.items():
-                    vfn = jax.vmap(fn)
-                    per[f"esr_{name}"] = vfn(pred, win["gt"])
-                    per[f"bicubic_{name}"] = vfn(bicubic, win["gt"])
-                valid = win["valid"]  # (B,) float mask
-                # where, not multiply: a masked (zero-padded) window can
-                # produce inf/nan metrics (e.g. psnr of a zero gt) and
-                # inf * 0 would poison the sum with NaN
-                sums = dict(sums)
-                for k in METRIC_KEYS:
-                    sums[k] = sums[k] + jnp.where(valid > 0, per[k], 0.0)
-                sums["count"] = sums["count"] + valid
-                # per-window SSIM pairs stacked by the scan: the report's
-                # paired-delta diagnostics are host-side sample statistics
-                stacked = {
-                    "esr_ssim": per["esr_ssim"],
-                    "bicubic_ssim": per["bicubic_ssim"],
-                }
-                return (states, sums), stacked
-
-            multi = make_multi_step(window_step, self.chunk_windows)
-            # where, not multiply, for the same reason as the metric sums:
-            # a lane state driven non-finite (overflow, padded-tail
-            # garbage) must reset to a CLEAN zero, and 0 * inf is NaN
-            states = jax.tree.map(
-                lambda z: jnp.where(
-                    reset_keep.reshape((-1,) + (1,) * (z.ndim - 1)) > 0,
-                    z, 0.0,
-                ),
-                states,
-            )
-            sums0 = {
-                k: jnp.zeros((lanes,), jnp.float32) for k in sum_keys
-            }
-            (states, sums), stacked = multi((states, sums0), windows)
-            return states, sums, stacked
-
-        return run_chunk
+        """The one-dispatch-per-chunk executable: the shared
+        :func:`make_chunk_fn` program under ``checked_jit``, with the
+        recurrent-state carry donated so lane states keep single-copy HBM
+        residency across chunks exactly like the training carry."""
+        return checked_jit(
+            make_chunk_fn(self.model, self.lanes, self.chunk_windows,
+                          kh, kw),
+            donate_argnums=(1,), name="infer_engine_chunk",
+        )
 
     # -- host loop ----------------------------------------------------------
 

@@ -119,19 +119,119 @@ def load_exported_model(path: str) -> Tuple[Callable, Dict]:
     return fn, sidecar
 
 
+def export_chunk_program(
+    model,
+    params,
+    lanes: int,
+    chunk_windows: int,
+    gt_hw: Tuple[int, int],
+    inp_hw: Optional[Tuple[int, int]] = None,
+    lr_hw: Optional[Tuple[int, int]] = None,
+    seqn: int = 3,
+    platforms: Tuple[str, ...] = ("tpu", "cpu"),
+) -> bytes:
+    """Lower the ENGINE CHUNK PROGRAM (``inference/engine.make_chunk_fn``)
+    and serialize — the AOT artifact the serving tier loads so the serving
+    process never traces (``esr_tpu.serving.server``, docs/SERVING.md).
+
+    Signature of the exported callable: ``(params, states, reset_keep,
+    windows) -> (states, sums, stacked)`` with ``windows`` the engine's
+    ``{"inp_scaled": (W, B, seqn, ih, iw, c), "gt": (W, B, kh, kw, c),
+    "inp_mid": (W, B, lh, lw, c), "valid": (W, B)}`` chunk dict. ``gt_hw``
+    is the GT grid (also the recurrent-state grid); ``inp_hw`` defaults to
+    the GT grid (LR events are rasterized onto it upstream) and ``lr_hw``
+    to the LR sensor grid implied by nothing — pass it explicitly for a
+    non-trivial scale. Multi-platform exports rebind the TPU-only Pallas
+    DCN kernel to the portable jnp formulation, as in
+    :func:`export_forward`.
+    """
+    from esr_tpu.inference.engine import make_chunk_fn
+
+    if len(platforms) > 1 and getattr(model, "dcn_impl", None) in (
+            "auto", "pallas"):
+        model = model.clone(dcn_impl="jnp")
+    kh, kw = gt_hw
+    ih, iw = inp_hw if inp_hw is not None else gt_hw
+    lh, lw = lr_hw if lr_hw is not None else gt_hw
+    inch = int(getattr(model, "inch", 2))
+    w_, b = int(chunk_windows), int(lanes)
+    windows = {
+        "inp_scaled": jnp.zeros((w_, b, seqn, ih, iw, inch), jnp.float32),
+        "gt": jnp.zeros((w_, b, kh, kw, inch), jnp.float32),
+        "inp_mid": jnp.zeros((w_, b, lh, lw, inch), jnp.float32),
+        "valid": jnp.zeros((w_, b), jnp.float32),
+    }
+    states = model.init_states(b, kh, kw)
+    reset_keep = jnp.zeros((b,), jnp.float32)
+    fn = make_chunk_fn(model, b, w_, kh, kw)
+    exported = jax.export.export(jax.jit(fn), platforms=list(platforms))(
+        _shape_dtype(params), _shape_dtype(states),
+        _shape_dtype(reset_keep), _shape_dtype(windows),
+    )
+    return bytes(exported.serialize())
+
+
 def export_checkpoint(ckpt_path: str, out_path: str,
-                      batch: int = 1, height: int = 64, width: int = 64) -> str:
+                      batch: int = 1, height: int = 64, width: int = 64,
+                      program: str = "forward",
+                      chunk_windows: int = 8, scale: int = 2,
+                      platforms: Tuple[str, ...] = ("tpu", "cpu")) -> str:
     """Checkpoint directory -> deployable artifact: rebuilds the model from
     the embedded config (the same convention inference uses,
-    ``training/checkpoint.py:load_for_inference``) and exports its forward
-    at the given input geometry."""
+    ``training/checkpoint.py:load_for_inference``) and exports at the given
+    input geometry.
+
+    ``program`` selects WHAT is lowered:
+
+    - ``"forward"`` (default): one ``model.apply`` call at batch ``batch``
+      — the single-stream deployment artifact;
+    - ``"engine_chunk"``: the fused chunk program at ``batch`` lanes x
+      ``chunk_windows`` scan-fused windows on a ``(height, width)`` GT
+      grid with an LR grid of ``(height//scale, width//scale)`` — the
+      serving tier's AOT artifact (one per request-class
+      ``chunk_windows``; ``esr_tpu.serving``, docs/SERVING.md).
+
+    The sidecar records ``program`` plus, for chunk programs, the
+    ``lanes``/``chunk_windows`` geometry the serving loader validates
+    against its configuration.
+    """
+    if program not in ("forward", "engine_chunk"):
+        raise ValueError(
+            f"unknown program {program!r} (forward | engine_chunk)"
+        )
     from esr_tpu.training.checkpoint import load_for_inference
 
     model, params, config = load_for_inference(ckpt_path)
     seqn = int(config.get("model", {}).get("args", {}).get("num_frame", 3))
     inch = int(getattr(model, "inch", 2))
+    if program == "engine_chunk":
+        blob = export_chunk_program(
+            model, params, lanes=batch, chunk_windows=chunk_windows,
+            gt_hw=(height, width),
+            lr_hw=(height // scale, width // scale),
+            seqn=seqn, platforms=platforms,
+        )
+        os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                    exist_ok=True)
+        with open(out_path, "wb") as f:
+            f.write(blob)
+        sidecar = {
+            "model": type(model).__name__,
+            "program": "engine_chunk",
+            "config": config,
+            "platforms": list(platforms),
+            "lanes": int(batch),
+            "chunk_windows": int(chunk_windows),
+            "gt_hw": [height, width],
+            "lr_hw": [height // scale, width // scale],
+            "seqn": seqn,
+        }
+        with open(out_path + ".json", "w") as f:
+            json.dump(sidecar, f, indent=2, default=str)
+        return out_path
     x = jnp.zeros((batch, seqn, height, width, inch), jnp.float32)
     states = model.init_states(batch, height, width)
     return save_exported_model(
-        out_path, model, params, x, states, config=config
+        out_path, model, params, x, states, config=config,
+        platforms=platforms,
     )

@@ -1,0 +1,24 @@
+"""esr_tpu.serving — multi-tenant continuous-batching serving tier.
+
+Live event streams in, per-request SR metric reports + SLO evidence out,
+over the same fused chunk program the offline engine runs
+(docs/SERVING.md). ``scheduler`` is the host-side policy (admission queue,
+virtual-lane binding, quantum preemption), ``server`` the device loop
+(state save/evict/restore, per-class chunk sizing, AOT programs),
+``loadgen`` the seeded synthetic-traffic driver.
+"""
+
+from esr_tpu.serving.scheduler import (  # noqa: F401
+    DEFAULT_CLASSES,
+    AdmissionFull,
+    LaneScheduler,
+    RequestClass,
+    StreamRequest,
+)
+from esr_tpu.serving.server import RecordingStream, ServingEngine  # noqa: F401
+from esr_tpu.serving.loadgen import (  # noqa: F401
+    Arrival,
+    cohorts,
+    make_stream_corpus,
+    poisson_schedule,
+)

@@ -1,0 +1,145 @@
+"""Seeded synthetic traffic for the serving tier: streams + Poisson arrivals.
+
+Two halves, both deterministic per seed:
+
+- **corpus**: a directory of short synthetic event recordings with VARIED
+  lengths (stream raggedness is what continuous batching monetizes).
+  ``kind="synthetic"`` uses the fast random-walk generator
+  (``data/synthetic.write_synthetic_h5`` — the tier-1/bench path);
+  ``kind="simulate"`` renders procedurally textured scenes through the
+  full ESIM contrast-threshold simulator
+  (``tools/simulate.render_scene_frames`` + ``simulate_ladder_recording``)
+  for natural event statistics (needs cv2; slower — demo/quality runs).
+- **schedule**: :func:`poisson_schedule` draws exponential inter-arrival
+  gaps (rate ``rate_hz``) and deals request classes round-robin; the
+  resulting :class:`Arrival` list feeds ``ServingEngine.run(arrivals=…)``
+  (and the bench's cohort baseline replays the SAME schedule, so the
+  continuous-vs-cohort comparison sees identical traffic —
+  ``bench.py:stage_serve_loadgen``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Arrival", "make_stream_corpus", "poisson_schedule", "cohorts"]
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled stream arrival: ``t`` seconds after traffic start."""
+
+    t: float
+    path: str
+    request_class: Optional[str] = None
+    request_id: Optional[str] = None
+
+
+def make_stream_corpus(
+    out_dir: str,
+    n: int = 8,
+    seed: int = 0,
+    kind: str = "synthetic",
+    sensor_resolution: Tuple[int, int] = (64, 64),
+    base_events: Tuple[int, int] = (1024, 4096),
+    num_frames: int = 6,
+    events_schedule: Optional[Sequence[int]] = None,
+) -> List[str]:
+    """``n`` short recordings with seeded, deliberately unequal lengths.
+
+    ``base_events`` bounds the per-recording event-count draw — the knob
+    that varies stream length (window count) across the corpus.
+    ``events_schedule`` overrides the draw with an explicit cycled list
+    (e.g. ``[400, 4000]`` for alternating short interactive / long bulk
+    streams — the raggedness profile the bench's cohort comparison uses).
+    Both are ``kind="synthetic"``-only: the ESIM path's length knob is
+    the seeded ``num_frames`` draw, so passing ``events_schedule`` with
+    ``kind="simulate"`` raises instead of silently losing the requested
+    raggedness profile."""
+    if kind == "simulate" and events_schedule:
+        raise ValueError(
+            "events_schedule applies only to kind='synthetic'; simulate "
+            "recordings vary length via the seeded num_frames draw "
+            f"(got events_schedule={list(events_schedule)!r})"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    lo, hi = base_events
+    paths = []
+    for i in range(n):
+        path = os.path.join(out_dir, f"stream{i:03d}.h5")
+        if kind == "synthetic":
+            from esr_tpu.data.synthetic import write_synthetic_h5
+
+            ev = (int(events_schedule[i % len(events_schedule)])
+                  if events_schedule
+                  else int(rng.integers(lo, hi + 1)))
+            write_synthetic_h5(
+                path, sensor_resolution,
+                base_events=ev,
+                num_frames=num_frames, seed=seed * 1000 + i,
+            )
+        elif kind == "simulate":
+            from esr_tpu.tools.simulate import (
+                render_scene_frames,
+                simulate_ladder_recording,
+            )
+
+            h, w = sensor_resolution
+            frames, ts = render_scene_frames(
+                seed=seed * 1000 + i,
+                num_frames=int(rng.integers(num_frames, num_frames * 2)),
+                h=h * 8, w=w * 8,  # ladder rungs downscale back to (h, w)
+                disc_radius_scale=max(h * 8, w * 8) / 720 + 0.2,
+            )
+            simulate_ladder_recording(
+                frames, ts, path, seed=seed * 1000 + i
+            )
+        else:
+            raise ValueError(f"unknown corpus kind {kind!r}")
+        paths.append(path)
+    return paths
+
+
+def poisson_schedule(
+    paths: Sequence[str],
+    rate_hz: float,
+    seed: int = 0,
+    classes: Sequence[Optional[str]] = (None,),
+) -> List[Arrival]:
+    """Seeded Poisson arrival schedule over ``paths`` (in order): gaps are
+    iid exponential with mean ``1/rate_hz``; classes deal round-robin.
+    The first arrival lands at t=0 so a drained server starts immediately."""
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for i, path in enumerate(paths):
+        out.append(Arrival(
+            t=round(t, 6), path=path,
+            request_class=classes[i % len(classes)],
+            request_id=f"lg-{i:04d}",
+        ))
+        t += float(rng.exponential(1.0 / rate_hz))
+    return out
+
+
+def cohorts(
+    schedule: Sequence[Arrival], size: int
+) -> List[Tuple[float, List[Arrival]]]:
+    """Group a schedule into fixed-size arrival cohorts (the restart-the-
+    fixed-batch-engine baseline): each cohort is ready only when its LAST
+    member has arrived — the wait the continuous path does not pay."""
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    ordered = sorted(schedule, key=lambda a: a.t)
+    out = []
+    for i in range(0, len(ordered), size):
+        group = ordered[i: i + size]
+        out.append((max(a.t for a in group), group))
+    return out

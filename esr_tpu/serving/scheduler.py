@@ -1,0 +1,260 @@
+"""Continuous-batching lane scheduler: admission queue -> virtual lanes.
+
+Pure host-side policy (no jax, no device state — that is
+``serving/server.py``'s half), so every invariant is unit-testable without
+an accelerator. The design rule comes from PAPERS.md's VirtualFlow (arxiv
+2009.09523): requests bind to *virtual lanes* decoupled from the physical
+batch shape, so the same serving config runs unchanged from 1 CPU core to
+a TPU slice — the scheduler only ever talks about lane INDICES.
+
+Contract:
+
+- **admission** is FIFO through a bounded queue; a full queue rejects the
+  submit (:class:`AdmissionFull`) — backpressure is explicit, never an
+  unbounded buffer (analysis rule ESR009 polices the blocking flavor of
+  the same hazard).
+- **binding** happens only at chunk boundaries: :meth:`bind_free_lanes`
+  fills every free lane from the queue head. A freshly bound request gets
+  a zeroed recurrent state; a RESUMED request (evicted earlier) gets its
+  saved state injected back (``server.py`` owns the device half of both).
+- **preemption** is quantum-based round-robin: when the queue is non-empty
+  and no lane is free, any lane that has held its slot for at least
+  ``preempt_quantum`` consecutive chunks may be evicted
+  (:meth:`preempt_candidates`, most-served-first so long streams yield to
+  the queue). The evicted request re-enters the queue TAIL with its saved
+  state and window position — resuming is bit-identical by construction
+  (``tests/test_serving.py`` pins it).
+- **SLO-aware chunk sizing**: every request carries a
+  :class:`RequestClass` whose ``chunk_windows`` caps how many windows may
+  be fused per dispatch while that class occupies a lane
+  (:meth:`chunk_windows` = min over bound classes). Small W = the host
+  sees results (and can re-schedule) sooner = lower p99 window latency;
+  large W = fewer dispatches per window = higher throughput
+  (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "AdmissionFull",
+    "RequestClass",
+    "StreamRequest",
+    "LaneScheduler",
+    "DEFAULT_CLASSES",
+]
+
+
+class AdmissionFull(RuntimeError):
+    """The admission queue is at capacity — the caller must retry later or
+    shed the request (explicit backpressure; the queue never grows
+    unboundedly)."""
+
+
+@dataclass(frozen=True)
+class RequestClass:
+    """An SLO class: how aggressively windows are fused for its streams.
+
+    ``chunk_windows`` is the latency/throughput knob — the maximum windows
+    scan-fused per dispatch while a stream of this class is lane-bound.
+    ``preemptible=False`` pins a stream to its lane once bound (it is
+    never offered by :meth:`LaneScheduler.preempt_candidates`)."""
+
+    name: str
+    chunk_windows: int = 8
+    preemptible: bool = True
+
+    def __post_init__(self):
+        if self.chunk_windows < 1:
+            raise ValueError(
+                f"chunk_windows must be >= 1, got {self.chunk_windows}"
+            )
+
+
+# the stock classes serve.py exposes; callers can define their own
+DEFAULT_CLASSES: Dict[str, RequestClass] = {
+    # latency-sensitive: small fusion so results (and re-scheduling
+    # opportunities) surface every few windows
+    "interactive": RequestClass("interactive", chunk_windows=2),
+    # the default: the engine's balanced fusion depth
+    "standard": RequestClass("standard", chunk_windows=8),
+    # throughput-oriented offline backfill: deep fusion, first to yield
+    "bulk": RequestClass("bulk", chunk_windows=16),
+}
+
+
+@dataclass
+class StreamRequest:
+    """One live stream request and its scheduling/runtime bookkeeping.
+
+    The scheduler owns the policy fields; ``server.py`` attaches the
+    host-side window ``source`` and the saved recurrent state across
+    preemptions. ``saved_state``/``peek`` persist across evictions — they
+    ARE the resume point."""
+
+    request_id: str
+    path: str
+    cls: RequestClass
+    submitted_t: float = 0.0
+
+    # runtime (server-owned)
+    source: object = None          # window iterator, built at first bind
+    peek: object = None            # one-window lookahead (lane-free probe)
+    saved_state: object = None     # host pytree while evicted / pre-resume
+    ended: bool = False            # stream exhausted (awaiting last chunk)
+
+    # accounting
+    inflight: int = 0              # dispatched chunks not yet resolved
+    windows_done: int = 0
+    chunks_since_bind: int = 0
+    preemptions: int = 0
+    first_bind_t: Optional[float] = None
+    completed_t: Optional[float] = None
+    error: Optional[str] = None
+    window_latencies: List[float] = field(default_factory=list)
+
+    @property
+    def resumable(self) -> bool:
+        return self.saved_state is not None
+
+
+class LaneScheduler:
+    """Admission queue + lane binding + quantum preemption (host policy).
+
+    One instance per :class:`esr_tpu.serving.server.ServingEngine`; all
+    methods are called from the serving loop thread (no internal locking —
+    the server serializes rounds)."""
+
+    def __init__(
+        self,
+        lanes: int,
+        max_pending: int = 64,
+        preempt_quantum: int = 4,
+    ):
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {max_pending}"
+            )
+        if preempt_quantum < 0:
+            raise ValueError(
+                f"preempt_quantum must be >= 0 (0 disables preemption), "
+                f"got {preempt_quantum}"
+            )
+        self.num_lanes = int(lanes)
+        self.max_pending = int(max_pending)
+        self.preempt_quantum = int(preempt_quantum)
+        self.lanes: List[Optional[StreamRequest]] = [None] * self.num_lanes
+        self._queue: deque = deque()
+        self._ids = itertools.count()
+        self.rejected = 0
+        self.completed: List[StreamRequest] = []
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, req: StreamRequest) -> StreamRequest:
+        """FIFO admission; raises :class:`AdmissionFull` at capacity."""
+        if len(self._queue) >= self.max_pending:
+            self.rejected += 1
+            raise AdmissionFull(
+                f"admission queue at capacity ({self.max_pending} pending); "
+                f"retry after a lane frees"
+            )
+        self._queue.append(req)
+        return req
+
+    def requeue(self, req: StreamRequest) -> None:
+        """Re-admit a preempted request at the queue TAIL (round-robin
+        fairness). Exempt from the ``max_pending`` cap: the request was
+        already admitted — eviction must never be able to LOSE it."""
+        self._queue.append(req)
+
+    def next_request_id(self) -> str:
+        return f"req-{next(self._ids):05d}"
+
+    # -- binding -------------------------------------------------------------
+
+    def bind_free_lanes(self, now: float) -> List[Tuple[int, StreamRequest]]:
+        """Fill every free lane from the queue head; returns the new
+        ``(lane, request)`` bindings (the server resets/injects the device
+        state and emits the ``serve_admit`` span per binding)."""
+        out = []
+        for lane in range(self.num_lanes):
+            if self.lanes[lane] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            self.lanes[lane] = req
+            req.chunks_since_bind = 0
+            if req.first_bind_t is None:
+                req.first_bind_t = now
+            out.append((lane, req))
+        return out
+
+    def release(self, lane: int, completed_t: Optional[float] = None) -> None:
+        """Free a lane whose stream ended (or errored)."""
+        req = self.lanes[lane]
+        if req is not None:
+            if completed_t is not None:
+                req.completed_t = completed_t
+            self.completed.append(req)
+        self.lanes[lane] = None
+
+    # -- preemption ----------------------------------------------------------
+
+    def preempt_candidates(self) -> List[int]:
+        """Lanes to evict THIS boundary: only when the queue is non-empty
+        and no lane is free, only preemptible requests that have held
+        their lane for >= ``preempt_quantum`` chunks, most-served first,
+        at most one eviction per queued request. Quantum 0 disables."""
+        if not self.preempt_quantum or not self._queue:
+            return []
+        if any(r is None for r in self.lanes):
+            return []
+        eligible = [
+            (req.chunks_since_bind, lane)
+            for lane, req in enumerate(self.lanes)
+            if req is not None and req.cls.preemptible and not req.ended
+            and req.chunks_since_bind >= self.preempt_quantum
+        ]
+        eligible.sort(reverse=True)
+        return [lane for _, lane in eligible[: len(self._queue)]]
+
+    def evict(self, lane: int) -> StreamRequest:
+        """Unbind (the server must have saved the lane state first) and
+        requeue; returns the evicted request."""
+        req = self.lanes[lane]
+        assert req is not None, f"evict of empty lane {lane}"
+        self.lanes[lane] = None
+        req.preemptions += 1
+        self.requeue(req)
+        return req
+
+    # -- chunk sizing --------------------------------------------------------
+
+    def chunk_windows(self, default: int = 8) -> int:
+        """Fused windows for the NEXT chunk: min over the bound requests'
+        class caps (the latency-sensitive class bounds the whole batch —
+        every lane shares one program), ``default`` when idle."""
+        caps = [
+            r.cls.chunk_windows for r in self.lanes if r is not None
+        ]
+        return min(caps) if caps else int(default)
+
+    # -- introspection -------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def occupancy(self) -> int:
+        return sum(1 for r in self.lanes if r is not None)
+
+    def live_requests(self) -> List[StreamRequest]:
+        return [r for r in self.lanes if r is not None] + list(self._queue)
+
+    def drained(self) -> bool:
+        return self.occupancy() == 0 and not self._queue

@@ -1,0 +1,692 @@
+"""The serving session API: live streams through continuous-batched lanes.
+
+``ServingEngine`` generalizes the fixed-datalist
+:class:`esr_tpu.inference.engine.StreamingEngine` to PRODUCTION traffic:
+independent event streams arriving and ending at arbitrary times. The
+device program is the SAME fused chunk program
+(``inference/engine.make_chunk_fn`` — scan-fused windows, per-lane
+recurrent state, on-device metric sums); what changes is who feeds it:
+
+- a :class:`esr_tpu.serving.scheduler.LaneScheduler` binds admitted
+  streams to lane slots as they free (chunk-boundary refill, generalizing
+  ``LanePackedChunks``'s refill machinery from a static recording list to
+  a live queue), with quantum preemption under load;
+- per-stream recurrent state is saved on eviction
+  (``engine.extract_lane_state``) and injected back on resume
+  (``engine.inject_lane_state``) — a preempted stream resumes
+  bit-identically, pinned by ``tests/test_serving.py``;
+- the fused depth ``W`` is chosen PER CHUNK from the bound requests' SLO
+  classes (min of their ``chunk_windows`` caps): one compiled program per
+  distinct ``W``, traced once (``checked_jit``) or — the production path —
+  loaded AHEAD OF TIME from ``inference/export.py`` artifacts so the
+  serving process never traces;
+- chunk readbacks resolve one chunk behind dispatch (the engine's
+  pending-deque idiom), and every resolve folds per-lane metric sums into
+  per-REQUEST reports with window-latency series (p50/p99 — the SLO
+  evidence).
+
+Telemetry (docs/OBSERVABILITY.md): a ``serve_admit`` span per binding
+(admission latency, fresh vs resume), a ``serve_chunk`` span per chunk
+(occupancy, valid windows, fused depth, queue depth, windows/s),
+``serve_queue_depth`` / ``serve_lane_occupancy`` gauges per round, a
+``serve_backpressure`` counter per rejected submit, ``serve_preempt`` /
+``serve_request_done`` events.
+
+Deliberate differences from the offline engine (docs/SERVING.md): no
+``DevicePrefetcher`` between host chunk building and dispatch — the next
+chunk's composition depends on the previous round's scheduling decisions,
+so speculative staging would have to be thrown away on every bind/evict;
+the readback overlap is kept. LPIPS/PNG dumps are sequential-harness-only,
+as in engine mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esr_tpu.analysis.retrace_guard import checked_jit
+from esr_tpu.data.loader import InferenceSequenceLoader
+from esr_tpu.inference.engine import (
+    METRIC_KEYS,
+    extract_lane_state,
+    inject_lane_state,
+    make_chunk_fn,
+)
+from esr_tpu.obs import active_sink
+from esr_tpu.serving.scheduler import (
+    DEFAULT_CLASSES,
+    AdmissionFull,
+    LaneScheduler,
+    RequestClass,
+    StreamRequest,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RecordingStream", "ServingEngine", "AdmissionFull"]
+
+# Traced chunk programs shared ACROSS serving sessions in this process,
+# keyed by (model, lanes, chunk_windows, gt grid) — flax modules are frozen
+# dataclasses, so equal configs share programs. A new ServingEngine per
+# traffic burst must not re-trace/re-compile programs an earlier session
+# already owns (params are call arguments, not part of the program).
+_PROGRAM_CACHE: Dict[tuple, object] = {}
+
+
+class RecordingStream:
+    """Host-side window source for ONE stream (numpy-only, stream order).
+
+    Yields the engine's window tuples ``(inp_scaled, gt_mid, inp_mid)``
+    — the per-window model input, the GT count image of the middle frame,
+    and the LR middle-frame counts (bicubic-baseline input). The iterator
+    is *pausable by construction*: the serving tier holds it (plus a
+    one-window peek) across preemptions, so a resumed stream continues at
+    exactly the next unserved window.
+    """
+
+    def __init__(self, path: str, config: Dict):
+        cfg = dict(config)
+        # the chunk program consumes only these three streams; selecting
+        # item_keys skips building the unused encodings (same contract as
+        # LanePackedChunks)
+        cfg.setdefault("item_keys", ["inp_scaled_cnt", "gt_cnt", "inp_cnt"])
+        self.path = path
+        self.seqn = int(cfg["sequence"].get("seqn", 3))
+        self.mid_idx = (self.seqn - 1) // 2
+        self._loader = InferenceSequenceLoader(path, cfg)
+        self.inp_resolution = tuple(self._loader.inp_resolution)
+        self.gt_resolution = tuple(self._loader.gt_resolution)
+        self._it = self._windows()
+
+    def _windows(self):
+        for batch in self._loader:
+            yield (
+                np.asarray(batch["inp_scaled_cnt"][0, : self.seqn],
+                           np.float32),
+                np.asarray(batch["gt_cnt"][0, self.mid_idx], np.float32),
+                np.asarray(batch["inp_cnt"][0, self.mid_idx], np.float32),
+            )
+
+    def __iter__(self):
+        return self._it
+
+    def __next__(self):
+        return next(self._it)
+
+
+class ServingEngine:
+    """Multi-tenant continuous-batching serving session (module docstring).
+
+    ``model``/``params`` come from a trained checkpoint
+    (``training/checkpoint.load_for_inference``). With ``aot_programs``
+    (``{chunk_windows: artifact path}`` from
+    ``inference/export.export_checkpoint(program='engine_chunk')``) the
+    chunk programs are deserialized instead of traced — the production
+    serving configuration. The model object is still used for
+    ``init_states`` (host-side zeros; no forward trace).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        dataset_config: Dict,
+        seqn: Optional[int] = None,
+        lanes: int = 4,
+        classes: Optional[Dict[str, RequestClass]] = None,
+        default_class: str = "standard",
+        max_pending: int = 64,
+        preempt_quantum: int = 4,
+        aot_programs: Optional[Dict[int, str]] = None,
+    ):
+        self.model = model
+        self.params = params
+        self.dataset_config = dict(dataset_config)
+        # seqn parameter (when given) overrides the dataset config's —
+        # RecordingStream reads it from the config, so write it through
+        seq = dict(self.dataset_config.get("sequence", {}))
+        if seqn is not None:
+            seq["seqn"] = int(seqn)
+        self.dataset_config["sequence"] = seq
+        self.seqn = int(seq.get("seqn", 3))
+        self.lanes = int(lanes)
+        self.classes = dict(classes if classes is not None
+                            else DEFAULT_CLASSES)
+        if default_class not in self.classes:
+            raise ValueError(
+                f"default_class {default_class!r} not among classes "
+                f"{sorted(self.classes)}"
+            )
+        self.default_class = default_class
+        self.default_chunk_windows = self.classes[default_class].chunk_windows
+        self.scheduler = LaneScheduler(
+            lanes, max_pending=max_pending, preempt_quantum=preempt_quantum
+        )
+        self._aot_paths = dict(aot_programs or {})
+        self._programs: Dict[int, object] = {}
+        self._requests: Dict[str, StreamRequest] = {}
+        self._acc: Dict[str, Dict] = {}
+        self._pending: deque = deque()
+        self._states = None
+        self._resolutions = None  # ((ih, iw), (kh, kw)) once probed
+        self._shapes = None       # per-window array shapes once probed
+        self._chunk_idx = 0
+        self._last_gauges = None
+        self._t0 = time.perf_counter()
+        self._first_dispatch_t: Optional[float] = None
+        self._last_resolve_t: Optional[float] = None
+        self._windows_total = 0
+
+    # -- time ----------------------------------------------------------------
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- programs / device state ---------------------------------------------
+
+    def _program(self, w: int):
+        """The fused chunk program at depth ``w``: AOT-deserialized when an
+        artifact was supplied (the serving process never traces), else
+        traced once per distinct ``w`` under ``checked_jit``."""
+        prog = self._programs.get(w)
+        if prog is not None:
+            return prog
+        (ih, iw), (kh, kw) = self._resolutions
+        if self._aot_paths:
+            if w not in self._aot_paths:
+                raise KeyError(
+                    f"no AOT chunk program for chunk_windows={w}; exported "
+                    f"depths: {sorted(self._aot_paths)} (export one per "
+                    "request-class chunk_windows, docs/SERVING.md)"
+                )
+            from esr_tpu.inference.export import load_exported_model
+
+            fn, sidecar = load_exported_model(self._aot_paths[w])
+            got = (sidecar.get("lanes"), sidecar.get("chunk_windows"))
+            if got != (self.lanes, w):
+                raise ValueError(
+                    f"AOT artifact {self._aot_paths[w]} was exported for "
+                    f"(lanes, chunk_windows)={got}, serving needs "
+                    f"({self.lanes}, {w})"
+                )
+            # grid geometry too: a mismatch would otherwise surface as an
+            # opaque exported-call shape error mid-loop, killing the session
+            want = {
+                "gt_hw": list(self._resolutions[1]),
+                "lr_hw": list(self._resolutions[0]),
+                "seqn": self.seqn,
+            }
+            got_geo = {k: sidecar.get(k) for k in want}
+            if any(got_geo[k] is not None and got_geo[k] != want[k]
+                   for k in want):
+                raise ValueError(
+                    f"AOT artifact {self._aot_paths[w]} geometry {got_geo} "
+                    f"does not match the serving pack's {want}"
+                )
+            prog = fn
+        else:
+            key = (self.model, self.lanes, w, kh, kw)
+            prog = _PROGRAM_CACHE.get(key)
+            if prog is None:
+                # donation is traced-path-only: a deserialized exported
+                # call owns no donation metadata, and the states buffers
+                # there are small relative to serving batch arrays
+                prog = checked_jit(
+                    make_chunk_fn(self.model, self.lanes, w, kh, kw),
+                    donate_argnums=(1,), name=f"serve_chunk_w{w}",
+                )
+                _PROGRAM_CACHE[key] = prog
+        self._programs[w] = prog
+        return prog
+
+    def _ensure_device(self, stream: RecordingStream) -> None:
+        """First admitted stream fixes the pack resolutions and
+        materializes the lane state batch (each leaf its own buffer — the
+        donated carry cannot alias)."""
+        if self._resolutions is None:
+            self._resolutions = (
+                stream.inp_resolution, stream.gt_resolution
+            )
+        if self._states is None:
+            # the GT grid, not the LR sensor grid: inp_scaled windows live
+            # on the GT grid (LR events rasterized onto it), exactly like
+            # the offline engine's init_states(lanes, kh, kw)
+            kh, kw = self._resolutions[1]
+            self._states = jax.tree.map(
+                jnp.array, self.model.init_states(self.lanes, kh, kw)
+            )
+
+    # -- session API ---------------------------------------------------------
+
+    def submit(
+        self,
+        path: str,
+        request_class: Union[str, RequestClass, None] = None,
+        request_id: Optional[str] = None,
+    ) -> str:
+        """Admit one stream; returns its request id. Raises
+        :class:`AdmissionFull` when the admission queue is at capacity
+        (explicit backpressure — shed or retry)."""
+        if request_class is None:
+            cls = self.classes[self.default_class]
+        elif isinstance(request_class, RequestClass):
+            cls = request_class
+        else:
+            cls = self.classes[request_class]
+        rid = request_id or self.scheduler.next_request_id()
+        if rid in self._requests:
+            raise ValueError(f"duplicate request_id {rid!r}")
+        req = StreamRequest(rid, path, cls, submitted_t=self._now())
+        try:
+            self.scheduler.submit(req)
+        except AdmissionFull:
+            sink = active_sink()
+            if sink is not None:
+                sink.counter(
+                    "serve_backpressure",
+                    queue_depth=self.scheduler.queue_depth(),
+                )
+            raise
+        self._requests[rid] = req
+        self._acc[rid] = {
+            "sums": {k: 0.0 for k in METRIC_KEYS}, "count": 0,
+        }
+        return rid
+
+    # -- the serving loop ----------------------------------------------------
+
+    def _bind(self, now: float) -> None:
+        sink = active_sink()
+        for lane, req in self.scheduler.bind_free_lanes(now):
+            if req.source is None:
+                try:
+                    req.source = RecordingStream(
+                        req.path, self.dataset_config
+                    )
+                    self._ensure_device(req.source)
+                    if (req.source.inp_resolution,
+                            req.source.gt_resolution) != self._resolutions:
+                        raise ValueError(
+                            f"stream {req.path} resolution "
+                            f"{req.source.inp_resolution}->"
+                            f"{req.source.gt_resolution} does not match "
+                            f"the serving pack's {self._resolutions}"
+                        )
+                except Exception as e:  # noqa: BLE001 - a bad stream must
+                    # fail ITS request, never the serving loop
+                    req.error = repr(e)
+                    req.ended = True
+                    self.scheduler.release(lane, completed_t=self._now())
+                    self._finish(req)
+                    continue
+            action = "resume" if req.resumable else "fresh"
+            if req.resumable:
+                self._states = inject_lane_state(
+                    self._states, lane, req.saved_state
+                )
+                req.saved_state = None
+            if sink is not None:
+                sink.span(
+                    "serve_admit", now - req.submitted_t,
+                    request=req.request_id, cls=req.cls.name, lane=lane,
+                    action=action,
+                    queue_depth=self.scheduler.queue_depth(),
+                )
+            # a resumed lane KEEPS its (just injected) state; a fresh one
+            # is zeroed by the program's reset mask
+            if action == "fresh":
+                self._fresh_lanes.add(lane)
+
+    def _finish(self, req: StreamRequest) -> None:
+        sink = active_sink()
+        if req.completed_t is None:
+            req.completed_t = self._now()
+        if sink is not None:
+            sink.event(
+                "serve_request_done", request=req.request_id,
+                cls=req.cls.name, windows=req.windows_done,
+                preemptions=req.preemptions,
+                completed=req.error is None, error=req.error,
+            )
+
+    def _pull(self, req: StreamRequest, w: int) -> List[tuple]:
+        """Up to ``w`` windows from a lane's stream, with the engine's
+        one-window lookahead so a stream whose length is an exact multiple
+        of ``w`` frees its lane NOW instead of costing a fully-masked
+        chunk."""
+        wins: List[tuple] = []
+        while len(wins) < w:
+            if req.peek is not None:
+                wins.append(req.peek)
+                req.peek = None
+                continue
+            try:
+                wins.append(next(req.source))
+            except StopIteration:
+                req.ended = True
+                return wins
+        try:
+            req.peek = next(req.source)
+        except StopIteration:
+            req.ended = True
+        return wins
+
+    def pump(self) -> str:
+        """One scheduling round: bind free lanes, build + dispatch one
+        fused chunk, resolve the previous readback, preempt under load.
+        Returns ``"dispatched"`` or ``"drained"`` (no bound lane, empty
+        queue — pending readbacks are flushed before reporting drained).
+        """
+        now = self._now()
+        self._fresh_lanes: set = set()
+        self._bind(now)
+        sched = self.scheduler
+        sink = active_sink()
+        gauges = (sched.queue_depth(), sched.occupancy())
+        if sink is not None and gauges != self._last_gauges:
+            # emit on CHANGE only: the drained-idle polling loop would
+            # otherwise write hundreds of identical zero rows per second
+            sink.gauge(
+                "serve_queue_depth", gauges[0], round=self._chunk_idx,
+            )
+            sink.gauge(
+                "serve_lane_occupancy", gauges[1],
+                lanes=self.lanes, round=self._chunk_idx,
+            )
+            self._last_gauges = gauges
+        if sched.occupancy() == 0:
+            if sched.drained():
+                while self._pending:
+                    self._resolve(self._pending.popleft())
+                return "drained"
+            # queued requests remain but every bind this round failed
+            # (bad streams released their lanes mid-bind); the next round
+            # binds the rest — the queue only ever shrinks on this path
+            return "idle"
+
+        w = sched.chunk_windows(default=self.default_chunk_windows)
+        program = self._program(w)
+        t_build = time.perf_counter()
+
+        # -- build the host chunk (the LanePackedChunks contract, over the
+        # scheduler's live lane map)
+        per_lane: List[List[tuple]] = [[] for _ in range(self.lanes)]
+        meta: List[Optional[Dict]] = [None] * self.lanes
+        reset_keep = np.zeros(self.lanes, np.float32)
+        for lane in range(self.lanes):
+            req = sched.lanes[lane]
+            if req is None:
+                continue
+            wins = self._pull(req, w)
+            per_lane[lane] = wins
+            if wins:
+                meta[lane] = {"request": req, "windows": len(wins)}
+                # continuing lanes keep state; fresh binds are zeroed
+                reset_keep[lane] = 0.0 if lane in self._fresh_lanes else 1.0
+
+        if all(m is None for m in meta):
+            # every bound stream was empty (zero-window recordings):
+            # release and report them without a dispatch
+            for lane in range(self.lanes):
+                req = sched.lanes[lane]
+                if req is not None and req.ended:
+                    sched.release(lane, completed_t=self._now())
+                    if req.inflight == 0:
+                        self._finish(req)
+            return "dispatched"
+
+        if self._shapes is None:
+            first = next(wins[0] for wins in per_lane if wins)
+            self._shapes = tuple(a.shape for a in first)
+        arrays = [
+            np.zeros((w, self.lanes) + s, np.float32) for s in self._shapes
+        ]
+        valid = np.zeros((w, self.lanes), np.float32)
+        for lane, wins in enumerate(per_lane):
+            for t, win in enumerate(wins):
+                for arr, a in zip(arrays, win):
+                    arr[t, lane] = a
+                valid[t, lane] = 1.0
+
+        windows = {
+            "inp_scaled": jnp.asarray(arrays[0]),
+            "gt": jnp.asarray(arrays[1]),
+            "inp_mid": jnp.asarray(arrays[2]),
+            "valid": jnp.asarray(valid),
+        }
+        t_dispatch = time.perf_counter()
+        self._states, sums, _stacked = program(
+            self.params, self._states, jnp.asarray(reset_keep), windows
+        )
+        if self._first_dispatch_t is None:
+            self._first_dispatch_t = self._now()
+        for m in meta:
+            if m is not None:
+                m["request"].inflight += 1
+                m["request"].chunks_since_bind += 1
+        self._pending.append({
+            "chunk": self._chunk_idx,
+            "meta": meta,
+            "sums": sums,
+            "w": w,
+            "occupancy": sched.occupancy(),
+            "queue_depth": sched.queue_depth(),
+            "t_build": t_build,
+            "t_dispatch": t_dispatch,
+        })
+        self._chunk_idx += 1
+
+        # -- boundary housekeeping: free ended lanes, then preempt under
+        # load (extraction blocks on the just-dispatched chunk — the
+        # barrier eviction needs; resolve-one-behind keeps the common
+        # rounds overlap-friendly)
+        for lane in range(self.lanes):
+            req = sched.lanes[lane]
+            if req is not None and req.ended:
+                sched.release(lane)
+                # a zero-window stream dispatched nothing this chunk, so
+                # no resolve will ever reach it — emit its terminal event
+                # now (streams with in-flight chunks finish at resolve)
+                if req.inflight == 0:
+                    self._finish(req)
+        for lane in sched.preempt_candidates():
+            req = sched.lanes[lane]
+            req.saved_state = extract_lane_state(self._states, lane)
+            sched.evict(lane)
+            if sink is not None:
+                sink.event(
+                    "serve_preempt", request=req.request_id,
+                    cls=req.cls.name, lane=lane,
+                    windows_done=req.windows_done,
+                    queue_depth=sched.queue_depth(),
+                )
+        if len(self._pending) > 1:
+            self._resolve(self._pending.popleft())
+        return "dispatched"
+
+    def _resolve(self, entry: Dict) -> None:
+        """Block on one chunk's device sums and fold them into per-request
+        accumulators + window-latency series."""
+        sums = {k: np.asarray(v) for k, v in entry["sums"].items()}
+        t_res = time.perf_counter()
+        now = self._now()
+        self._last_resolve_t = now
+        total_valid = int(round(float(sums["count"].sum())))
+        latency = t_res - entry["t_build"]
+        for lane, m in enumerate(entry["meta"]):
+            if m is None:
+                continue
+            req: StreamRequest = m["request"]
+            acc = self._acc[req.request_id]
+            for k in METRIC_KEYS:
+                acc["sums"][k] += float(sums[k][lane])
+            acc["count"] += m["windows"]
+            req.windows_done += m["windows"]
+            req.window_latencies.extend([latency] * m["windows"])
+            req.inflight -= 1
+            if req.ended and req.inflight == 0:
+                self._finish(req)
+        self._windows_total += total_valid
+        sink = active_sink()
+        seconds = t_res - entry["t_dispatch"]
+        if sink is not None:
+            sink.span(
+                "serve_chunk", seconds,
+                chunk=entry["chunk"], lanes=self.lanes,
+                occupancy=entry["occupancy"],
+                chunk_windows=entry["w"], windows=total_valid,
+                queue_depth=entry["queue_depth"],
+                windows_per_sec=round(total_valid / seconds, 3)
+                if seconds > 0 else None,
+            )
+
+    def run(
+        self,
+        arrivals: Optional[Sequence] = None,
+        idle_slice_s: float = 0.005,
+        max_wall_s: Optional[float] = None,
+    ) -> Dict:
+        """Drive the loop until every admitted stream (and every scheduled
+        arrival) completes; returns :meth:`summary`.
+
+        ``arrivals`` is an optional schedule of
+        ``esr_tpu.serving.loadgen.Arrival``-shaped items (``t`` offsets in
+        seconds from the start of this call); an arrival hitting a full
+        queue waits — backpressure delays traffic, it never drops an
+        already-scheduled request. ``max_wall_s`` bounds the loop (safety
+        for driver-run benches)."""
+        t_run0 = time.perf_counter()
+        todo = deque(sorted(arrivals or [], key=lambda a: a.t))
+        while True:
+            if max_wall_s is not None and (
+                    time.perf_counter() - t_run0) > max_wall_s:
+                logger.warning("serving loop hit max_wall_s=%s", max_wall_s)
+                break
+            rel = time.perf_counter() - t_run0
+            while todo and todo[0].t <= rel:
+                # capacity pre-check: a scheduled arrival waiting out
+                # backpressure is DELAYED, not shed — it must not inflate
+                # the rejected counter / serve_backpressure telemetry
+                # (those measure genuinely shed submits)
+                if (self.scheduler.queue_depth()
+                        >= self.scheduler.max_pending):
+                    break  # retry after the next round frees a slot
+                a = todo.popleft()
+                try:
+                    self.submit(
+                        a.path, a.request_class,
+                        request_id=getattr(a, "request_id", None),
+                    )
+                except AdmissionFull:
+                    todo.appendleft(a)  # retry after the next round
+                    break
+            status = self.pump()
+            if status == "drained":
+                if not todo:
+                    break
+                # idle until the next scheduled arrival, in bounded slices
+                wait = todo[0].t - (time.perf_counter() - t_run0)
+                if wait > 0:
+                    time.sleep(min(wait, idle_slice_s))
+        while self._pending:
+            self._resolve(self._pending.popleft())
+        return self.summary()
+
+    # -- reports -------------------------------------------------------------
+
+    @staticmethod
+    def _pctl(lat_s: Sequence[float]) -> Tuple[Optional[float], Optional[float]]:
+        if not lat_s:
+            return None, None
+        arr = np.asarray(lat_s, np.float64) * 1e3
+        return (
+            round(float(np.percentile(arr, 50)), 3),
+            round(float(np.percentile(arr, 99)), 3),
+        )
+
+    def report(self, request_id: str) -> Dict:
+        """Per-request report: metric means (engine schema keys), window
+        count, admission latency, window-latency p50/p99, preemptions."""
+        req = self._requests[request_id]
+        acc = self._acc[request_id]
+        n = acc["count"]
+        out = {
+            "request_id": request_id,
+            "path": req.path,
+            "request_class": req.cls.name,
+            "n_windows": n,
+            "completed": req.error is None and req.ended
+            and req.inflight == 0,
+            "error": req.error,
+            "preemptions": req.preemptions,
+            "admit_latency_s": (
+                round(req.first_bind_t - req.submitted_t, 6)
+                if req.first_bind_t is not None else None
+            ),
+        }
+        p50, p99 = self._pctl(req.window_latencies)
+        out["window_latency_p50_ms"] = p50
+        out["window_latency_p99_ms"] = p99
+        for k in METRIC_KEYS:
+            out[k] = acc["sums"][k] / n if n else 0.0
+        return out
+
+    def reports(self) -> Dict[str, Dict]:
+        return {rid: self.report(rid) for rid in self._requests}
+
+    def summary(self) -> Dict:
+        """Session-level SLO summary: sustained windows/s (first dispatch
+        -> last resolve), global + per-class window-latency p50/p99,
+        admission stats."""
+        all_lat: List[float] = []
+        by_cls: Dict[str, List[float]] = {}
+        admit: List[float] = []
+        completed = 0
+        preemptions = 0
+        for req in self._requests.values():
+            all_lat.extend(req.window_latencies)
+            by_cls.setdefault(req.cls.name, []).extend(
+                req.window_latencies
+            )
+            preemptions += req.preemptions
+            if req.error is None and req.ended and req.inflight == 0:
+                completed += 1
+            if req.first_bind_t is not None:
+                admit.append(req.first_bind_t - req.submitted_t)
+        wall = None
+        if (self._first_dispatch_t is not None
+                and self._last_resolve_t is not None):
+            wall = self._last_resolve_t - self._first_dispatch_t
+        p50, p99 = self._pctl(all_lat)
+        out = {
+            "requests": len(self._requests),
+            "completed": completed,
+            "rejected": self.scheduler.rejected,
+            "preemptions": preemptions,
+            "windows": self._windows_total,
+            "wall_s": round(wall, 6) if wall else None,
+            "windows_per_sec": (
+                round(self._windows_total / wall, 3) if wall else None
+            ),
+            "p50_window_ms": p50,
+            "p99_window_ms": p99,
+            "admit_p50_ms": (
+                round(float(np.percentile(np.asarray(admit) * 1e3, 50)), 3)
+                if admit else None
+            ),
+            "classes": {},
+        }
+        for name, lat in sorted(by_cls.items()):
+            c50, c99 = self._pctl(lat)
+            out["classes"][name] = {
+                "p50_window_ms": c50, "p99_window_ms": c99,
+                "windows": len(lat),
+            }
+        return out

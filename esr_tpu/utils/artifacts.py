@@ -35,6 +35,108 @@ def probe_backend() -> Dict:
     }
 
 
+def probe_backend_bounded(
+    attempt_timeout_s: float = 150.0,
+    attempts: int = 3,
+    cache_path: str = None,
+    probe_fn=None,
+    backoff_s: float = 2.0,
+) -> Dict:
+    """Watchdog + bounded retry + cached-probe wrapper around
+    :func:`probe_backend` — the bench backend bring-up path.
+
+    The observed failure mode (every ``MULTICHIP_r*.json`` since r2):
+    ``make_c_api_client`` blocks forever on a wedged tunnel, the stage
+    watchdog fires at 600s, and the run dies having produced NOTHING —
+    not even the device identity of the last healthy contact. This
+    wrapper makes bring-up bounded and evidence-preserving:
+
+    - each attempt runs the probe on a DAEMON thread and abandons it at
+      ``attempt_timeout_s`` (the hung client releases the GIL, so the
+      timer thread fires; the zombie attempt is daemonic and reaped with
+      the process);
+    - a raising attempt retries after ``backoff_s`` (transient
+      UNAVAILABLE during tunnel heal), up to ``attempts`` total;
+    - a SUCCESSFUL probe is cached to ``cache_path`` (JSON + UTC stamp),
+      and a fully failed bring-up attaches that cache as
+      ``cached_probe`` — the artifact then carries the last-known device
+      identity instead of nulls.
+
+    Returns ``{"ok": True, **probe fields, "attempts", "attempt_log"}`` on
+    success, ``{"ok": False, "error", "attempts", "attempt_log"
+    [, "cached_probe"]}`` on bounded failure. Never raises, never hangs
+    past ``attempts * (attempt_timeout_s + backoff_s)``.
+    """
+    import threading
+
+    probe = probe_fn if probe_fn is not None else probe_backend
+    attempt_log = []
+    for i in range(1, int(attempts) + 1):
+        box: Dict = {}
+
+        def _run(box=box):
+            try:
+                box["result"] = probe()
+            except BaseException as e:  # noqa: BLE001 - reported, bounded
+                box["error"] = repr(e)
+
+        th = threading.Thread(
+            target=_run, daemon=True, name=f"backend-probe-{i}"
+        )
+        t0 = time.monotonic()
+        th.start()
+        th.join(attempt_timeout_s)
+        elapsed = round(time.monotonic() - t0, 3)
+        if th.is_alive():
+            attempt_log.append(
+                {"attempt": i, "hung_after_s": elapsed}
+            )
+            continue  # abandon the zombie; no backoff — we already waited
+        if "error" in box:
+            attempt_log.append(
+                {"attempt": i, "elapsed_s": elapsed, "error": box["error"]}
+            )
+            if i < attempts:
+                time.sleep(backoff_s)
+            continue
+        rec = {
+            "ok": True, **box["result"],
+            "attempts": i, "attempt_log": attempt_log,
+        }
+        if cache_path:
+            try:
+                os.makedirs(
+                    os.path.dirname(os.path.abspath(cache_path)),
+                    exist_ok=True,
+                )
+                with open(cache_path, "w") as f:
+                    json.dump({
+                        "ts": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                        "probe": box["result"],
+                    }, f, indent=2)
+            except OSError:
+                pass  # caching is best-effort; the probe itself succeeded
+        return rec
+    out = {
+        "ok": False,
+        "error": (
+            f"backend probe failed/hung on all {attempts} attempts "
+            f"(timeout {attempt_timeout_s:g}s each)"
+        ),
+        "attempts": int(attempts),
+        "attempt_log": attempt_log,
+    }
+    if cache_path:
+        try:
+            with open(cache_path) as f:
+                out["cached_probe"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+    return out
+
+
 def emit_jsonl(log_path: str, rec: Dict) -> Dict:
     """UTC-stamp and manifest-stamp ``rec``, print it to stdout (flushed),
     append it to ``log_path`` (creating parent dirs; I/O errors on the file

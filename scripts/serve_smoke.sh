@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Serving-tier smoke: seeded Poisson loadgen drives ~8 short synthetic
+# streams through 2 continuous-batching lanes END TO END on CPU —
+# admission queue -> lane binding, per-class chunk sizing, quantum
+# preemption with bit-identical resume, per-request reports and the SLO
+# summary (sustained windows/s, p50/p99 window latency), plus the
+# serve_admit / serve_chunk telemetry spans.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_serve_smoke.py)
+# as a standalone gate; architecture + knobs: docs/SERVING.md.
+#
+# Usage: scripts/serve_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_serve_smoke.py -q "$@"

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Serving entry point: multi-tenant continuous-batching event-stream SR.
+
+Streams a datalist — or seeded Poisson loadgen traffic — through the
+``esr_tpu.serving`` tier (docs/SERVING.md): live admission to virtual
+lanes, per-stream recurrent-state preemption/resume, SLO request classes
+with per-class chunk sizing, AOT chunk programs so the serving process
+never traces.
+
+    # replay a datalist as Poisson traffic at 5 streams/s, 4 lanes
+    python serve.py --model_path <ckpt-dir> --data_list test.txt \\
+                    --output_path /tmp/serve --rate 5 --lanes 4 \\
+                    --scale 2 --ori_scale down16
+
+    # synthetic loadgen (no data needed): 16 generated streams
+    python serve.py --model_path <ckpt-dir> --loadgen 16 \\
+                    --output_path /tmp/serve --rate 8 --lanes 4
+
+Outputs under ``--output_path``: ``serve_requests.jsonl`` (one report per
+request: metric means, window count, admit latency, window-latency
+p50/p99, preemptions), ``serve_summary.json`` (sustained windows/s,
+global + per-class p50/p99), and ``telemetry.jsonl`` (``serve_admit`` /
+``serve_chunk`` spans, queue/occupancy gauges — docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def get_flags():
+    p = argparse.ArgumentParser(description="ESR-TPU serving tier")
+    p.add_argument("--model_path", type=str, required=True,
+                   help="checkpoint dir")
+    p.add_argument("--data_list", type=str, default=None,
+                   help="datalist txt replayed as arriving streams")
+    p.add_argument("--loadgen", type=int, default=None,
+                   help="generate N synthetic streams instead of a "
+                        "datalist (seeded; serving loadgen)")
+    p.add_argument("--loadgen_kind", type=str, default="synthetic",
+                   choices=["synthetic", "simulate"],
+                   help="synthetic=random-walk streams (fast); "
+                        "simulate=ESIM contrast-threshold simulation")
+    p.add_argument("--output_path", type=str, required=True)
+    p.add_argument("--rate", type=float, default=4.0,
+                   help="Poisson arrival rate, streams/s")
+    p.add_argument("--seed", type=int, default=0)
+
+    # the serving shape (docs/SERVING.md knob table)
+    p.add_argument("--lanes", type=int, default=4,
+                   help="virtual lanes = physical batch size")
+    p.add_argument("--classes", type=str,
+                   default="interactive:2,standard:8,bulk:16",
+                   help="request classes as name:chunk_windows[,...]; "
+                        "arrivals deal round-robin across them")
+    p.add_argument("--default_class", type=str, default="standard")
+    p.add_argument("--max_pending", type=int, default=64,
+                   help="admission queue capacity (backpressure beyond)")
+    p.add_argument("--preempt_quantum", type=int, default=4,
+                   help="chunks a stream may hold a contended lane before "
+                        "eviction (0 disables preemption)")
+    p.add_argument("--aot", action="store_true", default=False,
+                   help="export + load AOT chunk programs so the serving "
+                        "loop never traces (inference/export.py)")
+    p.add_argument("--max_wall", type=float, default=None,
+                   help="hard wall-clock bound on the serving loop, s")
+
+    # dataset overrides (the infer.py set)
+    p.add_argument("--scale", type=int, default=4)
+    p.add_argument("--seqn", type=int, default=3)
+    p.add_argument("--seql", type=int, default=9)
+    p.add_argument("--step_size", type=int, default=None)
+    p.add_argument("--time_bins", type=int, default=1)
+    p.add_argument("--ori_scale", type=str, default="down4")
+    p.add_argument("--mode", type=str, default="events")
+    p.add_argument("--window", type=int, default=2048)
+    p.add_argument("--sliding_window", type=int, default=1024)
+    return p.parse_args()
+
+
+def parse_classes(spec: str):
+    from esr_tpu.serving import RequestClass
+
+    out = {}
+    for part in spec.split(","):
+        name, _, w = part.strip().partition(":")
+        if not name or not w:
+            raise ValueError(
+                f"bad --classes entry {part!r} (want name:chunk_windows)"
+            )
+        out[name] = RequestClass(name, chunk_windows=int(w))
+    return out
+
+
+def main():
+    flags = get_flags()
+    from esr_tpu.parallel.mesh import honor_platform_env
+
+    honor_platform_env()
+    assert (flags.data_list is None) != (flags.loadgen is None), (
+        "pass exactly one of --data_list / --loadgen"
+    )
+    os.makedirs(flags.output_path, exist_ok=True)
+
+    dataset_config = {
+        "scale": flags.scale,
+        "ori_scale": flags.ori_scale,
+        "time_bins": flags.time_bins,
+        "need_gt_frame": False,
+        "need_gt_events": True,
+        "mode": flags.mode,
+        "window": flags.window,
+        "sliding_window": flags.sliding_window,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {
+            "sequence_length": flags.seql,
+            "seqn": flags.seqn,
+            "step_size": flags.step_size,
+            "pause": {"enabled": False},
+        },
+    }
+
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.serving import (
+        ServingEngine,
+        make_stream_corpus,
+        poisson_schedule,
+    )
+    from esr_tpu.training.checkpoint import load_for_inference
+    from esr_tpu.utils.logging import setup_logging
+
+    setup_logging(flags.output_path)
+    model, params, _config = load_for_inference(flags.model_path)
+    classes = parse_classes(flags.classes)
+
+    if flags.loadgen is not None:
+        paths = make_stream_corpus(
+            os.path.join(flags.output_path, "loadgen_streams"),
+            n=flags.loadgen, seed=flags.seed, kind=flags.loadgen_kind,
+        )
+    else:
+        from esr_tpu.data.loader import read_datalist
+
+        paths = read_datalist(flags.data_list)
+
+    aot_programs = None
+    if flags.aot:
+        # one exported chunk program per distinct class fusion depth: the
+        # serving loop then only ever deserializes — it never traces
+        from esr_tpu.inference.export import export_checkpoint
+
+        from esr_tpu.serving.server import RecordingStream
+
+        probe = RecordingStream(paths[0], dataset_config)
+        kh, kw = probe.gt_resolution
+        aot_programs = {}
+        for w in sorted({c.chunk_windows for c in classes.values()}):
+            path = os.path.join(
+                flags.output_path, f"chunk_program.w{w}.stablehlo"
+            )
+            export_checkpoint(
+                flags.model_path, path, batch=flags.lanes,
+                height=kh, width=kw, program="engine_chunk",
+                chunk_windows=w, scale=flags.scale,
+            )
+            aot_programs[w] = path
+
+    schedule = poisson_schedule(
+        paths, rate_hz=flags.rate, seed=flags.seed,
+        classes=tuple(sorted(classes)),
+    )
+
+    sink = TelemetrySink(os.path.join(flags.output_path, "telemetry.jsonl"))
+    prev = set_active_sink(sink)
+    try:
+        server = ServingEngine(
+            model, params, dataset_config, seqn=flags.seqn,
+            lanes=flags.lanes, classes=classes,
+            default_class=flags.default_class,
+            max_pending=flags.max_pending,
+            preempt_quantum=flags.preempt_quantum,
+            aot_programs=aot_programs,
+        )
+        summary = server.run(
+            arrivals=schedule, max_wall_s=flags.max_wall
+        )
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+    with open(os.path.join(flags.output_path, "serve_requests.jsonl"),
+              "w") as f:
+        for rid in sorted(server.reports()):
+            f.write(json.dumps(server.report(rid)) + "\n")
+    with open(os.path.join(flags.output_path, "serve_summary.json"),
+              "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -453,6 +453,102 @@ def test_esr008_traced_context_is_esr002s_beat():
 
 
 # ---------------------------------------------------------------------------
+# ESR009 unbounded queue wait in loop
+
+
+def test_esr009_flags_unbounded_get_and_put_in_loop():
+    src = (
+        "import queue\n"
+        "class Server:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue(maxsize=4)\n"
+        "    def serve(self):\n"
+        "        while True:\n"
+        "            req = self._q.get()\n"
+        "    def feed(self, items):\n"
+        "        for item in items:\n"
+        "            self._q.put(item)\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR009"]
+    assert [f.line for f in findings] == [7, 10]
+
+
+def test_esr009_bounded_nowait_and_nonqueue_receivers_are_clean():
+    """timeout=, block=False, the _nowait variants, a get outside any
+    loop, and dict.get on a non-queue receiver must all stay clean —
+    receiver resolution is anchored to queue-constructor assignments."""
+    src = (
+        "import queue\n"
+        "class Server:\n"
+        "    def __init__(self, cfg):\n"
+        "        self._q = queue.Queue(maxsize=4)\n"
+        "        self.cfg = cfg\n"
+        "    def serve(self, stop):\n"
+        "        while not stop.is_set():\n"
+        "            try:\n"
+        "                req = self._q.get(timeout=0.2)\n"
+        "            except queue.Empty:\n"
+        "                continue\n"
+        "            self._q.put(req, block=False)\n"
+        "            name = self.cfg.get('name')\n"
+        "            extra = self._q.get_nowait()\n"
+        "    def one_shot(self):\n"
+        "        return self._q.get()\n"
+    )
+    assert "ESR009" not in rules_hit(src)
+
+
+def test_esr009_positional_block_timeout():
+    """queue.Queue accepts block/timeout positionally — get(True, 0.2)
+    and put(item, False) are bounded/non-blocking and must stay clean,
+    while a positional block=True with no timeout is still unbounded."""
+    src = (
+        "import queue\n"
+        "q = queue.Queue(maxsize=4)\n"
+        "def pump():\n"
+        "    while True:\n"
+        "        item = q.get(True, 0.2)\n"
+        "        q.put(item, False)\n"
+        "        other = q.get(True)\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR009"]
+    assert [f.line for f in findings] == [7]
+
+
+def test_esr009_noqa_and_nested_def_are_clean():
+    src = (
+        "import queue\n"
+        "q = queue.Queue()\n"
+        "def pump():\n"
+        "    while True:\n"
+        "        item = q.get()  # esr: noqa(ESR009)\n"
+        "def register():\n"
+        "    for _ in range(3):\n"
+        "        def later():\n"
+        "            return q.get()\n"
+        "        schedule(later)\n"
+    )
+    assert "ESR009" not in rules_hit(src)
+
+
+def test_esr009_plain_name_queue_from_ctor():
+    """SimpleQueue.get blocks like any queue get — flagged; SimpleQueue
+    is unbounded and its put NEVER blocks, so put stays clean."""
+    src = (
+        "from queue import SimpleQueue\n"
+        "jobs = SimpleQueue()\n"
+        "def drain():\n"
+        "    for _ in range(10):\n"
+        "        jobs.get()\n"
+        "def feed(items):\n"
+        "    for item in items:\n"
+        "        jobs.put(item)\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py") if f.rule == "ESR009"]
+    assert [f.line for f in findings] == [5]
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 
 

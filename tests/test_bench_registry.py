@@ -36,7 +36,7 @@ def test_stage_registry_names_order_and_timeouts():
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "e2e",
         "e2e_device_raster", "scaling", "breakdown", "infer_throughput",
-        "ckpt_overlap",
+        "ckpt_overlap", "serve_loadgen",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -126,6 +126,95 @@ def test_ckpt_overlap_stage_registered_and_schema_pinned():
         "valid_readbacks_sequential", "valid_readbacks_fused",
         "valid_batches",
     )
+
+
+def test_serve_loadgen_stage_registered_and_schema_pinned():
+    """The SERVING headline (ISSUE 6): sustained windows/s + p50/p99
+    window latency under seeded Poisson churn, continuous batching vs
+    restarting the fixed-batch engine per arrival cohort. Tiny and
+    dispatch-bound by design, so it runs in smoke (CPU) too."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "serve_loadgen"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_serve_loadgen
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.SERVE_LOADGEN_KEYS == (
+        "windows_per_sec", "cohort_windows_per_sec",
+        "continuous_vs_cohort", "p50_window_ms", "p99_window_ms",
+        "requests", "completed", "windows", "preemptions", "lanes",
+        "arrival_rate_hz", "seed",
+    )
+
+
+def test_backend_up_bounded_probe_success_and_cache(tmp_path):
+    """Bring-up satellite (ISSUE 6): a successful probe reports attempt
+    accounting and caches the device identity for later failed runs."""
+    from esr_tpu.utils.artifacts import probe_backend_bounded
+
+    cache = str(tmp_path / "DEVICE_PROBE.json")
+    rec = probe_backend_bounded(
+        attempt_timeout_s=5.0, attempts=2, cache_path=cache,
+        probe_fn=lambda: {"device_kind": "unit", "n_devices": 1},
+    )
+    assert rec["ok"] is True
+    assert rec["device_kind"] == "unit"
+    assert rec["attempts"] == 1 and rec["attempt_log"] == []
+    cached = json.load(open(cache))
+    assert cached["probe"]["device_kind"] == "unit"
+    assert cached["ts"]
+
+
+def test_backend_up_bounded_probe_hang_retries_and_reports_cache(tmp_path):
+    """The observed wedge — the probe blocking forever — must be abandoned
+    at the per-attempt timeout, retried a bounded number of times, and a
+    fully failed bring-up must carry the LAST cached device identity
+    instead of nulling the artifact (the MULTICHIP_r* failure mode)."""
+    import threading
+
+    from esr_tpu.utils.artifacts import probe_backend_bounded
+
+    cache = str(tmp_path / "DEVICE_PROBE.json")
+    with open(cache, "w") as f:
+        json.dump({"ts": "2026-01-01T00:00:00Z",
+                   "probe": {"device_kind": "TPU v5 lite"}}, f)
+    release = threading.Event()
+
+    def hung_probe():
+        release.wait(30)  # far beyond the attempt timeout
+        return {}
+
+    rec = probe_backend_bounded(
+        attempt_timeout_s=0.1, attempts=2, cache_path=cache,
+        probe_fn=hung_probe, backoff_s=0.01,
+    )
+    release.set()  # unblock the abandoned daemon threads
+    assert rec["ok"] is False
+    assert rec["attempts"] == 2
+    assert [a["attempt"] for a in rec["attempt_log"]] == [1, 2]
+    assert all("hung_after_s" in a for a in rec["attempt_log"])
+    assert rec["cached_probe"]["probe"]["device_kind"] == "TPU v5 lite"
+
+
+def test_backend_up_bounded_probe_error_then_success():
+    """A transiently raising backend (tunnel mid-heal) retries with
+    backoff and succeeds within the attempt budget."""
+    from esr_tpu.utils.artifacts import probe_backend_bounded
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise RuntimeError("UNAVAILABLE: tunnel healing")
+        return {"device_kind": "unit", "n_devices": 4}
+
+    rec = probe_backend_bounded(
+        attempt_timeout_s=5.0, attempts=3, backoff_s=0.01, probe_fn=flaky,
+    )
+    assert rec["ok"] is True and rec["attempts"] == 2
+    assert rec["attempt_log"][0]["error"].startswith("RuntimeError")
+    assert rec["n_devices"] == 4
 
 
 class _TinyState(NamedTuple):

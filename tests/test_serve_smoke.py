@@ -1,0 +1,182 @@
+"""Serving-tier smoke (tier-1, also driven by ``scripts/serve_smoke.sh``):
+seeded Poisson loadgen drives ~8 short synthetic streams through 2 lanes
+END TO END on CPU — admission, continuous refill, per-class chunk sizing,
+preemption under churn, per-request reports, SLO summary, telemetry.
+
+The acceptance contract (ISSUE 6 / docs/SERVING.md):
+
+- every loadgen request completes with a per-request report (finite
+  engine-schema metric means, window count, admit latency, window-latency
+  p50/p99);
+- one ``serve_admit`` span per binding (fresh AND resume actions under
+  churn) and one ``serve_chunk`` span per dispatched chunk, with the
+  span-summed valid windows equal to the session total;
+- the session summary carries the serving headline fields: sustained
+  windows/s plus global and per-class p50/p99 window latency.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from esr_tpu.inference.engine import METRIC_KEYS
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.obs import TelemetrySink, set_active_sink
+from esr_tpu.serving import (
+    RequestClass,
+    ServingEngine,
+    make_stream_corpus,
+    poisson_schedule,
+)
+
+LANES = 2
+N_STREAMS = 8
+CLASSES = {
+    "interactive": RequestClass("interactive", chunk_windows=2),
+    "standard": RequestClass("standard", chunk_windows=4),
+}
+
+DATASET_CFG = {
+    "scale": 2,
+    "ori_scale": "down8",
+    "time_bins": 1,
+    "mode": "events",
+    "window": 1024,
+    "sliding_window": 512,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def smoke_run(tmp_path_factory):
+    """One loadgen-driven serving session; returns (server, summary,
+    telemetry records, schedule)."""
+    import jax
+
+    tmp = tmp_path_factory.mktemp("serve_smoke")
+    paths = make_stream_corpus(
+        str(tmp / "streams"), n=N_STREAMS, seed=0,
+        events_schedule=(1200, 4200),  # alternating short/long churn
+    )
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    x = np.zeros((1, 3, 16, 16, 2), np.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), x, model.init_states(1, 16, 16)
+    )
+    schedule = poisson_schedule(
+        paths, rate_hz=20.0, seed=0,
+        classes=("standard", "interactive"),
+    )
+    tel_path = str(tmp / "telemetry.jsonl")
+    sink = TelemetrySink(tel_path)
+    prev = set_active_sink(sink)
+    try:
+        server = ServingEngine(
+            model, params, DATASET_CFG, lanes=LANES, classes=CLASSES,
+            default_class="standard", max_pending=16, preempt_quantum=2,
+        )
+        summary = server.run(arrivals=schedule, max_wall_s=300)
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    with open(tel_path) as f:
+        records = [json.loads(line) for line in f]
+    return server, summary, records, schedule
+
+
+def test_all_requests_complete_with_reports(smoke_run):
+    server, summary, _, schedule = smoke_run
+    assert summary["requests"] == N_STREAMS
+    assert summary["completed"] == N_STREAMS
+    reports = server.reports()
+    assert len(reports) == N_STREAMS
+    for rep in reports.values():
+        assert rep["completed"], rep
+        assert rep["error"] is None
+        assert rep["n_windows"] >= 1
+        assert rep["request_class"] in CLASSES
+        assert rep["admit_latency_s"] is not None
+        assert rep["window_latency_p50_ms"] > 0
+        assert rep["window_latency_p99_ms"] >= rep["window_latency_p50_ms"]
+        for k in METRIC_KEYS:
+            assert np.isfinite(rep[k]), (k, rep)
+    # the loadgen ids round-trip (arrival -> admission -> report)
+    assert set(reports) == {a.request_id for a in schedule}
+
+
+def test_summary_has_slo_headline_fields(smoke_run):
+    _, summary, _, _ = smoke_run
+    assert summary["windows"] >= N_STREAMS  # every stream contributed
+    assert summary["wall_s"] > 0
+    assert summary["windows_per_sec"] > 0
+    assert summary["p50_window_ms"] > 0
+    assert summary["p99_window_ms"] >= summary["p50_window_ms"]
+    # both request classes served and reported separately
+    assert set(summary["classes"]) == set(CLASSES)
+    for cls in summary["classes"].values():
+        assert cls["windows"] >= 1
+        assert cls["p50_window_ms"] > 0
+
+
+def test_serve_admit_spans(smoke_run):
+    server, _, records, _ = smoke_run
+    admits = [r for r in records
+              if r["type"] == "span" and r["name"] == "serve_admit"]
+    # one per binding: 8 fresh + one per preemption resume
+    preemptions = server.summary()["preemptions"]
+    assert len(admits) == N_STREAMS + preemptions
+    for s in admits:
+        assert s["seconds"] >= 0
+        assert 0 <= s["lane"] < LANES
+        assert s["action"] in ("fresh", "resume")
+        assert s["cls"] in CLASSES
+        assert s["queue_depth"] >= 0
+    assert sum(1 for s in admits if s["action"] == "fresh") == N_STREAMS
+    # churn at 2 lanes under quantum 2 genuinely preempts
+    assert preemptions >= 1
+    assert sum(1 for s in admits if s["action"] == "resume") == preemptions
+    preempts = [r for r in records
+                if r["type"] == "event" and r["name"] == "serve_preempt"]
+    assert len(preempts) == preemptions
+
+
+def test_serve_chunk_spans_account_every_window(smoke_run):
+    _, summary, records, _ = smoke_run
+    chunks = [r for r in records
+              if r["type"] == "span" and r["name"] == "serve_chunk"]
+    assert len(chunks) >= 2
+    total = 0
+    for s in chunks:
+        assert s["seconds"] > 0
+        assert s["lanes"] == LANES
+        assert 1 <= s["occupancy"] <= LANES
+        assert s["chunk_windows"] in (2, 4)  # the two class depths
+        assert 1 <= s["windows"] <= LANES * s["chunk_windows"]
+        assert s["windows_per_sec"] > 0
+        total += s["windows"]
+    assert total == summary["windows"]
+    assert [s["chunk"] for s in chunks] == list(range(len(chunks)))
+    # queue/occupancy gauges ride along for dashboards
+    assert any(r["type"] == "gauge" and r["name"] == "serve_queue_depth"
+               for r in records)
+    assert any(r["type"] == "gauge" and r["name"] == "serve_lane_occupancy"
+               for r in records)
+
+
+def test_request_done_events(smoke_run):
+    _, _, records, _ = smoke_run
+    done = [r for r in records
+            if r["type"] == "event" and r["name"] == "serve_request_done"]
+    assert len(done) == N_STREAMS
+    assert all(d["completed"] for d in done)
+    assert all(d["windows"] >= 1 for d in done)
