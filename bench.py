@@ -50,7 +50,13 @@ Stage order (most diagnostic value first):
   headline only if scan_compute failed.
 - ``bf16``: same step with bfloat16 compute (the MXU-native option).
 - ``dcn_ab``: fused Pallas DCNv2 vs jnp gather formulation, forward and
-  training direction (fwd + full VJP under grad).
+  training direction (fwd + full VJP under grad), + which direction(s)
+  the auto gate opened.
+- ``dcn_fwd_ab``: the inference-direction A/B — DCNv4-style fused
+  forward vs jnp vs the train kernel's forward, per-direction dispatch
+  decisions, fwd parity-gate evidence (ISSUE 7; the r4 0.961 baseline).
+- ``mfu_ceiling``: manifest-level roofline record (model-imposed MXU
+  occupancy ceiling + chip peak, device-free eval_shape trace).
 - ``e2e`` / ``e2e_device_raster``: the same step fed by the REAL host
   pipeline (synthetic HDF5 -> windowing -> rasterization -> collate ->
   device), the input-starvation check SURVEY §7.3-6 calls the main
@@ -131,7 +137,8 @@ def _last_known_good():
     a timing stage is returned — never a stitch of stages from different
     runs."""
     interest = ("backend_up", "scan_compute", "compute", "bf16",
-                "mosaic_dcn", "dcn_ab", "scan_matmul", "wide_model")
+                "mosaic_dcn", "dcn_ab", "dcn_fwd_ab", "scan_matmul",
+                "wide_model")
     for log in [_REAL_STAGELOG, *_PRIOR_STAGELOGS]:
         runs, cur = [], None
         try:
@@ -380,9 +387,11 @@ def stage_mosaic_dcn():
     from esr_tpu.ops.dcn_pallas import (
         dcn_parity_errors,
         dcn_parity_ok,
+        fwd_gate_mode,
         gate_mode,
         gate_used_fallback,
         pallas_compiles,
+        pallas_fwd_compiles,
     )
 
     gate_ok = pallas_compiles()
@@ -406,7 +415,13 @@ def stage_mosaic_dcn():
         "dcn_pallas_mosaic_ok": bool(flagship_ok and gate_ok),
         "auto_dispatch_gate": gate_ok,
         "gate_mode": gate_mode(),
-        "resolved_impl_at_bottleneck": resolve_dcn_impl(12, 20),
+        # the two directions gate independently (ISSUE 7): the train
+        # column is this stage's kernel pair; the fwd column is the
+        # DCNv4-style forward whose full evidence lands in dcn_fwd_ab
+        "auto_dispatch_gate_fwd": pallas_fwd_compiles(),
+        "fwd_gate_mode": fwd_gate_mode(),
+        "resolved_impl_at_bottleneck": resolve_dcn_impl(12, 20, "train"),
+        "resolved_impl_fwd_at_bottleneck": resolve_dcn_impl(12, 20, "fwd"),
         **{k: round(v, 8) for k, v in errs.items()},
         **{f"prod_{k}": round(v, 8) for k, v in errs_prod.items()},
     }
@@ -786,35 +801,44 @@ def stage_bf16(ctx):
     return {"steps_per_sec": EXTRA["bf16_steps_per_sec"]}
 
 
+def _timed_jit(f, iters=50, reps=3):
+    """Warm-jit + best-of-reps wall time per call of a nullary traced fn —
+    the timing core shared by the two DCN A/B stages."""
+    import jax
+
+    g = jax.jit(f)
+    jax.block_until_ready(g())
+
+    def run():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = g()
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    return _best_of_reps(run, reps)
+
+
 def stage_dcn_ab():
     """Pallas vs jnp DCNv2 at the flagship bottleneck shape.
 
     Measured on the TRAINING direction (forward + full VJP under grad) —
     training is mostly backward, and the backward is fused too — plus the
-    forward-only direction (the round-2 meaning, kept commensurable)."""
+    forward-only direction (the round-2 meaning, kept commensurable; the
+    dedicated inference-direction A/B with the DCNv4-style kernel is
+    ``dcn_fwd_ab``). Also records which direction(s) the ``auto``
+    dispatch gate opened at the flagship bottleneck map, so a capture can
+    no longer show a speedup whose impl never ships."""
     import jax
 
     if jax.default_backend() == "cpu":
         return {"skipped": "cpu backend (interpreter timing is meaningless)"}
 
     from esr_tpu.ops import dcn_pallas as DP
-    from esr_tpu.ops.dcn import deform_conv2d
+    from esr_tpu.ops.dcn import deform_conv2d, resolve_dcn_impl
     from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
 
     x, off, mask, wt = _flagship_dcn_inputs()
-
-    def timed(f, iters=50, reps=3):
-        g = jax.jit(f)
-        jax.block_until_ready(g())
-
-        def run():
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                r = g()
-            jax.block_until_ready(r)
-            return (time.perf_counter() - t0) / iters
-
-        return _best_of_reps(run, reps)
 
     def grad_of(fn):
         def loss(x_, o_, m_, w_):
@@ -822,11 +846,11 @@ def stage_dcn_ab():
 
         return lambda: jax.grad(loss, argnums=(0, 1, 2, 3))(x, off, mask, wt)
 
-    t_jnp_f = timed(lambda: deform_conv2d(x, off, mask, wt))
-    t_pal_f = timed(lambda: deform_conv2d_pallas(x, off, mask, wt))
-    t_jnp_g = timed(grad_of(lambda *a: deform_conv2d(*a)))
+    t_jnp_f = _timed_jit(lambda: deform_conv2d(x, off, mask, wt))
+    t_pal_f = _timed_jit(lambda: deform_conv2d_pallas(x, off, mask, wt))
+    t_jnp_g = _timed_jit(grad_of(lambda *a: deform_conv2d(*a)))
     DP.dcn_backward_impl("pallas")
-    t_pal_g = timed(grad_of(lambda *a: deform_conv2d_pallas(*a)))
+    t_pal_g = _timed_jit(grad_of(lambda *a: deform_conv2d_pallas(*a)))
     EXTRA["dcn_pallas_speedup"] = round(t_jnp_f / t_pal_f, 3)
     EXTRA["dcn_pallas_train_speedup"] = round(t_jnp_g / t_pal_g, 3)
     return {"fwd_speedup": EXTRA["dcn_pallas_speedup"],
@@ -834,7 +858,114 @@ def stage_dcn_ab():
             "jnp_fwd_ms": round(t_jnp_f * 1e3, 3),
             "pallas_fwd_ms": round(t_pal_f * 1e3, 3),
             "jnp_train_ms": round(t_jnp_g * 1e3, 3),
-            "pallas_train_ms": round(t_pal_g * 1e3, 3)}
+            "pallas_train_ms": round(t_pal_g * 1e3, 3),
+            "auto_open_train": resolve_dcn_impl(12, 20, "train") == "pallas",
+            "auto_open_fwd": resolve_dcn_impl(12, 20, "fwd") == "pallas"}
+
+
+# The dcn_fwd_ab stage record schema, pinned by test_bench_registry (ISSUE
+# 7): the inference-direction DCN series — the DCNv4-style fused forward
+# vs the jnp composite (fwd_speedup, to beat the r4 0.961 baseline) and
+# vs the train-direction kernel's forward (the kernel it replaces in this
+# direction), plus the per-direction dispatch decisions and the fwd
+# parity-gate evidence — stays machine-comparable across rounds.
+DCN_FWD_AB_KEYS = (
+    "fwd_speedup", "fwd_speedup_vs_old_kernel",
+    "jnp_fwd_ms", "pallas_fwd_ms", "old_kernel_fwd_ms",
+    "dispatch_fwd", "dispatch_train", "fwd_gate", "fwd_gate_mode",
+    "fwd_max_err", "fwd_scale", "fwd_parity_ok",
+)
+
+
+def stage_dcn_fwd_ab():
+    """Inference-direction DCN A/B at the flagship bottleneck shape.
+
+    The r4 capture showed the one-hot-matmul kernel LOSING the forward
+    direction to the jnp composite (fwd_speedup 0.961) — exactly the
+    direction the streaming engine and serving tier dispatch millions of
+    times. This stage times three forwards warm: the jnp composite, the
+    DCNv4-style fused forward (``deform_conv2d_pallas_fwd`` — separable
+    line-buffer gather, unnormalized modulation, single VMEM accumulator)
+    and the train-direction kernel's forward (the old fwd path). It also
+    records the per-direction ``auto`` resolutions at the bottleneck map
+    and the forward gate's parity evidence (``dcn_fwd_parity_errors`` at
+    the flagship shape, judged by the same scale-normalized methodology
+    as the train gate), so the next TPU capture can verify
+    ``fwd_speedup > 1.0`` AND that the win actually dispatches."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "cpu backend (interpreter timing is meaningless)"}
+
+    from esr_tpu.ops import dcn_pallas as DP
+    from esr_tpu.ops.dcn import deform_conv2d, resolve_dcn_impl
+
+    x, off, mask, wt = _flagship_dcn_inputs()
+
+    # Gate FIRST: if Mosaic rejects the fwd kernel the timing below raises
+    # too, but the gate catches its exception and records the diagnosis —
+    # running it first guarantees fwd_gate_mode() carries the 'failed: ...'
+    # evidence even when the stage itself then errors out.
+    gate = DP.pallas_fwd_compiles()
+
+    t_jnp = _timed_jit(lambda: deform_conv2d(x, off, mask, wt))
+    t_new = _timed_jit(
+        lambda: DP.deform_conv2d_pallas_fwd(x, off, mask, wt))
+    t_old = _timed_jit(lambda: DP.deform_conv2d_pallas(x, off, mask, wt))
+    errs = DP.dcn_fwd_parity_errors(x, off, mask, wt, interpret=False)
+    res = dict(zip(DCN_FWD_AB_KEYS, (
+        round(t_jnp / t_new, 3),
+        round(t_old / t_new, 3),
+        round(t_jnp * 1e3, 3),
+        round(t_new * 1e3, 3),
+        round(t_old * 1e3, 3),
+        resolve_dcn_impl(12, 20, "fwd"),
+        resolve_dcn_impl(12, 20, "train"),
+        bool(gate),
+        DP.fwd_gate_mode(),
+        round(errs["fwd_max_err"], 8),
+        round(errs["fwd_scale"], 8),
+        bool(DP.dcn_fwd_parity_ok(errs)),
+    ), strict=True))
+    EXTRA["dcn_fwd_ab"] = dict(res)
+    return res
+
+
+# The mfu_ceiling stage record schema, pinned by test_bench_registry: the
+# manifest-level roofline record (ROADMAP named scripts/mfu_ceiling.py as
+# unwired) — flops-weighted MXU tile-packing ceiling of the flagship
+# model, next to the chip's peak — so per-stage wins (dcn_fwd_ab, the
+# headline MFU) are read against what this model could possibly deliver
+# on this chip, not just against each other.
+MFU_CEILING_KEYS = (
+    "basech", "mxu_occupancy_ceiling", "total_gflops_fwd",
+    "n_contractions", "mean_mflops_per_contraction", "peak_flops_chip",
+    "device_kind",
+)
+
+
+def stage_mfu_ceiling():
+    """Manifest-level roofline record: the model-imposed MXU occupancy
+    ceiling for the flagship (``esr_tpu.utils.roofline``, device-free
+    ``eval_shape`` trace — runs in smoke) plus the chip's peak flops, so
+    ``measured_mfu / (ceiling)`` = stack efficiency is computable from
+    the artifact alone."""
+    import jax
+
+    from esr_tpu.utils.roofline import ceiling_for
+
+    ceil = ceiling_for(8)
+    res = dict(zip(MFU_CEILING_KEYS, (
+        ceil["basech"],
+        ceil["mxu_occupancy_ceiling"],
+        ceil["total_gflops_fwd"],
+        ceil["n_contractions"],
+        ceil["mean_mflops_per_contraction"],
+        _peak_flops(),
+        jax.devices()[0].device_kind,
+    ), strict=True))
+    EXTRA["mfu_ceiling"] = dict(res)
+    return res
 
 
 def stage_scaling(ctx, batches=None):
@@ -1509,6 +1640,12 @@ STAGE_REGISTRY = [
     ("compute", stage_compute, 900, True),
     ("bf16", stage_bf16, 900, True),
     ("dcn_ab", lambda ctx: stage_dcn_ab(), 900, True),
+    # inference-direction DCN A/B: DCNv4-style fused forward vs jnp vs the
+    # train kernel's forward, + per-direction dispatch proof (ISSUE 7)
+    ("dcn_fwd_ab", lambda ctx: stage_dcn_fwd_ab(), 900, True),
+    # manifest-level roofline record: device-free eval_shape trace, runs
+    # (and produces real numbers) in smoke too
+    ("mfu_ceiling", lambda ctx: stage_mfu_ceiling(), 600, True),
     # smoke = plumbing check on CPU; skip the slow loader stages
     ("e2e", stage_e2e, 900, False),
     ("e2e_device_raster",

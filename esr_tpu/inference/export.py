@@ -36,6 +36,25 @@ def _shape_dtype(tree):
     )
 
 
+def _portable_dcn(model, platforms: Tuple[str, ...]):
+    """Rebind TPU-only Pallas DCN dispatch to the portable jnp formulation
+    for multi-platform artifacts (identical math; the kernels are a
+    speed/precision upgrade). Both direction knobs are neutralized:
+    ``dcn_impl`` (train direction) and ``dcn_impl_fwd`` (the
+    forward/serving direction added in ops/dcn.py's direction-aware
+    dispatch) — an exported chunk program runs train=False, so a leaked
+    ``dcn_impl_fwd='pallas'`` would otherwise bake the unlowerable kernel
+    into the CPU target."""
+    if len(platforms) <= 1:
+        return model
+    updates = {}
+    if getattr(model, "dcn_impl", None) in ("auto", "pallas"):
+        updates["dcn_impl"] = "jnp"
+    if getattr(model, "dcn_impl_fwd", None) in ("auto", "pallas"):
+        updates["dcn_impl_fwd"] = "jnp"
+    return model.clone(**updates) if updates else model
+
+
 def export_forward(
     model,
     params,
@@ -54,8 +73,7 @@ def export_forward(
     ``ops/dcn.py:142-148``). Export with ``platforms=('tpu',)`` to keep the
     fused kernel in the artifact.
     """
-    if len(platforms) > 1 and getattr(model, "dcn_impl", None) in ("auto", "pallas"):
-        model = model.clone(dcn_impl="jnp")
+    model = _portable_dcn(model, platforms)
 
     def fn(params, x, states):
         return model.apply(params, x, states)
@@ -147,9 +165,7 @@ def export_chunk_program(
     """
     from esr_tpu.inference.engine import make_chunk_fn
 
-    if len(platforms) > 1 and getattr(model, "dcn_impl", None) in (
-            "auto", "pallas"):
-        model = model.clone(dcn_impl="jnp")
+    model = _portable_dcn(model, platforms)
     kh, kw = gt_hw
     ih, iw = inp_hw if inp_hw is not None else gt_hw
     lh, lw = lr_hw if lr_hw is not None else gt_hw

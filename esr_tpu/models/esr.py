@@ -165,6 +165,10 @@ class STFusion(nn.Module):
     has_scaleaggre: bool = True
     deformable_groups: int = 8
     dcn_impl: str = "auto"  # 'auto' -> Pallas kernel on TPU, jnp elsewhere
+    # forward-direction override (inference/serving calls, train=False):
+    # None defers to dcn_impl; the two directions gate independently in
+    # 'auto' (ops/dcn.py resolve_dcn_impl)
+    dcn_impl_fwd: Optional[str] = None
 
     def setup(self):
         assert self.has_dcnatten or self.has_scaleaggre
@@ -230,10 +234,19 @@ class STFusion(nn.Module):
             apply_seq(self.offset_conv, jnp.concatenate([feat0, feat1], axis=-1), train)
         )
         offsets, mask = dcn_offsets_from_conv(raw, self.deformable_groups, 9)
+        # Direction-aware dispatch: a train=True call is the grad-carrying
+        # direction (fused fwd+VJP kernel pair); train=False is the
+        # inference/serving-hot forward, where the DCNv4-style fused
+        # forward kernel and its own gate apply (ops/dcn.py).
+        direction = "train" if train else "fwd"
+        impl = (
+            self.dcn_impl if train
+            else (self.dcn_impl_fwd or self.dcn_impl)
+        )
         aligned = jax.nn.relu(
             deform_conv2d_auto(
                 feat0, offsets, mask, self.dcn_weight, self.dcn_bias,
-                impl=self.dcn_impl,
+                impl=impl, direction=direction,
             )
         )
         feat = apply_seq(self.post_dcn, jnp.concatenate([aligned, feat1], axis=-1), train)
@@ -312,6 +325,8 @@ class DeepRecurrNet(nn.Module):
     has_dcnatten: bool = True
     has_scaleaggre: bool = True
     dcn_impl: str = "auto"
+    # forward-direction (train=False) DCN impl override; None = dcn_impl
+    dcn_impl_fwd: Optional[str] = None
 
     down_scale: int = 8
 
@@ -331,6 +346,7 @@ class DeepRecurrNet(nn.Module):
             channels=c, num_frame=self.num_frame, norm=self.norm,
             activation=self.activation, has_dcnatten=self.has_dcnatten,
             has_scaleaggre=self.has_scaleaggre, dcn_impl=self.dcn_impl,
+            dcn_impl_fwd=self.dcn_impl_fwd,
         )
         self.tail = ConvLayer(
             self.inch, 3, padding=1, activation="relu", norm=self.norm

@@ -127,32 +127,60 @@ def deform_conv2d(
     return out
 
 
-# ``'auto'`` dispatch decisions observed during tracing, keyed "HxW" -> impl.
-# One entry per distinct input map size per process; read via dispatch_log().
+# ``'auto'`` dispatch decisions observed during tracing, keyed
+# "direction:HxW" -> impl (direction in {'train', 'fwd'}). A fwd and a
+# train call at the same map size are DIFFERENT decisions with different
+# gates, so they must never overwrite each other (the pre-PR-7 "HxW" key
+# did exactly that). One entry per (direction, map size) per process;
+# read via dispatch_log().
 _DISPATCH_LOG: dict = {}
+
+DCN_DIRECTIONS = ("train", "fwd")
 
 
 def dispatch_log() -> dict:
-    """Copy of the ``'auto'`` dispatch decisions traced so far (bench
-    evidence: which impl each DCN call site in a compiled step resolved to).
-    """
+    """Copy of the ``'auto'`` dispatch decisions traced so far (bench and
+    serving evidence: which impl each DCN call site in a compiled program
+    resolved to, per direction). Keys are ``"train:HxW"`` / ``"fwd:HxW"``
+    strings so the log serializes straight into JSONL artifacts."""
     return dict(_DISPATCH_LOG)
 
 
-def resolve_dcn_impl(h: int, w: int) -> str:
-    """The impl ``'auto'`` dispatch chooses for an ``h x w`` input map.
+def _dispatch_key(direction: str, h: int, w: int) -> str:
+    return f"{direction}:{h}x{w}"
 
-    One-hot-matmul gather work scales as HW x No: the fused kernel wins
-    decisively at bottleneck-sized maps (measured 1.3-2.5x on v5e up to
-    45x80) and loses to XLA's gather beyond ~4096 pixels. On top of the
-    size rule, Pallas requires the one-time real-Mosaic self-test
-    (:func:`esr_tpu.ops.dcn_pallas.pallas_compiles`) to have passed.
+
+def resolve_dcn_impl(h: int, w: int, direction: str = "train") -> str:
+    """The impl ``'auto'`` dispatch chooses for an ``h x w`` input map in
+    the given direction (``'train'`` = forward + VJP under grad,
+    ``'fwd'`` = inference/serving forward only).
+
+    One-hot-matmul gather work scales with the map size: the fused
+    kernels win at bottleneck-sized maps and lose to XLA's gather beyond
+    ~4096 pixels. On top of the size rule each direction has its OWN
+    one-time real-Mosaic self-test — the train direction gates on
+    :func:`esr_tpu.ops.dcn_pallas.pallas_compiles` (fwd+VJP kernel pair,
+    measured 3.17x on r4) and the fwd direction on
+    :func:`esr_tpu.ops.dcn_pallas.pallas_fwd_compiles` (the DCNv4-style
+    fused forward) — so the gates open independently per direction. A
+    single shared gate would have shipped the r4 forward regression
+    (``fwd_speedup`` 0.961) to the serving tier the moment train parity
+    passed.
     """
+    assert direction in DCN_DIRECTIONS, direction
     if h * w <= 4096:
-        from esr_tpu.ops.dcn_pallas import on_tpu_backend, pallas_compiles
+        from esr_tpu.ops.dcn_pallas import (
+            on_tpu_backend,
+            pallas_compiles,
+            pallas_fwd_compiles,
+        )
 
-        if on_tpu_backend() and pallas_compiles():
-            return "pallas"
+        if on_tpu_backend():
+            gate = (
+                pallas_fwd_compiles if direction == "fwd" else pallas_compiles
+            )
+            if gate():
+                return "pallas"
     return "jnp"
 
 
@@ -167,24 +195,42 @@ def deform_conv2d_auto(
     padding: int = 1,
     dilation: int = 1,
     impl: str = "auto",
+    direction: str = "train",
 ) -> jax.Array:
-    """Dispatch between the jnp formulation and the fused Pallas kernel.
+    """Dispatch between the jnp formulation and the fused Pallas kernels.
 
     ``impl``: ``'auto'`` uses Pallas on TPU backends (faster AND more
     accurate — the jnp einsum pays the MXU's default bf16 rounding) and the
     jnp path elsewhere (Pallas interpret mode is for tests, not speed);
-    ``'pallas'`` / ``'jnp'`` force a path. ``'auto'`` additionally requires
-    the kernel to pass a one-time real-Mosaic compile+exec self-test
-    (:func:`esr_tpu.ops.dcn_pallas.pallas_compiles`), so the default can
-    never silently depend on a kernel the resident compiler rejects.
+    ``'pallas'`` / ``'jnp'`` force a path.
+
+    ``direction``: which Pallas kernel ``'pallas'`` means and which gate
+    ``'auto'`` consults. ``'train'`` (default — grad-carrying call sites)
+    routes :func:`esr_tpu.ops.dcn_pallas.deform_conv2d_pallas` (one-hot
+    forward + fused VJP, gated by ``pallas_compiles``); ``'fwd'``
+    (inference/serving — the direction the streaming engine and serving
+    tier dispatch millions of times) routes the DCNv4-style fused forward
+    :func:`esr_tpu.ops.dcn_pallas.deform_conv2d_pallas_fwd`, gated by
+    ``pallas_fwd_compiles``. Either way ``'auto'`` can never silently
+    depend on a kernel the resident compiler rejects, and the traced
+    decision is logged under ``(direction, HxW)``.
     """
+    assert direction in DCN_DIRECTIONS, direction
     if impl == "auto":
-        impl = resolve_dcn_impl(x.shape[1], x.shape[2])
+        impl = resolve_dcn_impl(x.shape[1], x.shape[2], direction)
         # Traced once per compile; the log is what bench.py's on-chip
         # artifact reports as step-level proof of which impl actually ran
-        # (VERDICT r4: the only real-TPU capture silently dispatched jnp).
-        _DISPATCH_LOG[f"{x.shape[1]}x{x.shape[2]}"] = impl
+        # (VERDICT r4: the only real-TPU capture silently dispatched jnp),
+        # and what test_serve_smoke pins as the serving path's forward
+        # decision.
+        _DISPATCH_LOG[_dispatch_key(direction, x.shape[1], x.shape[2])] = impl
     if impl == "pallas":
+        if direction == "fwd":
+            from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas_fwd
+
+            return deform_conv2d_pallas_fwd(
+                x, offsets, mask, weight, bias, stride, padding, dilation
+            )
         from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
 
         return deform_conv2d_pallas(

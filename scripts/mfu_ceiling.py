@@ -22,127 +22,26 @@ the stack's efficiency, ceiling is the model's fault. Reference
 context: the reference never reports MFU; its hot path is the cuDNN
 conv + DCNv2 CUDA kernel (`models/DCNv2/src/cuda/dcn_v2_cuda.cu`).
 
+The analysis itself lives in ``esr_tpu.utils.roofline`` (bench.py stamps
+it into every capture as the ``mfu_ceiling`` stage record); this script
+is the offline CLI over it.
+
 Usage: python scripts/mfu_ceiling.py [--json OUT]
 """
 
 import json
-import math
 import os
 import sys
-from contextlib import contextmanager
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from esr_tpu.utils.roofline import (  # noqa: E402 - path bootstrap first
+    ceiling_for,
+    gemm_efficiency,
+    record_contractions,
+)
 
-def _ceil(x, m):
-    return int(math.ceil(x / m) * m)
-
-
-def gemm_efficiency(m, k, n):
-    """Fraction of MXU lanes doing useful work for an MxKxN contraction."""
-    return (m / _ceil(m, 8)) * (k / _ceil(k, 128)) * (n / _ceil(n, 128))
-
-
-@contextmanager
-def record_contractions(ops):
-    """Intercept conv/dot primitives during tracing and log GEMM shapes."""
-    import jax
-    from jax import lax
-
-    real_conv = lax.conv_general_dilated
-    real_dot = lax.dot_general
-
-    def conv_spy(lhs, rhs, *args, **kw):
-        out = real_conv(lhs, rhs, *args, **kw)
-        dn = kw.get("dimension_numbers")
-        # the GEMM model below assumes flax's NHWC/HWIO/NHWC lowering and
-        # dense (ungrouped) convs; anything else would silently produce
-        # wrong M/K/N, so refuse loudly instead
-        assert kw.get("feature_group_count", 1) == 1, kw
-        # NHWC/HWIO/NHWC, either as the string spec or flax's canonical
-        # ConvDimensionNumbers (lhs (0,3,1,2) = batch,feature,H,W;
-        # rhs (3,2,0,1) = O,I,H,W)
-        assert dn is None or tuple(dn) in (
-            ("NHWC", "HWIO", "NHWC"),
-            ((0, 3, 1, 2), (3, 2, 0, 1), (0, 3, 1, 2)),
-        ), dn
-        b = lhs.shape[0]
-        kh, kw_, cin, cout = rhs.shape
-        ho, wo = out.shape[1], out.shape[2]
-        m, k, n = b * ho * wo, kh * kw_ * cin, cout
-        ops.append({"kind": "conv", "m": m, "k": k, "n": n,
-                    "flops": 2.0 * m * k * n,
-                    "shape": f"{kh}x{kw_}x{cin}->{cout} @ {b}x{ho}x{wo}",
-                    "dn": str(dn)})
-        return out
-
-    def dot_spy(lhs, rhs, dimension_numbers, *args, **kw):
-        out = real_dot(lhs, rhs, dimension_numbers, *args, **kw)
-        (lc, rc), (lb, rb) = dimension_numbers
-        k = int(math.prod(lhs.shape[d] for d in lc)) or 1
-        bsz = int(math.prod(lhs.shape[d] for d in lb)) or 1
-        m = int(max(1, math.prod(lhs.shape) // (k * bsz)))
-        n = int(max(1, math.prod(rhs.shape) // (k * bsz)))
-        ops.append({"kind": "dot", "m": m * bsz, "k": k, "n": n,
-                    "flops": 2.0 * m * bsz * k * n,
-                    "shape": f"{lhs.shape}.{rhs.shape}"})
-        return out
-
-    lax.conv_general_dilated = conv_spy
-    lax.dot_general = dot_spy
-    try:
-        yield ops
-    finally:
-        lax.conv_general_dilated = real_conv
-        lax.dot_general = real_dot
-
-
-def ceiling_for(basech, b=2, h=90, w=160, seqn=3):
-    import jax
-    import jax.numpy as jnp
-
-    from esr_tpu.models.esr import DeepRecurrNet
-
-    model = DeepRecurrNet(inch=2, basech=basech, num_frame=seqn)
-    inp = jnp.zeros((b, seqn, h, w, 2), jnp.float32)
-    states = model.init_states(b, h, w)
-
-    # trace (abstract) only — records every contraction without compiling;
-    # params come from an uninstrumented shape-trace of init
-    params = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), inp, states))
-    ops2 = []
-    with record_contractions(ops2):
-        jax.eval_shape(lambda p: model.apply(p, inp, states), params)
-
-    total = sum(o["flops"] for o in ops2) or 1.0
-    for o in ops2:
-        o["eff"] = round(gemm_efficiency(o["m"], o["k"], o["n"]), 4)
-        o["flops_share"] = round(o["flops"] / total, 4)
-    ceiling = sum(o["eff"] * o["flops"] for o in ops2) / total
-    # aggregate identical shapes (the recurrent trunk repeats its convs)
-    agg = {}
-    for o in ops2:
-        key = (o["kind"], o["shape"])
-        a = agg.setdefault(key, dict(o, count=0, flops_share=0.0))
-        a["count"] += 1
-        a["flops_share"] += o["flops"] / total
-    for a in agg.values():
-        a["flops_share"] = round(a["flops_share"], 4)
-    worst = sorted(agg.values(),
-                   key=lambda o: (1 - o["eff"]) * o["flops"] * o["count"],
-                   reverse=True)[:6]
-    return {
-        "basech": basech,
-        "n_contractions": len(ops2),
-        "total_gflops_fwd": round(total / 1e9, 3),
-        "mean_mflops_per_contraction": round(total / len(ops2) / 1e6, 2),
-        "mxu_occupancy_ceiling": round(ceiling, 4),
-        "worst_ops": [
-            {k: o[k] for k in ("kind", "shape", "m", "k", "n", "eff",
-                               "flops_share", "count")}
-            for o in worst],
-    }
+__all__ = ["ceiling_for", "gemm_efficiency", "record_contractions", "main"]
 
 
 def main():

@@ -34,9 +34,9 @@ def test_stage_registry_names_order_and_timeouts():
     names = [e[0] for e in bench.STAGE_REGISTRY]
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
-        "conv_anchor", "compute", "bf16", "dcn_ab", "e2e",
-        "e2e_device_raster", "scaling", "breakdown", "infer_throughput",
-        "ckpt_overlap", "serve_loadgen",
+        "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
+        "mfu_ceiling", "e2e", "e2e_device_raster", "scaling", "breakdown",
+        "infer_throughput", "ckpt_overlap", "serve_loadgen",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -145,6 +145,55 @@ def test_serve_loadgen_stage_registered_and_schema_pinned():
         "requests", "completed", "windows", "preemptions", "lanes",
         "arrival_rate_hz", "seed",
     )
+
+
+def test_dcn_fwd_ab_stage_registered_and_schema_pinned():
+    """The inference-direction DCN series (ISSUE 7): fwd_speedup of the
+    DCNv4-style fused forward vs the jnp composite (the r4 0.961
+    baseline) and vs the train kernel's forward, per-direction dispatch
+    decisions, and the forward parity-gate evidence must stay
+    machine-comparable across rounds. Runs in smoke (skips cleanly on
+    CPU, like dcn_ab)."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "dcn_fwd_ab"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.DCN_FWD_AB_KEYS == (
+        "fwd_speedup", "fwd_speedup_vs_old_kernel",
+        "jnp_fwd_ms", "pallas_fwd_ms", "old_kernel_fwd_ms",
+        "dispatch_fwd", "dispatch_train", "fwd_gate", "fwd_gate_mode",
+        "fwd_max_err", "fwd_scale", "fwd_parity_ok",
+    )
+    # off-TPU the stage must skip, not fabricate interpreter timings
+    assert bench.stage_dcn_fwd_ab() == {
+        "skipped": "cpu backend (interpreter timing is meaningless)"
+    }
+
+
+def test_mfu_ceiling_stage_registered_schema_pinned_and_runs_offline():
+    """The manifest-level roofline record (ISSUE 7 satellite — ROADMAP
+    named scripts/mfu_ceiling.py as unwired): schema pinned, and the
+    stage must produce REAL numbers off-TPU (device-free eval_shape
+    trace), so every capture — including CPU smoke — carries the
+    model-imposed ceiling next to the chip peak."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "mfu_ceiling"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert timeout >= 300
+    assert in_smoke is True
+    assert bench.MFU_CEILING_KEYS == (
+        "basech", "mxu_occupancy_ceiling", "total_gflops_fwd",
+        "n_contractions", "mean_mflops_per_contraction", "peak_flops_chip",
+        "device_kind",
+    )
+    rec = bench.stage_mfu_ceiling()
+    assert tuple(rec.keys()) == bench.MFU_CEILING_KEYS
+    assert rec["basech"] == 8
+    assert 0.0 < rec["mxu_occupancy_ceiling"] <= 1.0
+    assert rec["total_gflops_fwd"] > 0
+    assert rec["n_contractions"] > 10
+    assert rec["peak_flops_chip"] > 0
 
 
 def test_backend_up_bounded_probe_success_and_cache(tmp_path):

@@ -129,6 +129,213 @@ def test_auto_dispatch_selects_jnp_on_cpu():
     out = deform_conv2d_auto(x, offsets, mask, weight, bias)
     ref = deform_conv2d(x, offsets, mask, weight, bias)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # ... in BOTH directions (the fwd gate is likewise closed off-TPU)
+    out_f = deform_conv2d_auto(x, offsets, mask, weight, bias,
+                               direction="fwd")
+    np.testing.assert_array_equal(np.asarray(out_f), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# DCNv4-style fused forward kernel (ISSUE 7 tentpole) — interpret-mode CPU
+# parity across the satellite matrix: deformable-group counts, odd and
+# non-tile-aligned H x W, mask on/off.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dg", [1, 2, 4])
+# odd / non-tile-aligned, plus one w > 128 shape so the x one-hot spans
+# multiple 128-lane blocks (auto dispatch admits maps up to 4096 px)
+@pytest.mark.parametrize("h,w", [(7, 9), (13, 5), (4, 150)])
+@pytest.mark.parametrize("with_mask", [True, False])
+def test_fwd_kernel_parity_matrix(dg, h, w, with_mask):
+    """The fused forward (separable line-buffer gather) against the jnp
+    formulation, judged by the production gate's own scale-normalized
+    criterion (dcn_fwd_parity_ok at the off-TPU f32-exact tolerance)."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    rng = np.random.default_rng(dg * 100 + h * 10 + w + with_mask)
+    b, cin, cout = 2, 4 * dg, 8
+    x = jnp.asarray(rng.standard_normal((b, h, w, cin)), jnp.float32)
+    offsets = jnp.asarray(
+        rng.standard_normal((b, h, w, dg, 9, 2)) * 3.0, jnp.float32
+    )
+    mask = (
+        jax.nn.sigmoid(jnp.asarray(
+            rng.standard_normal((b, h, w, dg, 9)), jnp.float32))
+        if with_mask else jnp.ones((b, h, w, dg, 9), jnp.float32)
+    )
+    weight = jnp.asarray(
+        rng.standard_normal((3, 3, cin, cout)) * 0.1, jnp.float32
+    )
+    errs = DP.dcn_fwd_parity_errors(
+        x, offsets, mask, weight, interpret=True
+    )
+    assert DP.dcn_fwd_parity_ok(errs), errs
+
+
+def test_fwd_kernel_strided_dilated_and_bias():
+    """Non-default conv geometry + bias through the fwd-specialized op."""
+    from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas_fwd
+
+    rng = np.random.default_rng(11)
+    b, h, w, cin, cout, dg = 1, 9, 11, 8, 6, 2
+    stride, padding, dilation = 2, 2, 2
+    ho = (h + 2 * padding - (dilation * 2 + 1)) // stride + 1
+    wo = (w + 2 * padding - (dilation * 2 + 1)) // stride + 1
+    x = jnp.asarray(rng.standard_normal((b, h, w, cin)), jnp.float32)
+    offsets = jnp.asarray(
+        rng.standard_normal((b, ho, wo, dg, 9, 2)) * 2, jnp.float32
+    )
+    mask = jax.nn.sigmoid(
+        jnp.asarray(rng.standard_normal((b, ho, wo, dg, 9)), jnp.float32)
+    )
+    weight = jnp.asarray(
+        rng.standard_normal((3, 3, cin, cout)) * 0.1, jnp.float32
+    )
+    bias = jnp.asarray(rng.standard_normal(cout), jnp.float32)
+    ref = deform_conv2d(x, offsets, mask, weight, bias,
+                        stride=stride, padding=padding, dilation=dilation)
+    out = deform_conv2d_pallas_fwd(x, offsets, mask, weight, bias,
+                                   stride, padding, dilation)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_fwd_kernel_bf16_in_f32_accumulate():
+    """bf16 inputs: output dtype follows the input (pipeline composition),
+    but accumulation inside the kernel is f32 — the bf16 output must agree
+    with the f32 computation to one bf16 rounding, far tighter than a
+    bf16-accumulated gather chain would."""
+    from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas_fwd
+
+    x, offsets, mask, weight, _ = _inputs(b=1, h=5, w=6, cin=8, cout=8, dg=2)
+    out32 = deform_conv2d_pallas_fwd(x, offsets, mask, weight)
+    cast = lambda a: a.astype(jnp.bfloat16)
+    out16 = deform_conv2d_pallas_fwd(*map(cast, (x, offsets, mask, weight)))
+    assert out16.dtype == jnp.bfloat16
+    # inputs themselves round to bf16, so allow a few input-rounding ulps
+    # on top of the single output rounding — still ~100x tighter than
+    # bf16 accumulation over 36 corner contributions would land
+    np.testing.assert_allclose(
+        np.asarray(out16, np.float32), np.asarray(out32), atol=0.1, rtol=0.1
+    )
+
+
+def test_fwd_kernel_backward_bit_identical_to_train_kernel():
+    """ISSUE 7 regression pin: the train-direction backward kernel is
+    untouched. Under a FIXED cotangent the fwd-specialized op's VJP and
+    the train op's VJP must produce bit-identical cotangents (both route
+    _pallas_backward on identical inputs), and dispatching through
+    deform_conv2d_auto(direction='train') is byte-for-byte the train op."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    x, offsets, mask, weight, _ = _inputs(b=1, h=5, w=6, cin=8, cout=8, dg=2)
+    cot = jnp.asarray(
+        np.random.default_rng(7).standard_normal((1, 5, 6, 8)), jnp.float32
+    )
+    DP.dcn_backward_impl("pallas")
+    _, vjp_new = jax.vjp(
+        lambda *a: DP.deform_conv2d_pallas_fwd(*a), x, offsets, mask, weight
+    )
+    _, vjp_old = jax.vjp(
+        lambda *a: deform_conv2d_pallas(*a), x, offsets, mask, weight
+    )
+    _, vjp_auto = jax.vjp(
+        lambda *a: deform_conv2d_auto(
+            *a, impl="pallas", direction="train"),
+        x, offsets, mask, weight,
+    )
+    for a, b_, name in zip(vjp_new(cot), vjp_old(cot),
+                           ("x", "offsets", "mask", "weight")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b_), err_msg=name
+        )
+    for a, b_, name in zip(vjp_auto(cot), vjp_old(cot),
+                           ("x", "offsets", "mask", "weight")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b_), err_msg=name
+        )
+
+
+# ---------------------------------------------------------------------------
+# Direction-aware dispatch (ISSUE 7 satellite: the fwd/train gates open
+# independently, and the dispatch log can no longer alias the directions).
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_dcn_impl_direction_split(monkeypatch):
+    """auto must be able to resolve 'pallas' for train and 'jnp' for fwd
+    at the SAME map size (and vice versa): the two directions consult
+    their own Mosaic gates. A single shared gate would ship the r4
+    forward regression (fwd_speedup 0.961) to serving the moment train
+    parity passed."""
+    from esr_tpu.ops import dcn as D
+    from esr_tpu.ops import dcn_pallas as DP
+
+    monkeypatch.setattr(DP, "on_tpu_backend", lambda: True)
+    monkeypatch.setattr(DP, "pallas_compiles", lambda: True)
+    monkeypatch.setattr(DP, "pallas_fwd_compiles", lambda: False)
+    assert D.resolve_dcn_impl(12, 20, "train") == "pallas"
+    assert D.resolve_dcn_impl(12, 20, "fwd") == "jnp"
+
+    monkeypatch.setattr(DP, "pallas_compiles", lambda: False)
+    monkeypatch.setattr(DP, "pallas_fwd_compiles", lambda: True)
+    assert D.resolve_dcn_impl(12, 20, "train") == "jnp"
+    assert D.resolve_dcn_impl(12, 20, "fwd") == "pallas"
+
+    # the size rule still caps both directions
+    assert D.resolve_dcn_impl(90, 160, "fwd") == "jnp"
+    with pytest.raises(AssertionError):
+        D.resolve_dcn_impl(12, 20, "sideways")
+
+
+def test_dispatch_log_keys_split_by_direction():
+    """Pre-PR-7 bug: dispatch_log keyed only on 'HxW', so a fwd and a
+    train call at the same map size overwrote each other's decision. The
+    log now keys on (direction, HxW) — both records coexist."""
+    from esr_tpu.ops import dcn as D
+
+    x, offsets, mask, weight, bias = _inputs(b=1, h=4, w=4, cin=4, cout=4,
+                                             dg=1)
+    deform_conv2d_auto(x, offsets, mask, weight, bias, direction="train")
+    deform_conv2d_auto(x, offsets, mask, weight, bias, direction="fwd")
+    log = D.dispatch_log()
+    assert log["train:4x4"] == "jnp"  # CPU: both gates closed
+    assert log["fwd:4x4"] == "jnp"
+
+
+def test_fwd_gate_false_on_cpu_and_parity_helper_shares_methodology():
+    """The forward-direction gate must refuse CPU like the train gate,
+    and dcn_fwd_parity_ok must be the SAME scale-normalized criterion /
+    tolerance ladder as dcn_parity_ok's forward half — pinned on the r4
+    capture numbers (in-tolerance on TPU at 5e-3, a defect off-TPU at
+    the f32-exact 1e-3)."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    assert DP.pallas_fwd_compiles() is False
+    assert DP.fwd_gate_mode() == "off-tpu (gate closed)"
+
+    r4_fwd = {"fwd_max_err": 0.00447407, "fwd_scale": 2.06631136}
+    assert not DP.dcn_fwd_parity_ok(r4_fwd)  # off-TPU f32-exact bound
+
+    class _OnTpu:
+        def __enter__(self):
+            self._prev = DP.on_tpu_backend
+            DP.on_tpu_backend = lambda: True
+            return self
+
+        def __exit__(self, *a):
+            DP.on_tpu_backend = self._prev
+
+    with _OnTpu():
+        assert DP.dcn_fwd_parity_ok(r4_fwd)  # on-TPU 5e-3, like the train gate
+        # scale normalization: same abs error fails at unit output scale
+        assert not DP.dcn_fwd_parity_ok(
+            dict(fwd_max_err=0.008, fwd_scale=1.0))
+        assert DP.dcn_fwd_parity_ok(dict(fwd_max_err=0.008, fwd_scale=2.07))
+        # defect-scale errors still fail everywhere
+        assert not DP.dcn_fwd_parity_ok(dict(fwd_max_err=0.5, fwd_scale=2.0))
 
 
 def test_parity_tolerance_calibration(monkeypatch):

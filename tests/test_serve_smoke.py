@@ -173,6 +173,23 @@ def test_serve_chunk_spans_account_every_window(smoke_run):
                for r in records)
 
 
+def test_serving_dcn_dispatch_is_forward_direction(smoke_run):
+    """The serving path must trace the DCN in the FORWARD dispatch
+    direction (ISSUE 7): the chunk program runs train=False, so its
+    ``auto`` decisions are logged under ``fwd:HxW`` and consult the
+    forward gate — a future gate regression that silently routes serving
+    through the train-direction rule (or vice versa) flips these keys
+    and fails tier-1. On this CPU suite both gates are closed, so every
+    forward decision must be the jnp formulation."""
+    from esr_tpu.ops.dcn import dispatch_log
+
+    _ = smoke_run  # dependency: the serving session has traced its chunk
+    log = dispatch_log()
+    fwd = {k: v for k, v in log.items() if k.startswith("fwd:")}
+    assert fwd, f"serving traced no forward-direction DCN decision: {log}"
+    assert all(v == "jnp" for v in fwd.values()), fwd
+
+
 def test_request_done_events(smoke_run):
     _, _, records, _ = smoke_run
     done = [r for r in records
