@@ -575,6 +575,50 @@ def _slope_time_flops(make_run, arg, k_lo, k_hi, reps=3):
     return slope, fl, times
 
 
+# The scan_compute goodput sub-record schema, pinned by test_bench_registry
+# (ISSUE 8): goodput is derived from the run's OWN attribution spans via
+# the obs reporter (esr_tpu.obs.report), and the telemetry overhead is a
+# recorded check — tracing must cost <2% of the smoke-stage wall.
+SCAN_GOODPUT_KEYS = ("goodput", "obs_overhead_frac", "obs_overhead_ok")
+
+
+def _goodput_probe(run, arg, reps, telemetry_path):
+    """``reps`` instrumented super-steps of a warm ``run`` ->
+    ``(wall_seconds, goodput_or_None)``.
+
+    Drives the SHIPPED attribution machinery (``obs.spans.StepAttribution``
+    around each dispatch + sync scalar readback) into a real sink, then
+    derives goodput through the SHIPPED reporter
+    (``obs.report.build_report``) — the bench measures the production
+    telemetry path end to end, not a local model of it. With
+    ``telemetry_path=None`` the identical loop runs with no sink: the wall
+    difference IS the telemetry overhead."""
+    from esr_tpu.obs import TelemetrySink
+    from esr_tpu.obs.export import read_telemetry
+    from esr_tpu.obs.report import build_report
+    from esr_tpu.obs.spans import StepAttribution
+
+    sink = TelemetrySink(telemetry_path) if telemetry_path else None
+    attr = StepAttribution(sink=sink, batch_size=1, log_step=1)
+    t0 = time.perf_counter()
+    for i in range(reps):
+        attr.begin()
+        with attr.measure("dispatch"):
+            out = run(arg)
+        attr.dispatched()
+        attr.note(i, 1)
+        with attr.resolving(attr.current):
+            _ = [float(x) for x in out]  # sync scalar readback
+        attr.close()
+    wall = time.perf_counter() - t0
+    goodput = None
+    if sink is not None:
+        sink.close()
+        manifest, records, _torn = read_telemetry(telemetry_path)
+        goodput = build_report(records, manifest)["goodput"].get("value")
+    return wall, goodput
+
+
 def stage_scan_compute(ctx):
     """THE defensible steps/s number (r4 timing-contradiction arbiter) —
     runs FIRST among the timing stages so a short heal window still
@@ -623,6 +667,34 @@ def stage_scan_compute(ctx):
                         "sequences_per_sec": round(sps * ctx.b, 2),
                         "mfu": res["mfu"],
                         "ms_per_step": res["ms_per_step"]}
+
+    # ISSUE 8: the goodput headline — attribution spans from THIS run's
+    # step machinery, rolled up by the shipped obs reporter — plus the
+    # telemetry-overhead check. The probe rides the CHEAP k_lo program
+    # (goodput measures the attribution mechanics around a fused dispatch,
+    # not throughput — the headline already owns that) so the extra
+    # compile and the 4 probe loops stay a small fraction of the stage
+    # budget; min-merge one confirmation lap because contention only ever
+    # ADDS time.
+    run = make_run(k_lo)
+    _ = [float(x) for x in run(state)]  # warm outside both probes
+    reps = 3
+    with tempfile.TemporaryDirectory() as tmp:
+        wall_traced, goodput = _goodput_probe(
+            run, state, reps, os.path.join(tmp, "t1.jsonl"))
+        wall_plain, _n = _goodput_probe(run, state, reps, None)
+        wt2, g2 = _goodput_probe(
+            run, state, reps, os.path.join(tmp, "t2.jsonl"))
+        if wt2 < wall_traced:
+            wall_traced, goodput = wt2, g2
+        wall_plain = min(wall_plain, _goodput_probe(run, state, reps,
+                                                    None)[0])
+    frac = max(wall_traced - wall_plain, 0.0) / wall_plain
+    res.update(zip(SCAN_GOODPUT_KEYS, (
+        goodput, round(frac, 4), bool(frac < 0.02),
+    )))
+    EXTRA["goodput"] = goodput
+    EXTRA["obs_overhead_frac"] = res["obs_overhead_frac"]
     return res
 
 

@@ -38,6 +38,12 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   the ``backend_up`` hang this repo's bench guards against. Bound every
   wait and handle ``queue.Empty``/``queue.Full`` (the
   ``DevicePrefetcher`` producer's 0.2s-timeout put is the house pattern).
+- ESR010 span-context-leak — a manual ``trace.begin()``
+  (``esr_tpu.obs.trace``) whose handle is discarded, or whose matching
+  ``end()`` is not guaranteed on exception paths (not in a ``finally``):
+  ``begin`` re-points the AMBIENT trace context, so a skipped ``end``
+  mis-parents every later record under a dead span. Prefer ``with
+  trace.span(...)``; a manual begin must ``end()`` in a ``finally``.
 
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
@@ -623,6 +629,7 @@ class UnboundedQueueWait(Rule):
 
 
 _OBS_MODULE = "esr_tpu.obs"
+_TRACE_BEGIN = "esr_tpu.obs.trace.begin"
 
 
 def _obs_aliases(tree: ast.AST) -> dict:
@@ -649,6 +656,94 @@ def _obs_aliases(tree: ast.AST) -> dict:
                 if full == _OBS_MODULE or full.startswith(_OBS_MODULE + "."):
                     out[a.asname or a.name] = full
     return out
+
+
+@register_rule
+class SpanContextLeak(Rule):
+    name = "ESR010"
+    slug = "span-context-leak"
+    severity = "warning"
+    hint = (
+        "a manual trace.begin() re-points the AMBIENT trace context at the "
+        "new span; if end() is skipped on an exception path, every record "
+        "the process emits afterwards mis-parents under a dead span. Use "
+        "`with trace.span(...)` (closes on every exit path), put the "
+        "matching `handle.end()` in a `finally:` (the Trainer's train_run "
+        "pattern), or justify with `# esr: noqa(ESR010)`"
+    )
+
+    def _in_finally(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside the ``finally:`` suite of some
+        ``try``? (Walk up remembering the child: when the parent is a
+        ``Try``, membership of the child statement in ``finalbody`` is the
+        answer.)"""
+        prev, cur = node, ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Try) and prev in cur.finalbody:
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            prev, cur = cur, ctx.parents.get(cur)
+        return False
+
+    def _resolved(self, aliases: dict, node: ast.Call) -> str:
+        dotted = _dotted(node.func)
+        if not dotted:
+            return ""
+        head, _, rest = dotted.partition(".")
+        if head in aliases:
+            return aliases[head] + (f".{rest}" if rest else "")
+        return dotted
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        aliases = _obs_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._resolved(aliases, node) != _TRACE_BEGIN:
+                continue
+            parent = ctx.parents.get(node)
+            if isinstance(parent, ast.Return):
+                # a factory handing the handle to its caller: the leak
+                # (if any) is at the call site that owns the handle
+                continue
+            target = None
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                target = _dotted(parent.targets[0])
+            elif isinstance(parent, ast.AnnAssign):
+                target = _dotted(parent.target)
+            if not target:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "`trace.begin(...)` whose span handle is discarded — "
+                    "the span (and the ambient context it re-pointed) can "
+                    "never be closed",
+                )
+                continue
+            scope = ctx.enclosing_function(node) or ctx.tree
+            closed = False
+            for sub in ast.walk(scope):
+                if not isinstance(sub, ast.Call):
+                    continue
+                func = sub.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "end"
+                    and _dotted(func.value) == target
+                    and self._in_finally(ctx, sub)
+                ):
+                    closed = True
+                    break
+            if not closed:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"`{target} = trace.begin(...)` without a "
+                    f"`{target}.end()` in a `finally:` — an exception "
+                    "between begin and end leaks the span context",
+                )
 
 
 @register_rule

@@ -634,6 +634,14 @@ class DevicePrefetcher:
         self._reported_close = False
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        # trace context hand-off (obs/trace.py, schema v2): contextvars do
+        # not flow into threads, so capture the constructing context here
+        # and adopt it on the producer — stage spans and stall counters
+        # emitted from that thread link into the run's trace instead of
+        # parking with no causal parent
+        from esr_tpu.obs import trace
+
+        self._trace_ctx = trace.capture()
         self._thread = threading.Thread(
             target=self._produce,
             args=(iter(source), stage_fn),
@@ -643,6 +651,12 @@ class DevicePrefetcher:
         self._thread.start()
 
     def _produce(self, it, stage_fn):
+        from esr_tpu.obs import trace
+
+        with trace.adopt(self._trace_ctx):
+            self._produce_inner(it, stage_fn)
+
+    def _produce_inner(self, it, stage_fn):
         def put(item) -> bool:
             while not self._stop.is_set():
                 try:

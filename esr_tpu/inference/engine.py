@@ -67,7 +67,7 @@ from esr_tpu.losses.restore import (
     psnr_metric,
     ssim_metric,
 )
-from esr_tpu.obs import active_sink
+from esr_tpu.obs import active_sink, trace
 from esr_tpu.ops.resize import interpolate
 
 logger = logging.getLogger(__name__)
@@ -301,7 +301,8 @@ class StreamingEngine:
             idx, meta, sums_dev, stacked_dev, t_dispatch = entry
             sums = {k: np.asarray(v) for k, v in sums_dev.items()}
             stacked = {k: np.asarray(v) for k, v in stacked_dev.items()}
-            seconds = time.perf_counter() - t_dispatch
+            t_res = time.monotonic()
+            seconds = t_res - t_dispatch
             total_valid = int(round(float(sums["count"].sum())))
             for lane, m in enumerate(meta):
                 if m is None or m["windows"] == 0:
@@ -317,34 +318,53 @@ class StreamingEngine:
                         float(v) for v in stacked[k][: m["windows"], lane]
                     )
             if sink is not None:
+                # v2: the chunk span carries identity + clock edges
+                # (dispatch -> readback on the sink's t axis) and names
+                # the recordings bound to each lane, so the exporter can
+                # draw what each lane was serving; the ambient infer_run
+                # context supplies trace_id/parent via the sink
                 sink.span(
                     "infer_chunk", seconds,
+                    span_id=trace.new_id(),
+                    begin=round(sink.rel(t_dispatch), 6),
+                    end=round(sink.rel(t_res), 6),
                     chunk=idx, lanes=self.lanes,
                     chunk_windows=self.chunk_windows,
                     windows=total_valid,
+                    recordings=[
+                        os.path.basename(m["path"]) if m else None
+                        for m in meta
+                    ],
                     windows_per_sec=round(total_valid / seconds, 3)
                     if seconds > 0 else None,
                 )
 
         pending: deque = deque()
-        with DevicePrefetcher(
-            chunks, self._stage, depth=self.prefetch_depth
-        ) as pf:
-            for idx, (host_chunk, staged) in enumerate(pf):
-                t0 = time.perf_counter()
-                states, sums, stacked = self._run_chunk(
-                    self.params, states,
-                    staged["reset_keep"], staged["windows"],
-                )
-                pending.append(
-                    (idx, host_chunk["meta"], sums, stacked, t0)
-                )
-                # resolve one chunk BEHIND dispatch so the readback of
-                # chunk i overlaps the device running chunk i+1
-                if len(pending) > 1:
-                    _resolve(pending.popleft())
-        while pending:
-            _resolve(pending.popleft())
+        # one trace per engine pass (schema v2): chunk spans, prefetcher
+        # health, and compile events all auto-link under this root — the
+        # offline twin of the serving tier's per-request traces
+        with trace.span(
+            "infer_run", recordings=len(data_list), lanes=self.lanes,
+            chunk_windows=self.chunk_windows,
+        ):
+            with DevicePrefetcher(
+                chunks, self._stage, depth=self.prefetch_depth
+            ) as pf:
+                for idx, (host_chunk, staged) in enumerate(pf):
+                    t0 = time.monotonic()
+                    states, sums, stacked = self._run_chunk(
+                        self.params, states,
+                        staged["reset_keep"], staged["windows"],
+                    )
+                    pending.append(
+                        (idx, host_chunk["meta"], sums, stacked, t0)
+                    )
+                    # resolve one chunk BEHIND dispatch so the readback of
+                    # chunk i overlaps the device running chunk i+1
+                    if len(pending) > 1:
+                        _resolve(pending.popleft())
+            while pending:
+                _resolve(pending.popleft())
 
         results, names = [], []
         for path in data_list:

@@ -1,28 +1,47 @@
 """esr_tpu.obs — structured host-side telemetry (docs/OBSERVABILITY.md).
 
-One subsystem, three pieces:
+One subsystem, now two halves:
+
+**Producing** (in-process, hot-path-safe):
 
 - :mod:`esr_tpu.obs.sink` — the structured JSONL event/metric sink
   (monotonic-clock records, counters, gauges, per-run manifest with config
   fingerprint + jax version + device kind + schema version) and the
   process-active sink registry every instrumented component checks;
+- :mod:`esr_tpu.obs.trace` — ambient trace context (schema v2): spans
+  carry ``trace_id``/``span_id``/``parent_id`` + monotonic begin/end,
+  nested records auto-link through a ``contextvars`` context, and worker
+  threads adopt their submitter's context (``capture``/``adopt``);
 - :mod:`esr_tpu.obs.spans` — span-based step-time attribution: the Trainer
   decomposes each super-step's wall-clock into ``data_wait`` /
   ``stage_megabatch`` / ``dispatch`` / ``device_step`` (non-blocking) /
   ``metric_readback`` / ``checkpoint`` + residual, with derived samples/s
-  and goodput;
+  and goodput — emitted as one attribution record plus a ``super_step``
+  span tree;
 - instrumented producers elsewhere: ``checked_jit`` compile/retrace events
   (analysis/retrace_guard.py), the ``DevicePrefetcher`` health channel
-  (data/loader.py), per-sequence inference latency spans
-  (inference/harness.py), and the metric writers (utils/writer.py,
-  utils/trackers.py).
+  (data/loader.py), per-chunk inference/serving spans
+  (inference/engine.py, serving/server.py), and the metric writers
+  (utils/writer.py, utils/trackers.py).
+
+**Consuming** (offline, ``python -m esr_tpu.obs``):
+
+- :mod:`esr_tpu.obs.export` — telemetry.jsonl → Chrome trace-event /
+  Perfetto JSON (one track per host thread, virtual tracks per lane and
+  request class, counter tracks), v1 files convert too;
+- :mod:`esr_tpu.obs.report` — offline rollup (goodput, per-span p50/p99,
+  per-class window-latency distributions, trace completeness) gated
+  against declarative SLO thresholds (``configs/slo.yml``) with CI-ready
+  exit codes.
 
 Design rules: stdlib-only (importable from the NumPy-only data layer and
-accelerator-free CI hosts), and host-side only — no ``obs`` call may appear
-inside jitted/scanned code (enforced by analysis rule ESR007 and the
-self-check in ``tests/test_obs.py``).
+accelerator-free CI hosts; only the SLO loader touches yaml, lazily), and
+host-side only — no ``obs`` call may appear inside jitted/scanned code
+(enforced by analysis rules ESR007/ESR010 and the self-check in
+``tests/test_obs.py``).
 """
 
+from esr_tpu.obs import trace
 from esr_tpu.obs.sink import (
     SCHEMA_VERSION,
     TelemetrySink,
@@ -42,4 +61,5 @@ __all__ = [
     "set_active_sink",
     "StepAttribution",
     "StepSpans",
+    "trace",
 ]

@@ -1,0 +1,83 @@
+"""CLI: ``python -m esr_tpu.obs <export|report> ...``.
+
+- ``export telemetry.jsonl [-o trace.json]`` — Chrome trace-event /
+  Perfetto JSON (open in ``ui.perfetto.dev``; obs/export.py).
+- ``report telemetry.jsonl [--slo configs/slo.yml] [-o report.json]`` —
+  offline rollup (goodput, per-span p50/p99, per-class window latency,
+  trace completeness) printed as JSON; with ``--slo`` the run is gated
+  against declarative thresholds (obs/report.py).
+
+Exit codes: 0 ok / every SLO rule passed, 1 SLO violation, 2 usage or
+unreadable input (a broken gate must fail loudly, never pass silently).
+Full walkthrough: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m esr_tpu.obs",
+        description=(
+            "telemetry.jsonl tooling: Perfetto export + SLO-gated run "
+            "reporter (docs/OBSERVABILITY.md)"
+        ),
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ex = sub.add_parser(
+        "export", help="convert telemetry.jsonl to Perfetto/Chrome JSON"
+    )
+    ex.add_argument("telemetry", help="path to a telemetry.jsonl")
+    ex.add_argument(
+        "-o", "--out", default=None,
+        help="output path (default: <telemetry>.trace.json)",
+    )
+
+    rp = sub.add_parser(
+        "report", help="roll up a run and (optionally) gate it on an SLO"
+    )
+    rp.add_argument("telemetry", help="path to a telemetry.jsonl")
+    rp.add_argument(
+        "--slo", default=None, metavar="YAML",
+        help="SLO thresholds (e.g. configs/slo.yml); exit 1 on violation",
+    )
+    rp.add_argument(
+        "-o", "--out", default=None,
+        help="also write the JSON document to this path",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "export":
+        from esr_tpu.obs.export import export_file
+
+        out = args.out or (args.telemetry + ".trace.json")
+        try:
+            stats = export_file(args.telemetry, out)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(stats))
+        return 0
+
+    from esr_tpu.obs.report import report_file
+
+    try:
+        doc, code = report_file(args.telemetry, args.slo, args.out)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(doc, indent=2))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""telemetry.jsonl → Chrome trace-event / Perfetto JSON.
+
+The sink's JSONL is the durable record; this module turns it into a
+timeline a human can scrub in ``ui.perfetto.dev`` (File → Open, or drag
+the exported ``.json`` next to a ``jax.profiler`` device trace from the
+``trainer.profile`` hook — the two open side by side). Output is the
+Chrome trace-event format (the JSON flavor Perfetto ingests natively):
+
+- **one track per host thread** (process "host"): every span becomes a
+  complete event (``ph: "X"``) on its emitting thread's track. v2 spans
+  place by their ``begin``/``end`` fields; v1 spans (durations only) are
+  placed ending at their record time ``t`` — same convention the sink's
+  readers always assumed.
+- **one virtual track per lane** (process "lanes"): spans/events carrying
+  a ``lane`` field (``serve_admit``, ``serve_chunk_part``,
+  ``serve_preempt``) draw each lane's occupancy timeline.
+- **one virtual track per request class** (process "requests"):
+  ``serve_request`` root spans + terminal events grouped by ``cls`` — the
+  per-class SLO picture.
+- **counter tracks** (``ph: "C"``): counters (running total) and gauges
+  (sampled value) — queue depth, lane occupancy, prefetch stalls,
+  backpressure.
+- point events become instants (``ph: "i"``); trace linkage
+  (``trace_id``/``span_id``/``parent_id``) rides in ``args`` so a slice
+  click shows its family, and ``obs/report.py`` can check connectivity
+  machine-side.
+
+The reader (:func:`read_telemetry`) is the ONE ingestion point shared
+with ``obs/report.py``: schema v1 and v2 files both normalize, and a
+torn final line (a SIGKILLed run — the sink flushes per record, so at
+most one line can be mid-write) is tolerated, not fatal.
+
+stdlib-only, like the whole obs package (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "read_telemetry",
+    "to_chrome_trace",
+    "export_file",
+    "span_index",
+    "trace_of",
+]
+
+# fixed virtual-process ids for the exported track groups
+_PID_HOST = 1
+_PID_LANES = 2
+_PID_REQUESTS = 3
+_PID_COUNTERS = 4
+
+
+def read_telemetry(path: str) -> Tuple[Optional[Dict], List[Dict], int]:
+    """Parse one telemetry.jsonl → ``(manifest, records, torn_lines)``.
+
+    - the manifest is the run's ``type: "manifest"`` header record (None
+      for a file that lost its header — still readable);
+    - **appended multi-run files return the LAST run only**: the sink
+      opens its file in append mode, and every run's ``t``/``begin`` axis
+      restarts at zero — merging two runs would overlay their timelines
+      (inflating the reporter's serving wall and drawing two runs on top
+      of each other in Perfetto). Each subsequent manifest record starts
+      a fresh segment; earlier segments are discarded.
+    - v1 files (``schema_version: 1``, spans without trace fields) come
+      back as-is; consumers treat missing trace fields as "unlinked";
+    - unparseable lines are skipped and counted (``torn_lines``): a
+      SIGKILL mid-write tears at most the final line because every record
+      is flushed as it is written (obs/sink.py).
+    """
+    manifest: Optional[Dict] = None
+    records: List[Dict] = []
+    torn = 0
+    # errors="replace": a SIGKILL can tear the final line mid-multibyte
+    # character; strict decoding would raise UnicodeDecodeError before
+    # json.loads ever ran, breaking the crash-safe contract — replacement
+    # chars make the torn line fail JSON parsing and count as torn
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                torn += 1
+                continue
+            if not isinstance(rec, dict) or "type" not in rec:
+                torn += 1
+                continue
+            if rec["type"] == "manifest":
+                # a new run appended to the same file: last run wins
+                manifest = rec
+                records.clear()
+                torn = 0
+                continue
+            records.append(rec)
+    return manifest, records, torn
+
+
+def span_index(records: Iterable[Dict]) -> Dict[str, Dict]:
+    """``{span_id: span record}`` over every identified span."""
+    out: Dict[str, Dict] = {}
+    for rec in records:
+        if rec.get("type") == "span" and rec.get("span_id"):
+            out[rec["span_id"]] = rec
+    return out
+
+
+def trace_of(records: Iterable[Dict], trace_id: str) -> List[Dict]:
+    """Every record belonging to one trace, in file order."""
+    return [r for r in records if r.get("trace_id") == trace_id]
+
+
+def _span_edges(rec: Dict) -> Tuple[float, float]:
+    """(begin, end) seconds on the sink's ``t`` axis. v2 spans carry the
+    edges; v1 spans end at their record time ``t``."""
+    seconds = float(rec.get("seconds", 0.0) or 0.0)
+    if rec.get("begin") is not None and rec.get("end") is not None:
+        return float(rec["begin"]), float(rec["end"])
+    t = float(rec.get("t", 0.0))
+    return t - seconds, t
+
+
+def _args_of(rec: Dict) -> Dict:
+    skip = {"t", "type", "name", "seconds", "begin", "end", "thread"}
+    return {k: v for k, v in rec.items() if k not in skip}
+
+
+class _Tids:
+    """Stable small integer tids per track label within one process."""
+
+    def __init__(self):
+        self._by_label: Dict[object, int] = {}
+
+    def get(self, label) -> int:
+        if label not in self._by_label:
+            self._by_label[label] = len(self._by_label)
+        return self._by_label[label]
+
+    def items(self):
+        return self._by_label.items()
+
+
+def to_chrome_trace(
+    records: Iterable[Dict], manifest: Optional[Dict] = None
+) -> Dict:
+    """Normalized telemetry records → a Chrome trace-event JSON object
+    (``{"traceEvents": [...], ...}``) loadable in ``ui.perfetto.dev``."""
+    events: List[Dict] = []
+    host_tids = _Tids()
+    lane_tids = _Tids()
+    class_tids = _Tids()
+    host_tids.get("main")  # tid 0 is always the main host track
+
+    def _track(rec: Dict) -> Tuple[int, int]:
+        # serve_admit spans cover submit -> bind (mostly QUEUE wait):
+        # drawing them on the lane track would paint the lane occupied
+        # for the whole wait, overlapping the chunks it actually served
+        # — they belong to the request-class story, like the roots
+        if rec.get("name") == "serve_request" or (
+            rec.get("name") == "serve_admit" and rec.get("cls") is not None
+        ) or (
+            rec.get("type") == "event" and rec.get("request") is not None
+            and rec.get("lane") is None
+        ):
+            return _PID_REQUESTS, class_tids.get(rec.get("cls", "default"))
+        if rec.get("lane") is not None:
+            return _PID_LANES, lane_tids.get(int(rec["lane"]))
+        return _PID_HOST, host_tids.get(rec.get("thread", "main"))
+
+    for rec in records:
+        kind = rec.get("type")
+        if kind == "span":
+            begin, end = _span_edges(rec)
+            pid, tid = _track(rec)
+            events.append({
+                "ph": "X",
+                "name": rec.get("name", "span"),
+                "pid": pid,
+                "tid": tid,
+                "ts": round(begin * 1e6, 3),
+                "dur": round(max(end - begin, 0.0) * 1e6, 3),
+                "cat": "span",
+                "args": _args_of(rec),
+            })
+        elif kind in ("counter", "gauge"):
+            value = rec.get("total") if kind == "counter" else rec.get("value")
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            events.append({
+                "ph": "C",
+                "name": rec.get("name", kind),
+                "pid": _PID_COUNTERS,
+                "tid": 0,
+                "ts": round(float(rec.get("t", 0.0)) * 1e6, 3),
+                "args": {"value": value},
+            })
+        elif kind == "event":
+            pid, tid = _track(rec)
+            events.append({
+                "ph": "i",
+                "s": "t",
+                "name": rec.get("name", "event"),
+                "pid": pid,
+                "tid": tid,
+                "ts": round(float(rec.get("t", 0.0)) * 1e6, 3),
+                "cat": "event",
+                "args": _args_of(rec),
+            })
+        elif kind == "attribution":
+            # the span tree for a super-step is emitted alongside the
+            # attribution record (obs/spans.py); the record itself would
+            # only duplicate those slices
+            continue
+
+    meta: List[Dict] = []
+
+    def _name(pid: int, name: str, sort: int) -> None:
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "args": {"name": name}})
+        meta.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                     "args": {"sort_index": sort}})
+
+    _name(_PID_HOST, "host", 0)
+    _name(_PID_LANES, "lanes", 1)
+    _name(_PID_REQUESTS, "requests", 2)
+    _name(_PID_COUNTERS, "counters", 3)
+    for label, tid in host_tids.items():
+        meta.append({"ph": "M", "name": "thread_name", "pid": _PID_HOST,
+                     "tid": tid, "args": {"name": str(label)}})
+    for label, tid in lane_tids.items():
+        meta.append({"ph": "M", "name": "thread_name", "pid": _PID_LANES,
+                     "tid": tid, "args": {"name": f"lane {label}"}})
+    for label, tid in class_tids.items():
+        meta.append({"ph": "M", "name": "thread_name", "pid": _PID_REQUESTS,
+                     "tid": tid, "args": {"name": f"class {label}"}})
+
+    out = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+    }
+    if manifest is not None:
+        out["metadata"] = {
+            k: manifest.get(k)
+            for k in ("host", "pid", "jax_version", "device_kind",
+                      "platform", "schema_version", "config_fingerprint")
+            if k in manifest
+        }
+    return out
+
+
+def export_file(in_path: str, out_path: str) -> Dict:
+    """Read a telemetry.jsonl and write the Perfetto-loadable JSON;
+    returns ``{"events": n, "torn_lines": n, "out": path}``."""
+    manifest, records, torn = read_telemetry(in_path)
+    doc = to_chrome_trace(records, manifest)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return {
+        "events": len(doc["traceEvents"]),
+        "records": len(records),
+        "torn_lines": torn,
+        "out": out_path,
+    }

@@ -1,0 +1,372 @@
+"""Offline run rollup + SLO gate over a telemetry.jsonl.
+
+``python -m esr_tpu.obs report run/telemetry.jsonl --slo configs/slo.yml``
+turns the JSONL firehose into one machine-checkable verdict: goodput,
+per-span-name p50/p99, backpressure/retrace/stall totals, per-request-
+class window-latency distributions, and trace completeness — evaluated
+against declarative thresholds so bench/CI can gate on regressions
+instead of eyeballing JSONL (the role Perfetto-style tooling and
+VirtualFlow's per-virtual-node accounting play in production stacks).
+
+Report shape (all sections always present; serving/training sections are
+empty-but-typed when the run had no such activity):
+
+- ``goodput`` — the headline. Source "attribution" (wall-weighted mean of
+  the Trainer's per-super-step goodput) when the run trained; source
+  "serving"/"inference" (fused-chunk busy time over the chunk wall, from
+  ``serve_chunk``/``infer_chunk`` spans respectively) when it served or
+  streamed offline; ``value: None`` when none — which the shipped SLO
+  config treats as a violation.
+- ``spans`` — per span name: count, total seconds, p50/p99/max
+  milliseconds (pure-python linear-interpolation percentiles, pinned
+  against numpy in tests/test_obs_report.py).
+- ``counters`` / ``events`` — final running totals and occurrence counts
+  (``serve_backpressure``, ``prefetch_stall``, ``compile`` retraces, …).
+- ``serving`` — requests/completed/errors, windows, per-class
+  window-latency p50/p99 rebuilt from ``serve_chunk_part`` spans (each
+  chunk participation contributes its resolve latency once per window —
+  the same definition ``ServingEngine.report`` uses live).
+- ``traces`` — per ``serve_request_done``: is the terminal event
+  connected to its ``serve_request`` root through parent links? Counted
+  as ``complete``/``incomplete`` (+ ids), the acceptance criterion for a
+  causally-reconstructable request journey.
+
+SLO YAML (``configs/slo.yml``)::
+
+    schema: 1
+    rules:
+      - name: goodput-positive     # any label, shows in the verdict
+        metric: goodput.value      # dotted path into the report
+        min: 1.0e-6                # and/or `max:`
+        allow_missing: true        # optional: absent metric != violation
+
+Exit codes (CLI, obs/__main__.py): 0 every rule passed, 1 violation(s),
+2 unreadable input/SLO file. The report module itself is stdlib-only;
+only SLO loading imports yaml (lazily — a repo dependency already).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from esr_tpu.obs.export import _span_edges, read_telemetry
+
+__all__ = [
+    "percentile",
+    "build_report",
+    "load_slo",
+    "evaluate_slo",
+    "report_file",
+]
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """The ``q``-th percentile (0..100) with linear interpolation between
+    order statistics — numpy.percentile's default method, implemented
+    stdlib-only and pinned against numpy in tests."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    rank = (q / 100.0) * (len(vals) - 1)
+    lo = int(math.floor(rank))
+    hi = int(math.ceil(rank))
+    if lo == hi:
+        return vals[lo]
+    frac = rank - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+def _pctl_ms(lat_s: Sequence[float]) -> Dict[str, Optional[float]]:
+    return {
+        "p50_ms": _round(percentile(lat_s, 50), 1e3),
+        "p99_ms": _round(percentile(lat_s, 99), 1e3),
+        "max_ms": _round(max(lat_s) if lat_s else None, 1e3),
+    }
+
+
+def _round(v: Optional[float], scale: float = 1.0) -> Optional[float]:
+    return None if v is None else round(v * scale, 4)
+
+
+# the terminal event a complete request trace must hang off of
+_REQUEST_TERMINAL = "serve_request_done"
+
+
+def _trace_completeness(records: List[Dict]) -> Dict:
+    """Walk every ``serve_request_done`` event's parent chain: complete
+    iff it reaches a root span (``parent_id: None``) of the same trace
+    through recorded spans."""
+    spans = {
+        r["span_id"]: r
+        for r in records
+        if r.get("type") == "span" and r.get("span_id")
+    }
+    requests = 0
+    complete = 0
+    incomplete_ids: List[str] = []
+    for rec in records:
+        if rec.get("type") != "event" or rec.get("name") != _REQUEST_TERMINAL:
+            continue
+        requests += 1
+        rid = rec.get("request", "?")
+        trace_id = rec.get("trace_id")
+        ok = False
+        if trace_id is not None:
+            seen = set()
+            pid = rec.get("parent_id")
+            while pid is not None and pid not in seen:
+                seen.add(pid)
+                parent = spans.get(pid)
+                if parent is None or parent.get("trace_id") != trace_id:
+                    break
+                if parent.get("parent_id") is None:
+                    ok = True
+                    break
+                pid = parent.get("parent_id")
+        if ok:
+            complete += 1
+        else:
+            incomplete_ids.append(rid)
+    return {
+        "requests": requests,
+        "complete": complete,
+        "incomplete": requests - complete,
+        "incomplete_ids": incomplete_ids,
+    }
+
+
+def build_report(
+    records: List[Dict],
+    manifest: Optional[Dict] = None,
+    torn_lines: int = 0,
+) -> Dict:
+    """One run's telemetry records → the rollup dict (module docstring)."""
+    span_secs: Dict[str, List[float]] = {}
+    counters: Dict[str, float] = {}
+    event_counts: Dict[str, int] = {}
+    attributions: List[Dict] = []
+    class_lat: Dict[str, List[float]] = {}
+    class_windows: Dict[str, int] = {}
+    chunk_edges: List[Tuple[float, float]] = []
+    chunk_busy = 0.0
+    chunk_kinds: set = set()
+    requests_done = 0
+    requests_failed = 0
+    windows_total = 0
+
+    for rec in records:
+        kind = rec.get("type")
+        name = rec.get("name", "")
+        if kind == "span":
+            span_secs.setdefault(name, []).append(
+                float(rec.get("seconds", 0.0) or 0.0)
+            )
+            if name == "serve_chunk_part":
+                cls = rec.get("cls", "default")
+                n = int(rec.get("windows", 0) or 0)
+                class_lat.setdefault(cls, []).extend(
+                    [float(rec.get("seconds", 0.0))] * n
+                )
+                class_windows[cls] = class_windows.get(cls, 0) + n
+            elif name in ("serve_chunk", "infer_chunk"):
+                chunk_edges.append(_span_edges(rec))
+                chunk_busy += float(rec.get("seconds", 0.0) or 0.0)
+                chunk_kinds.add(name)
+        elif kind == "counter":
+            counters[name] = float(rec.get("total", 0.0) or 0.0)
+        elif kind == "event":
+            event_counts[name] = event_counts.get(name, 0) + 1
+            if name == _REQUEST_TERMINAL:
+                requests_done += 1
+                windows_total += int(rec.get("windows", 0) or 0)
+                if not rec.get("completed", False):
+                    requests_failed += 1
+        elif kind == "attribution":
+            attributions.append(rec)
+
+    spans_out = {
+        name: {
+            "count": len(vals),
+            "total_s": round(sum(vals), 6),
+            **_pctl_ms(vals),
+        }
+        for name, vals in sorted(span_secs.items())
+    }
+
+    # -- goodput ------------------------------------------------------------
+    goodput: Dict = {"value": None, "source": None}
+    if attributions:
+        walls = [float(a.get("wall_s", 0.0) or 0.0) for a in attributions]
+        goods = [float(a.get("goodput", 0.0) or 0.0) for a in attributions]
+        total_wall = sum(walls)
+        if total_wall > 0:
+            goodput = {
+                "value": round(
+                    sum(w * g for w, g in zip(walls, goods)) / total_wall, 6
+                ),
+                "source": "attribution",
+                "records": len(attributions),
+                "min": round(min(goods), 6),
+                "max": round(max(goods), 6),
+            }
+    elif chunk_edges:
+        begin = min(e[0] for e in chunk_edges)
+        end = max(e[1] for e in chunk_edges)
+        wall = max(end - begin, 1e-9)
+        goodput = {
+            # resolve-one-behind overlaps dispatches, so busy/wall can
+            # nominally exceed 1 — clamp like the attribution goodput
+            "value": round(min(chunk_busy / wall, 1.0), 6),
+            # name the tier honestly: an offline StreamingEngine run
+            # (infer_chunk spans only) is "inference", not "serving"
+            "source": ("serving" if "serve_chunk" in chunk_kinds
+                       else "inference"),
+            "busy_s": round(chunk_busy, 6),
+            "wall_s": round(wall, 6),
+        }
+
+    serving = {
+        "requests": requests_done,
+        "completed": requests_done - requests_failed,
+        "errors": requests_failed,
+        "windows": windows_total,
+        "preemptions": event_counts.get("serve_preempt", 0),
+        "backpressure": counters.get("serve_backpressure", 0.0),
+        "classes": {
+            cls: {
+                "windows": class_windows.get(cls, 0),
+                "window_latency_p50_ms": _round(
+                    percentile(lat, 50), 1e3
+                ),
+                "window_latency_p99_ms": _round(
+                    percentile(lat, 99), 1e3
+                ),
+            }
+            for cls, lat in sorted(class_lat.items())
+        },
+    }
+
+    return {
+        "schema_version": (manifest or {}).get("schema_version"),
+        "records": len(records),
+        "torn_lines": torn_lines,
+        "goodput": goodput,
+        "spans": spans_out,
+        "counters": {k: counters[k] for k in sorted(counters)},
+        "events": {k: event_counts[k] for k in sorted(event_counts)},
+        "serving": serving,
+        "traces": _trace_completeness(records),
+    }
+
+
+# -- SLO evaluation ---------------------------------------------------------
+
+
+def load_slo(path: str) -> Dict:
+    """Parse an SLO YAML; raises ``ValueError`` on a malformed file (the
+    CLI maps that to exit 2 — a broken gate must not silently pass)."""
+    import yaml  # lazy: the only non-stdlib import in esr_tpu.obs
+
+    with open(path) as f:
+        try:
+            doc = yaml.safe_load(f)
+        except yaml.YAMLError as e:
+            # normalize to the documented contract: a broken gate file is
+            # exit 2 (unreadable), never exit 1 (a "real" SLO violation)
+            raise ValueError(f"SLO file {path!r} is not valid YAML: {e}")
+    if not isinstance(doc, dict) or not isinstance(doc.get("rules"), list):
+        raise ValueError(
+            f"SLO file {path!r} must be a mapping with a `rules:` list "
+            "(docs/OBSERVABILITY.md)"
+        )
+    for rule in doc["rules"]:
+        if not isinstance(rule, dict) or "metric" not in rule:
+            raise ValueError(f"SLO rule without a `metric:`: {rule!r}")
+        if "min" not in rule and "max" not in rule:
+            raise ValueError(
+                f"SLO rule {rule.get('name', rule['metric'])!r} has "
+                "neither `min:` nor `max:`"
+            )
+    return doc
+
+
+def _lookup(report: Dict, dotted: str):
+    cur = report
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def evaluate_slo(report: Dict, slo: Dict) -> Tuple[bool, List[Dict]]:
+    """Apply every rule; returns ``(all_ok, verdicts)`` where each verdict
+    is ``{name, metric, value, min, max, ok, reason}``."""
+    verdicts: List[Dict] = []
+    all_ok = True
+    for rule in slo.get("rules", []):
+        metric = rule["metric"]
+        value = _lookup(report, metric)
+        lo = rule.get("min")
+        hi = rule.get("max")
+        verdict = {
+            "name": rule.get("name", metric),
+            "metric": metric,
+            "value": value,
+            "min": lo,
+            "max": hi,
+        }
+        if value is None or (
+            isinstance(value, float) and not math.isfinite(value)
+        ):
+            if rule.get("allow_missing", False) and value is None:
+                verdict.update(ok=True, reason="missing (allowed)")
+            else:
+                verdict.update(
+                    ok=False,
+                    reason="metric missing or non-finite",
+                )
+        else:
+            try:
+                num = float(value)
+            except (TypeError, ValueError):
+                verdict.update(ok=False, reason="metric not numeric")
+                verdicts.append(verdict)
+                all_ok = False
+                continue
+            if lo is not None and num < float(lo):
+                verdict.update(ok=False, reason=f"{num} < min {lo}")
+            elif hi is not None and num > float(hi):
+                verdict.update(ok=False, reason=f"{num} > max {hi}")
+            else:
+                verdict.update(ok=True, reason="within bounds")
+        all_ok = all_ok and verdict["ok"]
+        verdicts.append(verdict)
+    return all_ok, verdicts
+
+
+def report_file(
+    telemetry_path: str,
+    slo_path: Optional[str] = None,
+    out_path: Optional[str] = None,
+) -> Tuple[Dict, int]:
+    """The CLI body: read, roll up, optionally gate; returns
+    ``(document, exit_code)``. The document always contains the report;
+    with an SLO it adds ``{"slo": {"ok", "verdicts"}}``."""
+    manifest, records, torn = read_telemetry(telemetry_path)
+    report = build_report(records, manifest, torn_lines=torn)
+    doc: Dict = {"report": report}
+    code = 0
+    if slo_path is not None:
+        slo = load_slo(slo_path)
+        ok, verdicts = evaluate_slo(report, slo)
+        doc["slo"] = {"ok": ok, "path": slo_path, "verdicts": verdicts}
+        code = 0 if ok else 1
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    return doc, code

@@ -31,6 +31,7 @@ Contract:
 
 from __future__ import annotations
 
+import atexit
 import hashlib
 import json
 import os
@@ -39,7 +40,17 @@ import threading
 import time
 from typing import Dict, Optional
 
-SCHEMA_VERSION = 1
+# module-level, not per-record: trace.py never imports sink at module
+# scope (its sink lookup is lazy inside SpanHandle.end), so this creates
+# no cycle — and _trace_fields runs on EVERY record write
+from esr_tpu.obs.trace import current as _trace_current
+
+# v2 (docs/OBSERVABILITY.md "Schema v2"): span records MAY carry trace
+# context (trace_id / span_id / parent_id), begin/end timestamps on the
+# sink clock base, and a host thread name; events/counters/gauges MAY
+# carry trace_id/parent_id. v1 files (none of those fields) stay readable
+# — obs/export.read_telemetry normalizes both.
+SCHEMA_VERSION = 2
 
 
 def config_fingerprint(config: Dict) -> str:
@@ -128,6 +139,10 @@ class TelemetrySink:
         self.path = path
         self._clock = clock
         self._t0 = clock()
+        # trace begin/end timestamps arrive as raw time.monotonic values
+        # (obs/trace.py); rel() maps them onto the same zero as `t`. Kept
+        # separate from _t0 so injected test clocks don't skew it.
+        self._mono0 = time.monotonic()
         self._lock = threading.RLock()
         self._counts: Dict[str, float] = {}
         self.dropped = 0
@@ -139,6 +154,13 @@ class TelemetrySink:
         man["schema_version"] = SCHEMA_VERSION
         man["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         self._write("manifest", "run", man)
+        # crash-safe teardown: every record is already flushed on write, so
+        # a SIGKILL leaves at worst one torn final line (tolerated by the
+        # v1/v2 reader); atexit covers the softer exits — an interpreter
+        # shutting down with the sink still open closes the file cleanly
+        # instead of relying on GC order (docs/OBSERVABILITY.md).
+        self._atexit = self.close
+        atexit.register(self._atexit)
 
     # -- record plumbing ---------------------------------------------------
 
@@ -167,11 +189,38 @@ class TelemetrySink:
             except (OSError, ValueError):
                 self.dropped += 1
 
+    # -- v2 trace plumbing -------------------------------------------------
+
+    def rel(self, monotonic_t: float) -> float:
+        """Map a raw ``time.monotonic()`` stamp onto this sink's ``t``
+        axis (seconds since the sink opened) — the clock base for span
+        ``begin``/``end`` fields (obs/trace.py)."""
+        return monotonic_t - self._mono0
+
+    @staticmethod
+    def _trace_fields(fields: Dict) -> Dict:
+        """Attach the ambient trace context (obs/trace.py) when the caller
+        did not link explicitly — this is what makes nested spans, compile
+        events, and stall counters auto-join the enclosing trace without
+        their call sites knowing about tracing."""
+        if "trace_id" in fields:
+            return fields
+        ctx = _trace_current()
+        if ctx is None:
+            return fields
+        out = dict(fields)
+        out["trace_id"] = ctx.trace_id
+        out.setdefault("parent_id", ctx.span_id)
+        return out
+
     # -- record kinds ------------------------------------------------------
 
     def event(self, name: str, **fields) -> None:
-        """A point-in-time occurrence (``compile``, ``prefetch_close``, …)."""
-        self._write("event", name, fields)
+        """A point-in-time occurrence (``compile``, ``prefetch_close``, …).
+        v2: carries the emitting host thread like spans do, so the
+        exporter draws instants on the track they causally belong to."""
+        fields.setdefault("thread", threading.current_thread().name)
+        self._write("event", name, self._trace_fields(fields))
 
     def counter(self, name: str, inc: float = 1, **fields) -> None:
         """A monotonically accumulating count; each record carries this
@@ -179,11 +228,15 @@ class TelemetrySink:
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + inc
             total = self._counts[name]
-        self._write("counter", name, {"inc": inc, "total": total, **fields})
+        self._write(
+            "counter", name,
+            self._trace_fields({"inc": inc, "total": total, **fields}),
+        )
 
     def gauge(self, name: str, value, **fields) -> None:
         """A sampled instantaneous value (queue depth, lookahead fill)."""
-        self._write("gauge", name, {"value": value, **fields})
+        self._write("gauge", name,
+                    self._trace_fields({"value": value, **fields}))
 
     def metric(self, name: str, value: float, step=None, **fields) -> None:
         """A training metric scalar (the MetricWriter/MetricTracker path)."""
@@ -191,9 +244,12 @@ class TelemetrySink:
                                      **fields})
 
     def span(self, name: str, seconds: float, **fields) -> None:
-        """A completed named duration (per-sequence inference latency, …)."""
-        self._write("span", name, {"seconds": round(float(seconds), 6),
-                                   **fields})
+        """A completed named duration. v2: carries the host thread name
+        (one exporter track per thread) and — explicitly from obs/trace.py
+        or implicitly from the ambient context — its trace linkage."""
+        payload = {"seconds": round(float(seconds), 6), **fields}
+        payload.setdefault("thread", threading.current_thread().name)
+        self._write("span", name, self._trace_fields(payload))
 
     def attribution(self, fields: Dict) -> None:
         """A per-super-step wall-clock attribution record (obs/spans.py);
@@ -209,7 +265,17 @@ class TelemetrySink:
     def close(self) -> None:
         with self._lock:
             if self._f is not None and not self._f.closed:
+                try:
+                    self._f.flush()
+                except (OSError, ValueError):
+                    pass
                 self._f.close()
+            cb, self._atexit = getattr(self, "_atexit", None), None
+        if cb is not None:
+            try:
+                atexit.unregister(cb)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
 
     def __enter__(self) -> "TelemetrySink":
         return self

@@ -46,7 +46,9 @@ from __future__ import annotations
 
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
+
+from esr_tpu.obs import trace
 
 
 class StepSpans:
@@ -56,14 +58,25 @@ class StepSpans:
     ``pending`` deque alongside the in-flight metrics, finalized when both
     the loop body closed it (wall-clock end) AND the metrics readback
     resolved it (device span end) — whichever happens last emits.
+
+    v2 (docs/OBSERVABILITY.md "Schema v2"): the bucket carries a trace
+    identity from birth — ``span_id`` is the super-step ROOT span, parented
+    under the ambient context at :meth:`StepAttribution.begin` time (the
+    Trainer's ``train_run`` span), and every :meth:`measure` block records
+    its begin/end edges (``marks``) so emission can produce properly
+    nested child spans, not just duration sums. The dispatch wrapper
+    (``training/multistep.instrument_dispatch``) adopts :attr:`ctx` around
+    the jitted call, which is how ``compile`` events land INSIDE the
+    super-step's trace.
     """
 
     __slots__ = (
         "first", "k", "t0", "t_close", "t_dispatch", "t_resolved",
         "spans", "overlapped", "readback_s", "emitted",
+        "trace_id", "span_id", "parent_id", "marks",
     )
 
-    def __init__(self, t0: float):
+    def __init__(self, t0: float, trace_id: str, parent_id: Optional[str]):
         self.first: Optional[int] = None
         self.k: int = 0
         self.t0 = t0
@@ -74,11 +87,24 @@ class StepSpans:
         self.overlapped: set = set()
         self.readback_s = 0.0
         self.emitted = False
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.span_id = trace.new_id()
+        self.marks: Dict[str, List[Tuple[float, float]]] = {}
+
+    @property
+    def ctx(self) -> trace.TraceContext:
+        """The context child records adopt to join this super-step."""
+        return trace.TraceContext(self.trace_id, self.span_id)
 
     def add(self, name: str, seconds: float, overlapped: bool = False):
         self.spans[name] = self.spans.get(name, 0.0) + float(seconds)
         if overlapped:
             self.overlapped.add(name)
+
+    def mark(self, name: str, t0: float, t1: float):
+        """Record one timed block's clock edges (same clock as ``t0``)."""
+        self.marks.setdefault(name, []).append((t0, t1))
 
 
 class StepAttribution:
@@ -102,17 +128,37 @@ class StepAttribution:
         self._clock = clock
         self.current: Optional[StepSpans] = None
         self.emitted_records = 0
+        # one trace per attribution driver (i.e. per train run) when no
+        # ambient trace encloses the loop; under an ambient span (the
+        # Trainer's `train_run`) buckets join ITS trace instead
+        self._trace_id: Optional[str] = None
 
     # -- super-step lifecycle ---------------------------------------------
 
     def begin(self) -> StepSpans:
-        """Open a fresh bucket at the top of a loop iteration."""
-        self.current = StepSpans(self._clock())
+        """Open a fresh bucket at the top of a loop iteration; the bucket
+        is born with a trace identity — a child of the ambient span when
+        one is open (the Trainer's ``train_run``)."""
+        ambient = trace.current()
+        if ambient is not None:
+            trace_id, parent_id = ambient.trace_id, ambient.span_id
+        else:
+            if self._trace_id is None:
+                self._trace_id = trace.new_id()
+            trace_id, parent_id = self._trace_id, None
+        self.current = StepSpans(self._clock(), trace_id, parent_id)
         return self.current
 
     def discard(self) -> None:
         """Drop an empty bucket (source exhausted before a group arrived)."""
         self.current = None
+
+    def current_ctx(self) -> Optional[trace.TraceContext]:
+        """The open bucket's trace context, or None — THE way work done
+        on a super-step's behalf (the instrumented dispatch, checkpoint
+        snapshot/commit) joins its trace via ``trace.adopt``."""
+        cur = self.current
+        return cur.ctx if cur is not None else None
 
     def note(self, first: int, k: int) -> None:
         """Record which iterations this super-step covers."""
@@ -146,7 +192,9 @@ class StepAttribution:
         try:
             yield
         finally:
-            cur.add(name, self._clock() - t0)
+            t1 = self._clock()
+            cur.add(name, t1 - t0)
+            cur.mark(name, t0, t1)
 
     def add(self, name: str, seconds: float, overlapped: bool = False):
         if self.current is not None:
@@ -171,6 +219,7 @@ class StepAttribution:
         finally:
             now = self._clock()
             bucket.readback_s += now - t0
+            bucket.mark("metric_readback", t0, now)
             bucket.t_resolved = now
             self._maybe_emit(bucket)
 
@@ -212,6 +261,11 @@ class StepAttribution:
             "residual_s": round(wall - accounted, 6),
             "samples_per_sec": round(k * self.batch_size / wall, 3),
             "goodput": round(min(max(device / wall, 1e-9), 1.0), 6),
+            # v2 trace linkage, trailing so the v1 column order is a
+            # strict prefix: span_id IS the super_step root span below
+            "trace_id": bucket.trace_id,
+            "span_id": bucket.span_id,
+            "parent_id": bucket.parent_id,
         }
 
     def _due(self, bucket: StepSpans) -> bool:
@@ -232,9 +286,88 @@ class StepAttribution:
         if bucket.t_close is None or bucket.t_resolved is None:
             return
         bucket.emitted = True
-        if not self._due(bucket):
+        due = self._due(bucket)
+        if not due:
+            # root-only emission: components that ADOPTED this bucket's
+            # context (compile events inside the dispatch, checkpoint
+            # snapshot/commit) reference its span_id as parent — the root
+            # span must exist in the file for EVERY super-step or those
+            # links dangle; the attribution record and the child span
+            # tree stay behind the train_log_step cadence.
+            if self.sink is not None:
+                self._emit_root(bucket, self.record(bucket))
             return
         rec = self.record(bucket)
         self.emitted_records += 1
         if self.sink is not None:
             self.sink.attribution(rec)
+            self._emit_trace_spans(bucket, rec)
+
+    def _edge_conv(self):
+        """Clock edges translate onto the sink's ``t`` axis only when
+        this driver runs on the real monotonic clock (the production
+        configuration); under an injected test clock spans carry
+        durations only — same contract as v1 spans."""
+        return self.sink.rel if self._clock is time.monotonic else None
+
+    def _emit_root(self, bucket: StepSpans, rec: Dict) -> None:
+        conv = self._edge_conv()
+        end = bucket.t_close if bucket.t_close is not None else bucket.t0
+        edges = ({} if conv is None else
+                 {"begin": round(conv(bucket.t0), 6),
+                  "end": round(conv(end), 6)})
+        self.sink.span(
+            "super_step", max(end - bucket.t0, 0.0),
+            trace_id=bucket.trace_id, span_id=bucket.span_id,
+            parent_id=bucket.parent_id,
+            first_iteration=bucket.first, k=bucket.k or 1,
+            goodput=rec["goodput"],
+            **edges,
+        )
+
+    def _emit_trace_spans(self, bucket: StepSpans, rec: Dict) -> None:
+        """The bucket as a span tree: one ``super_step`` root plus one
+        child per named attribution block (docs/OBSERVABILITY.md v2).
+
+        Children are emitted at the same ``train_log_step`` cadence as
+        the attribution record, so trace volume scales with the logging
+        budget, not the step count (the root alone is emitted for every
+        super-step — see :meth:`_maybe_emit`).
+        """
+        sink = self.sink
+        conv = self._edge_conv()
+
+        def _edges(t0, t1):
+            if conv is None or t0 is None or t1 is None:
+                return {}
+            return {"begin": round(conv(t0), 6), "end": round(conv(t1), 6)}
+
+        self._emit_root(bucket, rec)
+        for name, edges in bucket.marks.items():
+            over = {"overlapped": True} if name in bucket.overlapped else {}
+            for t0, t1 in edges:
+                sink.span(
+                    name, t1 - t0,
+                    trace_id=bucket.trace_id, span_id=trace.new_id(),
+                    parent_id=bucket.span_id,
+                    **over, **_edges(t0, t1),
+                )
+        # buckets recorded via add() only (the prefetcher's producer-thread
+        # staging parks a duration, no edges) still surface as children
+        for name in bucket.spans:
+            if name in bucket.marks:
+                continue
+            over = {"overlapped": True} if name in bucket.overlapped else {}
+            sink.span(
+                name, bucket.spans[name],
+                trace_id=bucket.trace_id, span_id=trace.new_id(),
+                parent_id=bucket.span_id, **over,
+            )
+        if bucket.t_dispatch is not None and bucket.t_resolved is not None:
+            sink.span(
+                "device_step",
+                max(bucket.t_resolved - bucket.t_dispatch, 0.0),
+                trace_id=bucket.trace_id, span_id=trace.new_id(),
+                parent_id=bucket.span_id,
+                **_edges(bucket.t_dispatch, bucket.t_resolved),
+            )

@@ -101,6 +101,16 @@ class StreamRequest:
     cls: RequestClass
     submitted_t: float = 0.0
 
+    # trace identity (schema v2, docs/OBSERVABILITY.md): one trace per
+    # request, rooted at the `serve_request` span the server emits at
+    # completion; every admit/chunk-participation/preempt record parents
+    # under root_span_id so the whole journey is one connected trace.
+    # submitted_mono is the raw time.monotonic() at submit — the root
+    # span's begin edge on the sink's clock base.
+    trace_id: Optional[str] = None
+    root_span_id: Optional[str] = None
+    submitted_mono: Optional[float] = None
+
     # runtime (server-owned)
     source: object = None          # window iterator, built at first bind
     peek: object = None            # one-window lookahead (lane-free probe)

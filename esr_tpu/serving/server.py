@@ -30,7 +30,13 @@ Telemetry (docs/OBSERVABILITY.md): a ``serve_admit`` span per binding
 (occupancy, valid windows, fused depth, queue depth, windows/s),
 ``serve_queue_depth`` / ``serve_lane_occupancy`` gauges per round, a
 ``serve_backpressure`` counter per rejected submit, ``serve_preempt`` /
-``serve_request_done`` events.
+``serve_request_done`` events. Schema v2 makes each request ONE connected
+trace: ``submit`` allocates ``trace_id`` + the ``serve_request`` root
+span id, every admit / per-chunk participation (``serve_chunk_part``,
+whose ``seconds`` is that chunk's build→resolve latency) / preempt record
+parents under it, and the root span itself is emitted at completion
+(submit → done) — ``python -m esr_tpu.obs report`` checks the
+connectivity and rebuilds per-class window-latency p50/p99 offline.
 
 Deliberate differences from the offline engine (docs/SERVING.md): no
 ``DevicePrefetcher`` between host chunk building and dispatch — the next
@@ -59,7 +65,7 @@ from esr_tpu.inference.engine import (
     inject_lane_state,
     make_chunk_fn,
 )
-from esr_tpu.obs import active_sink
+from esr_tpu.obs import active_sink, trace
 from esr_tpu.serving.scheduler import (
     DEFAULT_CLASSES,
     AdmissionFull,
@@ -284,6 +290,13 @@ class ServingEngine:
         if rid in self._requests:
             raise ValueError(f"duplicate request_id {rid!r}")
         req = StreamRequest(rid, path, cls, submitted_t=self._now())
+        # one trace per request (schema v2): root_span_id is the
+        # `serve_request` span emitted at completion; every record of this
+        # request's journey (admit, chunk participation, preempt, done)
+        # parents under it so the journey reads as ONE connected trace
+        req.trace_id = trace.new_id()
+        req.root_span_id = trace.new_id()
+        req.submitted_mono = time.monotonic()
         try:
             self.scheduler.submit(req)
         except AdmissionFull:
@@ -333,8 +346,19 @@ class ServingEngine:
                 )
                 req.saved_state = None
             if sink is not None:
+                # seconds AND begin/end from the same monotonic stamps —
+                # one clock axis per span (the t_build comment's rule)
+                mono = time.monotonic()
+                admit_s = (mono - req.submitted_mono
+                           if req.submitted_mono is not None
+                           else now - req.submitted_t)
                 sink.span(
-                    "serve_admit", now - req.submitted_t,
+                    "serve_admit", admit_s,
+                    trace_id=req.trace_id, span_id=trace.new_id(),
+                    parent_id=req.root_span_id,
+                    begin=(round(sink.rel(req.submitted_mono), 6)
+                           if req.submitted_mono is not None else None),
+                    end=round(sink.rel(mono), 6),
                     request=req.request_id, cls=req.cls.name, lane=lane,
                     action=action,
                     queue_depth=self.scheduler.queue_depth(),
@@ -349,8 +373,29 @@ class ServingEngine:
         if req.completed_t is None:
             req.completed_t = self._now()
         if sink is not None:
+            mono = time.monotonic()
+            # the trace ROOT: one `serve_request` span covering submit ->
+            # completion; admit/chunk/preempt records already parent under
+            # root_span_id, and the terminal event below parents here too,
+            # closing the connected admit -> chunks -> done trace the
+            # reporter's completeness check walks (obs/report.py)
+            sink.span(
+                "serve_request",
+                (mono - req.submitted_mono
+                 if req.submitted_mono is not None else 0.0),
+                trace_id=req.trace_id, span_id=req.root_span_id,
+                parent_id=None,
+                begin=(round(sink.rel(req.submitted_mono), 6)
+                       if req.submitted_mono is not None else None),
+                end=round(sink.rel(mono), 6),
+                request=req.request_id, cls=req.cls.name,
+                windows=req.windows_done,
+                preemptions=req.preemptions,
+                completed=req.error is None,
+            )
             sink.event(
                 "serve_request_done", request=req.request_id,
+                trace_id=req.trace_id, parent_id=req.root_span_id,
                 cls=req.cls.name, windows=req.windows_done,
                 preemptions=req.preemptions,
                 completed=req.error is None, error=req.error,
@@ -413,7 +458,11 @@ class ServingEngine:
 
         w = sched.chunk_windows(default=self.default_chunk_windows)
         program = self._program(w)
-        t_build = time.perf_counter()
+        # one clock for everything chunk-scoped (latency math AND the v2
+        # span edges): time.monotonic, same as the offline engine — dual
+        # perf_counter/monotonic stamps for one instant would put span
+        # `seconds` and `begin`/`end` on subtly different axes
+        t_build = time.monotonic()
 
         # -- build the host chunk (the LanePackedChunks contract, over the
         # scheduler's live lane map)
@@ -461,7 +510,7 @@ class ServingEngine:
             "inp_mid": jnp.asarray(arrays[2]),
             "valid": jnp.asarray(valid),
         }
-        t_dispatch = time.perf_counter()
+        t_dispatch = time.monotonic()
         self._states, sums, _stacked = program(
             self.params, self._states, jnp.asarray(reset_keep), windows
         )
@@ -503,6 +552,7 @@ class ServingEngine:
             if sink is not None:
                 sink.event(
                     "serve_preempt", request=req.request_id,
+                    trace_id=req.trace_id, parent_id=req.root_span_id,
                     cls=req.cls.name, lane=lane,
                     windows_done=req.windows_done,
                     queue_depth=sched.queue_depth(),
@@ -515,11 +565,12 @@ class ServingEngine:
         """Block on one chunk's device sums and fold them into per-request
         accumulators + window-latency series."""
         sums = {k: np.asarray(v) for k, v in entry["sums"].items()}
-        t_res = time.perf_counter()
+        t_res = time.monotonic()
         now = self._now()
         self._last_resolve_t = now
         total_valid = int(round(float(sums["count"].sum())))
         latency = t_res - entry["t_build"]
+        sink = active_sink()
         for lane, m in enumerate(entry["meta"]):
             if m is None:
                 continue
@@ -531,18 +582,42 @@ class ServingEngine:
             req.windows_done += m["windows"]
             req.window_latencies.extend([latency] * m["windows"])
             req.inflight -= 1
+            if sink is not None:
+                # per-request chunk PARTICIPATION (schema v2): the child
+                # span linking this request's trace into the chunk — its
+                # `seconds` is the build->resolve latency every window of
+                # this participation experienced (the same definition the
+                # live per-request p50/p99 uses), so the offline reporter
+                # rebuilds per-class window-latency distributions from
+                # these spans alone
+                sink.span(
+                    "serve_chunk_part", latency,
+                    trace_id=req.trace_id, span_id=trace.new_id(),
+                    parent_id=req.root_span_id,
+                    begin=round(sink.rel(entry["t_build"]), 6),
+                    end=round(sink.rel(t_res), 6),
+                    request=req.request_id, cls=req.cls.name,
+                    chunk=entry["chunk"], lane=lane,
+                    windows=m["windows"],
+                )
             if req.ended and req.inflight == 0:
                 self._finish(req)
         self._windows_total += total_valid
-        sink = active_sink()
         seconds = t_res - entry["t_dispatch"]
         if sink is not None:
             sink.span(
                 "serve_chunk", seconds,
+                span_id=trace.new_id(),
+                begin=round(sink.rel(entry["t_dispatch"]), 6),
+                end=round(sink.rel(t_res), 6),
                 chunk=entry["chunk"], lanes=self.lanes,
                 occupancy=entry["occupancy"],
                 chunk_windows=entry["w"], windows=total_valid,
                 queue_depth=entry["queue_depth"],
+                requests=[
+                    m["request"].request_id if m else None
+                    for m in entry["meta"]
+                ],
                 windows_per_sec=round(total_valid / seconds, 3)
                 if seconds > 0 else None,
             )

@@ -102,10 +102,17 @@ class AsyncCheckpointer:
         # donates these buffers, so the copy must be complete (numpy owns
         # its memory) by the time save() returns
         host_state = _to_host(state)
+        # capture the submitter's AMBIENT trace context (obs/trace.py) so
+        # the writer thread's checkpoint_commit span stays in the causal
+        # tree — the Trainer adopts the snapshotting super-step's bucket
+        # context around _save, so in production this IS that super-step
+        from esr_tpu.obs import trace
+
+        ctx = trace.capture()
         self._thread = threading.Thread(
             target=self._commit,
             args=(ckpt_dir, host_state, config, int(iteration),
-                  float(monitor_best), bool(save_best)),
+                  float(monitor_best), bool(save_best), ctx),
             name="ckpt-commit",
             # daemonic: a crash elsewhere must not hang the process on a
             # disk write; an interrupted commit leaves a torn (meta-less)
@@ -141,7 +148,15 @@ class AsyncCheckpointer:
     # -- the writer thread -------------------------------------------------
 
     def _commit(self, ckpt_dir, host_state, config, iteration,
-                monitor_best, save_best):
+                monitor_best, save_best, trace_ctx=None):
+        from esr_tpu.obs import trace
+
+        with trace.adopt(trace_ctx):
+            self._commit_inner(ckpt_dir, host_state, config, iteration,
+                               monitor_best, save_best)
+
+    def _commit_inner(self, ckpt_dir, host_state, config, iteration,
+                      monitor_best, save_best):
         t0 = time.monotonic()
         try:
             path = save_checkpoint(

@@ -51,6 +51,8 @@ from typing import Callable
 
 import jax
 
+from esr_tpu.obs import trace
+
 
 def make_multi_step(
     train_step: Callable, k: int, *, reuse_batch: bool = False
@@ -126,9 +128,14 @@ class _InstrumentedStep:
 
     def __call__(self, *args, **kwargs):
         attribution = self._attribution
-        with attribution.measure("dispatch"):
-            out = self._step(*args, **kwargs)
-        attribution.dispatched()
+        # run the dispatch under the super-step's trace context (schema
+        # v2): a (re)trace firing inside this call emits its `compile`
+        # event as a CHILD of the super-step span, so a retrace storm is
+        # attributable to the exact super-step that paid for it
+        with trace.adopt(attribution.current_ctx()):
+            with attribution.measure("dispatch"):
+                out = self._step(*args, **kwargs)
+            attribution.dispatched()
         return out
 
     def __getattr__(self, name):

@@ -905,10 +905,17 @@ class Trainer:
         import contextlib
 
         from esr_tpu.data.loader import DevicePrefetcher, group_batches
+        from esr_tpu.obs import trace
+
+        # checkpoint work (snapshot + its background commit) adopts the
+        # open super-step bucket's context so those spans parent under
+        # the super_step span, not the run root (docs/OBSERVABILITY.md)
+        _bucket_ctx = self._attr.current_ctx
 
         _END = object()  # sentinel: (group, None) is a real inline item
 
         completed = False
+        run_span = None
         try:
             if self.sink is not None:
                 from esr_tpu.obs import set_active_sink
@@ -926,6 +933,18 @@ class Trainer:
                     "compile_cache",
                     enabled=self.compile_cache_dir is not None,
                     dir=self.compile_cache_dir,
+                )
+                # run-level trace root (schema v2): every super-step
+                # bucket opened below becomes a child of this span, so a
+                # whole training run exports as ONE connected trace.
+                # Manual begin() because the span brackets the loop, not
+                # a lexical block; the matching end() sits in the finally
+                # (exactly the contract analysis rule ESR010 enforces).
+                run_span = trace.begin(
+                    "train_run", sink=self.sink,
+                    iterations=self.iterations,
+                    start_iteration=self.start_iteration,
+                    k_steps=self.k_steps,
                 )
             while not stop:
                 self.train_loader.set_epoch(epoch)
@@ -1060,7 +1079,8 @@ class Trainer:
 
                             saved_now = save_due or best
                             if saved_now:
-                                with self._attr.measure("checkpoint"):
+                                with trace.adopt(_bucket_ctx()), \
+                                        self._attr.measure("checkpoint"):
                                     self._save(last, best)
 
                             if final_due:
@@ -1077,7 +1097,8 @@ class Trainer:
                                 # the TRUE last iteration so resume stays
                                 # consistent (docs/PERF.md).
                                 if not saved_now:
-                                    with self._attr.measure("checkpoint"):
+                                    with trace.adopt(_bucket_ctx()), \
+                                            self._attr.measure("checkpoint"):
                                         self._save(last, False)
                                 stop = True
                                 break
@@ -1112,10 +1133,18 @@ class Trainer:
             if self.sink is not None:
                 from esr_tpu.obs import active_sink, set_active_sink
 
+                link = {}
+                if run_span is not None:
+                    # close the run root FIRST so train_end stays the
+                    # stream's terminal record (tail-readers rely on it);
+                    # the explicit link keeps the event inside the trace
+                    link = {"trace_id": run_span.trace_id,
+                            "parent_id": run_span.span_id}
+                    run_span.end(completed=completed)
                 self.sink.event(
                     "train_end", iterations=iter_idx, epochs=epoch,
                     attribution_records=self._attr.emitted_records,
-                    completed=completed,
+                    completed=completed, **link,
                 )
                 if active_sink() is self.sink:
                     set_active_sink(None)

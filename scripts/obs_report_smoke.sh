@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Observability-pipeline smoke: a loadgen-driven serving session on CPU
+# must yield a telemetry.jsonl from which
+#   python -m esr_tpu.obs export   produces a Perfetto-loadable trace
+#                                  where every completed request is ONE
+#                                  connected trace (admit -> chunks ->
+#                                  done, schema v2), and
+#   python -m esr_tpu.obs report   exits 0 against the shipped
+#                                  configs/slo.yml with finite goodput
+#                                  and per-class window-latency p50/p99.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_obs_report_smoke.py)
+# as a standalone gate; schema + CLI walkthrough: docs/OBSERVABILITY.md.
+#
+# Usage: scripts/obs_report_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_report_smoke.py -q "$@"

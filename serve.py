@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 
 
 def get_flags():
@@ -199,6 +200,13 @@ def main():
               "w") as f:
         json.dump(summary, f, indent=2)
     print(json.dumps(summary))
+    tel = os.path.join(flags.output_path, "telemetry.jsonl")
+    print(
+        f"# traces + SLO verdict (docs/OBSERVABILITY.md):\n"
+        f"#   python -m esr_tpu.obs export {tel}\n"
+        f"#   python -m esr_tpu.obs report {tel} --slo configs/slo.yml",
+        file=sys.stderr,
+    )
 
 
 if __name__ == "__main__":

@@ -549,6 +549,87 @@ def test_esr009_plain_name_queue_from_ctor():
 
 
 # ---------------------------------------------------------------------------
+# ESR010 span context leak
+
+
+def test_esr010_flags_begin_without_finally_end():
+    src = (
+        "from esr_tpu.obs import trace\n"
+        "def serve_loop(items):\n"
+        "    h = trace.begin('serve_request')\n"
+        "    for item in items:\n"
+        "        item.process()\n"
+        "    h.end()\n"  # skipped if process() raises: context leaks
+    )
+    findings = [f for f in analyze_source(src, "m.py")
+                if f.rule == "ESR010"]
+    assert [f.line for f in findings] == [3]
+
+
+def test_esr010_flags_discarded_handle():
+    src = (
+        "from esr_tpu.obs import trace\n"
+        "def f():\n"
+        "    trace.begin('oops')\n"
+    )
+    assert "ESR010" in rules_hit(src)
+
+
+def test_esr010_clean_when_end_in_finally():
+    src = (
+        "from esr_tpu.obs import trace\n"
+        "def serve_loop(items):\n"
+        "    h = trace.begin('serve_request')\n"
+        "    try:\n"
+        "        for item in items:\n"
+        "            item.process()\n"
+        "    finally:\n"
+        "        h.end()\n"
+    )
+    assert "ESR010" not in rules_hit(src)
+
+
+def test_esr010_clean_for_with_form_and_factory_return():
+    src = (
+        "from esr_tpu.obs import trace\n"
+        "def f(items):\n"
+        "    with trace.span('batch'):\n"
+        "        for item in items:\n"
+        "            item.process()\n"
+        "def open_span(name):\n"
+        "    return trace.begin(name)\n"  # caller owns the handle
+    )
+    assert "ESR010" not in rules_hit(src)
+
+
+def test_esr010_import_alias_aware_and_scoped():
+    # resolves `from esr_tpu.obs.trace import begin`; an unrelated
+    # `.begin(` receiver never fires
+    src = (
+        "from esr_tpu.obs.trace import begin\n"
+        "def f():\n"
+        "    h = begin('x')\n"
+        "    h.end()\n"  # not in a finally
+        "def g(db):\n"
+        "    tx = db.begin()\n"  # not obs.trace: out of scope
+        "    tx.commit()\n"
+    )
+    findings = [f for f in analyze_source(src, "m.py")
+                if f.rule == "ESR010"]
+    assert [f.line for f in findings] == [3]
+
+
+def test_esr010_noqa_escape():
+    src = (
+        "from esr_tpu.obs import trace\n"
+        "def f():\n"
+        "    h = trace.begin('x')  # esr: noqa(ESR010)\n"
+        "    h.end()\n"
+    )
+    assert "ESR010" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 
 

@@ -93,6 +93,35 @@ def test_emit_jsonl_stamps_schema_version_and_manifest(tmp_path, capsys):
     assert json.loads(printed) == rec
 
 
+def test_scan_goodput_schema_pinned_and_probe_reports():
+    """ISSUE 8: the scan_compute stage's goodput sub-record — derived from
+    the run's own attribution spans via the obs reporter — and the
+    telemetry-overhead check keep a pinned schema, and the probe itself
+    produces a real goodput from a plain callable (no device needed)."""
+    import time as _time
+
+    assert bench.SCAN_GOODPUT_KEYS == (
+        "goodput", "obs_overhead_frac", "obs_overhead_ok",
+    )
+
+    def run(_arg):
+        _time.sleep(0.002)  # stands in for the fused super-step
+        return (1.0, 2.0)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        import os as _os
+
+        wall, goodput = bench._goodput_probe(
+            run, None, 3, _os.path.join(tmp, "t.jsonl"))
+        assert wall > 0
+        assert goodput is not None and 0 < goodput <= 1.0
+        # the sink-less twin measures the same loop without telemetry
+        wall_plain, none = bench._goodput_probe(run, None, 3, None)
+        assert none is None and wall_plain > 0
+
+
 def test_infer_throughput_stage_registered_and_schema_pinned():
     """The inference-side perf series: the stage must run in smoke (CPU
     plumbing check — it is tiny and dispatch-bound by design) and its

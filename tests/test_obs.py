@@ -89,7 +89,9 @@ def test_sink_stable_key_order(tmp_path):
     s.event("x", mid=6, alpha=5, zebra=4)  # different kwarg order
     recs = read_records(s)
     assert list(recs[1]) == list(recs[2])
-    assert list(recs[1]) == ["t", "type", "name", "alpha", "mid", "zebra"]
+    # v2: events carry the emitting host thread, sorted with the payload
+    assert list(recs[1]) == ["t", "type", "name", "alpha", "mid",
+                             "thread", "zebra"]
 
 
 def test_sink_counter_totals_accumulate(tmp_path):
@@ -153,11 +155,21 @@ def test_run_manifest_never_initializes_a_backend():
 
 
 class _RecSink:
+    """Duck-typed sink: records attribution rows and (v2) the span tree
+    emitted alongside them (obs/spans.py:_emit_trace_spans)."""
+
     def __init__(self):
         self.records = []
+        self.spans = []
 
     def attribution(self, rec):
         self.records.append(rec)
+
+    def span(self, name, seconds, **fields):
+        self.spans.append({"name": name, "seconds": seconds, **fields})
+
+    def rel(self, monotonic_t):
+        return monotonic_t
 
 
 def _fake_clock():
@@ -213,6 +225,44 @@ def test_span_attribution_accounting_identity():
     assert rec["samples_per_sec"] == pytest.approx(4 * 2 / 0.76, rel=1e-3)
     assert 0.0 < rec["goodput"] <= 1.0
     assert rec["goodput"] == pytest.approx(0.50 / 0.76, rel=1e-3)
+    # schema v2: the same bucket emits a super_step root span plus one
+    # child per named block, all linked into one trace whose root span id
+    # the attribution record carries in its trailing columns
+    assert rec["trace_id"] and rec["span_id"] and rec["parent_id"] is None
+    roots = [s for s in out.spans if s["name"] == "super_step"]
+    assert len(roots) == 1 and roots[0]["span_id"] == rec["span_id"]
+    children = {s["name"]: s for s in out.spans
+                if s.get("parent_id") == rec["span_id"]}
+    assert {"data_wait", "stage_megabatch", "dispatch", "device_step",
+            "metric_readback", "checkpoint"} <= set(children)
+    assert children["device_step"]["seconds"] == pytest.approx(0.50)
+    assert all(s["trace_id"] == rec["trace_id"] for s in out.spans)
+
+
+def test_non_due_super_steps_still_emit_their_root_span():
+    """Components adopt a bucket's ctx regardless of log cadence (compile
+    events, checkpoint commits) — every super-step's root span must land
+    in the file so those parent links never dangle; the attribution
+    record and child spans stay behind the cadence."""
+    clock, advance = _fake_clock()
+    out = _RecSink()
+    attr = StepAttribution(sink=out, log_step=2, clock=clock)
+    for first, due in ((1, False), (2, True)):
+        bucket = attr.begin()
+        with attr.measure("dispatch"):
+            advance(0.01)
+        attr.dispatched()
+        attr.note(first, 1)
+        with attr.resolving(bucket):
+            advance(0.02)
+        attr.close()
+    assert len(out.records) == 1  # only the due bucket's attribution
+    roots = [s for s in out.spans if s["name"] == "super_step"]
+    assert [r["first_iteration"] for r in roots] == [1, 2]
+    # children only for the due bucket
+    children = [s for s in out.spans if s["name"] == "dispatch"]
+    assert len(children) == 1
+    assert children[0]["parent_id"] == roots[1]["span_id"]
 
 
 def test_span_nested_and_overlapping_spans_aggregate():

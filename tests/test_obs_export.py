@@ -1,0 +1,162 @@
+"""obs.export unit contracts: telemetry.jsonl → Chrome trace-event JSON
+(docs/OBSERVABILITY.md "Open it in Perfetto").
+
+- round-trip: a v2 file (trace-context spans, counters, gauges, events)
+  exports to a JSON document Perfetto ingests (trace-event schema: ph/X
+  slices with ts+dur, ph/C counters, ph/i instants, ph/M metadata);
+- track routing: host spans by thread, lane-carrying records onto
+  per-lane virtual tracks, ``serve_request`` roots onto per-class tracks;
+- nesting: child slice windows sit inside their parent's;
+- v1 compatibility: spans without trace fields still convert (placed
+  ending at their record time ``t``), torn final lines are tolerated.
+"""
+
+import json
+
+import pytest
+
+from esr_tpu.obs import TelemetrySink, set_active_sink, trace
+from esr_tpu.obs.export import (
+    export_file,
+    read_telemetry,
+    span_index,
+    to_chrome_trace,
+)
+
+
+def _write_v2(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    s = TelemetrySink(path)
+    prev = set_active_sink(s)
+    try:
+        with trace.span("serve_request", request="req-0", cls="standard",
+                        completed=True) as root:
+            with trace.span("serve_admit", lane=0, request="req-0",
+                            cls="standard"):
+                pass
+            with trace.span("serve_chunk_part", lane=0, request="req-0",
+                            cls="standard", chunk=0, windows=3):
+                pass
+            s.event("serve_request_done", request="req-0", cls="standard",
+                    completed=True, windows=3)
+        s.gauge("serve_queue_depth", 2, round=0)
+        s.counter("serve_backpressure", queue_depth=4)
+        s.span("plain_host_span", 0.25)
+    finally:
+        set_active_sink(prev)
+        s.close()
+    return path, root
+
+
+def test_v2_roundtrip_tracks_and_counts(tmp_path):
+    path, root = _write_v2(tmp_path)
+    manifest, records, torn = read_telemetry(path)
+    assert torn == 0 and manifest["schema_version"] == 2
+    doc = to_chrome_trace(records, manifest)
+    json.loads(json.dumps(doc))  # serializable
+    events = doc["traceEvents"]
+    slices = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert set(slices) == {"serve_request", "serve_admit",
+                           "serve_chunk_part", "plain_host_span"}
+    # chunk participations land on the lanes process; serve_admit rides
+    # the request-class process WITH the root (its span covers the queue
+    # wait — drawn on a lane it would fake occupancy); the plain span on
+    # the host process
+    pids = {e["name"]: e["pid"] for e in events if e["ph"] == "X"}
+    assert pids["serve_admit"] == pids["serve_request"]
+    assert pids["serve_chunk_part"] != pids["serve_request"]
+    assert pids["plain_host_span"] not in (pids["serve_chunk_part"],
+                                           pids["serve_request"])
+    # child slices nest inside the root's window
+    r = slices["serve_request"]
+    for name in ("serve_admit", "serve_chunk_part"):
+        c = slices[name]
+        assert r["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= r["ts"] + r["dur"] + 1
+    # counters + gauges become counter samples; the event an instant
+    assert any(e["ph"] == "C" and e["name"] == "serve_queue_depth"
+               and e["args"]["value"] == 2 for e in events)
+    assert any(e["ph"] == "C" and e["name"] == "serve_backpressure"
+               and e["args"]["value"] == 1 for e in events)
+    inst = next(e for e in events if e["ph"] == "i")
+    assert inst["name"] == "serve_request_done"
+    assert inst["args"]["trace_id"] == root.trace_id
+    # metadata names every virtual process
+    proc_names = {e["args"]["name"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"host", "lanes", "requests", "counters"} <= proc_names
+    # manifest surfaces as metadata
+    assert doc["metadata"]["schema_version"] == 2
+
+
+def test_v1_file_still_converts(tmp_path):
+    """A pre-trace telemetry file (schema 1: spans carry only name +
+    seconds) exports with slices placed ending at their record time."""
+    path = str(tmp_path / "v1.jsonl")
+    lines = [
+        {"t": 0.0, "type": "manifest", "name": "run", "schema_version": 1,
+         "host": "h", "pid": 1},
+        {"t": 1.0, "type": "span", "name": "infer_forward",
+         "seconds": 0.25, "recording": "rec.h5", "window": 3},
+        {"t": 1.5, "type": "counter", "name": "prefetch_stall",
+         "inc": 1, "total": 1, "waited_s": 0.1},
+        {"t": 2.0, "type": "event", "name": "train_end", "iterations": 8},
+        {"t": 2.5, "type": "attribution", "name": "super_step",
+         "wall_s": 0.5, "goodput": 0.9},
+    ]
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+        f.write('{"t": 3.0, "type": "span", "name": "torn')  # torn tail
+    manifest, records, torn = read_telemetry(path)
+    assert manifest["schema_version"] == 1
+    assert torn == 1
+    assert len(records) == 4
+    doc = to_chrome_trace(records, manifest)
+    sl = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+    # placed ending at t: [t - seconds, t] in microseconds
+    assert sl["ts"] == pytest.approx((1.0 - 0.25) * 1e6)
+    assert sl["dur"] == pytest.approx(0.25 * 1e6)
+    # attribution records do not duplicate into slices
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "X") == 1
+
+
+def test_appended_multirun_file_returns_last_run_only(tmp_path):
+    """The sink appends; every run's t/begin axis restarts at zero —
+    merging runs would overlay timelines (inflated reporter wall, double
+    -drawn Perfetto slices). Each manifest starts a fresh segment."""
+    path = str(tmp_path / "telemetry.jsonl")
+    runs = [
+        [{"t": 0.0, "type": "manifest", "name": "run",
+          "schema_version": 2, "pid": 1},
+         {"t": 1.0, "type": "span", "name": "serve_chunk",
+          "seconds": 1.0, "begin": 0.0, "end": 1.0}],
+        [{"t": 0.0, "type": "manifest", "name": "run",
+          "schema_version": 2, "pid": 2},
+         {"t": 0.5, "type": "span", "name": "serve_chunk",
+          "seconds": 0.25, "begin": 0.25, "end": 0.5}],
+    ]
+    with open(path, "w") as f:
+        f.write(json.dumps(runs[0][0]) + "\n")
+        f.write(json.dumps(runs[0][1]) + "\n")
+        f.write('{"torn from run 1\n')  # earlier run's torn line
+        for rec in runs[1]:
+            f.write(json.dumps(rec) + "\n")
+    manifest, records, torn = read_telemetry(path)
+    assert manifest["pid"] == 2  # last run's header
+    assert len(records) == 1 and records[0]["seconds"] == 0.25
+    assert torn == 0  # run 1's torn line is not the returned segment's
+
+
+def test_span_index_and_export_file(tmp_path):
+    path, root = _write_v2(tmp_path)
+    _, records, _ = read_telemetry(path)
+    idx = span_index(records)
+    assert root.span_id in idx
+    assert idx[root.span_id]["name"] == "serve_request"
+    out = str(tmp_path / "trace.json")
+    stats = export_file(path, out)
+    assert stats["torn_lines"] == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert len(doc["traceEvents"]) == stats["events"]

@@ -1,0 +1,250 @@
+"""obs.report unit contracts: percentile math, rollup sections, SLO
+evaluation semantics, and the CLI exit codes (docs/OBSERVABILITY.md).
+
+The percentile implementation is pure python (the obs package is
+stdlib-only); it must agree with ``numpy.percentile``'s default linear
+interpolation to float precision — pinned here over awkward sizes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from esr_tpu.obs.report import (
+    build_report,
+    evaluate_slo,
+    load_slo,
+    percentile,
+    report_file,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# percentile math
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 10, 101])
+@pytest.mark.parametrize("q", [0, 1, 25, 50, 75, 99, 100])
+def test_percentile_matches_numpy(n, q):
+    rng = np.random.RandomState(n * 1000 + q)
+    vals = rng.exponential(5.0, size=n).tolist()
+    assert percentile(vals, q) == pytest.approx(
+        float(np.percentile(vals, q)), rel=1e-12, abs=1e-12
+    )
+
+
+def test_percentile_empty_is_none():
+    assert percentile([], 50) is None
+
+
+# ---------------------------------------------------------------------------
+# rollup
+# ---------------------------------------------------------------------------
+
+
+def _attr(wall, goodput):
+    return {"t": 1.0, "type": "attribution", "name": "super_step",
+            "wall_s": wall, "goodput": goodput}
+
+
+def test_goodput_from_attribution_is_wall_weighted():
+    recs = [_attr(1.0, 0.2), _attr(3.0, 0.6)]
+    rep = build_report(recs)
+    g = rep["goodput"]
+    assert g["source"] == "attribution"
+    assert g["value"] == pytest.approx((1 * 0.2 + 3 * 0.6) / 4.0)
+    assert g["min"] == 0.2 and g["max"] == 0.6
+
+
+def test_goodput_from_serving_busy_over_wall():
+    recs = [
+        {"t": 1.0, "type": "span", "name": "serve_chunk", "seconds": 0.5,
+         "begin": 0.5, "end": 1.0},
+        {"t": 2.0, "type": "span", "name": "serve_chunk", "seconds": 0.5,
+         "begin": 1.5, "end": 2.0},
+    ]
+    rep = build_report(recs)
+    g = rep["goodput"]
+    assert g["source"] == "serving"
+    # busy 1.0s over wall 1.5s (first begin -> last end)
+    assert g["value"] == pytest.approx(1.0 / 1.5)
+
+
+def test_goodput_source_labels_offline_inference_honestly():
+    recs = [
+        {"t": 1.0, "type": "span", "name": "infer_chunk", "seconds": 0.5,
+         "begin": 0.5, "end": 1.0},
+    ]
+    g = build_report(recs)["goodput"]
+    assert g["source"] == "inference"
+    assert g["value"] == pytest.approx(1.0)
+
+
+def test_goodput_absent_when_run_has_neither():
+    rep = build_report([{"t": 0.1, "type": "event", "name": "compile"}])
+    assert rep["goodput"] == {"value": None, "source": None}
+
+
+def test_span_rollup_and_class_latencies():
+    recs = []
+    for i, secs in enumerate([0.010, 0.020, 0.030, 0.040]):
+        recs.append({"t": float(i), "type": "span",
+                     "name": "serve_chunk_part", "seconds": secs,
+                     "cls": "interactive" if i % 2 else "standard",
+                     "windows": 2, "chunk": i, "lane": 0})
+    rep = build_report(recs)
+    sp = rep["spans"]["serve_chunk_part"]
+    assert sp["count"] == 4
+    assert sp["total_s"] == pytest.approx(0.1)
+    assert sp["p50_ms"] == pytest.approx(25.0)
+    cls = rep["serving"]["classes"]
+    # each participation contributes `seconds` once per window
+    assert cls["standard"]["windows"] == 4
+    assert cls["standard"]["window_latency_p50_ms"] == pytest.approx(20.0)
+    assert cls["interactive"]["window_latency_p50_ms"] == pytest.approx(30.0)
+
+
+def test_trace_completeness_walks_parent_chain():
+    root = {"t": 1.0, "type": "span", "name": "serve_request",
+            "seconds": 1.0, "trace_id": "T1", "span_id": "R1",
+            "parent_id": None, "request": "req-0"}
+    done_ok = {"t": 1.1, "type": "event", "name": "serve_request_done",
+               "request": "req-0", "trace_id": "T1", "parent_id": "R1",
+               "completed": True, "windows": 2}
+    done_orphan = {"t": 2.0, "type": "event",
+                   "name": "serve_request_done", "request": "req-1",
+                   "trace_id": "T2", "parent_id": "MISSING",
+                   "completed": True, "windows": 1}
+    done_unlinked = {"t": 3.0, "type": "event",
+                     "name": "serve_request_done", "request": "req-2",
+                     "completed": True, "windows": 1}  # v1-style: no ids
+    rep = build_report([root, done_ok, done_orphan, done_unlinked])
+    tr = rep["traces"]
+    assert tr["requests"] == 3
+    assert tr["complete"] == 1
+    assert tr["incomplete"] == 2
+    assert set(tr["incomplete_ids"]) == {"req-1", "req-2"}
+    assert rep["serving"]["requests"] == 3
+    assert rep["serving"]["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation
+# ---------------------------------------------------------------------------
+
+
+def _slo(*rules):
+    return {"schema": 1, "rules": list(rules)}
+
+
+def test_slo_min_max_and_missing_semantics():
+    rep = {"goodput": {"value": 0.5}, "serving": {"errors": 0}}
+    ok, v = evaluate_slo(rep, _slo(
+        {"name": "g", "metric": "goodput.value", "min": 0.1, "max": 1.0},
+        {"name": "e", "metric": "serving.errors", "max": 0},
+    ))
+    assert ok and all(x["ok"] for x in v)
+
+    ok, v = evaluate_slo(rep, _slo(
+        {"metric": "goodput.value", "min": 0.6},
+    ))
+    assert not ok and "min" in v[0]["reason"]
+
+    # a missing metric is a violation unless allow_missing
+    ok, _ = evaluate_slo(rep, _slo({"metric": "nope.nothing", "max": 1}))
+    assert not ok
+    ok, v = evaluate_slo(rep, _slo(
+        {"metric": "nope.nothing", "max": 1, "allow_missing": True},
+    ))
+    assert ok and v[0]["reason"] == "missing (allowed)"
+
+
+def test_load_slo_rejects_malformed(tmp_path):
+    p = str(tmp_path / "bad.yml")
+    with open(p, "w") as f:
+        f.write("rules:\n  - name: no-metric-or-bound\n")
+    with pytest.raises(ValueError):
+        load_slo(p)
+    with open(p, "w") as f:
+        f.write("rules:\n  - metric: goodput.value\n")  # no min/max
+    with pytest.raises(ValueError):
+        load_slo(p)
+    # yaml SYNTAX errors normalize to the same ValueError contract, so
+    # the CLI maps a broken gate file to exit 2, never exit 1
+    with open(p, "w") as f:
+        f.write("rules:\n\t- metric: bad tab indent\n")
+    with pytest.raises(ValueError):
+        load_slo(p)
+
+
+def test_shipped_slo_config_parses():
+    slo = load_slo(os.path.join(REPO_ROOT, "configs", "slo.yml"))
+    names = [r.get("name") for r in slo["rules"]]
+    assert "goodput-positive" in names and "traces-complete" in names
+
+
+# ---------------------------------------------------------------------------
+# exit codes (report_file + the CLI)
+# ---------------------------------------------------------------------------
+
+
+def _telemetry_with_goodput(tmp_path, goodput=0.5):
+    path = str(tmp_path / "telemetry.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"t": 0.0, "type": "manifest", "name": "run",
+                            "schema_version": 2}) + "\n")
+        f.write(json.dumps(_attr(1.0, goodput)) + "\n")
+    return path
+
+
+def test_report_file_exit_codes(tmp_path):
+    tel = _telemetry_with_goodput(tmp_path)
+    doc, code = report_file(tel)
+    assert code == 0 and "slo" not in doc
+
+    slo_ok = str(tmp_path / "ok.yml")
+    with open(slo_ok, "w") as f:
+        f.write("rules:\n  - metric: goodput.value\n    min: 0.1\n")
+    doc, code = report_file(tel, slo_ok)
+    assert code == 0 and doc["slo"]["ok"]
+
+    slo_bad = str(tmp_path / "bad.yml")
+    with open(slo_bad, "w") as f:
+        f.write("rules:\n  - metric: goodput.value\n    min: 0.9\n")
+    doc, code = report_file(tel, slo_bad)
+    assert code == 1 and not doc["slo"]["ok"]
+
+
+def test_cli_exit_codes(tmp_path):
+    """0 pass / 1 violation / 2 unreadable — the contract bench/CI gates
+    on (scripts/obs_report_smoke.sh)."""
+    tel = _telemetry_with_goodput(tmp_path)
+    slo_bad = str(tmp_path / "bad.yml")
+    with open(slo_bad, "w") as f:
+        f.write("rules:\n  - metric: goodput.value\n    min: 0.9\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "esr_tpu.obs", *args],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+            timeout=120,
+        )
+
+    assert run("report", tel).returncode == 0
+    assert run("report", tel, "--slo", slo_bad).returncode == 1
+    assert run("report", str(tmp_path / "missing.jsonl")).returncode == 2
+    assert run("export", str(tmp_path / "missing.jsonl")).returncode == 2
+    # a syntactically broken SLO file is a broken GATE (2), not a
+    # violation (1)
+    slo_broken = str(tmp_path / "broken.yml")
+    with open(slo_broken, "w") as f:
+        f.write("rules:\n\t- metric: tab indent\n")
+    assert run("report", tel, "--slo", slo_broken).returncode == 2

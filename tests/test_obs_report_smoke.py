@@ -1,0 +1,233 @@
+"""Observability pipeline smoke (tier-1, also driven by
+``scripts/obs_report_smoke.sh``): serving session → telemetry.jsonl →
+Perfetto export → SLO-gated reporter, END TO END on CPU.
+
+The acceptance contract (ISSUE 8 / docs/OBSERVABILITY.md):
+
+- a loadgen-driven serving run yields a telemetry.jsonl from which
+  ``python -m esr_tpu.obs export`` produces a Perfetto-loadable Chrome
+  trace JSON;
+- every completed request is a SINGLE connected trace: its
+  ``serve_request_done`` event walks parent links to the
+  ``serve_request`` root, with the admit and every chunk participation
+  parented under the same root and nested inside its begin/end window;
+- ``python -m esr_tpu.obs report --slo configs/slo.yml`` exits 0 on the
+  shipped SLO file, with finite goodput and per-class window-latency
+  p50/p99 in its JSON output.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.obs import TelemetrySink, set_active_sink
+from esr_tpu.obs.export import read_telemetry, to_chrome_trace
+from esr_tpu.obs.report import build_report, evaluate_slo, load_slo
+from esr_tpu.serving import (
+    RequestClass,
+    ServingEngine,
+    make_stream_corpus,
+    poisson_schedule,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLO_PATH = os.path.join(REPO_ROOT, "configs", "slo.yml")
+
+LANES = 2
+N_STREAMS = 6
+CLASSES = {
+    "interactive": RequestClass("interactive", chunk_windows=2),
+    "standard": RequestClass("standard", chunk_windows=4),
+}
+
+# down4 grid + basech=4, deliberately DIFFERENT from test_serve_smoke's
+# down8/basech=2: the serving tier shares chunk programs process-wide
+# (server._PROGRAM_CACHE keys on the model dataclass + geometry), so an
+# identical model here would pre-warm that suite's session and flip its
+# load-dependent preemption assertion
+DATASET_CFG = {
+    "scale": 2,
+    "ori_scale": "down4",
+    "time_bins": 1,
+    "mode": "events",
+    "window": 1024,
+    "sliding_window": 512,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def smoke_run(tmp_path_factory):
+    """One loadgen serving session writing telemetry; returns
+    (telemetry_path, manifest, records, summary)."""
+    import jax
+
+    tmp = tmp_path_factory.mktemp("obs_report_smoke")
+    paths = make_stream_corpus(
+        str(tmp / "streams"), n=N_STREAMS, seed=0,
+        events_schedule=(1200, 3600),
+    )
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    x = np.zeros((1, 3, 32, 32, 2), np.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), x, model.init_states(1, 32, 32)
+    )
+    schedule = poisson_schedule(
+        paths, rate_hz=20.0, seed=0,
+        classes=("standard", "interactive"),
+    )
+    tel_path = str(tmp / "telemetry.jsonl")
+    sink = TelemetrySink(tel_path)
+    prev = set_active_sink(sink)
+    try:
+        server = ServingEngine(
+            model, params, DATASET_CFG, lanes=LANES, classes=CLASSES,
+            default_class="standard", max_pending=16, preempt_quantum=2,
+        )
+        summary = server.run(arrivals=schedule, max_wall_s=300)
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    manifest, records, torn = read_telemetry(tel_path)
+    assert torn == 0  # a cleanly-closed sink tears nothing
+    return tel_path, manifest, records, summary
+
+
+def _spans(records, name=None):
+    return [r for r in records if r["type"] == "span"
+            and (name is None or r["name"] == name)]
+
+
+def test_every_request_is_one_connected_trace(smoke_run):
+    _, _, records, summary = smoke_run
+    assert summary["completed"] == N_STREAMS
+    roots = {r["span_id"]: r for r in _spans(records, "serve_request")}
+    assert len(roots) == N_STREAMS
+    by_id = {r["span_id"]: r for r in _spans(records) if r.get("span_id")}
+    done = [r for r in records
+            if r["type"] == "event" and r["name"] == "serve_request_done"]
+    assert len(done) == N_STREAMS
+    for d in done:
+        # the terminal event parents directly on a root span of its trace
+        root = by_id.get(d["parent_id"])
+        assert root is not None and root["name"] == "serve_request"
+        assert root["trace_id"] == d["trace_id"]
+        assert root["parent_id"] is None
+        # the whole journey shares the trace: >=1 admit + >=1 chunk
+        # participation, all parented under the SAME root
+        fam = [r for r in _spans(records)
+               if r.get("trace_id") == d["trace_id"]]
+        names = {r["name"] for r in fam}
+        assert "serve_admit" in names and "serve_chunk_part" in names
+        for r in fam:
+            if r["name"] == "serve_request":
+                continue
+            assert r["parent_id"] == root["span_id"], r
+            # children nest within the root's begin/end window (6-dp
+            # record rounding)
+            assert r["begin"] >= root["begin"] - 1e-5, r
+            assert r["end"] <= root["end"] + 1e-5, r
+
+
+def test_chunk_spans_link_bound_requests(smoke_run):
+    _, _, records, _ = smoke_run
+    chunks = _spans(records, "serve_chunk")
+    assert chunks
+    parts = _spans(records, "serve_chunk_part")
+    by_chunk = {}
+    for p in parts:
+        by_chunk.setdefault(p["chunk"], []).append(p)
+    for c in chunks:
+        bound = [rid for rid in c["requests"] if rid is not None]
+        assert bound, c
+        # one participation span per bound lane, same chunk index
+        assert sorted(p["request"] for p in by_chunk[c["chunk"]]) == \
+            sorted(bound)
+
+
+def test_export_produces_perfetto_loadable_trace(smoke_run, tmp_path):
+    tel_path, manifest, records, _ = smoke_run
+    doc = to_chrome_trace(records, manifest)
+    # JSON-serializable and shaped like the Chrome trace-event format
+    blob = json.dumps(doc)
+    parsed = json.loads(blob)
+    events = parsed["traceEvents"]
+    assert events and all("ph" in e for e in events)
+    slices = [e for e in events if e["ph"] == "X"]
+    assert len(slices) == len(_spans(records))
+    assert all(e["dur"] >= 0 and "ts" in e for e in slices)
+    # one virtual track per lane and per request class, plus counters
+    lane_meta = [e for e in events
+                 if e["ph"] == "M" and e["name"] == "thread_name"
+                 and e["args"]["name"].startswith("lane ")]
+    assert len(lane_meta) == LANES
+    cls_meta = {e["args"]["name"] for e in events
+                if e["ph"] == "M" and e["name"] == "thread_name"
+                and e["args"]["name"].startswith("class ")}
+    assert cls_meta == {"class interactive", "class standard"}
+    assert any(e["ph"] == "C" and e["name"] == "serve_queue_depth"
+               for e in events)
+
+
+def test_report_has_goodput_and_per_class_percentiles(smoke_run):
+    _, manifest, records, summary = smoke_run
+    rep = build_report(records, manifest)
+    g = rep["goodput"]
+    assert g["source"] == "serving"
+    assert g["value"] is not None and 0 < g["value"] <= 1.0
+    assert np.isfinite(g["value"])
+    assert rep["traces"]["requests"] == N_STREAMS
+    assert rep["traces"]["incomplete"] == 0
+    for cls in ("interactive", "standard"):
+        c = rep["serving"]["classes"][cls]
+        assert c["windows"] >= 1
+        assert c["window_latency_p50_ms"] > 0
+        assert c["window_latency_p99_ms"] >= c["window_latency_p50_ms"]
+    assert rep["serving"]["windows"] == summary["windows"]
+    # the shipped SLO file passes on a healthy smoke run
+    ok, verdicts = evaluate_slo(rep, load_slo(SLO_PATH))
+    assert ok, verdicts
+
+
+def test_cli_report_gates_and_export_roundtrips(smoke_run, tmp_path):
+    """The CLI contract end to end: report --slo exits 0 and prints the
+    JSON document; export writes a parseable trace file."""
+    tel_path, _, _, _ = smoke_run
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out_json = str(tmp_path / "report.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "esr_tpu.obs", "report", tel_path,
+         "--slo", SLO_PATH, "-o", out_json],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["slo"]["ok"] is True
+    assert doc["report"]["goodput"]["value"] > 0
+    with open(out_json) as f:
+        assert json.load(f)["report"]["traces"]["incomplete"] == 0
+
+    trace_out = str(tmp_path / "trace.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "esr_tpu.obs", "export", tel_path,
+         "-o", trace_out],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(trace_out) as f:
+        assert json.load(f)["traceEvents"]

@@ -146,8 +146,15 @@ def test_one_attribution_record_per_super_step(telemetry_records):
             "data_wait_s", "stage_megabatch_s", "stage_overlapped",
             "dispatch_s", "device_step_s", "metric_readback_s",
             "checkpoint_s", "validate_s", "residual_s", "samples_per_sec",
-            "goodput"]
+            "goodput",
+            # schema v2: trace linkage trails the v1 columns (a strict
+            # prefix, so v1 consumers keep indexing by position)
+            "trace_id", "span_id", "parent_id"]
     assert all(list(a) == head for a in attrs)
+    # every super-step record is linked into one run trace, parented
+    # under the Trainer's train_run root span
+    assert len({a["trace_id"] for a in attrs}) == 1
+    assert all(a["span_id"] and a["parent_id"] for a in attrs)
 
 
 def test_spans_sum_to_wall_within_5pct(telemetry_records):

@@ -1,0 +1,259 @@
+"""obs.trace unit contracts (schema v2, docs/OBSERVABILITY.md):
+
+- span identity + nesting: children inherit the ambient trace and parent
+  under the enclosing span; siblings get distinct ids;
+- ambient auto-linking: plain ``sink.event``/``counter``/``gauge``/
+  ``span`` calls inside an open span join its trace without their call
+  sites knowing about tracing;
+- cross-thread propagation: a worker thread adopting a captured context
+  parents its records under the submitter's span (the prefetcher /
+  async-checkpoint pattern);
+- the manual begin/end form restores the ambient context on end and is
+  idempotent/never-raising (safe in a crashing loop's finally);
+- crash-safety: a SIGKILLed child leaves a parseable telemetry file (at
+  worst one torn final line, tolerated by the reader) from which the
+  reporter still builds.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from esr_tpu.obs import TelemetrySink, set_active_sink, trace
+from esr_tpu.obs.export import read_telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def sink(tmp_path):
+    s = TelemetrySink(str(tmp_path / "telemetry.jsonl"))
+    prev = set_active_sink(s)
+    yield s
+    set_active_sink(prev)
+    s.close()
+
+
+def _records(s):
+    s.close()
+    return [json.loads(line) for line in open(s.path)]
+
+
+def test_nested_spans_link_and_nest(sink):
+    with trace.span("outer") as outer:
+        with trace.span("inner_a"):
+            time.sleep(0.002)
+        with trace.span("inner_b"):
+            pass
+    recs = [r for r in _records(sink) if r["type"] == "span"]
+    by_name = {r["name"]: r for r in recs}
+    out, a, b = by_name["outer"], by_name["inner_a"], by_name["inner_b"]
+    # one trace, children parent under outer, sibling ids distinct
+    assert out["parent_id"] is None
+    assert a["trace_id"] == b["trace_id"] == out["trace_id"]
+    assert a["parent_id"] == b["parent_id"] == out["span_id"]
+    assert a["span_id"] != b["span_id"] != out["span_id"]
+    # children nest within the parent's begin/end window, and the v2
+    # edges agree with the v1 duration field
+    for r in (a, b):
+        assert out["begin"] <= r["begin"] <= r["end"] <= out["end"]
+        assert r["end"] - r["begin"] == pytest.approx(r["seconds"],
+                                                      abs=2e-6)
+    assert out["thread"] == threading.current_thread().name
+
+
+def test_ambient_context_auto_links_plain_sink_calls(sink):
+    with trace.span("outer") as outer:
+        sink.event("compile", fn="step")
+        sink.counter("prefetch_stall", waited_s=0.1)
+        sink.gauge("queue_depth", 3)
+        sink.span("legacy_span", 0.5)  # v1-style call site, no ids passed
+    recs = _records(sink)
+    for kind in ("event", "counter", "gauge"):
+        rec = next(r for r in recs if r["type"] == kind)
+        assert rec["trace_id"] == outer.trace_id
+        assert rec["parent_id"] == outer.span_id
+    legacy = next(r for r in recs if r["name"] == "legacy_span")
+    assert legacy["trace_id"] == outer.trace_id
+    assert legacy["parent_id"] == outer.span_id
+    assert "span_id" not in legacy  # unidentified: linked, not a parent
+
+
+def test_no_ambient_context_means_no_trace_fields(sink):
+    sink.event("compile", fn="step")
+    sink.span("plain", 0.1)
+    recs = _records(sink)
+    assert all("trace_id" not in r for r in recs[1:])
+
+
+def test_cross_thread_capture_adopt(sink):
+    got = {}
+
+    def worker(ctx):
+        with trace.adopt(ctx):
+            with trace.span("staged") as h:
+                got["trace_id"] = h.trace_id
+                got["parent_id"] = h.parent_id
+
+    with trace.span("outer") as outer:
+        ctx = trace.capture()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    assert got["trace_id"] == outer.trace_id
+    assert got["parent_id"] == outer.span_id
+    staged = next(r for r in _records(sink) if r["name"] == "staged")
+    assert staged["thread"] != threading.current_thread().name
+
+
+def test_manual_begin_end_restores_context_and_is_idempotent(sink):
+    assert trace.current() is None
+    h = trace.begin("manual", tag=1)
+    assert trace.current() == trace.TraceContext(h.trace_id, h.span_id)
+    h.note(tag=2)
+    h.end()
+    assert trace.current() is None
+    h.end()  # idempotent: no second record
+    recs = [r for r in _records(sink) if r["type"] == "span"]
+    assert len(recs) == 1
+    assert recs[0]["tag"] == 2
+
+
+def test_cross_thread_end_leaves_enders_context_alone(sink):
+    """Ending a handle begun on ANOTHER thread must not clobber the
+    ending thread's own ambient context (e.g. an adopt() block it is
+    running under) — the span still emits, the context stays put."""
+    h_box = {}
+
+    def opener():
+        h_box["h"] = trace.begin("foreign")
+
+    t = threading.Thread(target=opener)
+    t.start()
+    t.join()
+    with trace.span("mine") as mine:
+        h_box["h"].end()
+        assert trace.current() == trace.TraceContext(
+            mine.trace_id, mine.span_id
+        )
+        sink.event("after_foreign_end")
+    recs = _records(sink)
+    assert any(r.get("name") == "foreign" for r in recs)
+    ev = next(r for r in recs if r.get("name") == "after_foreign_end")
+    assert ev["trace_id"] == mine.trace_id
+    assert ev["parent_id"] == mine.span_id
+
+
+def test_reserved_payload_fields_never_crash_end(sink):
+    """end() runs in finallys — a payload field colliding with a reserved
+    span key must emit renamed (`<name>_`), never raise TypeError (which
+    would mask the in-flight exception of a crashing block)."""
+    with trace.span("clash", begin=123, seconds="user", tag="ok") as h:
+        h.note(end="also-user")
+    rec = next(r for r in _records(sink) if r.get("name") == "clash")
+    assert rec["tag"] == "ok"
+    assert rec["begin_"] == 123 and rec["end_"] == "also-user"
+    assert rec["seconds_"] == "user"
+    assert isinstance(rec["seconds"], float)  # the real duration survives
+    assert rec["begin"] <= rec["end"]
+
+
+def test_explicit_sink_beats_active(tmp_path):
+    own = TelemetrySink(str(tmp_path / "own.jsonl"))
+    h = trace.begin("routed", sink=own)
+    h.end()
+    own.close()
+    recs = [json.loads(line) for line in open(own.path)]
+    assert any(r.get("name") == "routed" for r in recs)
+
+
+def test_step_attribution_buckets_join_ambient_trace(tmp_path):
+    """StepAttribution buckets become children of an enclosing span (the
+    Trainer's train_run), and emit a super_step root + child spans."""
+    from esr_tpu.obs.spans import StepAttribution
+
+    s = TelemetrySink(str(tmp_path / "t.jsonl"))
+    attr = StepAttribution(sink=s, batch_size=2, log_step=1)
+    with trace.span("train_run", sink=s) as run:
+        b = attr.begin()
+        assert b.trace_id == run.trace_id
+        assert b.parent_id == run.span_id
+        with attr.measure("data_wait"):
+            pass
+        with attr.measure("dispatch"):
+            pass
+        attr.dispatched()
+        attr.note(0, 1)
+        with attr.resolving(attr.current):
+            pass
+        attr.close()
+    s.close()
+    recs = [json.loads(line) for line in open(s.path)]
+    root = next(r for r in recs if r.get("name") == "super_step")
+    assert root["trace_id"] == run.trace_id
+    assert root["parent_id"] == run.span_id
+    children = [r for r in recs if r.get("parent_id") == root["span_id"]
+                and r["type"] == "span"]
+    names = {r["name"] for r in children}
+    assert {"data_wait", "dispatch", "metric_readback",
+            "device_step"} <= names
+    # the attribution record carries the same linkage (trailing columns)
+    att = next(r for r in recs if r["type"] == "attribution")
+    assert att["trace_id"] == run.trace_id
+    assert att["span_id"] == root["span_id"]
+
+
+_CRASH_CHILD = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {root!r})
+    from esr_tpu.obs import TelemetrySink, set_active_sink, trace
+
+    sink = TelemetrySink({path!r})
+    set_active_sink(sink)
+    i = 0
+    while True:  # runs until SIGKILLed by the parent
+        with trace.span("crash_loop", i=i):
+            pass
+        i += 1
+""")
+
+
+def test_sigkilled_run_leaves_reportable_telemetry(tmp_path):
+    """The crash-safe sink contract: every record is flushed as written,
+    so a SIGKILL mid-run tears at most the final line — the reader
+    tolerates it and the reporter still rolls the run up."""
+    from esr_tpu.obs.report import build_report
+
+    tel = str(tmp_path / "telemetry.jsonl")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         _CRASH_CHILD.format(root=REPO_ROOT, path=tel)],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if os.path.exists(tel) and os.path.getsize(tel) > 4096:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("child produced no telemetry within 60s")
+    finally:
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+
+    manifest, records, torn = read_telemetry(tel)
+    assert manifest is not None and manifest["schema_version"] == 2
+    assert torn <= 1  # at most the single mid-write line
+    spans = [r for r in records if r["type"] == "span"]
+    assert spans, "no complete span survived the kill"
+    rep = build_report(records, manifest, torn_lines=torn)
+    assert rep["spans"]["crash_loop"]["count"] == len(spans)
+    assert rep["torn_lines"] == torn

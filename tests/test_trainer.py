@@ -150,21 +150,27 @@ def test_reference_yaml_schema_parses():
 
 
 def test_shipped_configs_parse_and_build():
-    """Every YAML under configs/ drives the registry builders."""
+    """Every RUN YAML under configs/ drives the registry builders
+    (non-run configs — the SLO gate configs/slo.yml — have no `model`
+    section and are validated by their own consumers)."""
     import glob
 
     from esr_tpu.config.build import build_model
 
     paths = sorted(glob.glob("configs/*.yml"))
-    assert len(paths) >= 3
+    run_paths = []
     for p in paths:
         config = load_config(p)
+        if "model" not in config:
+            continue
+        run_paths.append(p)
         model = build_model(config["model"])
         assert model is not None, p
         build_optimizer(
             config["optimizer"], config.get("lr_scheduler"),
             config["trainer"]["iteration_based_train"]["lr_change_rate"],
         )
+    assert len(run_paths) >= 3
 
 
 @pytest.mark.slow
