@@ -23,15 +23,19 @@ from esr_tpu.analysis.core import (
     all_rules,
     analyze_paths,
     analyze_source,
+    baseline_rules_version,
+    check_baseline_version,
     load_baseline,
     new_findings,
     register_rule,
+    rules_signature,
     write_baseline,
 )
-# The runtime guard needs jax; the lint CLI must not (it runs on bare CI
-# hosts and must start fast). PEP 562 lazy attributes keep `from
-# esr_tpu.analysis import checked_jit` working without making
-# `python -m esr_tpu.analysis` pay the jax import.
+# The runtime guard and the jaxpr auditor need jax; the lint CLI must not
+# (it runs on bare CI hosts and must start fast). PEP 562 lazy attributes
+# keep `from esr_tpu.analysis import checked_jit` (and the audit entry
+# points) working without making `python -m esr_tpu.analysis <paths>` pay
+# the jax import.
 _GUARD_EXPORTS = (
     "DEFAULT_MAX_TRACES",
     "RetraceBudgetError",
@@ -39,6 +43,14 @@ _GUARD_EXPORTS = (
     "checked_jit",
     "retrace_stats",
 )
+_JAXPR_EXPORTS = {
+    "audit_callable": "jaxpr_audit",
+    "ProgramAudit": "jaxpr_audit",
+    "JAXPR_RULES": "jaxpr_audit",
+    "ProgramSpec": "programs",
+    "production_programs": "programs",
+    "audit_production_programs": "programs",
+}
 
 
 def __getattr__(name):
@@ -46,6 +58,13 @@ def __getattr__(name):
         from esr_tpu.analysis import retrace_guard
 
         return getattr(retrace_guard, name)
+    if name in _JAXPR_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"esr_tpu.analysis.{_JAXPR_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -56,10 +75,19 @@ __all__ = [
     "all_rules",
     "analyze_paths",
     "analyze_source",
+    "baseline_rules_version",
+    "check_baseline_version",
     "load_baseline",
     "new_findings",
     "register_rule",
+    "rules_signature",
     "write_baseline",
+    "audit_callable",
+    "ProgramAudit",
+    "JAXPR_RULES",
+    "ProgramSpec",
+    "production_programs",
+    "audit_production_programs",
     "DEFAULT_MAX_TRACES",
     "RetraceBudgetError",
     "TraceCounter",

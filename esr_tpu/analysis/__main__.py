@@ -1,8 +1,27 @@
-"""CLI: ``python -m esr_tpu.analysis [options] <paths>`` (= ``esr-analyze``).
+"""CLI: ``python -m esr_tpu.analysis [options] [paths]`` (= ``esr-analyze``).
 
-Exit codes: 0 clean (no findings beyond the baseline), 1 new findings,
-2 usage error. ``--write-baseline`` regenerates the grandfather file from
-the current findings and exits 0 (review the diff before committing it).
+Two gates behind one exit code:
+
+- the **AST lint** over ``paths`` (files/directories), against
+  ``--baseline``;
+- the **jaxpr audit** (``--jaxpr``) over the registered production
+  programs (``esr_tpu.analysis.programs``, or any module named by
+  ``--jaxpr-registry`` that exposes ``PROGRAMS``), against
+  ``--jaxpr-baseline``. This half imports jax and traces programs
+  device-free — still CPU/CI safe, just not import-free.
+
+``--rules`` subsets either gate by catalog: ESR names restrict the AST
+lint, JX names restrict the jaxpr audit; a gate whose subset is empty is
+skipped (with a note), and an unknown name is a usage error.
+
+Exit codes: 0 clean (no findings beyond the baselines), 1 new findings
+(or a baseline generated under a different rule set — regenerate it),
+2 usage error. ``--write-baseline`` regenerates the grandfather file(s)
+for whichever gates are active and exits 0 (review the diff before
+committing). Baselines carry a ``rules_version`` stamp; a rule upgrade
+therefore reports "regenerate the baseline" instead of mass-firing every
+re-fingerprinted finding as new (full-rule-set runs only — a subset run
+legitimately signs differently).
 """
 
 from __future__ import annotations
@@ -10,13 +29,16 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from esr_tpu.analysis.core import (
+    Finding,
     all_rules,
     analyze_paths,
+    check_baseline_version,
     load_baseline,
     new_findings,
+    rules_signature,
     write_baseline,
 )
 
@@ -26,7 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m esr_tpu.analysis",
         description="JAX-hazard static analysis (rule catalog: docs/ANALYSIS.md)",
     )
-    p.add_argument("paths", nargs="+", help="files and/or directories to lint")
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files and/or directories to lint (optional with --jaxpr)",
+    )
     p.add_argument(
         "--format",
         choices=("text", "json"),
@@ -42,15 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--write-baseline",
         action="store_true",
-        help="rewrite --baseline (or analysis_baseline.json) from current "
+        help="rewrite the baseline(s) for the active gates from current "
         "findings and exit 0",
     )
     p.add_argument(
         "--rules",
         metavar="LIST",
         default=None,
-        help="comma-separated rule names to run (default: all), e.g. "
-        "ESR002,ESR006",
+        help="comma-separated rule names to run (default: all) — ESR names "
+        "subset the AST lint, JX names the jaxpr audit, e.g. "
+        "ESR002,ESR006 or JX001",
     )
     p.add_argument(
         "--relative-to",
@@ -59,16 +86,91 @@ def build_parser() -> argparse.ArgumentParser:
         help="base directory for finding paths (default: cwd); baselines "
         "must be generated and checked with the same base",
     )
+    p.add_argument(
+        "--jaxpr",
+        action="store_true",
+        help="audit the registered production programs at jaxpr level "
+        "(precision/donation/memory contracts — JX rule catalog in "
+        "docs/ANALYSIS.md)",
+    )
+    p.add_argument(
+        "--jaxpr-baseline",
+        metavar="FILE",
+        default="jaxpr_baseline.json",
+        help="baseline for the jaxpr audit (default: jaxpr_baseline.json)",
+    )
+    p.add_argument(
+        "--jaxpr-registry",
+        metavar="MODULE",
+        default="esr_tpu.analysis.programs",
+        help="module exposing PROGRAMS (a list of ProgramSpec) — the "
+        "production registry by default; point it at a fixture module to "
+        "audit seeded hazards",
+    )
     return p
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+def _ratchet_report(
+    findings: Sequence[Finding],
+    *,
+    baseline_path: Optional[str],
+    signature: str,
+    full_run: bool,
+    args,
+    json_out: dict,
+    json_key: Optional[str],
+    label: str,
+    json_extra: Optional[dict] = None,
+) -> int:
+    """The shared gate tail: optional baseline write, rules_version drift
+    check (full-rule-set runs only), ratchet, and report. With ``--format
+    json`` the payload lands in ``json_out`` (under ``json_key`` when
+    given) so main() prints ONE document covering every active gate."""
+    if args.write_baseline:
+        target = baseline_path or "analysis_baseline.json"
+        write_baseline(target, findings, rules_version=signature)
+        print(
+            f"wrote {len(findings)} finding(s) to {target}", file=sys.stderr
+        )
+        return 0
+
+    if baseline_path and full_run:
+        drift = check_baseline_version(baseline_path, signature)
+        if drift:
+            print(drift, file=sys.stderr)
+            return 1
+
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    fresh = new_findings(findings, baseline) if baseline else list(findings)
+    grandfathered = len(findings) - len(fresh)
+
+    if args.format == "json":
+        payload = {
+            "findings": [f.to_json() for f in fresh],
+            "grandfathered": grandfathered,
+        }
+        payload.update(json_extra or {})
+        if json_key:
+            json_out[json_key] = payload
+        else:
+            json_out.update(payload)
+    else:
+        for f in fresh:
+            print(f.format())
+        summary = f"{label}{len(fresh)} new finding(s)"
+        if grandfathered:
+            summary += f" ({grandfathered} grandfathered by baseline)"
+        print(summary, file=sys.stderr)
+
+    return 1 if fresh else 0
+
+
+def _run_ast(args, rule_subset, json_out: dict) -> int:
+    """The AST half; returns an exit code."""
+    import os
 
     # a typo'd path must NOT greenlight as "0 findings" — that would
     # silently disable the gate while CI stays green
-    import os
-
     bad_paths = [
         p
         for p in args.paths
@@ -92,53 +194,131 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     rules = all_rules()
-    if args.rules:
-        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
-        known = {r.name for r in rules}
-        unknown = wanted - known
-        if unknown:
-            print(
-                f"unknown rule(s): {sorted(unknown)}; known: {sorted(known)}",
-                file=sys.stderr,
-            )
-            return 2
-        rules = [r for r in rules if r.name in wanted]
+    if rule_subset is not None:
+        rules = [r for r in rules if r.name in rule_subset]
 
     findings = analyze_paths(
         args.paths, rules=rules, relative_to=args.relative_to
     )
+    return _ratchet_report(
+        findings,
+        baseline_path=args.baseline,
+        signature=rules_signature(rules),
+        full_run=rule_subset is None,
+        args=args,
+        json_out=json_out,
+        json_key=None,  # top level: the original AST json contract
+        label="",
+    )
 
-    if args.write_baseline:
-        target = args.baseline or "analysis_baseline.json"
-        write_baseline(target, findings)
+
+def _run_jaxpr(args, rule_subset, json_out: dict) -> int:
+    """The jaxpr half; returns an exit code."""
+    import importlib
+
+    from esr_tpu.analysis.jaxpr_audit import rules_signature as jx_signature
+    from esr_tpu.analysis.programs import audit_production_programs
+
+    try:
+        mod = importlib.import_module(args.jaxpr_registry)
+        specs = list(getattr(mod, "PROGRAMS"))
+    except (ImportError, AttributeError) as e:
         print(
-            f"wrote {len(findings)} finding(s) to {target}", file=sys.stderr
+            f"--jaxpr-registry {args.jaxpr_registry!r} did not yield a "
+            f"PROGRAMS list: {e}",
+            file=sys.stderr,
         )
-        return 0
-
-    baseline = load_baseline(args.baseline) if args.baseline else {}
-    fresh = new_findings(findings, baseline) if baseline else findings
-    grandfathered = len(findings) - len(fresh)
-
-    if args.format == "json":
+        return 2
+    if not specs:
         print(
-            json.dumps(
-                {
-                    "findings": [f.to_json() for f in fresh],
-                    "grandfathered": grandfathered,
-                },
-                indent=2,
+            f"{args.jaxpr_registry}.PROGRAMS is empty — refusing to report "
+            "a clean audit over nothing",
+            file=sys.stderr,
+        )
+        return 2
+
+    audits = audit_production_programs(
+        specs, rules=sorted(rule_subset) if rule_subset is not None else None
+    )
+    findings = [f for a in audits for f in a.findings]
+
+    code = _ratchet_report(
+        findings,
+        baseline_path=args.jaxpr_baseline,
+        signature=jx_signature(),
+        full_run=rule_subset is None,
+        args=args,
+        json_out=json_out,
+        json_key="jaxpr",
+        label=f"jaxpr audit: {len(audits)} program(s), ",
+        json_extra={
+            "profiles": {a.name: a.profile for a in audits},
+            "rules_version": jx_signature(),
+        },
+    )
+    return code
+
+
+def _partition_rules(args):
+    """``--rules`` names split by catalog: (ast_subset, jx_subset), either
+    None meaning "full set". Unknown names raise SystemExit-style code 2
+    via a (None, None, error) triple."""
+    if not args.rules:
+        return None, None, None
+    from esr_tpu.analysis.jaxpr_audit import JAXPR_RULES
+
+    wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+    known_ast = {r.name for r in all_rules()}
+    known_jx = set(JAXPR_RULES)
+    unknown = wanted - known_ast - known_jx
+    if unknown:
+        return None, None, (
+            f"unknown rule(s): {sorted(unknown)}; known: "
+            f"{sorted(known_ast | known_jx)}"
+        )
+    return wanted & known_ast, wanted & known_jx, None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if not args.paths and not args.jaxpr:
+        print(
+            "nothing to do: give paths to lint and/or --jaxpr to audit "
+            "the production programs",
+            file=sys.stderr,
+        )
+        return 2
+
+    ast_subset, jx_subset, err = _partition_rules(args)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+
+    json_out: dict = {}
+    codes = []
+    if args.paths:
+        if ast_subset is not None and not ast_subset:
+            print(
+                "--rules names no AST (ESR*) rule — skipping the lint gate",
+                file=sys.stderr,
             )
-        )
-    else:
-        for f in fresh:
-            print(f.format())
-        summary = f"{len(fresh)} new finding(s)"
-        if grandfathered:
-            summary += f" ({grandfathered} grandfathered by baseline)"
-        print(summary, file=sys.stderr)
-
-    return 1 if fresh else 0
+        else:
+            codes.append(_run_ast(args, ast_subset, json_out))
+    if args.jaxpr and (not codes or codes[0] != 2):
+        if jx_subset is not None and not jx_subset:
+            print(
+                "--rules names no jaxpr (JX*) rule — skipping the jaxpr "
+                "gate",
+                file=sys.stderr,
+            )
+        else:
+            codes.append(_run_jaxpr(args, jx_subset, json_out))
+    if args.format == "json" and json_out:
+        # one parseable document no matter how many gates ran
+        print(json.dumps(json_out, indent=2))
+    return max(codes) if codes else 2
 
 
 if __name__ == "__main__":

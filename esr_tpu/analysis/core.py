@@ -34,7 +34,7 @@ import json
 import os
 import tokenize
 from io import StringIO
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 SEVERITIES = ("error", "warning")
 
@@ -117,6 +117,14 @@ def all_rules() -> List[Rule]:
     from esr_tpu.analysis import rules as _rules  # noqa: F401
 
     return [_RULES[k] for k in sorted(_RULES)]
+
+
+def rules_signature(rules: Optional[Sequence[Rule]] = None) -> str:
+    """Stable identity of a rule set, stamped into baselines so a rule
+    upgrade reports "regenerate the baseline" instead of mass-firing its
+    new findings as regressions (docs/ANALYSIS.md)."""
+    names = sorted(r.name for r in (rules if rules is not None else all_rules()))
+    return "ast:" + ",".join(names)
 
 
 # ---------------------------------------------------------------------------
@@ -324,7 +332,7 @@ class ModuleContext:
                     sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
                 ):
                     self.traced_defs.add(sub)
-        self._noqa = _noqa_lines(source)
+        self._noqa, self._noqa_broken = _noqa_lines(source)
 
     # -- helpers rules lean on ------------------------------------------
 
@@ -387,10 +395,16 @@ class ModuleContext:
 _NOQA_RULE_RE = None  # compiled lazily (keeps `re` out of the hot import)
 
 
-def _noqa_lines(source: str) -> Dict[int, Set[str]]:
-    """``{line: set(rule_names)}`` for ``# esr: noqa(...)`` comments; an
-    empty set means blanket suppression for that line. Comment scanning
-    uses tokenize so strings containing the marker never suppress.
+def _noqa_lines(source: str) -> "Tuple[Dict[int, Set[str]], Dict[int, str]]":
+    """``({line: set(rule_names)}, {line: comment_text})``: the first map
+    is the recognized ``# esr: noqa(...)`` directives (an empty set means
+    blanket suppression for that line); the second is comments that
+    CONTAIN an ``esr: noqa`` marker the parser does NOT honor (the marker
+    buried mid-comment: ``# blah blah  # esr: noqa(ESR002)`` is one
+    comment token whose text does not START with ``esr:``) — those look
+    like suppressions to a human and do nothing, so the stale-suppression
+    detector (ESR011) must see them. Comment scanning uses tokenize so
+    strings containing the marker never suppress.
 
     Parsing is lenient but fails CLOSED: ``noqa(ESR1)`` / ``noqa ESR1`` /
     ``noqa: ESR1`` all scope to the named rules, and a directive with
@@ -402,6 +416,7 @@ def _noqa_lines(source: str) -> Dict[int, Set[str]]:
     if _NOQA_RULE_RE is None:
         _NOQA_RULE_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
     out: Dict[int, Set[str]] = {}
+    broken: Dict[int, str] = {}
     try:
         tokens = tokenize.generate_tokens(StringIO(source).readline)
         for tok in tokens:
@@ -409,6 +424,8 @@ def _noqa_lines(source: str) -> Dict[int, Set[str]]:
                 continue
             text = tok.string.lstrip("#").strip()
             if not text.startswith("esr:"):
+                if "esr:" in text and "noqa" in text:
+                    broken[tok.start[0]] = text
                 continue
             directive = text[len("esr:") :].strip()
             if not directive.startswith("noqa"):
@@ -422,7 +439,7 @@ def _noqa_lines(source: str) -> Dict[int, Set[str]]:
                 out[tok.start[0]] = names or {"<malformed-noqa>"}
     except tokenize.TokenError:
         pass
-    return out
+    return out, broken
 
 
 # ---------------------------------------------------------------------------
@@ -467,11 +484,63 @@ def analyze_source(
                 code="",
             )
         ]
+    run_rules = list(rules) if rules is not None else all_rules()
     findings: List[Finding] = []
-    for rule in rules if rules is not None else all_rules():
+    used_noqa: Set[int] = set()
+    for rule in run_rules:
         for f in rule.check(ctx):
-            if not ctx.suppressed(f):
+            if ctx.suppressed(f):
+                used_noqa.add(f.line)
+            else:
                 findings.append(f)
+    # stale-suppression detection (ESR011) runs only with the FULL rule
+    # set: under a --rules subset every noqa for an unrun rule would look
+    # stale. A noqa line that suppressed nothing this run is dead weight
+    # that rots the ratchet; a marker the parser does not even honor is
+    # worse — it reads as a suppression and does nothing.
+    if {r.name for r in run_rules} >= set(_RULES):
+        for line, names in sorted(ctx._noqa.items()):
+            if line in used_noqa:
+                continue
+            # explicit `noqa(ESR011)` opts a line out of staleness
+            # reporting; a blanket noqa must NOT self-suppress its own
+            # staleness finding (it suppressed nothing — that is the bug)
+            if "ESR011" in names:
+                continue
+            what = (
+                "blanket `# esr: noqa`" if not names
+                else f"`# esr: noqa({', '.join(sorted(names))})`"
+            )
+            findings.append(Finding(
+                rule="ESR011",
+                path=ctx.path,
+                line=line,
+                col=1,
+                severity="warning",
+                message=f"stale suppression: {what} suppresses no "
+                "finding on this line — delete it (or fix the rule name)",
+                hint=(
+                    "a suppression that no longer suppresses anything "
+                    "rots the ratchet: the hazard it excused is gone (or "
+                    "never fired here) and the comment now only masks "
+                    "future findings from review"
+                ),
+                code=ctx.source_line(line),
+            ))
+        for line, text in sorted(ctx._noqa_broken.items()):
+            findings.append(Finding(
+                rule="ESR011",
+                path=ctx.path,
+                line=line,
+                col=1,
+                severity="warning",
+                message="ineffective noqa: the `esr: noqa` marker is "
+                "buried mid-comment, so the parser never honors it — "
+                "make it its own trailing comment (`... # esr: "
+                "noqa(RULE)`) or delete it",
+                hint="the directive must START the comment text",
+                code=ctx.source_line(line),
+            ))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -525,13 +594,51 @@ def load_baseline(path: str) -> Dict[str, int]:
     return counts
 
 
-def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+def baseline_rules_version(path: str) -> Optional[str]:
+    """The ``rules_version`` stamp a baseline was generated under (None
+    if the file is missing or predates stamping)."""
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return data.get("rules_version")
+
+
+def check_baseline_version(path: str, current: str) -> Optional[str]:
+    """Baseline hygiene gate: when a NON-EMPTY baseline was generated
+    under a different rule set than ``current``, return a one-line
+    "regenerate" message (the caller fails with THAT instead of
+    mass-firing every re-fingerprinted finding as new). An empty baseline
+    grandfathers nothing, so a version drift on it is harmless and
+    returns None."""
+    if not load_baseline(path):
+        return None
+    stamped = baseline_rules_version(path)
+    if stamped is not None and stamped != current:
+        return (
+            f"rule set changed since {path} was generated "
+            f"(baseline: {stamped}; current: {current}) — fingerprints "
+            "are not comparable across rule sets. Regenerate with "
+            "--write-baseline and review the diff (docs/ANALYSIS.md); "
+            "not listing per-finding noise."
+        )
+    return None
+
+
+def write_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    rules_version: Optional[str] = None,
+) -> None:
     data = {
-        "version": 1,
+        "version": 2,
         "comment": (
             "Grandfathered esr_tpu.analysis findings. Regenerate with "
             "`python -m esr_tpu.analysis --write-baseline ...` after "
             "reviewing that every entry is intentional (docs/ANALYSIS.md)."
+        ),
+        "rules_version": (
+            rules_version if rules_version is not None else rules_signature()
         ),
         "findings": [
             {"rule": f.rule, "path": f.path, "code": f.code}

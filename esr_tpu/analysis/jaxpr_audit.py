@@ -1,0 +1,632 @@
+"""jaxpr-level program auditor: precision, donation, and memory contracts.
+
+The AST lint (``core``/``rules``) stops at the source text — it cannot see
+what XLA actually receives. A bf16 matmul that silently accumulates in
+bf16, a donated buffer the lowering never aliased, a broadcast that
+materializes a gigabyte, a dead output the trainer keeps paying for: all
+of these are invisible in Python and *explicit* in the traced program.
+This module walks the jaxpr (and, for donation, the lowered StableHLO) of
+each registered production program (``esr_tpu.analysis.programs``) traced
+DEVICE-FREE — ``jax.make_jaxpr`` / ``.lower()`` on synthetic
+``ShapeDtypeStruct`` args, no compile, no accelerator, CPU tier-1 safe —
+and applies the JX rule family:
+
+- JX001 low-precision-accumulation — a ``dot_general``/
+  ``conv_general_dilated`` with bf16/f16/f8/int8 inputs whose output
+  dtype is equally narrow (no f32/i32 ``preferred_element_type``): the
+  MXU will accumulate in the narrow type and the loss curve silently
+  degrades. This is the gate the bf16/int8 precision-ladder work lands
+  behind (docs/PERF.md).
+- JX002 f64-promotion — any equation producing float64/complex128: on
+  TPU f64 is emulated at ~1/10 throughput, and it almost always means a
+  python float leaked through ``enable_x64``.
+- JX003 cast-churn — ``convert_element_type`` of a value that is itself
+  the result of a ``convert_element_type``, round-tripping back to the
+  original dtype: at best a wasted pass over the array, at worst a
+  silent precision wash through the narrow intermediate.
+- JX004 ineffective-donation — the program declares ``donate_argnums``
+  but the lowering aliases fewer input buffers to outputs than the
+  donated pytree has array leaves (counted via the ``tf.aliasing_output``
+  arg attributes in the lowered module): HBM residency silently doubles
+  for the unaliased leaves — exactly what donation exists to prevent.
+- JX005 broadcast-blowup — a ``broadcast_in_dim``/``iota`` materializing
+  an array ≥ ``JX005_FACTOR`` x the program's total input bytes (and
+  ≥ ``JX005_MIN_BYTES``): the per-eqn peak-residency estimate says this
+  one equation dominates the program's memory high-water mark.
+- JX006 dead-code — an equation none of whose outputs reach any later
+  equation or the program outputs (effect-free only): ``make_jaxpr``
+  does not DCE, so this is computation the author *believes* matters and
+  XLA will silently delete — usually a dropped metric or a stale debug
+  path.
+- JX007 host-callback — ``pure_callback``/``io_callback``/
+  ``debug_callback`` (``jax.debug.print``) inside a production program:
+  a host round-trip serialized into every dispatch.
+
+Each audit also emits a static profile — executed-FLOPs estimate (scan
+trip counts multiplied through; same 2·M·K·N contraction math as
+``esr_tpu.utils.roofline``), peak-residency bytes (linear liveness scan),
+cast count — so the bench's ``program_audit`` stage can track program
+growth across rounds.
+
+Findings reuse the existing :class:`~esr_tpu.analysis.core.Finding` /
+baseline-ratchet machinery: ``path`` is ``jaxpr://<program>``, ``code``
+is a stable equation descriptor (primitive + dtypes/shapes + scope), so
+fingerprints survive equation reordering the way AST fingerprints
+survive line drift. Per-program rule allowlists
+(:class:`~esr_tpu.analysis.programs.ProgramSpec.allow`) are the
+jaxpr-side ``# esr: noqa`` equivalent; ``jaxpr_baseline.json`` is the
+ratchet. CLI: ``python -m esr_tpu.analysis --jaxpr``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from esr_tpu.analysis.core import Finding
+
+# rule name -> (severity, one-line summary); the catalog docs/ANALYSIS.md
+# mirrors. Version-stamped into jaxpr_baseline.json (rules_signature) so a
+# rule upgrade reports "regenerate the baseline" instead of mass-firing.
+JAXPR_RULES: Dict[str, Tuple[str, str]] = {
+    "JX001": ("error", "low-precision dot/conv without a wider accumulator"),
+    "JX002": ("error", "unintended f64/c128 promotion"),
+    "JX003": ("warning", "convert_element_type round-trip churn"),
+    "JX004": ("error", "declared donation not aliased in the lowering"),
+    "JX005": ("warning", "broadcast materialization dominates residency"),
+    "JX006": ("warning", "dead computation (outputs reach nothing)"),
+    "JX007": ("error", "host callback inside a production program"),
+}
+
+# JX005 thresholds: an eqn output this much bigger than ALL program inputs
+# combined (and above the absolute floor) is a materialization hazard, not
+# a working buffer.
+JX005_FACTOR = 4.0
+JX005_MIN_BYTES = 1 << 20  # 1 MiB
+
+_LOW_PRECISION_PREFIXES = ("bfloat16", "float16", "float8", "int8", "uint8")
+_WIDE_FOR = {"f": ("float32", "float64"), "i": ("int32", "int64")}
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+}
+_ALIASING_RE = re.compile(r"tf\.aliasing_output")
+
+
+def rules_signature() -> str:
+    """Stable identity of the JX rule set, stamped into the baseline."""
+    return "jx:" + ",".join(sorted(JAXPR_RULES))
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plumbing (jax imported lazily: the AST half of the package must
+# stay importable on bare CI hosts)
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        import numpy as np
+
+        size = 1
+        for d in aval.shape:
+            size *= int(d)
+        return size * np.dtype(aval.dtype).itemsize
+    except (TypeError, AttributeError, ValueError):
+        return 0
+
+
+def _dtype_name(aval) -> str:
+    try:
+        return str(aval.dtype)
+    except AttributeError:
+        return "?"
+
+
+def _short_aval(aval) -> str:
+    try:
+        dt = str(aval.dtype)
+        abbrev = {
+            "float32": "f32", "float64": "f64", "float16": "f16",
+            "bfloat16": "bf16", "int32": "i32", "int64": "i64",
+            "int8": "i8", "bool": "b1", "uint32": "u32", "uint8": "u8",
+            "complex64": "c64", "complex128": "c128",
+        }.get(dt, dt)
+        return f"{abbrev}[{','.join(str(d) for d in aval.shape)}]"
+    except AttributeError:
+        return "?"
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[str, Any]]:
+    """(label, core.Jaxpr) pairs for every sub-program an eqn carries
+    (scan/while bodies, cond branches, pjit/remat call jaxprs, custom_*
+    rules) — the walker recurses through all of them."""
+    from jax import core as jcore
+
+    out: List[Tuple[str, Any]] = []
+    for key, val in eqn.params.items():
+        vals: Sequence = val if isinstance(val, (tuple, list)) else (val,)
+        for i, v in enumerate(vals):
+            sub = None
+            if isinstance(v, jcore.ClosedJaxpr):
+                sub = v.jaxpr
+            elif isinstance(v, jcore.Jaxpr):
+                sub = v
+            if sub is not None:
+                label = key if len(vals) == 1 else f"{key}[{i}]"
+                out.append((label, sub))
+    return out
+
+
+def _trip_count(eqn) -> int:
+    """Execution multiplier for an eqn's sub-jaxprs: scan runs its body
+    ``length`` times; everything else (cond branches, while bodies —
+    trip count unknowable statically) counts once."""
+    if eqn.primitive.name == "scan":
+        try:
+            return max(1, int(eqn.params.get("length", 1)))
+        except (TypeError, ValueError):
+            return 1
+    return 1
+
+
+@dataclasses.dataclass(frozen=True)
+class _WalkedEqn:
+    eqn: Any
+    scope: str       # "" at top level, "scan/body" etc. below
+    ordinal: int     # 1-based position in the flattened walk
+    weight: int      # product of enclosing scan trip counts
+
+
+def walk_eqns(jaxpr) -> Iterator[_WalkedEqn]:
+    """Depth-first walk over every equation, recursing into sub-jaxprs,
+    with scope labels and executed-count weights."""
+    counter = [0]
+
+    def _walk(jx, scope: str, weight: int):
+        for eqn in jx.eqns:
+            counter[0] += 1
+            yield _WalkedEqn(eqn, scope, counter[0], weight)
+            subs = _sub_jaxprs(eqn)
+            if subs:
+                mult = _trip_count(eqn)
+                for label, sub in subs:
+                    inner = f"{scope}/{eqn.primitive.name}:{label}" if scope \
+                        else f"{eqn.primitive.name}:{label}"
+                    yield from _walk(sub, inner, weight * mult)
+
+    yield from _walk(jaxpr, "", 1)
+
+
+def _eqn_code(w: _WalkedEqn) -> str:
+    """Stable fingerprint text for one equation: primitive, in/out
+    avals, scope. Survives reordering and unrelated program edits the way
+    the AST fingerprint's stripped source line survives line drift."""
+    ins = ",".join(
+        _short_aval(v.aval) for v in w.eqn.invars if hasattr(v, "aval")
+    )
+    outs = ",".join(_short_aval(v.aval) for v in w.eqn.outvars)
+    loc = f" @ {w.scope}" if w.scope else ""
+    return f"{w.eqn.primitive.name}({ins})->({outs}){loc}"
+
+
+def _finding(program: str, rule: str, w: Optional[_WalkedEqn],
+             message: str, code: Optional[str] = None) -> Finding:
+    severity = JAXPR_RULES[rule][0]
+    return Finding(
+        rule=rule,
+        path=f"jaxpr://{program}",
+        line=w.ordinal if w is not None else 0,
+        col=0,
+        severity=severity,
+        message=message,
+        hint="",
+        code=code if code is not None else (_eqn_code(w) if w else ""),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the rules
+
+
+def _check_jx001(program: str, walked: List[_WalkedEqn]) -> List[Finding]:
+    out = []
+    for w in walked:
+        if w.eqn.primitive.name not in (
+            "dot_general", "conv_general_dilated"
+        ):
+            continue
+        in_dtypes = [
+            _dtype_name(v.aval) for v in w.eqn.invars if hasattr(v, "aval")
+        ]
+        narrow = [
+            d for d in in_dtypes
+            if d.startswith(_LOW_PRECISION_PREFIXES)
+        ]
+        if not narrow:
+            continue
+        out_dtype = _dtype_name(w.eqn.outvars[0].aval)
+        if out_dtype.startswith(_LOW_PRECISION_PREFIXES):
+            kind = "float32" if out_dtype[0] in ("b", "f") else "int32"
+            out.append(_finding(
+                program, "JX001", w,
+                f"{w.eqn.primitive.name} with {'/'.join(sorted(set(narrow)))}"
+                f" inputs accumulates in {out_dtype} — pass "
+                f"preferred_element_type={kind} so the MXU keeps a wide "
+                "accumulator",
+            ))
+    return out
+
+
+def _check_jx002(program: str, walked: List[_WalkedEqn]) -> List[Finding]:
+    out = []
+    for w in walked:
+        for v in w.eqn.outvars:
+            d = _dtype_name(v.aval)
+            if d in ("float64", "complex128"):
+                out.append(_finding(
+                    program, "JX002", w,
+                    f"{w.eqn.primitive.name} produces {d} — f64 leaked "
+                    "into the traced program (TPU emulates it at ~1/10 "
+                    "throughput; find the enable_x64 / python-float leak)",
+                ))
+                break
+    return out
+
+
+def _check_jx003(program: str, walked: List[_WalkedEqn]) -> List[Finding]:
+    # producer map is per scope: a var is only meaningful inside its jaxpr
+    producers: Dict[Tuple[str, Any], _WalkedEqn] = {}
+    for w in walked:
+        for v in w.eqn.outvars:
+            producers[(w.scope, id(v))] = w
+    out = []
+    for w in walked:
+        if w.eqn.primitive.name != "convert_element_type":
+            continue
+        src = w.eqn.invars[0]
+        prev = producers.get((w.scope, id(src)))
+        if prev is None or prev.eqn.primitive.name != "convert_element_type":
+            continue
+        origin = prev.eqn.invars[0]
+        if not hasattr(origin, "aval"):
+            continue
+        if _dtype_name(origin.aval) == _dtype_name(w.eqn.outvars[0].aval):
+            mid = _dtype_name(src.aval)
+            end = _dtype_name(w.eqn.outvars[0].aval)
+            out.append(_finding(
+                program, "JX003", w,
+                f"cast round-trip {end} -> {mid} -> {end} along one value "
+                "path — a wasted pass at best, a silent precision wash "
+                f"through {mid} at worst",
+            ))
+    return out
+
+
+def _check_jx005(
+    program: str, walked: List[_WalkedEqn], input_bytes: int
+) -> List[Finding]:
+    threshold = max(JX005_MIN_BYTES, JX005_FACTOR * max(1, input_bytes))
+    out = []
+    for w in walked:
+        if w.eqn.primitive.name not in ("broadcast_in_dim", "iota"):
+            continue
+        bytes_out = sum(_aval_bytes(v.aval) for v in w.eqn.outvars)
+        if bytes_out >= threshold:
+            out.append(_finding(
+                program, "JX005", w,
+                f"{w.eqn.primitive.name} materializes "
+                f"{bytes_out / 1e6:.1f} MB "
+                f"({bytes_out / max(1, input_bytes):.0f}x the program's "
+                "total input bytes) — restructure so the broadcast stays "
+                "fused (or is consumed lazily) instead of resident",
+            ))
+    return out
+
+
+# dead LAYOUT ops are exempt from JX006: shape/dtype plumbing is free
+# after DCE and is exactly what AD partial-eval leaves behind as DropVar
+# residue (dead broadcasts/squeezes inside a grad-of-scan body) — the
+# actionable signal is dead ARITHMETIC (mul, reduce, dot, conv, scan...),
+# which means a metric or output the author believes exists and doesn't
+_DEAD_EXEMPT_PRIMS = {
+    "broadcast_in_dim", "squeeze", "reshape", "transpose", "copy",
+    "convert_element_type", "expand_dims", "rev", "iota", "slice",
+}
+
+
+def _dead_eqns(jaxpr) -> Iterator[Any]:
+    """Per-scope dead-code scan: effect-free, non-layout eqns none of
+    whose outputs are read by a later eqn or the scope's outputs (a
+    trace-time-dropped output is a ``DropVar``). Recurses."""
+    from jax import core as jcore
+
+    used = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var):
+                used.add(id(v))
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var):
+            used.add(id(v))
+    for eqn in jaxpr.eqns:
+        if eqn.effects:
+            continue
+        if (
+            eqn.outvars
+            and eqn.primitive.name not in _DEAD_EXEMPT_PRIMS
+            and all(
+                isinstance(v, jcore.DropVar) or id(v) not in used
+                for v in eqn.outvars
+            )
+        ):
+            yield eqn
+        for _, sub in _sub_jaxprs(eqn):
+            yield from _dead_eqns(sub)
+
+
+def _check_jx006(program: str, jaxpr,
+                 walked: List[_WalkedEqn]) -> List[Finding]:
+    by_eqn = {id(w.eqn): w for w in walked}
+    out = []
+    for eqn in _dead_eqns(jaxpr):
+        w = by_eqn.get(id(eqn))
+        if w is None:
+            continue
+        out.append(_finding(
+            program, "JX006", w,
+            f"{eqn.primitive.name} result reaches no later equation and "
+            "no program output — XLA will DCE it, so either the compute "
+            "is waste or an output was dropped by mistake",
+        ))
+    return out
+
+
+def _check_jx007(program: str, walked: List[_WalkedEqn]) -> List[Finding]:
+    out = []
+    for w in walked:
+        name = w.eqn.primitive.name
+        if name in _CALLBACK_PRIMS or "callback" in name:
+            out.append(_finding(
+                program, "JX007", w,
+                f"host callback `{name}` inside a production program — a "
+                "device->host round-trip serialized into every dispatch "
+                "(move it outside the traced program, behind a cadence)",
+            ))
+    return out
+
+
+def _count_donated_leaves(args: Sequence, donate_argnums: Sequence[int]) -> int:
+    import jax
+
+    n = 0
+    for i in donate_argnums:
+        if i < len(args):
+            n += len(jax.tree_util.tree_leaves(args[i]))
+    return n
+
+
+def _check_jx004(
+    program: str,
+    traced,
+    args: Sequence,
+    donate_argnums: Sequence[int],
+    static_argnums: Sequence[int] = (),
+) -> List[Finding]:
+    """Donation contract: lower the already-traced program (device-free —
+    no compile, no second trace) and count ``tf.aliasing_output``
+    argument attributes in the StableHLO against the donated pytrees'
+    array-leaf count."""
+    aliased = len(_ALIASING_RE.findall(traced.lower().as_text()))
+    # donate_argnums index ORIGINAL argument positions (jax's own
+    # convention — donating a static arg is a jax error anyway)
+    donated = _count_donated_leaves(
+        args, [i for i in donate_argnums if i not in set(static_argnums)]
+    )
+    if aliased < donated:
+        return [_finding(
+            program, "JX004", None,
+            f"declared donation is ineffective: {donated} array leaf/leaves"
+            f" donated but only {aliased} aliased in the lowering — the "
+            "unaliased buffers stay live across the call and HBM "
+            "residency doubles for them (shape/dtype mismatch between the "
+            "donated input and every output, or the donated value is "
+            "still referenced)",
+            code=f"donated={donated} aliased={aliased}",
+        )]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# profile: executed-FLOPs / peak residency / cast count
+
+
+def _conv_flops(eqn) -> float:
+    """2·M·K·N for conv_general_dilated via its dimension numbers
+    (grouped convs divide K by the group count) — the same implicit-GEMM
+    model as esr_tpu.utils.roofline."""
+    dn = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    batch = lhs.shape[dn.lhs_spec[0]]
+    cout = rhs.shape[dn.rhs_spec[0]]
+    # rhs feature dim is ALREADY per-group (Cin/fgc), so grouped convs
+    # need no extra division for the GEMM K
+    cin_per_group = rhs.shape[dn.rhs_spec[1]]
+    k_spatial = 1
+    for d in dn.rhs_spec[2:]:
+        k_spatial *= rhs.shape[d]
+    out_spatial = 1
+    for d in dn.out_spec[2:]:
+        out_spatial *= out.shape[d]
+    m = batch * out_spatial
+    k = k_spatial * cin_per_group
+    return 2.0 * m * k * cout
+
+
+def _dot_flops(eqn) -> float:
+    import math
+
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    k = int(math.prod(lhs.shape[d] for d in lc)) or 1
+    bsz = int(math.prod(lhs.shape[d] for d in lb)) or 1
+    m = int(max(1, math.prod(lhs.shape) // (k * bsz)))
+    n = int(max(1, math.prod(rhs.shape) // (k * bsz)))
+    return 2.0 * m * bsz * k * n
+
+
+def _peak_bytes(jaxpr) -> int:
+    """Linear-scan liveness estimate of peak residency for one jaxpr
+    scope. Sub-jaxpr peaks are charged while their eqn executes (their
+    operands are the eqn's invars, already live at this scope). An
+    estimate, not an XLA allocator model — fusion/rematerialization can
+    only shrink it."""
+    from jax import core as jcore
+
+    eqns = jaxpr.eqns
+    last_use: Dict[int, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var):
+                last_use[id(v)] = i
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var):
+            last_use[id(v)] = len(eqns)
+
+    live: Dict[int, int] = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        live[id(v)] = _aval_bytes(v.aval)
+    cur = sum(live.values())
+    peak = cur
+    for i, eqn in enumerate(eqns):
+        inner = 0
+        for _, sub in _sub_jaxprs(eqn):
+            inner = max(inner, _peak_bytes(sub))
+        for v in eqn.outvars:
+            if isinstance(v, jcore.DropVar):
+                continue
+            if id(v) not in live:
+                live[id(v)] = _aval_bytes(v.aval)
+                cur += live[id(v)]
+        peak = max(peak, cur + inner)
+        for vid in [vid for vid, last in last_use.items() if last == i]:
+            if vid in live:
+                cur -= live.pop(vid)
+    return peak
+
+
+def _profile(jaxpr, walked: List[_WalkedEqn]) -> Dict[str, Any]:
+    flops = 0.0
+    casts = 0
+    n_eqns = 0
+    for w in walked:
+        n_eqns += 1
+        name = w.eqn.primitive.name
+        if name == "dot_general":
+            flops += w.weight * _dot_flops(w.eqn)
+        elif name == "conv_general_dilated":
+            flops += w.weight * _conv_flops(w.eqn)
+        elif name == "convert_element_type":
+            casts += w.weight
+    input_bytes = sum(
+        _aval_bytes(v.aval)
+        for v in list(jaxpr.invars) + list(jaxpr.constvars)
+    )
+    output_bytes = sum(
+        _aval_bytes(v.aval) for v in jaxpr.outvars if hasattr(v, "aval")
+    )
+    return {
+        "flops": flops,
+        "peak_bytes": _peak_bytes(jaxpr),
+        "cast_count": casts,
+        "n_eqns": n_eqns,
+        "input_bytes": input_bytes,
+        "output_bytes": output_bytes,
+    }
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    """One program's audit: surviving findings + static profile."""
+
+    name: str
+    findings: List[Finding]
+    profile: Dict[str, Any]
+    allowed: Tuple[str, ...] = ()
+    suppressed: int = 0  # findings dropped by the per-program allowlist
+
+
+def audit_callable(
+    name: str,
+    fn: Callable,
+    args: Sequence,
+    *,
+    donate_argnums: Sequence[int] = (),
+    static_argnums: Sequence[int] = (),
+    allow: Sequence[str] = (),
+    rules: Optional[Sequence[str]] = None,
+) -> ProgramAudit:
+    """Trace ``fn(*args)`` device-free and audit the jaxpr.
+
+    ``args`` may be concrete arrays or ``jax.ShapeDtypeStruct`` pytrees —
+    nothing is compiled or executed. ``allow`` is the per-program
+    allowlist (the jaxpr-side ``# esr: noqa``): findings for those rules
+    are dropped and counted in ``suppressed``. ``rules`` restricts the
+    pass (default: all JX rules).
+    """
+    import jax
+
+    unknown = set(allow) - set(JAXPR_RULES)
+    if unknown:
+        raise ValueError(
+            f"program {name!r} allowlists unknown rule(s) {sorted(unknown)};"
+            f" known: {sorted(JAXPR_RULES)}"
+        )
+    # ONE trace serves both halves: ``.jaxpr`` for the walkers and (for
+    # donated programs) ``.lower()`` for JX004 — the registry's heaviest
+    # programs would otherwise pay a second full trace per audit
+    traced = jax.jit(
+        fn,
+        donate_argnums=tuple(donate_argnums),
+        static_argnums=tuple(static_argnums),
+    ).trace(*args)
+    jaxpr = traced.jaxpr.jaxpr
+    walked = list(walk_eqns(jaxpr))
+    input_bytes = sum(
+        _aval_bytes(v.aval)
+        for v in list(jaxpr.invars) + list(jaxpr.constvars)
+    )
+
+    active = set(rules if rules is not None else JAXPR_RULES)
+    findings: List[Finding] = []
+    if "JX001" in active:
+        findings += _check_jx001(name, walked)
+    if "JX002" in active:
+        findings += _check_jx002(name, walked)
+    if "JX003" in active:
+        findings += _check_jx003(name, walked)
+    if "JX004" in active and donate_argnums:
+        findings += _check_jx004(
+            name, traced, args, donate_argnums, static_argnums
+        )
+    if "JX005" in active:
+        findings += _check_jx005(name, walked, input_bytes)
+    if "JX006" in active:
+        findings += _check_jx006(name, jaxpr, walked)
+    if "JX007" in active:
+        findings += _check_jx007(name, walked)
+
+    allowed = tuple(sorted(set(allow)))
+    kept = [f for f in findings if f.rule not in allowed]
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return ProgramAudit(
+        name=name,
+        findings=kept,
+        profile=_profile(jaxpr, walked),
+        allowed=allowed,
+        suppressed=len(findings) - len(kept),
+    )

@@ -44,6 +44,11 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   ``begin`` re-points the AMBIENT trace context, so a skipped ``end``
   mis-parents every later record under a dead span. Prefer ``with
   trace.span(...)``; a manual begin must ``end()`` in a ``finally``.
+- ESR011 stale-suppression — a ``# esr: noqa(...)`` that suppresses no
+  finding on its line, or an ``esr: noqa`` marker buried mid-comment the
+  parser never honors: dead suppressions rot the ratchet. Detection is
+  framework-side (``core.analyze_source``, after suppression
+  bookkeeping); the class below only registers the name.
 
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
@@ -744,6 +749,28 @@ class SpanContextLeak(Rule):
                     f"`{target}.end()` in a `finally:` — an exception "
                     "between begin and end leaks the span context",
                 )
+
+
+@register_rule
+class StaleNoqa(Rule):
+    """ESR011 is emitted by the FRAMEWORK (``core.analyze_source``), not
+    by this ``check``: staleness is knowable only after every other rule
+    has run and suppression has been applied, so the rule class exists to
+    put the name in the registry (catalog, ``--rules`` validation,
+    ``rules_signature``) while the detection lives where the suppression
+    bookkeeping does."""
+
+    name = "ESR011"
+    slug = "stale-suppression"
+    severity = "warning"
+    hint = (
+        "a `# esr: noqa(...)` that suppresses nothing rots the ratchet — "
+        "delete it, fix the rule name, or (if intentionally defensive) "
+        "add ESR011 to the named rules"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
 
 
 @register_rule

@@ -184,7 +184,7 @@ class InferenceRunner:
             # intentional per-window latency probe (the one sequential-mode
             # sync the deferred-readback audit keeps): bounding the forward
             # here is what makes `time`/`infer_forward` true dispatch->ready
-            # wall per window  # esr: noqa(ESR002)
+            # wall per window
             pred = jax.block_until_ready(pred)
             latency = time.perf_counter() - t0
             track.update("time", latency)

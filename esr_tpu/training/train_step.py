@@ -285,6 +285,35 @@ def make_eval_step(
     return eval_step
 
 
+def make_fused_eval_accum(
+    model, seqn: int = 3, rasterize: Optional[Callable] = None
+) -> Callable:
+    """The scanned accumulator behind fused validation: ``((params, sums),
+    batch) -> ((params, sums), {})`` where ``sums`` carries the
+    globally-reduced ``valid_loss``/``valid_mse_loss``/``count`` scalars
+    ON DEVICE across batches — chain it through
+    :func:`~esr_tpu.training.multistep.make_multi_step` for the
+    one-readback-per-pass validation program (the Trainer's
+    ``_build_fused_eval``) and audit it through
+    ``esr_tpu.analysis.programs`` (the jaxpr auditor registers exactly
+    this composition as the production validation program)."""
+    eval_fn = make_eval_step(model, seqn, rasterize=rasterize)
+
+    def accum(carry, batch):
+        params, sums = carry
+        out = eval_fn(params, batch)
+        sums = {
+            "valid_loss": sums["valid_loss"] + out["valid_loss"],
+            "valid_mse_loss": (
+                sums["valid_mse_loss"] + out["valid_mse_loss"]
+            ),
+            "count": sums["count"] + 1.0,
+        }
+        return (params, sums), {}
+
+    return accum
+
+
 def jit_eval_step(
     model,
     seqn: int = 3,

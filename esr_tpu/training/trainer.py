@@ -607,23 +607,13 @@ class Trainer:
         carry aliases ``self.state.params``)."""
         from esr_tpu.analysis.retrace_guard import checked_jit
         from esr_tpu.training.multistep import make_multi_step
-        from esr_tpu.training.train_step import make_eval_step
+        from esr_tpu.training.train_step import make_fused_eval_accum
 
-        eval_fn = make_eval_step(
+        # the accumulator is the registered production program the jaxpr
+        # auditor traces (esr_tpu.analysis.programs) — one definition
+        accum = make_fused_eval_accum(
             self.model, self.seqn, rasterize=self._rasterize
         )
-
-        def accum(carry, batch):
-            params, sums = carry
-            out = eval_fn(params, batch)
-            sums = {
-                "valid_loss": sums["valid_loss"] + out["valid_loss"],
-                "valid_mse_loss": (
-                    sums["valid_mse_loss"] + out["valid_mse_loss"]
-                ),
-                "count": sums["count"] + 1.0,
-            }
-            return (params, sums), {}
 
         repl = NamedSharding(self.mesh, P())
         data = NamedSharding(self.mesh, P("data"))

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# JAX-hazard static analysis over the package, against the committed
-# baseline — the same gate tests/test_analysis_selfcheck.py enforces in
-# tier-1. Rule catalog + baseline workflow: docs/ANALYSIS.md.
+# JAX-hazard static analysis over the package (AST lint + jaxpr program
+# audit), against the committed baselines — the same gates
+# tests/test_analysis_selfcheck.py and tests/test_analysis_cli_gate.py
+# enforce in tier-1. Rule catalogs + baseline workflow: docs/ANALYSIS.md.
 #
 # Usage: scripts/lint.sh [paths...]   (default: esr_tpu/)
 set -euo pipefail
@@ -10,4 +11,4 @@ if [ "$#" -eq 0 ]; then
   set -- esr_tpu/
 fi
 exec python -m esr_tpu.analysis \
-  --baseline analysis_baseline.json --relative-to . "$@"
+  --baseline analysis_baseline.json --relative-to . --jaxpr "$@"

@@ -1,0 +1,127 @@
+"""Seeded-hazard program registry for the jaxpr auditor's CLI gate.
+
+``python -m esr_tpu.analysis --jaxpr --jaxpr-registry
+tests.fixtures.jaxpr_hazard_programs`` must exit 1: every program here
+deliberately violates one JX contract (the headline seed is the JX001
+bf16 matmul that silently accumulates in bf16 — the exact hazard the
+precision-ladder work must not ship). ``tests/test_analysis_cli_gate.py``
+and ``tests/test_jaxpr_audit.py`` drive this module; it is NOT part of
+the production registry.
+"""
+
+from __future__ import annotations
+
+from esr_tpu.analysis.programs import BuiltProgram, ProgramSpec
+
+
+def _build_bf16_dot_narrow_accum() -> BuiltProgram:
+    """JX001 seed: a bf16 x bf16 contraction with no f32
+    ``preferred_element_type`` — the MXU accumulates in bf16."""
+    import jax
+
+    a = jax.ShapeDtypeStruct((32, 64), "bfloat16")
+    b = jax.ShapeDtypeStruct((64, 32), "bfloat16")
+    return BuiltProgram(lambda x, y: x @ y, (a, b))
+
+
+def _build_dropped_donation() -> BuiltProgram:
+    """JX004 seed: the donated arg's buffer shapes match no output, so
+    the lowering aliases nothing and residency doubles."""
+    import jax
+
+    state = jax.ShapeDtypeStruct((128, 128), "float32")
+    batch = jax.ShapeDtypeStruct((128,), "float32")
+
+    def step(state, batch):
+        return (state * batch).sum()  # donated (128,128) never reused
+
+    return BuiltProgram(step, (state, batch), donate_argnums=(0,))
+
+
+def _build_f64_leak() -> BuiltProgram:
+    """JX002 seed: an explicit f64 promotion (traced under enable_x64,
+    the way a leaked python float does it)."""
+    import jax
+
+    x = jax.ShapeDtypeStruct((16, 16), "float32")
+
+    def leak(x):
+        import jax.numpy as jnp
+
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            return (x.astype(jnp.float64) * 2.0).sum()
+
+    return BuiltProgram(leak, (x,))
+
+
+def _build_dead_output() -> BuiltProgram:
+    """JX006 seed: a computed metric that reaches no output — the
+    author believes it exists; XLA deletes it."""
+    import jax
+
+    x = jax.ShapeDtypeStruct((16, 16), "float32")
+
+    def f(x):
+        import jax.numpy as jnp
+
+        grad_norm = jnp.sqrt((x * x).sum())  # noqa: F841 - the hazard
+        return x + 1.0
+
+    return BuiltProgram(f, (x,))
+
+
+def _build_host_callback() -> BuiltProgram:
+    """JX007 seed: a debug print serialized into every dispatch."""
+    import jax
+
+    x = jax.ShapeDtypeStruct((16,), "float32")
+
+    def f(x):
+        jax.debug.print("sum={s}", s=x.sum())
+        return x * 2.0
+
+    return BuiltProgram(f, (x,))
+
+
+def _build_cast_churn() -> BuiltProgram:
+    """JX003 seed: f32 -> bf16 -> f32 round trip on one value path."""
+    import jax
+
+    x = jax.ShapeDtypeStruct((16, 16), "float32")
+
+    def f(x):
+        import jax.numpy as jnp
+
+        return x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+    return BuiltProgram(f, (x,))
+
+
+PROGRAMS = [
+    ProgramSpec(
+        "hazard_bf16_dot", _build_bf16_dot_narrow_accum,
+        description="JX001: bf16 matmul, narrow accumulator",
+    ),
+    ProgramSpec(
+        "hazard_dropped_donation", _build_dropped_donation,
+        description="JX004: donated buffer never aliased",
+    ),
+    ProgramSpec(
+        "hazard_f64_leak", _build_f64_leak,
+        description="JX002: f64 promotion",
+    ),
+    ProgramSpec(
+        "hazard_dead_output", _build_dead_output,
+        description="JX006: computed value reaches no output",
+    ),
+    ProgramSpec(
+        "hazard_host_callback", _build_host_callback,
+        description="JX007: debug callback in the program",
+    ),
+    ProgramSpec(
+        "hazard_cast_churn", _build_cast_churn,
+        description="JX003: dtype round trip",
+    ),
+]

@@ -1,0 +1,111 @@
+"""Tier-1 CLI gate (ISSUE 9 satellite): the EXACT commands CI and humans
+run — ``python -m esr_tpu.analysis`` over the repo for the AST lint and
+``--jaxpr`` for the program audit — as subprocesses against the committed
+baselines. A hazard introduced by any future PR fails here, in tier-1,
+not only when someone remembers ``scripts/lint.sh``.
+
+Subprocess on purpose: the gate must prove the real entry point (argv
+parsing, exit codes, baseline resolution from the repo root), not the
+in-process API the selfcheck tests already cover.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "esr_tpu.analysis", *argv],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+
+
+def test_repo_gate_ast_and_jaxpr_exit_zero():
+    """Both gates in one invocation (the lint.sh AST command + --jaxpr):
+    the package must lint clean AND every registered production program
+    must audit clean against the committed baselines."""
+    proc = _run(
+        "--baseline", "analysis_baseline.json", "--relative-to", ".",
+        "esr_tpu/", "--jaxpr",
+    )
+    assert proc.returncode == 0, (
+        f"analysis gate failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    assert "0 new finding(s)" in proc.stderr
+    assert "jaxpr audit:" in proc.stderr
+
+
+def test_seeded_hazard_registry_exits_one():
+    """ISSUE 9 acceptance: the CLI exits 1 on the seeded-hazard fixture
+    registry — including the JX001 bf16-accumulation seed the
+    precision-ladder work gates behind."""
+    proc = _run(
+        "--jaxpr", "--jaxpr-registry", "tests.fixtures.jaxpr_hazard_programs",
+    )
+    assert proc.returncode == 1, (
+        f"expected exit 1\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "JX001" in proc.stdout  # the headline precision hazard
+    assert "preferred_element_type" in proc.stdout
+
+
+def test_no_paths_and_no_jaxpr_is_a_usage_error():
+    proc = _run()
+    assert proc.returncode == 2
+    assert "nothing to do" in proc.stderr
+
+
+def test_combined_json_output_is_one_document():
+    """Both gates under --format json must print ONE parseable JSON
+    document (the AST findings plus a `jaxpr` section with per-program
+    profiles), not two concatenated objects."""
+    import json
+
+    proc = _run(
+        "--format", "json", "--baseline", "analysis_baseline.json",
+        "--relative-to", ".", "esr_tpu/", "--jaxpr",
+    )
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)  # raises on concatenated documents
+    assert doc["findings"] == []
+    assert doc["jaxpr"]["findings"] == []
+    assert len(doc["jaxpr"]["profiles"]) >= 5
+    assert doc["jaxpr"]["rules_version"].startswith("jx:")
+
+
+def test_rules_subset_skips_baseline_version_gate(tmp_path):
+    """A --rules subset legitimately signs differently than the
+    committed full-run baseline; the rules_version drift gate must not
+    make subset runs impossible (in-process: the AST half needs no jax)."""
+    from esr_tpu.analysis import write_baseline
+    from esr_tpu.analysis.__main__ import main as cli_main
+    from esr_tpu.analysis.core import Finding
+
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import numpy as np\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    baseline = tmp_path / "b.json"
+    # non-empty baseline stamped with the FULL rule-set signature,
+    # grandfathering the file's one ESR002 finding
+    write_baseline(str(baseline), [Finding(
+        "ESR002", "mod.py", 5, 12, "error",
+        "host-sync call `np.asarray(...)` inside traced code "
+        "(materializes the array on host)",
+        code="return np.asarray(x)",
+    )])
+    rc = cli_main([
+        "--rules", "ESR002", "--baseline", str(baseline),
+        "--relative-to", str(tmp_path), str(src),
+    ])
+    assert rc == 0  # grandfathered finding, and no spurious drift failure

@@ -35,8 +35,9 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
-        "mfu_ceiling", "e2e", "e2e_device_raster", "scaling", "breakdown",
-        "infer_throughput", "ckpt_overlap", "serve_loadgen",
+        "mfu_ceiling", "program_audit", "e2e", "e2e_device_raster",
+        "scaling", "breakdown", "infer_throughput", "ckpt_overlap",
+        "serve_loadgen",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -223,6 +224,37 @@ def test_mfu_ceiling_stage_registered_schema_pinned_and_runs_offline():
     assert rec["total_gflops_fwd"] > 0
     assert rec["n_contractions"] > 10
     assert rec["peak_flops_chip"] > 0
+
+
+def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
+    """The jaxpr-contract series (ISSUE 9): every registered production
+    program's finding count + flops/peak-bytes/cast-count growth
+    trackers, schema pinned so the series stays machine-comparable
+    across rounds. Device-free (make_jaxpr/lower, no compile), so the
+    stage runs — and must produce REAL numbers and a clean audit — in
+    CPU smoke too."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "program_audit"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert timeout >= 300
+    assert in_smoke is True
+    assert bench.PROGRAM_AUDIT_KEYS == (
+        "programs", "clean", "total_findings", "rules_version",
+    )
+    assert bench.PROGRAM_AUDIT_PROGRAM_KEYS == (
+        "flops", "peak_bytes", "cast_count", "findings",
+    )
+    rec = bench.stage_program_audit()
+    assert tuple(rec.keys()) == bench.PROGRAM_AUDIT_KEYS
+    # ISSUE 9 acceptance: >= 5 production programs audit device-free
+    assert len(rec["programs"]) >= 5
+    for pname, prog in rec["programs"].items():
+        assert tuple(prog.keys()) == bench.PROGRAM_AUDIT_PROGRAM_KEYS, pname
+        assert prog["flops"] > 0, pname
+        assert prog["peak_bytes"] > 0, pname
+        assert prog["findings"] == 0, pname
+    assert rec["clean"] is True and rec["total_findings"] == 0
+    assert rec["rules_version"].startswith("jx:")
 
 
 def test_backend_up_bounded_probe_success_and_cache(tmp_path):

@@ -1,0 +1,452 @@
+"""jaxpr-level auditor: every JX rule positive+negative, the production
+registry selfcheck against the committed baseline, the per-program
+allowlist, profile semantics (scan-weighted FLOPs), the roofline
+cross-check, and baseline rules-version hygiene.
+
+The deliberately-broken programs live in
+``tests/fixtures/jaxpr_hazard_programs.py`` (the CLI gate drives the same
+module as a registry); the synthetic one-liners here pin each rule's
+firing condition tightly.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from esr_tpu.analysis import load_baseline, new_findings
+from esr_tpu.analysis.core import check_baseline_version, write_baseline
+from esr_tpu.analysis.jaxpr_audit import (
+    JAXPR_RULES,
+    audit_callable,
+    rules_signature,
+)
+from esr_tpu.analysis.programs import audit_production_programs, production_programs
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JAXPR_BASELINE = os.path.join(REPO_ROOT, "jaxpr_baseline.json")
+
+
+def _rules(audit):
+    return sorted({f.rule for f in audit.findings})
+
+
+def _sds(shape, dtype="float32"):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# JX001 low-precision accumulation
+
+
+def test_jx001_bf16_dot_without_wide_accumulator_fires():
+    a, b = _sds((8, 16), "bfloat16"), _sds((16, 8), "bfloat16")
+    audit = audit_callable("p", lambda x, y: x @ y, (a, b))
+    assert "JX001" in _rules(audit)
+    (f,) = [f for f in audit.findings if f.rule == "JX001"]
+    assert "preferred_element_type" in f.message
+
+
+def test_jx001_f32_preferred_element_type_is_clean():
+    a, b = _sds((8, 16), "bfloat16"), _sds((16, 8), "bfloat16")
+
+    def good(x, y):
+        return jax.lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    assert _rules(audit_callable("p", good, (a, b))) == []
+
+
+def test_jx001_f32_inputs_are_clean():
+    a, b = _sds((8, 16)), _sds((16, 8))
+    assert _rules(audit_callable("p", lambda x, y: x @ y, (a, b))) == []
+
+
+def test_jx001_fires_inside_scan_and_conv():
+    x = _sds((2, 8, 8, 4), "bfloat16")
+    w = _sds((3, 3, 4, 4), "bfloat16")
+
+    def f(x, w):
+        def body(c, _):
+            y = jax.lax.conv_general_dilated(
+                c, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            return y, ()
+
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    audit = audit_callable("p", f, (x, w))
+    assert "JX001" in _rules(audit)
+
+
+# ---------------------------------------------------------------------------
+# JX002 f64 promotion
+
+
+def test_jx002_f64_leak_fires():
+    x = _sds((8,), "float32")
+
+    def leak(x):
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            return (x.astype(jnp.float64) * 2.0).sum()
+
+    assert "JX002" in _rules(audit_callable("p", leak, (x,)))
+
+
+def test_jx002_f32_program_is_clean():
+    x = _sds((8,), "float32")
+    assert _rules(audit_callable("p", lambda x: (x * 2.0).sum(), (x,))) == []
+
+
+# ---------------------------------------------------------------------------
+# JX003 cast churn
+
+
+def test_jx003_round_trip_cast_fires():
+    x = _sds((8, 8))
+    f = lambda x: x.astype(jnp.bfloat16).astype(jnp.float32) + 1  # noqa: E731
+    assert "JX003" in _rules(audit_callable("p", f, (x,)))
+
+
+def test_jx003_single_cast_is_clean():
+    x = _sds((8, 8))
+    f = lambda x: x.astype(jnp.bfloat16) * 2  # noqa: E731
+    assert "JX003" not in _rules(audit_callable("p", f, (x,)))
+
+
+# ---------------------------------------------------------------------------
+# JX004 ineffective donation
+
+
+def test_jx004_dropped_donation_fires_with_counts():
+    s, b = _sds((64, 64)), _sds((64,))
+
+    def step(state, batch):
+        return (state * batch).sum()
+
+    audit = audit_callable("p", step, (s, b), donate_argnums=(0,))
+    (f,) = [f for f in audit.findings if f.rule == "JX004"]
+    assert f.code == "donated=1 aliased=0"
+
+
+def test_jx004_effective_donation_is_clean():
+    s, b = _sds((64, 64)), _sds((64,))
+
+    def step(state, batch):
+        return state + batch, (state * batch).sum()
+
+    audit = audit_callable("p", step, (s, b), donate_argnums=(0,))
+    assert "JX004" not in _rules(audit)
+
+
+def test_jx004_donated_leaf_count_respects_static_argnums():
+    """donate_argnums index ORIGINAL argument positions: with a static
+    arg before the donated one, the donated pytree's own leaves must be
+    counted (a filtered-list index would count the wrong argument)."""
+    state = {"a": _sds((32, 32)), "b": _sds((32,))}
+    batch = _sds((32,))
+
+    def step(k, state, batch):
+        return (state["a"].sum() + state["b"].sum() + batch.sum()) * k
+
+    audit = audit_callable(
+        "p", step, (2, state, batch),
+        static_argnums=(0,), donate_argnums=(1,),
+    )
+    (f,) = [f for f in audit.findings if f.rule == "JX004"]
+    assert f.code == "donated=2 aliased=0"
+
+
+def test_jx004_silent_without_declared_donation():
+    s, b = _sds((64, 64)), _sds((64,))
+    audit = audit_callable("p", lambda s_, b_: (s_ * b_).sum(), (s, b))
+    assert "JX004" not in _rules(audit)
+
+
+# ---------------------------------------------------------------------------
+# JX005 broadcast blowup
+
+
+def test_jx005_materialized_broadcast_fires():
+    x = _sds((8, 8))
+
+    def blow(x):
+        return jnp.broadcast_to(x[:, None, :], (8, 200_000, 8)).sum()
+
+    assert "JX005" in _rules(audit_callable("p", blow, (x,)))
+
+
+def test_jx005_small_broadcast_is_clean():
+    x = _sds((8, 8))
+
+    def ok(x):
+        return jnp.broadcast_to(x[:, None, :], (8, 4, 8)).sum()
+
+    assert "JX005" not in _rules(audit_callable("p", ok, (x,)))
+
+
+# ---------------------------------------------------------------------------
+# JX006 dead outputs
+
+
+def test_jx006_dead_arithmetic_fires_top_level_and_in_scan_body():
+    x = _sds((8, 8))
+
+    def dead(x):
+        y = jnp.sin(x) * 2  # noqa: F841
+        return x + 1
+
+    assert "JX006" in _rules(audit_callable("p", dead, (x,)))
+
+    def scan_dead(x):
+        def body(c, _):
+            waste = jnp.cos(c) * 3  # noqa: F841
+            return c + 1, c.sum()
+
+        return jax.lax.scan(body, x, None, length=4)
+
+    assert "JX006" in _rules(audit_callable("p", scan_dead, (x,)))
+
+
+def test_jx006_live_program_is_clean():
+    x = _sds((8, 8))
+    assert "JX006" not in _rules(
+        audit_callable("p", lambda x: jnp.sin(x) * 2 + x, (x,))
+    )
+
+
+def test_jx006_grad_of_scan_residue_is_not_flagged():
+    """value_and_grad over a scanned loss leaves DropVar'd layout eqns in
+    the jaxpr (AD partial-eval residue) — the exact pattern that made the
+    production train step false-positive during bring-up. Must be clean."""
+    x = _sds((4, 8))
+
+    def loss(w):
+        def body(c, _):
+            return c @ w.T @ w, (c * c).mean()
+
+        _, losses = jax.lax.scan(body, jnp.ones((2, 8)), None, length=3)
+        return losses.sum()
+
+    audit = audit_callable(
+        "p", lambda w: jax.value_and_grad(loss)(w), (x,)
+    )
+    assert "JX006" not in _rules(audit)
+
+
+# ---------------------------------------------------------------------------
+# JX007 host callbacks
+
+
+def test_jx007_debug_print_fires():
+    x = _sds((8,))
+
+    def f(x):
+        jax.debug.print("s={s}", s=x.sum())
+        return x * 2
+
+    assert "JX007" in _rules(audit_callable("p", f, (x,)))
+
+
+def test_jx007_pure_callback_fires():
+    import numpy as np
+
+    x = _sds((8,))
+
+    def f(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((8,), jnp.float32), x,
+        )
+        return y + 1
+
+    assert "JX007" in _rules(audit_callable("p", f, (x,)))
+
+
+# ---------------------------------------------------------------------------
+# allowlist (the jaxpr-side noqa) + unknown-rule validation
+
+
+def test_allowlist_suppresses_and_counts():
+    a, b = _sds((8, 16), "bfloat16"), _sds((16, 8), "bfloat16")
+    audit = audit_callable(
+        "p", lambda x, y: x @ y, (a, b), allow=("JX001",)
+    )
+    assert audit.findings == []
+    assert audit.suppressed == 1
+    assert audit.allowed == ("JX001",)
+
+
+def test_allowlist_unknown_rule_is_an_error():
+    x = _sds((8,))
+    with pytest.raises(ValueError, match="JX999"):
+        audit_callable("p", lambda v: v, (x,), allow=("JX999",))
+
+
+# ---------------------------------------------------------------------------
+# profile semantics
+
+
+def test_profile_scan_weighted_flops_and_cast_count():
+    a, b = _sds((8, 16)), _sds((16, 8))
+
+    def once(x, y):
+        return x @ y
+
+    def scanned(x, y):
+        def body(c, _):
+            return c, (x @ y).astype(jnp.bfloat16)
+
+        _, ys = jax.lax.scan(body, 0.0, None, length=5)
+        return ys
+
+    base = audit_callable("p", once, (a, b)).profile
+    prof = audit_callable("p", scanned, (a, b)).profile
+    assert base["flops"] == pytest.approx(2 * 8 * 16 * 8)
+    # the scanned dot runs `length` times: executed-FLOPs multiply
+    assert prof["flops"] == pytest.approx(5 * base["flops"])
+    assert prof["cast_count"] == 5
+    assert prof["peak_bytes"] > 0
+    assert prof["input_bytes"] == (8 * 16 + 16 * 8) * 4
+
+
+def test_profile_flops_cross_check_against_roofline():
+    """The audit's contraction FLOPs must agree with the MXU roofline's
+    (esr_tpu.utils.roofline.record_contractions) on the same forward —
+    same 2·M·K·N model, independent implementations. The jaxpr walk is
+    the more complete count (the roofline's spy patches the ``lax``
+    Python entry points and misses contractions that bind the primitive
+    directly), so the contract is audit >= roofline, within a few
+    percent — a real divergence (double counting, wrong conv dims) is
+    orders of magnitude, not 2%."""
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.utils.roofline import record_contractions
+
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    inp = jnp.zeros((2, 3, 8, 8, 2), jnp.float32)
+    states = model.init_states(2, 8, 8)
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), inp, states)
+    )
+    ops = []
+    with record_contractions(ops):
+        jax.eval_shape(lambda p: model.apply(p, inp, states), params)
+    roofline_flops = sum(o["flops"] for o in ops)
+
+    audit = audit_callable(
+        "flagship_fwd", lambda p: model.apply(p, inp, states), (params,)
+    )
+    assert roofline_flops > 0
+    assert audit.profile["flops"] >= roofline_flops
+    assert audit.profile["flops"] == pytest.approx(roofline_flops, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# the production registry
+
+
+@pytest.fixture(scope="module")
+def registry_audits():
+    return audit_production_programs()
+
+
+def test_registry_covers_the_production_programs():
+    names = {s.name for s in production_programs()}
+    assert {
+        "train_multi_step", "fused_valid_chunk", "infer_engine_chunk",
+        "dcn_train", "dcn_fwd",
+    } <= names
+    assert len(names) >= 5
+
+
+def test_registry_selfcheck_all_programs_clean_against_baseline(
+    registry_audits,
+):
+    """ISSUE 9 acceptance: every registered production program audits
+    clean (device-free, CPU) against the committed jaxpr baseline."""
+    findings = [f for a in registry_audits for f in a.findings]
+    fresh = new_findings(findings, load_baseline(JAXPR_BASELINE))
+    assert not fresh, (
+        "new jaxpr-audit findings (fix the program, allowlist with a "
+        "justification, or regenerate jaxpr_baseline.json per "
+        "docs/ANALYSIS.md):\n\n" + "\n".join(f.format() for f in fresh)
+    )
+
+
+def test_registry_profiles_are_nontrivial(registry_audits):
+    for a in registry_audits:
+        assert a.profile["flops"] > 0, a.name
+        assert a.profile["peak_bytes"] > 0, a.name
+        assert a.profile["n_eqns"] > 10, a.name
+    # the K-step fused train step is the biggest program by construction
+    by_name = {a.name: a.profile for a in registry_audits}
+    assert (
+        by_name["train_multi_step"]["flops"]
+        > by_name["eval_step"]["flops"]
+    )
+
+
+def test_hazard_fixture_programs_each_fire_their_rule():
+    from tests.fixtures.jaxpr_hazard_programs import PROGRAMS
+
+    expected = {
+        "hazard_bf16_dot": "JX001",
+        "hazard_dropped_donation": "JX004",
+        "hazard_f64_leak": "JX002",
+        "hazard_dead_output": "JX006",
+        "hazard_host_callback": "JX007",
+        "hazard_cast_churn": "JX003",
+    }
+    audits = {a.name: a for a in audit_production_programs(PROGRAMS)}
+    assert set(audits) == set(expected)
+    for name, rule in expected.items():
+        assert rule in _rules(audits[name]), (
+            f"{name} must trip {rule}; got {_rules(audits[name])}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# baseline hygiene: rules_version stamping
+
+
+def test_baseline_rules_version_drift_reports_regenerate(tmp_path):
+    """A non-empty baseline generated under a different rule set must
+    fail with ONE 'regenerate' message, not a mass-firing of every
+    re-fingerprinted finding."""
+    from esr_tpu.analysis.core import Finding
+
+    path = str(tmp_path / "b.json")
+    f = Finding("JX001", "jaxpr://p", 1, 0, "error", "m", code="c")
+    write_baseline(path, [f], rules_version="jx:OLD")
+    msg = check_baseline_version(path, rules_signature())
+    assert msg is not None and "regenerate" in msg.lower()
+    # same version: no drift
+    write_baseline(path, [f], rules_version=rules_signature())
+    assert check_baseline_version(path, rules_signature()) is None
+
+
+def test_empty_baseline_version_drift_is_harmless(tmp_path):
+    path = str(tmp_path / "b.json")
+    write_baseline(path, [], rules_version="jx:OLD")
+    assert check_baseline_version(path, rules_signature()) is None
+
+
+def test_committed_jaxpr_baseline_is_stamped_with_current_rules():
+    from esr_tpu.analysis.core import baseline_rules_version
+
+    assert os.path.exists(JAXPR_BASELINE)
+    assert baseline_rules_version(JAXPR_BASELINE) == rules_signature()
+
+
+def test_rules_signature_names_every_jx_rule():
+    sig = rules_signature()
+    assert sig.startswith("jx:")
+    for rule in JAXPR_RULES:
+        assert rule in sig
