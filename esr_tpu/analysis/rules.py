@@ -44,6 +44,12 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   ``begin`` re-points the AMBIENT trace context, so a skipped ``end``
   mis-parents every later record under a dead span. Prefer ``with
   trace.span(...)``; a manual begin must ``end()`` in a ``finally``.
+- ESR012 silent-exception-swallow — ``except Exception``/bare ``except``
+  in a host loop body whose handler neither re-raises nor emits a
+  telemetry event/counter (nor logs at warning+): the fault disappears
+  from the run's evidence stream while the loop spins on — the serving
+  tier's old blanket bad-stream swallow. Loud handling or an explicit
+  ``# esr: noqa(ESR012)`` justification.
 - ESR011 stale-suppression — a ``# esr: noqa(...)`` that suppresses no
   finding on its line, or an ``esr: noqa`` marker buried mid-comment the
   parser never honors: dead suppressions rot the ratchet. Detection is
@@ -749,6 +755,100 @@ class SpanContextLeak(Rule):
                     f"`{target}.end()` in a `finally:` — an exception "
                     "between begin and end leaks the span context",
                 )
+
+
+# names whose presence in an except-handler body makes a swallow "loud":
+# telemetry sink methods, the resilience recovery emitter, and >= warning
+# logging — anything below that (debug/info/pass/continue) leaves no
+# durable trace of the exception in the run's evidence stream
+_OBSERVABLE_METHODS = {"event", "counter", "gauge", "span", "metric"}
+_LOG_METHODS = {"warning", "error", "exception", "critical", "warn"}
+_OBSERVABLE_CALLS = {"emit_recovery", "warn"}
+
+
+@register_rule
+class SilentExceptionSwallow(Rule):
+    name = "ESR012"
+    slug = "silent-exception-swallow"
+    severity = "warning"
+    hint = (
+        "an `except Exception`/bare `except` in a host loop body that "
+        "neither re-raises nor emits a telemetry event/counter (nor logs "
+        "at warning+) makes the fault invisible: the loop keeps spinning "
+        "and the offline evidence stream shows a healthy run — the "
+        "serving tier's old blanket bad-stream swallow. Re-raise, emit "
+        "through the active sink (sink.event/counter, "
+        "resilience.recovery.emit_recovery), log at warning or above, or "
+        "justify with `# esr: noqa(ESR012)`"
+    )
+
+    def _loop_enclosed(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """Lexically inside a ``while``/``for`` body of the SAME function
+        (a nested def runs when called, not per loop iteration) — the
+        ESR008 ancestry walk."""
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+                return True
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+            cur = ctx.parents.get(cur)
+        return False
+
+    @staticmethod
+    def _broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except
+        names = []
+        if isinstance(t, ast.Tuple):
+            names = [_dotted(e) for e in t.elts]
+        else:
+            names = [_dotted(t)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _observable(handler: ast.ExceptHandler) -> bool:
+        for stmt in handler.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    return True
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _call_name(sub.func)
+                if name in _OBSERVABLE_CALLS:
+                    return True
+                if isinstance(sub.func, ast.Attribute) and (
+                    sub.func.attr in _OBSERVABLE_METHODS
+                    or sub.func.attr in _LOG_METHODS
+                ):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._broad(node):
+                continue
+            if ctx.in_traced_context(node):
+                continue  # exceptions under trace are a different disaster
+            if not self._loop_enclosed(ctx, node):
+                continue
+            if self._observable(node):
+                continue
+            what = "bare `except`" if node.type is None else (
+                f"`except {_dotted(node.type) or '...'}`"
+            )
+            yield self.finding(
+                ctx,
+                node,
+                f"{what} in a host loop body whose handler neither "
+                "re-raises nor emits telemetry/logging — the fault "
+                "vanishes from the evidence stream",
+            )
 
 
 @register_rule

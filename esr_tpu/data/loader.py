@@ -34,6 +34,8 @@ import numpy as np
 
 from esr_tpu.data.dataset import SequenceDataset
 from esr_tpu.obs import active_sink
+from esr_tpu.resilience import faults as _faults
+from esr_tpu.resilience.recovery import emit_recovery
 
 
 def read_datalist(path: str) -> List[str]:
@@ -579,6 +581,18 @@ class SequenceLoader:
                 yield pending.popleft().result()
 
 
+def _corrupt_item(host_batch):
+    """Enact a ``prefetch``/``corrupt`` fault on whatever the source
+    yields: a batch dict, or a k-step GROUP of batch dicts."""
+    if isinstance(host_batch, dict):
+        _faults.corrupt_batch(host_batch)
+    elif isinstance(host_batch, (list, tuple)):
+        for b in host_batch:
+            if isinstance(b, dict):
+                _faults.corrupt_batch(b)
+    return host_batch
+
+
 class DevicePrefetcher:
     """Overlap host->device staging with device compute (double-buffering).
 
@@ -614,10 +628,36 @@ class DevicePrefetcher:
     (the queue was empty — device idle, host feeding — with the blocked
     wait recorded), and a ``prefetch_close`` summary event at teardown.
     With no active sink every telemetry site is a no-op.
+
+    Stall watchdog (docs/RESILIENCE.md): with ``stall_timeout`` set, a
+    consumer wait exceeding it is treated as a hung producer, not a slow
+    one. The first timeout abandons the producer thread and starts a
+    replacement (``recovery_prefetch_restart``); a second timeout degrades
+    the prefetcher to SYNCHRONOUS staging on the consumer thread
+    (``recovery_prefetch_degrade``) — slower, but it can never hang on a
+    dead thread. Source-iterator access is generation-guarded behind a
+    lock, so an abandoned producer that later wakes exits without
+    consuming an item: hand-off never loses or duplicates a batch when the
+    stall struck between items (the fault plane's injection point); a
+    producer that hung INSIDE ``stage_fn`` holds one item that is lost on
+    abandonment — liveness over completeness, loudly. A producer hung
+    INSIDE ``next(source)`` holds the iterator lock forever: the watchdog
+    itself never touches that lock (it stays hang-proof), replacements
+    give up on a bounded lock acquire, and the degraded consumer raises a
+    loud RuntimeError — a wedged source becomes a bounded failure, never
+    a silent hang. ``stall_timeout`` None (default) keeps today's
+    unbounded wait.
+
+    Fault plane (``esr_tpu.resilience.faults``): the producer fires the
+    ``prefetch`` site once per item ordinal — ``stall`` sleeps the
+    producer (exercising the watchdog), ``corrupt`` NaN-poisons the host
+    batch before staging (exercising the trainer's anomaly guard). With no
+    installed plan the hook is one ``None`` check.
     """
 
     def __init__(self, source, stage_fn, depth: int = 2,
-                 join_timeout: float = 5.0, gauge_every: int = 32):
+                 join_timeout: float = 5.0, gauge_every: int = 32,
+                 stall_timeout: Optional[float] = None):
         import threading
 
         if depth < 1:
@@ -626,14 +666,37 @@ class DevicePrefetcher:
             raise ValueError(f"join_timeout must be > 0, got {join_timeout}")
         if gauge_every < 1:
             raise ValueError(f"gauge_every must be >= 1, got {gauge_every}")
+        if stall_timeout is not None and stall_timeout <= 0:
+            raise ValueError(
+                f"stall_timeout must be > 0 (or None), got {stall_timeout}"
+            )
         self._join_timeout = float(join_timeout)
         self._gauge_every = int(gauge_every)
+        self._stall_timeout = (
+            float(stall_timeout) if stall_timeout is not None else None
+        )
         self.gets = 0
         self.stalls = 0
         self.stall_s = 0.0
+        self.restarts = 0
+        self.degraded = False
         self._reported_close = False
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        # generation-guarded source hand-off (stall watchdog): every
+        # iterator pull happens under _it_lock after re-checking _gen, so
+        # an abandoned producer can never consume an item meant for its
+        # replacement (or the degraded consumer)
+        self._it = iter(source)
+        self._stage_fn = stage_fn
+        self._it_lock = threading.Lock()
+        # serializes the (abandoned-check -> enqueue) pair against the
+        # watchdog's generation bump, so a producer that passed the check
+        # an instant before abandonment can never land a stale item AFTER
+        # its replacement started delivering (ordering invariant)
+        self._put_lock = threading.Lock()
+        self._gen = 0
+        self._item_idx = 0
         # trace context hand-off (obs/trace.py, schema v2): contextvars do
         # not flow into threads, so capture the constructing context here
         # and adopt it on the producer — stage spans and stall counters
@@ -642,42 +705,206 @@ class DevicePrefetcher:
         from esr_tpu.obs import trace
 
         self._trace_ctx = trace.capture()
-        self._thread = threading.Thread(
-            target=self._produce,
-            args=(iter(source), stage_fn),
-            daemon=True,
-            name="device-prefetch",
-        )
-        self._thread.start()
+        self._thread = self._spawn_producer()
 
-    def _produce(self, it, stage_fn):
+    def _spawn_producer(self):
+        import threading
+
+        th = threading.Thread(
+            target=self._produce,
+            args=(self._gen,),
+            daemon=True,
+            name=f"device-prefetch-g{self._gen}",
+        )
+        th.start()
+        return th
+
+    def _produce(self, gen):
         from esr_tpu.obs import trace
 
         with trace.adopt(self._trace_ctx):
-            self._produce_inner(it, stage_fn)
+            self._produce_inner(gen)
 
-    def _produce_inner(self, it, stage_fn):
+    def _abandoned(self, gen) -> bool:
+        return self._stop.is_set() or gen != self._gen
+
+    def _acquire_source(self) -> bool:
+        """Bounded acquire of the iterator lock. A producer hung INSIDE
+        ``next(self._it)`` (dead filesystem, wedged data worker) holds
+        the lock forever — nothing can safely resume a shared iterator
+        mid-pull, so a replacement/degraded puller must give up loudly
+        instead of reproducing the hang. With no watchdog armed the wait
+        is unbounded (today's semantics)."""
+        if self._stall_timeout is None:
+            self._it_lock.acquire()
+            return True
+        return self._it_lock.acquire(timeout=self._stall_timeout)
+
+    def _pull_source(self, gen):
+        """One generation-checked iterator pull + fault-site firing.
+
+        Returns ``("item", host_batch)`` / ``("end", None)`` /
+        ``("abandoned", None)``. The ``stall`` fault sleeps OUTSIDE the
+        lock (a stalled producer must not block its replacement) and
+        re-checks the generation afterwards, so a watchdog-abandoned
+        producer wakes, sees the bumped generation, and exits without
+        consuming."""
+        if not self._acquire_source():
+            return "abandoned", None  # lock wedged by a hung pull
+        try:
+            if self._abandoned(gen):
+                return "abandoned", None
+            # PEEK the ordinal; it is consumed only on a successful pull
+            # below, so a stall-abandoned producer does not burn an index
+            # and the ordinal->batch mapping stays 1:1 (the chaos plan's
+            # fault placement depends on it). Specs fired here by a
+            # later-abandoned producer are consumed from the plan but not
+            # enacted on the batch — an accepted loss for co-scheduled
+            # faults at the exact stalled index.
+            idx = self._item_idx
+        finally:
+            self._it_lock.release()
+        specs = _faults.fire("prefetch", idx)
+        for spec in specs:
+            if spec.kind == "stall":
+                time.sleep(spec.arg)
+        if not self._acquire_source():
+            return "abandoned", None
+        try:
+            if self._abandoned(gen):
+                return "abandoned", None
+            try:
+                host_batch = next(self._it)
+            except StopIteration:
+                return "end", None
+            self._item_idx = idx + 1
+        finally:
+            self._it_lock.release()
+        for spec in specs:
+            if spec.kind == "corrupt":
+                _corrupt_item(host_batch)
+        return "item", host_batch
+
+    def _produce_inner(self, gen):
         def put(item) -> bool:
-            while not self._stop.is_set():
-                try:
-                    self._q.put(item, timeout=0.2)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            # abandoned-check and enqueue are ONE atomic step under
+            # _put_lock (the watchdog bumps the generation under the same
+            # lock), so an abandoned producer can never land a stale item
+            # after its replacement started delivering
+            while True:
+                with self._put_lock:
+                    if self._abandoned(gen):
+                        return False
+                    try:
+                        self._q.put_nowait(item)
+                        return True
+                    except queue.Full:
+                        pass
+                time.sleep(0.05)
 
         try:
-            for host_batch in it:
-                if self._stop.is_set():
+            while True:
+                kind, host_batch = self._pull_source(gen)
+                if kind == "abandoned":
                     return
-                if not put(("item", (host_batch, stage_fn(host_batch)))):
+                if kind == "end":
+                    put(("end", None))
                     return
-            put(("end", None))
+                if not put(("item", (host_batch,
+                                     self._stage_fn(host_batch)))):
+                    return
         except BaseException as e:  # noqa: BLE001 - re-raised at consumer
             put(("error", e))
 
     def __iter__(self):
         return self
+
+    def _watchdog_fire(self, waited: float) -> None:
+        """A consumer wait exceeded ``stall_timeout``: restart the
+        producer once, then degrade to synchronous staging."""
+        import warnings
+
+        if self.restarts == 0:
+            self.restarts += 1
+            # bump under _put_lock ONLY (never _it_lock: a producer hung
+            # inside next(self._it) holds that lock forever, and the
+            # watchdog must stay hang-proof — the whole point)
+            with self._put_lock:
+                self._gen += 1
+            emit_recovery(
+                "recovery_prefetch_restart", site="prefetch",
+                waited_s=round(waited, 6), timeout_s=self._stall_timeout,
+            )
+            warnings.warn(
+                f"DevicePrefetcher producer stalled >{self._stall_timeout:g}s"
+                "; abandoned the thread and started a replacement",
+                stacklevel=3,
+            )
+            self._thread = self._spawn_producer()
+        elif not self.degraded:
+            self.degraded = True
+            with self._put_lock:
+                self._gen += 1  # abandon every producer for good
+            emit_recovery(
+                "recovery_prefetch_degrade", site="prefetch",
+                waited_s=round(waited, 6), timeout_s=self._stall_timeout,
+            )
+            warnings.warn(
+                "DevicePrefetcher stalled again after a producer restart; "
+                "degrading to synchronous (consumer-thread) staging",
+                stacklevel=3,
+            )
+
+    def _get_blocking(self):
+        """Queue get with the stall accounting (+ watchdog when armed)."""
+        t0 = time.monotonic()
+        if self._stall_timeout is None:
+            kind, payload = self._q.get()
+        else:
+            while True:
+                try:
+                    kind, payload = self._q.get(
+                        timeout=self._stall_timeout
+                    )
+                    break
+                except queue.Empty:
+                    waited = time.monotonic() - t0
+                    self._watchdog_fire(waited)
+                    if self.degraded:
+                        # drain anything a producer landed between the
+                        # Empty and the generation bump BEFORE pulling
+                        # from the source, or the queued earlier item
+                        # would be yielded after a later one
+                        try:
+                            kind, payload = self._q.get_nowait()
+                        except queue.Empty:
+                            kind, payload = self._next_sync()
+                        break
+        waited = time.monotonic() - t0
+        self.stalls += 1
+        self.stall_s += waited
+        sink = active_sink()
+        if sink is not None:
+            sink.counter("prefetch_stall", waited_s=round(waited, 6))
+        return kind, payload
+
+    def _next_sync(self):
+        """Degraded mode: pull + stage on the consumer thread (the
+        generation bump already fenced every producer off the iterator).
+        A source wedged mid-pull (the abandoned producer still holds the
+        iterator lock) is unrecoverable — fail LOUDLY and bounded rather
+        than reproduce the hang the watchdog exists to escape."""
+        kind, host_batch = self._pull_source(self._gen)
+        if kind == "abandoned":
+            raise RuntimeError(
+                "DevicePrefetcher source is wedged mid-pull (the hung "
+                "producer still holds the iterator lock); the stream "
+                "cannot be resumed safely — restart the run from the "
+                "last checkpoint"
+            )
+        if kind != "item":
+            return "end", None
+        return "item", (host_batch, self._stage_fn(host_batch))
 
     def __next__(self):
         if self._stop.is_set():
@@ -686,20 +913,17 @@ class DevicePrefetcher:
         try:
             kind, payload = self._q.get_nowait()
         except queue.Empty:
-            # the consumer outran the producer: a prefetch stall — the
-            # device sits idle while the host builds/stages the next group.
-            # Counted (+ blocked wall) so starvation is a measured series,
-            # not a guess. Includes the inevitable first-item warmup wait
-            # and the end-of-source wait for the "end" marker: both are
-            # genuine host-feed waits.
-            t0 = time.monotonic()
-            kind, payload = self._q.get()
-            waited = time.monotonic() - t0
-            self.stalls += 1
-            self.stall_s += waited
-            sink = active_sink()
-            if sink is not None:
-                sink.counter("prefetch_stall", waited_s=round(waited, 6))
+            if self.degraded:
+                # the queue is drained; every item now stages inline
+                kind, payload = self._next_sync()
+            else:
+                # the consumer outran the producer: a prefetch stall — the
+                # device sits idle while the host builds/stages the next
+                # group. Counted (+ blocked wall) so starvation is a
+                # measured series, not a guess. Includes the inevitable
+                # first-item warmup wait and the end-of-source wait for
+                # the "end" marker: both are genuine host-feed waits.
+                kind, payload = self._get_blocking()
         self.gets += 1
         if self.gets % self._gauge_every == 0:
             sink = sink if sink is not None else active_sink()

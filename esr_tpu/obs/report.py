@@ -29,7 +29,12 @@ empty-but-typed when the run had no such activity):
 - ``traces`` — per ``serve_request_done``: is the terminal event
   connected to its ``serve_request`` root through parent links? Counted
   as ``complete``/``incomplete`` (+ ids), the acceptance criterion for a
-  causally-reconstructable request journey.
+  causally-reconstructable request journey (``status: shed`` submits are
+  skipped — no journey ever existed).
+- ``faults`` — fault -> recovery completeness (docs/RESILIENCE.md):
+  every ``fault_injected`` event matched one-to-one against
+  ``recovery_*`` events (by ``fault_id``, then by ``site``); the chaos
+  gate requires ``unrecovered == 0``.
 
 SLO YAML (``configs/slo.yml``)::
 
@@ -111,6 +116,10 @@ def _trace_completeness(records: List[Dict]) -> Dict:
     for rec in records:
         if rec.get("type") != "event" or rec.get("name") != _REQUEST_TERMINAL:
             continue
+        if rec.get("status") == "shed":
+            # a shed submit never had a journey (no root span exists);
+            # it is classified, not incomplete
+            continue
         requests += 1
         rid = rec.get("request", "?")
         trace_id = rec.get("trace_id")
@@ -139,6 +148,72 @@ def _trace_completeness(records: List[Dict]) -> Dict:
     }
 
 
+def _fault_completeness(records: List[Dict]) -> Dict:
+    """Match every ``fault_injected`` event to a ``recovery_*`` event —
+    the chaos gate's acceptance check (docs/RESILIENCE.md): a fault the
+    run did not visibly recover from is a broken recovery path.
+
+    Matching is two-pass and one-to-one: first by explicit ``fault_id``
+    (recovery paths that know their cause carry it), then by ``site`` in
+    record order (recovery paths that only observe the symptom — the
+    stall watchdog — still pair with the fault they answered). A fault's
+    symptom can surface one stage downstream of its injection point (a
+    corrupted prefetch batch is caught by the TRAIN STEP's anomaly
+    guard), so site matching accepts the documented answer sites."""
+    answers = {
+        "prefetch": ("prefetch", "train_step"),
+        "train_step": ("train_step",),
+        "ckpt_commit": ("ckpt_commit",),
+        "ckpt_restore": ("ckpt_restore",),
+        "serve_chunk": ("serve_chunk",),
+    }
+    faults = [
+        r for r in records
+        if r.get("type") == "event" and r.get("name") == "fault_injected"
+    ]
+    recoveries = [
+        r for r in records
+        if r.get("type") == "event"
+        and str(r.get("name", "")).startswith("recovery_")
+    ]
+    used = [False] * len(recoveries)
+    matched: Dict[int, Dict] = {}
+    for fi, fault in enumerate(faults):
+        fid = fault.get("fault_id")
+        for ri, rec in enumerate(recoveries):
+            if not used[ri] and fid and rec.get("fault_id") == fid:
+                used[ri] = True
+                matched[fi] = rec
+                break
+    for fi, fault in enumerate(faults):
+        if fi in matched:
+            continue
+        ok_sites = answers.get(fault.get("site"), (fault.get("site"),))
+        for ri, rec in enumerate(recoveries):
+            if not used[ri] and rec.get("site") in ok_sites:
+                used[ri] = True
+                matched[fi] = rec
+                break
+    by_site: Dict[str, Dict] = {}
+    unrecovered_ids: List[str] = []
+    for fi, fault in enumerate(faults):
+        site = fault.get("site", "?")
+        slot = by_site.setdefault(site, {"injected": 0, "recovered": 0})
+        slot["injected"] += 1
+        if fi in matched:
+            slot["recovered"] += 1
+        else:
+            unrecovered_ids.append(fault.get("fault_id", "?"))
+    return {
+        "injected": len(faults),
+        "recovered": len(matched),
+        "unrecovered": len(faults) - len(matched),
+        "unrecovered_ids": unrecovered_ids,
+        "recovery_events": len(recoveries),
+        "by_site": {k: by_site[k] for k in sorted(by_site)},
+    }
+
+
 def build_report(
     records: List[Dict],
     manifest: Optional[Dict] = None,
@@ -157,6 +232,7 @@ def build_report(
     requests_done = 0
     requests_failed = 0
     windows_total = 0
+    statuses: Dict[str, int] = {}
 
     for rec in records:
         kind = rec.get("type")
@@ -181,6 +257,12 @@ def build_report(
         elif kind == "event":
             event_counts[name] = event_counts.get(name, 0) + 1
             if name == _REQUEST_TERMINAL:
+                status = rec.get("status") or (
+                    "ok" if rec.get("completed", False) else "bad_stream"
+                )
+                statuses[status] = statuses.get(status, 0) + 1
+                if status == "shed":
+                    continue  # shed submits are classified, not served
                 requests_done += 1
                 windows_total += int(rec.get("windows", 0) or 0)
                 if not rec.get("completed", False):
@@ -233,6 +315,7 @@ def build_report(
         "requests": requests_done,
         "completed": requests_done - requests_failed,
         "errors": requests_failed,
+        "statuses": {k: statuses[k] for k in sorted(statuses)},
         "windows": windows_total,
         "preemptions": event_counts.get("serve_preempt", 0),
         "backpressure": counters.get("serve_backpressure", 0.0),
@@ -260,6 +343,7 @@ def build_report(
         "events": {k: event_counts[k] for k in sorted(event_counts)},
         "serving": serving,
         "traces": _trace_completeness(records),
+        "faults": _fault_completeness(records),
     }
 
 

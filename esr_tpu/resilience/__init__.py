@@ -1,0 +1,54 @@
+"""Deterministic fault injection + self-healing recovery (docs/RESILIENCE.md).
+
+Two halves, mirroring the attack/defense split:
+
+- :mod:`esr_tpu.resilience.faults` — a seeded, deterministic fault plane.
+  A :class:`FaultPlan` schedules faults keyed by ``site x index``; call
+  sites in the data loader, trainer, checkpoint commit/restore, and the
+  serving chunk loop carry zero-overhead hooks (one ``None`` check when no
+  plan is installed, no jitted-program changes ever — the hooks are
+  host-side only).
+- :mod:`esr_tpu.resilience.recovery` — the machinery that survives them:
+  trainer anomaly guard + rollback, checkpoint commit retry and
+  restore-time integrity validation with fallback, prefetcher stall
+  watchdog, serving lane quarantine + bounded request retry.
+
+Every injected fault emits a ``fault_injected`` event and every recovery
+action a ``recovery_*`` event through the process-active telemetry sink
+(``esr_tpu.obs``), so ``python -m esr_tpu.obs report`` can assert
+fault -> recovery completeness offline (the ``faults`` report section).
+"""
+
+from esr_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    active_plan,
+    clear_plan,
+    fire,
+    install_plan,
+    installed,
+)
+from esr_tpu.resilience.recovery import (
+    AnomalyGuard,
+    LaneHealth,
+    RollbackSignal,
+    classify_error,
+    emit_recovery,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "active_plan",
+    "clear_plan",
+    "fire",
+    "install_plan",
+    "installed",
+    "AnomalyGuard",
+    "LaneHealth",
+    "RollbackSignal",
+    "classify_error",
+    "emit_recovery",
+]

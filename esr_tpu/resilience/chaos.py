@@ -1,0 +1,416 @@
+"""The scripted chaos scenario: train -> restore -> serve under faults.
+
+One seeded, deterministic end-to-end recovery proof (docs/RESILIENCE.md),
+shared by the tier-1 chaos smoke (``tests/test_chaos_smoke.py``,
+``scripts/chaos_smoke.sh``) and the bench ``chaos_recovery`` stage:
+
+1. **twin train** — a fault-free run on a synthetic corpus (the ground
+   truth trajectory).
+2. **chaos train** — the SAME config and seed under a
+   :class:`~esr_tpu.resilience.faults.FaultPlan` covering the prefetch
+   (stall + corrupt megabatch), train-step (nan loss + dispatch error),
+   and checkpoint-commit (failing attempt) sites. The run must complete,
+   and after rollback/skip accounting its trajectory must REJOIN the
+   twin: the final checkpoint params match within ``1e-5`` rel (they are
+   equal by construction — rollback replays the identical batches) and
+   the per-step loss series agrees on every step both runs recorded.
+3. **restore** — a validated fallback restore with the latest commit's
+   arrays truncated on disk (``ckpt_restore``/``truncate``): the prior
+   commit must load, loudly.
+4. **serve** — a short serving session over the corpus with a lane fault
+   (quarantine + bounded request retry) and a simulated preemption
+   signal (drain + bit-identical resume); every request must terminate
+   with a classified status.
+
+Telemetry: phase 2 writes the chaos run's ``telemetry.jsonl`` (the
+Trainer owns its sink); phases 3–4 share ``serve_telemetry.jsonl``.
+``python -m esr_tpu.obs report`` over each must show
+``faults.unrecovered == 0`` — the standing chaos gate
+(``configs/slo_chaos.yml``).
+
+CLI: ``python -m esr_tpu.resilience.chaos --out DIR [--seed N]`` prints
+the summary JSON and exits 0 iff every acceptance property held.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+# scenario scale (kept tiny: the whole thing must run in a CPU smoke)
+ITERATIONS = 10
+SAVE_PERIOD = 4
+BATCH_SIZE = 8
+CORRUPT_ITER = 5          # after the first committed save (SAVE_PERIOD)
+STALL_ITEM = 1
+COMMIT_FAIL_ITER = 2 * SAVE_PERIOD
+STALL_S = 2.5
+STALL_TIMEOUT_S = 1.0
+
+
+def build_corpus(root: str, n_rec: int = 4, num_frames: int = 12,
+                 resolution: Tuple[int, int] = (64, 64)) -> str:
+    """Synthetic HDF5 recordings + datalist, sized so one epoch covers
+    the whole scenario (fault indices then map 1:1 onto iterations)."""
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    os.makedirs(root, exist_ok=True)
+    paths = []
+    for i in range(n_rec):
+        p = os.path.join(root, f"rec{i}.h5")
+        if not os.path.exists(p):
+            write_synthetic_h5(p, resolution, base_events=2048,
+                               num_frames=num_frames, seed=i)
+        paths.append(p)
+    datalist = os.path.join(root, "datalist.txt")
+    with open(datalist, "w") as f:
+        f.write("\n".join(paths) + "\n")
+    return datalist
+
+
+def dataset_config() -> Dict:
+    return {
+        "scale": 2,
+        "ori_scale": "down4",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 128,
+        "sliding_window": 64,
+        "need_gt_events": True,
+        "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {
+            "sequence_length": 4,
+            "seqn": 3,
+            "step_size": 2,
+            "pause": {"enabled": False},
+        },
+    }
+
+
+def train_config(out_root: str, datalist: str) -> Dict:
+    loader = {
+        "path_to_datalist_txt": datalist,
+        "batch_size": BATCH_SIZE,
+        "shuffle": True,
+        "drop_last": True,
+        "prefetch": 0,
+        "dataset": dataset_config(),
+    }
+    return {
+        "experiment": "chaos",
+        "model": {
+            "name": "DeepRecurrNet",
+            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+        },
+        "optimizer": {
+            "name": "Adam",
+            "args": {"lr": 1e-3, "weight_decay": 1e-4, "amsgrad": True},
+        },
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": out_root,
+            "iteration_based_train": {
+                "enabled": True,
+                "iterations": ITERATIONS,
+                "save_period": SAVE_PERIOD,
+                "train_log_step": 4,
+                "valid_step": 10**9,
+                "lr_change_rate": 4000,
+            },
+            "monitor": "off",
+            "tensorboard": False,
+            "vis": {"enabled": False},
+            "async_checkpoint": True,
+            "k_steps": 1,
+            # the resilience knobs under test (docs/RESILIENCE.md)
+            "max_bad_steps": 1,
+            "max_rollbacks": 2,
+            "dispatch_retries": 1,
+            "commit_retries": 2,
+            "commit_backoff_s": 0.05,
+            "prefetch_stall_timeout_s": STALL_TIMEOUT_S,
+        },
+        "train_dataloader": loader,
+        "valid_dataloader": None,
+    }
+
+
+def build_train_plan(seed: int) -> FaultPlan:
+    """The train-phase schedule: 5 faults over 3 sites. Placement is
+    structural (a corrupt batch must land after the first committed save
+    so rollback has a target; the commit fault must hit a save
+    iteration); the seed picks among the valid slots so the gate does not
+    ossify around one fixed trace."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    nan_iter = int(rng.integers(2, SAVE_PERIOD))          # pre-first-save
+    dispatch_iter = int(rng.integers(0, SAVE_PERIOD - 1))
+    if dispatch_iter == nan_iter:
+        dispatch_iter = nan_iter - 1
+    return FaultPlan([
+        FaultSpec("prefetch", STALL_ITEM, "stall", arg=STALL_S),
+        FaultSpec("prefetch", CORRUPT_ITER, "corrupt"),
+        FaultSpec("train_step", nan_iter, "nan_loss"),
+        FaultSpec("train_step", dispatch_iter, "dispatch_error"),
+        FaultSpec("ckpt_commit", COMMIT_FAIL_ITER, "fail"),
+    ])
+
+
+def build_serve_plan(seed: int) -> FaultPlan:
+    import numpy as np
+
+    rng = np.random.default_rng(seed + 1)
+    preempt_chunk = int(rng.integers(3, 5))
+    return FaultPlan([
+        FaultSpec("ckpt_restore", 0, "truncate"),
+        FaultSpec("serve_chunk", 1, "lane_fault"),
+        FaultSpec("serve_chunk", preempt_chunk, "preempt_signal"),
+    ])
+
+
+def _run_train(config: Dict, runid: str, seed: int,
+               plan: Optional[FaultPlan]) -> Dict:
+    import copy
+
+    from esr_tpu.config.parser import RunConfig
+    from esr_tpu.training.trainer import Trainer
+
+    run = RunConfig(copy.deepcopy(config), runid=runid, seed=seed)
+    trainer = Trainer(run)
+    if len(trainer.train_loader) < ITERATIONS:
+        raise RuntimeError(
+            f"corpus too small: {len(trainer.train_loader)} batches/epoch "
+            f"< {ITERATIONS} iterations (fault indices assume one epoch)"
+        )
+    t0 = time.monotonic()
+    if plan is not None:
+        with installed(plan):
+            result = trainer.train()
+    else:
+        result = trainer.train()
+    wall = time.monotonic() - t0
+    return {
+        "result": {k: round(v, 6) for k, v in result.items()},
+        "wall_s": round(wall, 3),
+        "save_dir": run.save_dir,
+        "telemetry": os.path.join(run.log_dir, "telemetry.jsonl"),
+        "rollbacks": trainer._guard.rollbacks if trainer._guard else 0,
+        "skipped_iterations": (
+            sorted(set(trainer._guard.skipped_iterations))
+            if trainer._guard else []
+        ),
+    }
+
+
+def _loss_series(telemetry_path: str) -> Dict[int, float]:
+    """Last-recorded ``train_loss`` per step — replayed steps overwrite
+    their pre-rollback record, exactly the accounting the parity check
+    needs."""
+    out: Dict[int, float] = {}
+    with open(telemetry_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("type") == "metric"
+                    and str(rec.get("name", "")).startswith("train_loss")
+                    and rec.get("step") is not None):
+                out[int(rec["step"])] = float(rec["value"])
+    return out
+
+
+def _params_max_rel_diff(path_a: str, path_b: str) -> float:
+    import jax
+    import numpy as np
+
+    from esr_tpu.training.checkpoint import load_for_inference
+
+    _, pa, _ = load_for_inference(path_a)
+    _, pb, _ = load_for_inference(path_b)
+    worst = 0.0
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        denom = np.maximum(np.abs(a), 1e-12)
+        worst = max(worst, float(np.max(np.abs(a - b) / denom)))
+    return worst
+
+
+def _run_serve(ckpt_path: str, recordings: List[str], seed: int,
+               plan: FaultPlan) -> Dict:
+    from esr_tpu.serving.server import ServingEngine
+    from esr_tpu.training.checkpoint import load_for_inference
+
+    model, params, _ = load_for_inference(ckpt_path)
+    cfg = dataset_config()
+    cfg["sequence"] = dict(cfg["sequence"], step_size=None)
+    srv = ServingEngine(
+        model, params, cfg, lanes=2, preempt_quantum=0,
+        lane_quarantine_k=1, request_retries=1,
+    )
+    rids = [srv.submit(p) for p in recordings]
+    with installed(plan):
+        summary = srv.run(max_wall_s=120.0)
+    reports = {rid: srv.report(rid) for rid in rids}
+    return {"summary": summary, "reports": reports}
+
+
+def run_scenario(out_dir: str, seed: int = 0) -> Dict:
+    """The whole scripted scenario; returns the machine-checkable summary
+    (every acceptance property precomputed as a boolean)."""
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.obs.report import report_file
+    from esr_tpu.resilience.recovery import restore_with_fallback
+
+    os.makedirs(out_dir, exist_ok=True)
+    datalist = build_corpus(os.path.join(out_dir, "corpus"))
+    config = train_config(out_dir, datalist)
+
+    twin = _run_train(config, "twin", seed, None)
+    train_plan = build_train_plan(seed)
+    chaos = _run_train(config, "chaos", seed, train_plan)
+
+    params_diff = _params_max_rel_diff(
+        os.path.join(twin["save_dir"], f"checkpoint-iteration{ITERATIONS - 1}"),
+        os.path.join(chaos["save_dir"],
+                     f"checkpoint-iteration{ITERATIONS - 1}"),
+    )
+    twin_losses = _loss_series(twin["telemetry"])
+    chaos_losses = _loss_series(chaos["telemetry"])
+    common = sorted(set(twin_losses) & set(chaos_losses))
+    loss_diff = max(
+        (abs(twin_losses[s] - chaos_losses[s])
+         / max(abs(twin_losses[s]), 1e-12) for s in common),
+        default=0.0,
+    )
+
+    # phases 3-4 under one dedicated sink (restore fallback + serving)
+    serve_plan = build_serve_plan(seed)
+    serve_tel = os.path.join(out_dir, "serve_telemetry.jsonl")
+    sink = TelemetrySink(serve_tel)
+    prev = set_active_sink(sink)
+    try:
+        with installed(serve_plan):
+            from esr_tpu.config.build import build_model, build_optimizer
+            from esr_tpu.training.train_step import TrainState
+
+            # template with the trained state's structure, for the
+            # validated restore (shapes only; values are overwritten)
+            import jax
+            import numpy as np
+
+            model = build_model(config["model"])
+            optimizer, _ = build_optimizer(
+                config["optimizer"], config.get("lr_scheduler"), None
+            )
+            x = np.zeros((1, 3, 16, 16, 2), np.float32)
+            params = model.init(
+                jax.random.PRNGKey(0), x, model.init_states(1, 16, 16)
+            )
+            template = TrainState.create(params, optimizer)
+            state, start_iter, _, used_path = restore_with_fallback(
+                chaos["save_dir"], template, config
+            )
+            restore = {
+                "path_used": used_path,
+                "start_iteration": start_iter,
+                "fell_back": used_path is not None and not used_path.endswith(
+                    f"checkpoint-iteration{ITERATIONS - 1}"
+                ),
+            }
+            serve = _run_serve(
+                used_path,
+                [p for p in open(datalist).read().split() if p][:3],
+                seed, serve_plan,
+            )
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+    train_report, _ = report_file(chaos["telemetry"])
+    serve_report, _ = report_file(serve_tel)
+    tf = train_report["report"]["faults"]
+    sf = serve_report["report"]["faults"]
+    statuses = {r["status"] for r in serve["reports"].values()}
+    sites = set(tf["by_site"]) | set(sf["by_site"])
+
+    summary = {
+        "seed": seed,
+        "twin": twin,
+        "chaos": chaos,
+        "restore": restore,
+        "serve": serve,
+        "serve_telemetry": serve_tel,
+        "params_max_rel_diff": params_diff,
+        "loss_series_max_rel_diff": loss_diff,
+        "loss_steps_compared": len(common),
+        "faults": {
+            "injected": tf["injected"] + sf["injected"],
+            "recovered": tf["recovered"] + sf["recovered"],
+            "unrecovered": tf["unrecovered"] + sf["unrecovered"],
+            "sites": sorted(sites),
+            "train": tf,
+            "serve": sf,
+        },
+        "checks": {
+            "params_match": params_diff <= 1e-5,
+            # the skipped (nan_loss) super-step is legitimately absent
+            # from the chaos series; everything else must be present AND
+            # agree — a vacuous 0-step comparison must fail the gate
+            "loss_series_match": (
+                loss_diff <= 1e-5 and len(common) >= ITERATIONS - 2
+            ),
+            "all_faults_recovered": (
+                tf["unrecovered"] == 0 and sf["unrecovered"] == 0
+            ),
+            "enough_faults": tf["injected"] + sf["injected"] >= 5,
+            "enough_sites": len(sites) >= 4,
+            "restore_fell_back": bool(restore["fell_back"]),
+            "statuses_classified": (
+                len(statuses) > 0 and None not in statuses
+            ),
+            "all_requests_terminal": all(
+                r["status"] is not None
+                for r in serve["reports"].values()
+            ),
+        },
+    }
+    summary["ok"] = all(summary["checks"].values())
+    summary["recovery_overhead_frac"] = round(
+        chaos["wall_s"] / max(twin["wall_s"], 1e-9) - 1.0, 4
+    )
+    return summary
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="scripted chaos scenario (docs/RESILIENCE.md)"
+    )
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    summary = run_scenario(args.out, seed=args.seed)
+    with open(os.path.join(args.out, "CHAOS_SUMMARY.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    print(json.dumps(
+        {"ok": summary["ok"], "checks": summary["checks"],
+         "faults": {k: summary["faults"][k]
+                    for k in ("injected", "recovered", "unrecovered",
+                              "sites")},
+         "params_max_rel_diff": summary["params_max_rel_diff"],
+         "recovery_overhead_frac": summary["recovery_overhead_frac"]},
+    ))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,300 @@
+"""The deterministic fault-injection plane (docs/RESILIENCE.md).
+
+A :class:`FaultPlan` is a seeded schedule of :class:`FaultSpec`s keyed by
+``site x index`` — *which* failure, *where*, at *which* step/chunk/save
+ordinal. Production code carries :func:`fire` hooks at the injection
+sites; with no plan installed a hook is one module-global ``None`` check
+(measured < 100 ns — the zero-cost-when-disabled contract, pinned by
+``tests/test_resilience.py``), and the hooks never enter jitted code, so
+the jaxpr audit and the compiled programs are byte-identical with or
+without the subsystem (``program_audit`` stays CLEAN).
+
+Sites and kinds (the catalog; docs/RESILIENCE.md has the full table):
+
+====================  =====================================================
+site                  kinds
+====================  =====================================================
+``prefetch``          ``corrupt`` (NaN-poison a host megabatch before
+                      staging), ``stall`` (sleep the producer ``arg``
+                      seconds — exercises the stall watchdog)
+``train_step``        ``nan_loss`` (force the super-step's readback loss
+                      to NaN — exercises the anomaly guard),
+                      ``dispatch_error`` (simulated transient
+                      ``XlaRuntimeError`` at dispatch — exercises the
+                      bounded dispatch retry)
+``ckpt_commit``       ``fail`` (commit attempt raises — exercises the
+                      backoff retry), ``torn`` (raise between the Orbax
+                      array write and the ``meta.yml`` marker — a torn
+                      directory the next attempt overwrites)
+``ckpt_restore``      ``truncate`` (truncate the largest array file of the
+                      checkpoint about to be restored — exercises the
+                      integrity fallback to the prior commit)
+``serve_chunk``       ``lane_fault`` (a bound lane's pull raises),
+                      ``stream_error`` (the stream iterator raises
+                      mid-iteration), ``preempt_signal`` (simulated host
+                      preemption — every bound lane is drained/saved and
+                      requeued)
+====================  =====================================================
+
+Everything here is stdlib+numpy only: the data layer imports this module
+(analysis rule ESR004 — no jax below the loader), and the plan must be
+installable in processes that never touch an accelerator.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SITES = ("prefetch", "train_step", "ckpt_commit", "ckpt_restore",
+         "serve_chunk")
+
+_KINDS: Dict[str, Tuple[str, ...]] = {
+    "prefetch": ("corrupt", "stall"),
+    "train_step": ("nan_loss", "dispatch_error"),
+    "ckpt_commit": ("fail", "torn"),
+    "ckpt_restore": ("truncate",),
+    "serve_chunk": ("lane_fault", "stream_error", "preempt_signal"),
+}
+
+
+class InjectedFault(RuntimeError):
+    """An error raised *by* the fault plane at an injection site.
+
+    ``transient=True`` marks faults the matching recovery path is allowed
+    to retry (a simulated dispatch ``XlaRuntimeError``, a failing commit
+    attempt); the recovery machinery treats it exactly like the real error
+    class it stands in for."""
+
+    def __init__(self, spec: "FaultSpec", transient: bool = True):
+        super().__init__(
+            f"injected fault {spec.fault_id} "
+            f"(site={spec.site}, kind={spec.kind}, index={spec.index})"
+        )
+        self.spec = spec
+        self.transient = transient
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: fire ``kind`` at ``site`` when that site's
+    ordinal counter reaches ``index``. ``arg`` is the kind-specific knob
+    (stall seconds, target lane); ``fault_id`` is stamped at plan build
+    time and rides every telemetry record the fault causes."""
+
+    site: str
+    index: int
+    kind: str
+    arg: float = 0.0
+    fault_id: str = ""
+
+    def __post_init__(self):
+        if self.site not in _KINDS:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"sites: {sorted(_KINDS)}")
+        if self.kind not in _KINDS[self.site]:
+            raise ValueError(
+                f"unknown kind {self.kind!r} for site {self.site!r}; "
+                f"kinds: {_KINDS[self.site]}"
+            )
+
+
+class FaultPlan:
+    """A deterministic schedule of faults, consumed one ``(site, index)``
+    lookup at a time.
+
+    The plan is *explicit* (a list of specs) or *seeded*
+    (:meth:`seeded` derives a reproducible schedule from an integer seed).
+    Each spec fires at most once — :func:`fire` pops it — and every firing
+    is appended to :attr:`injected` (the host-side ledger the chaos bench
+    cross-checks against the telemetry stream). Thread-safe: the
+    prefetcher producer, the checkpoint writer, and the main loop all
+    consult the same installed plan.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()):
+        self._lock = threading.Lock()
+        self._pending: Dict[Tuple[str, int], List[FaultSpec]] = {}
+        self.injected: List[FaultSpec] = []
+        self._n = 0
+        for spec in specs:
+            self.add(spec)
+
+    # -- construction --------------------------------------------------------
+
+    def add(self, spec: FaultSpec) -> FaultSpec:
+        if not spec.fault_id:
+            spec = FaultSpec(
+                spec.site, spec.index, spec.kind, spec.arg,
+                fault_id=f"{spec.site}:{spec.index}:{spec.kind}:{self._n}",
+            )
+        self._n += 1
+        self._pending.setdefault((spec.site, spec.index), []).append(spec)
+        return spec
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        n_faults: int = 5,
+        sites: Sequence[str] = SITES,
+        max_index: int = 8,
+        stall_s: float = 0.25,
+    ) -> "FaultPlan":
+        """A reproducible random schedule: ``n_faults`` faults dealt
+        round-robin over ``sites`` (so a small plan still covers many
+        distinct sites), kinds and indices drawn from a seeded generator.
+        Same seed -> identical plan, process- and platform-independent."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        plan = cls()
+        for i in range(int(n_faults)):
+            site = sites[i % len(sites)]
+            kind = _KINDS[site][int(rng.integers(len(_KINDS[site])))]
+            index = int(rng.integers(max_index))
+            arg = stall_s if kind == "stall" else 0.0
+            plan.add(FaultSpec(site, index, kind, arg))
+        return plan
+
+    # -- consumption ---------------------------------------------------------
+
+    def pop(self, site: str, index: int) -> List[FaultSpec]:
+        """The specs scheduled at ``(site, index)``, consumed (each spec
+        fires exactly once)."""
+        with self._lock:
+            specs = self._pending.pop((site, int(index)), [])
+            self.injected.extend(specs)
+            return specs
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._pending.values())
+
+    def summary(self) -> Dict:
+        with self._lock:
+            return {
+                "injected": len(self.injected),
+                "pending": sum(len(v) for v in self._pending.values()),
+                "by_site": _count_by(self.injected, "site"),
+                "by_kind": _count_by(self.injected, "kind"),
+            }
+
+
+def _count_by(specs: Sequence[FaultSpec], attr: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for s in specs:
+        k = getattr(s, attr)
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# process-global plan registry — the exact pattern of obs.set_active_sink:
+# None (the default) makes every hook a single attribute check, and
+# installation is strictly explicit (chaos bench, chaos smoke, tests).
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``plan`` process-wide; returns the previous plan (restore
+    it to scope installation, e.g. in tests)."""
+    global _PLAN
+    prev = _PLAN
+    _PLAN = plan
+    return prev
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+@contextlib.contextmanager
+def installed(plan: FaultPlan):
+    """Scope a plan installation (the chaos harness / test idiom)."""
+    prev = install_plan(plan)
+    try:
+        yield plan
+    finally:
+        install_plan(prev)
+
+
+def fire(site: str, index: int, **ctx) -> Tuple[FaultSpec, ...]:
+    """THE hook production call sites embed: the faults scheduled at
+    ``(site, index)``, consumed, each announced as a ``fault_injected``
+    telemetry event (site, kind, index, fault_id + caller context).
+
+    With no installed plan this is one global ``None`` check returning a
+    shared empty tuple — the zero-cost-when-disabled contract. The caller
+    owns *enacting* each returned spec (corrupting its batch, raising,
+    sleeping): the plane schedules and records, the site executes.
+    """
+    if _PLAN is None:
+        return ()
+    specs = _PLAN.pop(site, index)
+    if not specs:
+        return ()
+    from esr_tpu.obs import active_sink
+
+    sink = active_sink()
+    if sink is not None:
+        for spec in specs:
+            sink.event(
+                "fault_injected", site=spec.site, kind=spec.kind,
+                index=spec.index, fault_id=spec.fault_id, **ctx,
+            )
+    return tuple(specs)
+
+
+# -- kind helpers (site-side actions kept next to their schedule) -----------
+
+
+def corrupt_batch(batch, fraction: float = 0.25):
+    """NaN-poison a host batch dict in place (numpy only): the leading
+    ``fraction`` of every float array is set to NaN — the torn-DMA /
+    bad-shard stand-in. Returns the same dict for call-site chaining."""
+    import numpy as np
+
+    for key, arr in batch.items():
+        arr = np.asarray(arr)
+        if not np.issubdtype(arr.dtype, np.floating) or arr.size == 0:
+            continue
+        # .flat writes through for ANY layout — reshape(-1) on a
+        # non-contiguous array returns a copy and the poison would
+        # silently miss the batch
+        arr.flat[: max(1, int(arr.size * fraction))] = np.nan
+        batch[key] = arr
+    return batch
+
+
+def truncate_checkpoint_arrays(path: str) -> Optional[str]:
+    """Truncate the largest file under ``<path>/state`` to half its size —
+    a real on-disk corruption (the ``ckpt_restore``/``truncate`` kind), so
+    the restore-integrity machinery is tested against genuine torn bytes,
+    not a mock. Returns the truncated file's path (None when nothing to
+    truncate)."""
+    import os
+
+    state = os.path.join(path, "state")
+    largest, size = None, -1
+    for dirpath, _, filenames in os.walk(state):
+        for name in filenames:
+            p = os.path.join(dirpath, name)
+            try:
+                s = os.path.getsize(p)
+            except OSError:
+                continue
+            if s > size:
+                largest, size = p, s
+    if largest is None or size <= 0:
+        return None
+    with open(largest, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    return largest

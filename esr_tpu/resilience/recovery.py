@@ -1,0 +1,369 @@
+"""Self-healing recovery machinery (docs/RESILIENCE.md).
+
+The defense half of ``esr_tpu.resilience``: every component here answers
+one fault site of :mod:`esr_tpu.resilience.faults` and emits a paired
+``recovery_*`` telemetry event (same ``site`` field, ``fault_id`` when the
+causing fault is known) so ``python -m esr_tpu.obs report`` can prove
+fault -> recovery completeness offline:
+
+- :class:`AnomalyGuard` — per-super-step finite-loss check at the
+  trainer's existing cadence-gated readback: a non-finite loss is skipped
+  and logged (``recovery_skip_step``) up to ``trainer.max_bad_steps``
+  consecutive bad super-steps, then :class:`RollbackSignal` sends the
+  trainer back to the last *valid* committed checkpoint
+  (``recovery_rollback``) with a deterministic data fast-forward.
+- :func:`retry_with_backoff` — bounded exponential-backoff retry shared
+  by the checkpoint commit (``recovery_ckpt_retry``) and the train-step
+  dispatch (``recovery_dispatch_retry``).
+- checkpoint integrity: :func:`state_digest` (sha256 over the host state
+  pytree) is written as a ``digest.json`` sidecar at save;
+  :func:`validate_restored` recomputes it at restore (+ a finiteness
+  sweep — a committed-but-poisoned checkpoint must never be a rollback
+  target); :func:`restore_with_fallback` walks committed checkpoints
+  newest-first and falls back LOUDLY (``recovery_restore_fallback``) past
+  corrupted ones.
+- :class:`LaneHealth` — the serving circuit breaker's ledger: per-lane
+  fault counts feeding the quarantine decision
+  (``serving.lane_quarantine_k``) in ``serving/server.py``.
+
+Module-level imports are stdlib+numpy only (the data layer's
+``DevicePrefetcher`` imports :func:`emit_recovery`); jax/checkpoint
+machinery is imported lazily inside the functions that need it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from esr_tpu.resilience.faults import InjectedFault
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + classification
+
+
+def emit_recovery(name: str, site: str, fault_id: Optional[str] = None,
+                  **fields) -> None:
+    """Emit one ``recovery_*`` event through the process-active sink
+    (no-op without one) — the telemetry half of every recovery action.
+    ``site`` must name the fault site being answered; the offline
+    completeness check matches on it."""
+    if not name.startswith("recovery_"):
+        raise ValueError(f"recovery event name must start with "
+                         f"'recovery_', got {name!r}")
+    from esr_tpu.obs import active_sink
+
+    sink = active_sink()
+    if sink is not None:
+        sink.event(name, site=site, fault_id=fault_id, **fields)
+
+
+def classify_error(e: BaseException) -> str:
+    """Map an exception to a small, stable error taxonomy — the
+    ``error_kind`` field of per-request serving reports and
+    ``serve_request_done`` events (docs/SERVING.md):
+
+    ``injected`` (the fault plane), ``io`` (filesystem/stream I/O),
+    ``bad_input`` (malformed request/recording), ``runtime`` (accelerator
+    runtime error), ``internal`` (everything else)."""
+    if isinstance(e, InjectedFault):
+        return "injected"
+    if isinstance(e, (FileNotFoundError, PermissionError, OSError, EOFError)):
+        return "io"
+    if isinstance(e, (ValueError, KeyError)):
+        return "bad_input"
+    text = f"{type(e).__name__}: {e}"
+    if "XlaRuntimeError" in text or "RESOURCE_EXHAUSTED" in text or (
+            "UNAVAILABLE" in text):
+        return "runtime"
+    return "internal"
+
+
+def fault_id_of(e: BaseException) -> Optional[str]:
+    """The causing fault's id when ``e`` came from the fault plane."""
+    spec = getattr(e, "spec", None)
+    return getattr(spec, "fault_id", None)
+
+
+# ---------------------------------------------------------------------------
+# bounded retry (checkpoint commit, train-step dispatch)
+
+
+def retry_with_backoff(
+    fn: Callable,
+    retries: int,
+    backoff_s: float,
+    site: str,
+    event: str,
+    sleep=time.sleep,
+    **fields,
+):
+    """Run ``fn()`` with up to ``retries`` retries under exponential
+    backoff (``backoff_s * 2**attempt``). Every retried failure emits
+    ``event`` (a ``recovery_*`` name) with the attempt ordinal and the
+    classified error; the final failure re-raises untouched — bounded
+    recovery never silently converts a persistent fault into a hang or a
+    swallow."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - re-raised when exhausted
+            attempt += 1
+            if attempt > retries:
+                raise
+            emit_recovery(
+                event, site=site, fault_id=fault_id_of(e),
+                attempt=attempt, retries=retries,
+                error_kind=classify_error(e), error=repr(e), **fields,
+            )
+            logger.warning(
+                "%s: attempt %d/%d failed (%r); retrying in %.3fs",
+                site, attempt, retries, e, backoff_s * (2 ** (attempt - 1)),
+            )
+            sleep(backoff_s * (2 ** (attempt - 1)))
+
+
+# ---------------------------------------------------------------------------
+# trainer anomaly guard
+
+
+class RollbackSignal(Exception):
+    """Raised by :class:`AnomalyGuard` when the bad-step budget is
+    exhausted; the trainer's loop catches it, restores the last valid
+    committed checkpoint, and fast-forwards the data stream."""
+
+    def __init__(self, at_iteration: int, bad_steps: int,
+                 fault_id: Optional[str] = None):
+        super().__init__(
+            f"{bad_steps} consecutive non-finite super-steps "
+            f"(last at iteration {at_iteration}); rolling back"
+        )
+        self.at_iteration = int(at_iteration)
+        self.bad_steps = int(bad_steps)
+        self.fault_id = fault_id
+
+
+class AnomalyGuard:
+    """Per-super-step finite-loss sentry for the training loop.
+
+    :meth:`check` is called at the trainer's EXISTING cadence-gated metric
+    readback (no new host syncs) with the super-step's host loss scalars.
+    Finite losses reset the consecutive-bad counter. A non-finite loss:
+
+    - emits ``recovery_skip_step`` and returns False (the caller must
+      exclude the super-step from metric trackers/writer — *skip-and-log*);
+    - after ``max_bad_steps`` consecutive bad super-steps, raises
+      :class:`RollbackSignal` instead (the caller rolls back to the last
+      valid committed checkpoint and replays — *self-heal*).
+
+    ``max_bad_steps=0`` rolls back on the first bad super-step.
+    """
+
+    def __init__(self, max_bad_steps: int = 2):
+        if max_bad_steps < 0:
+            raise ValueError(
+                f"max_bad_steps must be >= 0, got {max_bad_steps}"
+            )
+        self.max_bad_steps = int(max_bad_steps)
+        self.consecutive_bad = 0
+        self.skipped_iterations: List[int] = []
+        self.rollbacks = 0
+
+    def check(
+        self,
+        losses: List[float],
+        first_iteration: int,
+        fault_id: Optional[str] = None,
+    ) -> bool:
+        """True when every loss is finite (metrics may be recorded)."""
+        import math
+
+        if all(math.isfinite(v) for v in losses):
+            self.consecutive_bad = 0
+            return True
+        self.consecutive_bad += 1
+        covered = list(range(first_iteration, first_iteration + len(losses)))
+        self.skipped_iterations.extend(covered)
+        if self.consecutive_bad > self.max_bad_steps:
+            self.rollbacks += 1
+            raise RollbackSignal(
+                first_iteration, self.consecutive_bad, fault_id=fault_id
+            )
+        emit_recovery(
+            "recovery_skip_step", site="train_step", fault_id=fault_id,
+            iteration=first_iteration, iterations=covered,
+            consecutive_bad=self.consecutive_bad,
+            budget=self.max_bad_steps,
+        )
+        logger.warning(
+            "non-finite loss at super-step %d (losses=%s); skipped "
+            "(%d/%d bad before rollback)",
+            first_iteration, losses, self.consecutive_bad,
+            self.max_bad_steps,
+        )
+        return False
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: digest sidecar + validated fallback restore
+
+DIGEST_SIDECAR = "digest.json"
+
+
+def state_digest(host_state) -> str:
+    """sha256 over the host state pytree: every leaf's key path, shape,
+    dtype, and raw bytes, in deterministic tree order. Computed on the
+    SAME host snapshot the commit writes, so a byte-level mismatch at
+    restore means the artifact (not the digest) changed."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    leaves, _ = jax.tree_util.tree_flatten_with_path(host_state)
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def write_digest(path: str, digest: str) -> None:
+    """Write the ``digest.json`` sidecar (temp-then-rename, like the
+    ``meta.yml`` commit marker it rides next to)."""
+    import json
+    import os
+
+    sidecar = os.path.join(path, DIGEST_SIDECAR)
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"algo": "sha256", "digest": digest}, f)
+    os.replace(tmp, sidecar)
+
+
+def read_digest(path: str) -> Optional[str]:
+    import json
+    import os
+
+    try:
+        with open(os.path.join(path, DIGEST_SIDECAR)) as f:
+            return json.load(f)["digest"]
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def validate_restored(path: str, restored) -> Tuple[bool, str]:
+    """Restore-time integrity verdict for a just-restored state pytree:
+
+    - when a ``digest.json`` sidecar exists, the recomputed digest must
+      match byte-for-byte (catches truncation/corruption Orbax silently
+      tolerates);
+    - every leaf must be finite (a committed checkpoint of a poisoned run
+      must never become a rollback target).
+
+    Returns ``(ok, reason)``; pre-sidecar checkpoints (older PRs) skip the
+    digest half but still get the finiteness sweep."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree.leaves(restored):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(
+                arr).all():
+            return False, "non-finite leaf values"
+    want = read_digest(path)
+    if want is not None:
+        got = state_digest(restored)
+        if got != want:
+            return False, f"digest mismatch (sidecar {want[:12]}…, " \
+                          f"restored {got[:12]}…)"
+    return True, "ok"
+
+
+def restore_with_fallback(
+    root: str,
+    template,
+    config: Dict,
+    reset: bool = False,
+):
+    """Validated resume over EVERY committed checkpoint under ``root``,
+    newest-first: the ``ckpt_restore`` fault site fires before the first
+    attempt (a ``truncate`` spec corrupts the candidate on disk — real
+    bytes, not a mock), and any candidate that fails to restore or fails
+    :func:`validate_restored` is skipped with a loud warning and a
+    ``recovery_restore_fallback`` event. Returns
+    ``(state, start_iteration, monitor_best, path)`` — ``path`` None when
+    no valid checkpoint exists (fresh start)."""
+    from esr_tpu.resilience import faults
+    from esr_tpu.training.checkpoint import (
+        find_committed_checkpoints,
+        resume_checkpoint,
+        restore_state,
+    )
+
+    candidates = find_committed_checkpoints(root)
+    for attempt, path in enumerate(candidates):
+        for spec in faults.fire("ckpt_restore", attempt, path=path):
+            if spec.kind == "truncate":
+                faults.truncate_checkpoint_arrays(path)
+        try:
+            restored = restore_state(path, template)
+            ok, reason = validate_restored(path, restored)
+        except Exception as e:  # noqa: BLE001 - corrupted artifact: fall back
+            ok, reason = False, repr(e)
+            logger.warning(
+                "checkpoint %s failed to restore (%r); trying the "
+                "previous commit", path, e,
+            )
+        if ok:
+            # hand the just-validated pytree through so the checkpoint is
+            # not read from disk a second time
+            state, start, best = resume_checkpoint(
+                path, template, config, reset=reset, restored=restored
+            )
+            return state, start, best, path
+        logger.error(
+            "checkpoint %s failed restore-time integrity validation "
+            "(%s); falling back to the previous commit", path, reason,
+        )
+        emit_recovery(
+            "recovery_restore_fallback", site="ckpt_restore",
+            path=path, reason=reason, attempt=attempt,
+            remaining=len(candidates) - attempt - 1,
+        )
+    return template, 0, None, None
+
+
+# ---------------------------------------------------------------------------
+# serving circuit breaker ledger
+
+
+class LaneHealth:
+    """Per-lane fault accounting for the serving tier's circuit breaker.
+
+    A lane accumulating ``quarantine_k`` faults should be drained and
+    quarantined (``LaneScheduler.quarantine``); the decision itself lives
+    in ``serving/server.py`` — this class is the pure, unit-testable
+    ledger."""
+
+    def __init__(self, quarantine_k: int = 3):
+        if quarantine_k < 1:
+            raise ValueError(
+                f"quarantine_k must be >= 1, got {quarantine_k}"
+            )
+        self.quarantine_k = int(quarantine_k)
+        self.faults: Dict[int, int] = {}
+
+    def record(self, lane: int) -> int:
+        self.faults[lane] = self.faults.get(lane, 0) + 1
+        return self.faults[lane]
+
+    def should_quarantine(self, lane: int) -> bool:
+        return self.faults.get(lane, 0) >= self.quarantine_k

@@ -127,6 +127,16 @@ class StreamRequest:
     error: Optional[str] = None
     window_latencies: List[float] = field(default_factory=list)
 
+    # resilience (docs/RESILIENCE.md): terminal classification + the
+    # bounded-retry ledger. ``status`` is pinned to the taxonomy in
+    # ``serving/server.py`` (ok / shed / bad_stream / faulted /
+    # quarantine_exhausted); ``error_kind`` is
+    # ``resilience.recovery.classify_error``'s verdict on the terminal
+    # exception; ``retries`` counts fault-triggered re-admissions.
+    status: Optional[str] = None
+    error_kind: Optional[str] = None
+    retries: int = 0
+
     @property
     def resumable(self) -> bool:
         return self.saved_state is not None
@@ -164,6 +174,10 @@ class LaneScheduler:
         self._ids = itertools.count()
         self.rejected = 0
         self.completed: List[StreamRequest] = []
+        # circuit-broken lanes (docs/RESILIENCE.md): a quarantined lane is
+        # never offered by bind_free_lanes until the session ends — the
+        # server's LaneHealth ledger decides WHEN (serving.lane_quarantine_k)
+        self.quarantined: set = set()
 
     # -- admission -----------------------------------------------------------
 
@@ -195,7 +209,8 @@ class LaneScheduler:
         state and emits the ``serve_admit`` span per binding)."""
         out = []
         for lane in range(self.num_lanes):
-            if self.lanes[lane] is not None or not self._queue:
+            if (self.lanes[lane] is not None or lane in self.quarantined
+                    or not self._queue):
                 continue
             req = self._queue.popleft()
             self.lanes[lane] = req
@@ -213,6 +228,30 @@ class LaneScheduler:
                 req.completed_t = completed_t
             self.completed.append(req)
         self.lanes[lane] = None
+
+    def unbind(self, lane: int) -> Optional[StreamRequest]:
+        """Clear a faulted lane WITHOUT completing its request — the
+        retry path (the server re-admits the request after resetting its
+        stream). Returns the unbound request."""
+        req = self.lanes[lane]
+        self.lanes[lane] = None
+        return req
+
+    def quarantine(self, lane: int) -> None:
+        """Circuit-break a lane: it must be empty (drained first) and is
+        excluded from every future bind. The last healthy lane can never
+        be quarantined — a session with zero bindable lanes could neither
+        drain its queue nor fail its requests loudly."""
+        assert self.lanes[lane] is None, f"quarantine of bound lane {lane}"
+        if self.healthy_lanes() <= 1:
+            raise ValueError(
+                f"refusing to quarantine lane {lane}: it is the last "
+                "healthy lane (circuit breaker saturated)"
+            )
+        self.quarantined.add(lane)
+
+    def healthy_lanes(self) -> int:
+        return self.num_lanes - len(self.quarantined)
 
     # -- preemption ----------------------------------------------------------
 

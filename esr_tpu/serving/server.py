@@ -66,6 +66,13 @@ from esr_tpu.inference.engine import (
     make_chunk_fn,
 )
 from esr_tpu.obs import active_sink, trace
+from esr_tpu.resilience import faults as _faults
+from esr_tpu.resilience.recovery import (
+    LaneHealth,
+    classify_error,
+    emit_recovery,
+    fault_id_of,
+)
 from esr_tpu.serving.scheduler import (
     DEFAULT_CLASSES,
     AdmissionFull,
@@ -151,6 +158,8 @@ class ServingEngine:
         max_pending: int = 64,
         preempt_quantum: int = 4,
         aot_programs: Optional[Dict[int, str]] = None,
+        lane_quarantine_k: int = 3,
+        request_retries: int = 1,
     ):
         self.model = model
         self.params = params
@@ -175,6 +184,17 @@ class ServingEngine:
         self.scheduler = LaneScheduler(
             lanes, max_pending=max_pending, preempt_quantum=preempt_quantum
         )
+        # circuit breaker + bounded retry (docs/RESILIENCE.md): a lane
+        # that faults lane_quarantine_k times is drained and quarantined;
+        # a request whose lane faults is re-admitted (stream restarted,
+        # accumulators reset) at most request_retries times, then fails
+        # loudly with a classified status in its report
+        self._lane_health = LaneHealth(lane_quarantine_k)
+        self.request_retries = int(request_retries)
+        if self.request_retries < 0:
+            raise ValueError(
+                f"request_retries must be >= 0, got {self.request_retries}"
+            )
         self._aot_paths = dict(aot_programs or {})
         self._programs: Dict[int, object] = {}
         self._requests: Dict[str, StreamRequest] = {}
@@ -306,6 +326,17 @@ class ServingEngine:
                     "serve_backpressure",
                     queue_depth=self.scheduler.queue_depth(),
                 )
+                # a shed submit still terminates with a CLASSIFIED status
+                # offline (docs/SERVING.md status taxonomy): no journey
+                # ever existed, so the completeness walker skips status
+                # "shed" instead of expecting a root span
+                sink.event(
+                    "serve_request_done", request=rid,
+                    trace_id=req.trace_id, cls=req.cls.name,
+                    windows=0, preemptions=0, completed=False,
+                    error="AdmissionFull", status="shed",
+                    error_kind="backpressure",
+                )
             raise
         self._requests[rid] = req
         self._acc[rid] = {
@@ -332,10 +363,20 @@ class ServingEngine:
                             f"{req.source.gt_resolution} does not match "
                             f"the serving pack's {self._resolutions}"
                         )
-                except Exception as e:  # noqa: BLE001 - a bad stream must
-                    # fail ITS request, never the serving loop
+                except Exception as e:  # noqa: BLE001
+                    # a bad stream must fail ITS request, never the
+                    # serving loop — typed capture (docs/RESILIENCE.md):
+                    # the terminal report/event carries the classified
+                    # error_kind + status "bad_stream" so shed / bad
+                    # stream / faulted are distinguishable offline
                     req.error = repr(e)
+                    req.error_kind = classify_error(e)
+                    req.status = "bad_stream"
                     req.ended = True
+                    logger.warning(
+                        "request %s failed at bind (lane %d): %r "
+                        "[%s]", req.request_id, lane, e, req.error_kind,
+                    )
                     self.scheduler.release(lane, completed_t=self._now())
                     self._finish(req)
                     continue
@@ -372,6 +413,11 @@ class ServingEngine:
         sink = active_sink()
         if req.completed_t is None:
             req.completed_t = self._now()
+        # terminal classification (docs/SERVING.md): ok / shed /
+        # bad_stream / faulted / quarantine_exhausted — pinned by
+        # tests/test_serving.py and consumed offline by obs report
+        if req.status is None:
+            req.status = "ok" if req.error is None else "bad_stream"
         if sink is not None:
             mono = time.monotonic()
             # the trace ROOT: one `serve_request` span covering submit ->
@@ -399,7 +445,106 @@ class ServingEngine:
                 cls=req.cls.name, windows=req.windows_done,
                 preemptions=req.preemptions,
                 completed=req.error is None, error=req.error,
+                status=req.status, error_kind=req.error_kind,
+                retries=req.retries,
             )
+
+    def _preempt_drain(self, spec) -> None:
+        """Simulated host preemption (``serve_chunk``/``preempt_signal``):
+        every bound lane's recurrent state is extracted and its request
+        requeued with the saved state + window position — the EXISTING
+        eviction machinery, so every stream resumes bit-identically once
+        lanes rebind."""
+        sched = self.scheduler
+        sink = active_sink()
+        drained = 0
+        for lane in range(self.lanes):
+            req = sched.lanes[lane]
+            if req is None:
+                continue
+            req.saved_state = extract_lane_state(self._states, lane)
+            sched.evict(lane)
+            drained += 1
+            if sink is not None:
+                sink.event(
+                    "serve_preempt", request=req.request_id,
+                    trace_id=req.trace_id, parent_id=req.root_span_id,
+                    cls=req.cls.name, lane=lane,
+                    windows_done=req.windows_done,
+                    queue_depth=sched.queue_depth(),
+                    signal=True,
+                )
+        emit_recovery(
+            "recovery_preempt_drain", site="serve_chunk",
+            fault_id=spec.fault_id, lanes_drained=drained,
+            chunk=self._chunk_idx,
+        )
+        logger.warning(
+            "preemption signal: drained %d lanes (states saved, requests "
+            "requeued)", drained,
+        )
+
+    def _lane_fault(self, lane: int, req: StreamRequest,
+                    e: BaseException) -> None:
+        """Typed capture of a lane fault mid-chunk-loop: record it on the
+        lane's health ledger (quarantine at ``lane_quarantine_k``), then
+        either re-admit the request (stream restarted from window 0,
+        accumulators reset — at most ``request_retries`` times) or fail it
+        loudly with a classified status."""
+        kind = classify_error(e)
+        fid = fault_id_of(e)
+        n = self._lane_health.record(lane)
+        sched = self.scheduler
+        sched.unbind(lane)
+        logger.warning(
+            "lane %d faulted serving %s (fault %d on this lane): %r [%s]",
+            lane, req.request_id, n, e, kind,
+        )
+        if (self._lane_health.should_quarantine(lane)
+                and lane not in sched.quarantined):
+            try:
+                sched.quarantine(lane)
+                emit_recovery(
+                    "recovery_lane_quarantine", site="serve_chunk",
+                    fault_id=fid, lane=lane, faults=n,
+                    healthy_lanes=sched.healthy_lanes(),
+                )
+            except ValueError:
+                logger.error(
+                    "circuit breaker saturated: lane %d kept in service "
+                    "(last healthy lane)", lane,
+                )
+        if req.retries < self.request_retries:
+            req.retries += 1
+            req.source = None
+            req.peek = None
+            req.saved_state = None
+            req.ended = False
+            req.windows_done = 0
+            req.chunks_since_bind = 0
+            req.window_latencies = []
+            self._acc[req.request_id] = {
+                "sums": {k: 0.0 for k in METRIC_KEYS}, "count": 0,
+            }
+            emit_recovery(
+                "recovery_request_retry", site="serve_chunk",
+                fault_id=fid, request=req.request_id,
+                attempt=req.retries, retries=self.request_retries,
+                lane=lane, error_kind=kind,
+            )
+            sched.requeue(req)
+            return
+        req.error = repr(e)
+        req.error_kind = kind
+        req.status = (
+            "quarantine_exhausted" if lane in sched.quarantined
+            else "faulted"
+        )
+        req.ended = True
+        req.completed_t = self._now()
+        sched.completed.append(req)
+        if req.inflight == 0:
+            self._finish(req)
 
     def _pull(self, req: StreamRequest, w: int) -> List[tuple]:
         """Up to ``w`` windows from a lane's stream, with the engine's
@@ -456,6 +601,22 @@ class ServingEngine:
             # binds the rest — the queue only ever shrinks on this path
             return "idle"
 
+        # serve_chunk fault site (docs/RESILIENCE.md), keyed by chunk
+        # index — fired only AFTER the occupancy early-returns, so a
+        # scheduled fault is never consumed by an idle/drained polling
+        # round where no bound lane exists to enact it:
+        # lane_fault/stream_error raise inside one bound lane's pull
+        # below (typed capture -> quarantine/retry); preempt_signal
+        # simulates a host preemption — every bound lane is drained with
+        # its state saved and requeued, resuming bit-identically
+        _specs = _faults.fire("serve_chunk", self._chunk_idx)
+        _lane_faults = [
+            s for s in _specs if s.kind in ("lane_fault", "stream_error")
+        ]
+        for s in _specs:
+            if s.kind == "preempt_signal":
+                self._preempt_drain(s)
+
         w = sched.chunk_windows(default=self.default_chunk_windows)
         program = self._program(w)
         # one clock for everything chunk-scoped (latency math AND the v2
@@ -473,10 +634,27 @@ class ServingEngine:
             req = sched.lanes[lane]
             if req is None:
                 continue
-            wins = self._pull(req, w)
+            try:
+                if _lane_faults:
+                    # enact one scheduled lane fault on this bound lane
+                    raise _faults.InjectedFault(_lane_faults.pop(0))
+                wins = self._pull(req, w)
+            except Exception as e:  # esr: noqa(ESR012)
+                # a faulting lane/stream fails (or retries) ITS request,
+                # never the serving loop: _lane_fault is the loud typed
+                # capture (warning log + recovery_* events + classified
+                # terminal status) + circuit breaker
+                self._lane_fault(lane, req, e)
+                continue
             per_lane[lane] = wins
             if wins:
-                meta[lane] = {"request": req, "windows": len(wins)}
+                meta[lane] = {
+                    "request": req, "windows": len(wins),
+                    # retry epoch at dispatch time: a participation from
+                    # before a retry is STALE at resolve (the accumulators
+                    # were reset) and must not fold into the fresh run
+                    "retries": req.retries,
+                }
                 # continuing lanes keep state; fresh binds are zeroed
                 reset_keep[lane] = 0.0 if lane in self._fresh_lanes else 1.0
 
@@ -575,6 +753,15 @@ class ServingEngine:
             if m is None:
                 continue
             req: StreamRequest = m["request"]
+            if m.get("retries", 0) != req.retries:
+                # stale participation: the request was retried after this
+                # chunk dispatched — its fresh run's accumulators must not
+                # absorb the failed run's sums; only settle the inflight
+                # accounting (and the terminal event it may gate)
+                req.inflight -= 1
+                if req.ended and req.inflight == 0:
+                    self._finish(req)
+                continue
             acc = self._acc[req.request_id]
             for k in METRIC_KEYS:
                 acc["sums"][k] += float(sums[k][lane])
@@ -692,14 +879,17 @@ class ServingEngine:
         req = self._requests[request_id]
         acc = self._acc[request_id]
         n = acc["count"]
+        completed = req.error is None and req.ended and req.inflight == 0
         out = {
             "request_id": request_id,
             "path": req.path,
             "request_class": req.cls.name,
             "n_windows": n,
-            "completed": req.error is None and req.ended
-            and req.inflight == 0,
+            "completed": completed,
             "error": req.error,
+            "status": req.status or ("ok" if completed else None),
+            "error_kind": req.error_kind,
+            "retries": req.retries,
             "preemptions": req.preemptions,
             "admit_latency_s": (
                 round(req.first_bind_t - req.submitted_t, 6)
@@ -725,6 +915,7 @@ class ServingEngine:
         admit: List[float] = []
         completed = 0
         preemptions = 0
+        statuses: Dict[str, int] = {}
         for req in self._requests.values():
             all_lat.extend(req.window_latencies)
             by_cls.setdefault(req.cls.name, []).extend(
@@ -733,6 +924,8 @@ class ServingEngine:
             preemptions += req.preemptions
             if req.error is None and req.ended and req.inflight == 0:
                 completed += 1
+            status = req.status or "live"
+            statuses[status] = statuses.get(status, 0) + 1
             if req.first_bind_t is not None:
                 admit.append(req.first_bind_t - req.submitted_t)
         wall = None
@@ -744,6 +937,8 @@ class ServingEngine:
             "requests": len(self._requests),
             "completed": completed,
             "rejected": self.scheduler.rejected,
+            "statuses": {k: statuses[k] for k in sorted(statuses)},
+            "quarantined_lanes": sorted(self.scheduler.quarantined),
             "preemptions": preemptions,
             "windows": self._windows_total,
             "wall_s": round(wall, 6) if wall else None,

@@ -67,7 +67,25 @@ class AsyncCheckpointer:
     corruption mode this class exists to exclude).
     """
 
-    def __init__(self):
+    def __init__(self, commit_retries: int = 2,
+                 commit_backoff_s: float = 0.1):
+        if commit_retries < 0:
+            raise ValueError(
+                f"commit_retries must be >= 0, got {commit_retries}"
+            )
+        if commit_backoff_s <= 0:
+            raise ValueError(
+                f"commit_backoff_s must be > 0, got {commit_backoff_s}"
+            )
+        # bounded exponential-backoff retry around each commit attempt
+        # (docs/RESILIENCE.md): a transiently failing filesystem (or an
+        # injected ckpt_commit fault) re-runs the SAME atomic
+        # arrays-then-meta protocol — force=True overwrites the torn
+        # directory the failed attempt left, and find_latest_checkpoint
+        # never saw it (no meta.yml marker). Retries exhausted -> the
+        # error surfaces at the next barrier exactly as before.
+        self.commit_retries = int(commit_retries)
+        self.commit_backoff_s = float(commit_backoff_s)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self.last_commit_s: Optional[float] = None
@@ -157,11 +175,31 @@ class AsyncCheckpointer:
 
     def _commit_inner(self, ckpt_dir, host_state, config, iteration,
                       monitor_best, save_best):
+        import jax
+
+        from esr_tpu.resilience.recovery import retry_with_backoff
+
+        # single-process only: the Orbax save is COLLECTIVE under
+        # jax.distributed (every process must call it exactly once per
+        # commit — internal sync_global_devices barriers), so one process
+        # retrying alone would desynchronize the barrier count and hang
+        # the fleet. Multi-process commits keep the fail-at-barrier path;
+        # a coordinated retry protocol is future elastic work.
+        retries = (
+            self.commit_retries if jax.process_count() == 1 else 0
+        )
         t0 = time.monotonic()
         try:
-            path = save_checkpoint(
-                ckpt_dir, host_state, config, iteration, monitor_best,
-                save_best=save_best,
+            path = retry_with_backoff(
+                lambda: save_checkpoint(
+                    ckpt_dir, host_state, config, iteration, monitor_best,
+                    save_best=save_best,
+                ),
+                retries=retries,
+                backoff_s=self.commit_backoff_s,
+                site="ckpt_commit",
+                event="recovery_ckpt_retry",
+                iteration=iteration,
             )
         except BaseException as e:  # noqa: BLE001 - surfaced at the barrier
             self._error = e

@@ -57,6 +57,18 @@ def save_checkpoint(
     save_best: bool = False,
 ) -> str:
     """Write ``checkpoint-iteration{N}`` (and the best-alias when asked)."""
+    from esr_tpu.resilience import faults
+
+    # ckpt_commit fault site (docs/RESILIENCE.md), keyed by iteration:
+    # `fail` raises before any byte lands (a commit attempt that never
+    # starts — the retry path's clean case); `torn` raises between the
+    # Orbax array write and the meta.yml marker (the exact preemption
+    # window the commit protocol tolerates). One fire() per commit
+    # attempt; a retried commit finds the spec consumed and succeeds.
+    _inj = faults.fire("ckpt_commit", iteration)
+    for spec in _inj:
+        if spec.kind == "fail":
+            raise faults.InjectedFault(spec)
     meta = {
         "format": CHECKPOINT_FORMAT,
         "model": {"name": config["model"]["name"]},
@@ -94,8 +106,19 @@ def save_checkpoint(
     # tests/test_async_checkpoint.py) would leave a present-but-torn
     # marker; os.replace makes the marker appear atomically, complete.
     ckptr.wait_until_finished()
+    for spec in _inj:
+        if spec.kind == "torn":
+            raise faults.InjectedFault(spec)
     if jax.process_index() == 0:
+        from esr_tpu.resilience.recovery import state_digest, write_digest
+
+        # integrity sidecar BEFORE the meta.yml marker: a committed
+        # checkpoint always carries the digest of the exact host snapshot
+        # its arrays were written from, so restore can prove the artifact
+        # unchanged (recovery.validate_restored) before trusting it
+        digest = state_digest(host_state)
         for path in paths:
+            write_digest(path, digest)
             meta_path = os.path.join(path, "meta.yml")
             tmp_path = meta_path + ".tmp"
             with open(tmp_path, "w") as f:
@@ -131,20 +154,18 @@ def read_meta(path: str) -> Dict:
         return yaml.safe_load(f)
 
 
-def find_latest_checkpoint(root: str) -> Optional[str]:
-    """Most recently SAVED ``checkpoint-iteration{N}`` under ``root``
-    (searched recursively, so a ``models/<experiment>`` dir spanning run ids
-    works) — the preemption-recovery hook: ``train.py -r auto`` resumes from
-    whatever the killed run saved last.
+def find_committed_checkpoints(root: str) -> list:
+    """Every COMMITTED ``checkpoint-iteration{N}`` under ``root``
+    (searched recursively), newest-first by ``meta.yml`` mtime (iteration
+    as tie-break) — the candidate list the validated-fallback restore
+    (``resilience.recovery.restore_with_fallback``) walks.
 
-    "Latest" is by ``meta.yml`` mtime (iteration as tie-break), NOT by
-    iteration number: a ``--reset`` restart in a new run id would otherwise
-    be shadowed forever by an abandoned run's higher-iteration checkpoint.
-    Only committed checkpoints count — ``meta.yml`` is written after the
-    async Orbax save lands, so torn saves are skipped. Returns None when
-    nothing is found."""
-    best: Optional[str] = None
-    best_key = (-1.0, -1)
+    Committed means the ``meta.yml`` marker exists AND parses as the
+    expected mapping: a torn save has no marker, and a garbage/truncated
+    marker (a writer killed mid-``os.replace`` on exotic filesystems, a
+    corrupted disk) is skipped with a loud warning — a broken marker must
+    never be silently preferred over an older intact commit."""
+    found = []
     for dirpath, dirnames, _ in os.walk(root):
         matched = [d for d in dirnames if d.startswith("checkpoint-iteration")]
         # never descend into checkpoint state trees (deep Orbax array dirs)
@@ -161,10 +182,36 @@ def find_latest_checkpoint(root: str) -> Optional[str]:
             meta = os.path.join(path, "meta.yml")
             if not os.path.exists(meta):
                 continue  # uncommitted / torn save
-            key = (os.path.getmtime(meta), it)
-            if key > best_key:
-                best, best_key = path, key
-    return best
+            try:
+                with open(meta) as f:
+                    doc = yaml.safe_load(f)
+                if not isinstance(doc, dict) or "model" not in doc:
+                    raise ValueError("not a checkpoint meta mapping")
+            except Exception as e:  # noqa: BLE001 - corrupt marker: skip loud
+                logger.error(
+                    "checkpoint %s has a corrupt meta.yml (%r); treating "
+                    "as uncommitted and falling back to an older commit",
+                    path, e,
+                )
+                continue
+            found.append(((os.path.getmtime(meta), it), path))
+    found.sort(reverse=True)
+    return [path for _, path in found]
+
+
+def find_latest_checkpoint(root: str) -> Optional[str]:
+    """Most recently SAVED committed ``checkpoint-iteration{N}`` under
+    ``root`` — the preemption-recovery hook: ``train.py -r auto`` resumes
+    from whatever the killed run saved last.
+
+    "Latest" is by ``meta.yml`` mtime (iteration as tie-break), NOT by
+    iteration number: a ``--reset`` restart in a new run id would otherwise
+    be shadowed forever by an abandoned run's higher-iteration checkpoint.
+    Only committed checkpoints count — torn saves (no ``meta.yml``) and
+    corrupt markers are skipped (:func:`find_committed_checkpoints`).
+    Returns None when nothing is found."""
+    committed = find_committed_checkpoints(root)
+    return committed[0] if committed else None
 
 
 def restore_state(path: str, template: TrainState) -> TrainState:
@@ -184,6 +231,7 @@ def resume_checkpoint(
     config: Dict,
     reset: bool = False,
     training_mode: str = "iteration_based_train",
+    restored: Optional[TrainState] = None,
 ) -> Tuple[TrainState, int, Optional[float]]:
     """Name-checked resume. Returns ``(state, start_iteration, monitor_best)``.
 
@@ -196,6 +244,11 @@ def resume_checkpoint(
     Mirrors the reference's semantics: same training mode and no ``--reset``
     → trainer progress restored (``start = iteration + 1``); otherwise weights
     only (``train_ours_cnt_seq.py:697-722``).
+
+    ``restored`` (optional) is a state pytree ALREADY restored from
+    ``path`` — the validated-fallback path (``resilience.recovery``)
+    passes the copy it just integrity-checked so the checkpoint is not
+    read from disk a second time.
     """
     meta = read_meta(path)
 
@@ -222,7 +275,8 @@ def resume_checkpoint(
         )
         return state, 0, None
 
-    restored = restore_state(path, state)
+    if restored is None:
+        restored = restore_state(path, state)
 
     if meta["optimizer"]["name"] != config["optimizer"]["name"]:
         logger.warning(

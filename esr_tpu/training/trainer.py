@@ -62,6 +62,8 @@ from esr_tpu.parallel.mesh import (
     replicate,
     stage_batch,
 )
+from esr_tpu.resilience import faults as _faults
+from esr_tpu.resilience.recovery import RollbackSignal
 from esr_tpu.training.checkpoint import resume_checkpoint, save_checkpoint
 from esr_tpu.training.train_step import (
     TrainState,
@@ -75,6 +77,29 @@ from esr_tpu.utils.writer import MetricWriter
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
+
+
+def _fast_forward_groups(source, n_iters: int):
+    """Deterministic data fast-forward after a rollback: consume (and
+    discard) the epoch's leading groups covering ``n_iters`` already-
+    trained iterations, so the replay resumes at exactly the checkpoint
+    boundary seeing the same batch sequence a fault-free run would (the
+    sampler is (seed, epoch)-deterministic). Checkpoints land on
+    super-step boundaries, so the skip normally ends exactly on a group
+    boundary; an overshoot (a checkpoint inherited from a differently-
+    grouped run) resumes at the next boundary with a loud warning."""
+    skipped = 0
+    for group in source:
+        if skipped < n_iters:
+            skipped += len(group)
+            if skipped > n_iters:
+                logger.warning(
+                    "rollback fast-forward overshot the checkpoint "
+                    "boundary (%d skipped, %d targeted); resuming at the "
+                    "group boundary", skipped, n_iters,
+                )
+            continue
+        yield group
 
 
 class Trainer:
@@ -121,11 +146,50 @@ class Trainer:
         self.async_checkpoint = bool(
             trainer_cfg.get("async_checkpoint", False)
         )
+        # resilience knobs (docs/RESILIENCE.md). max_bad_steps: how many
+        # CONSECUTIVE non-finite-loss super-steps are skipped-and-logged
+        # before the anomaly guard rolls back to the last valid committed
+        # checkpoint (None disables the guard — the pre-resilience
+        # behavior of silently recording NaN). dispatch_retries bounds the
+        # retry of a transiently failing step dispatch; commit_retries /
+        # commit_backoff_s parameterize the checkpoint-commit retry;
+        # prefetch_stall_timeout_s arms the DevicePrefetcher watchdog.
+        self.max_bad_steps = trainer_cfg.get("max_bad_steps", None)
+        self._guard = None
+        if self.max_bad_steps is not None:
+            from esr_tpu.resilience.recovery import AnomalyGuard
+
+            self._guard = AnomalyGuard(int(self.max_bad_steps))
+        self.max_rollbacks = int(trainer_cfg.get("max_rollbacks", 2))
+        self.dispatch_retries = int(trainer_cfg.get("dispatch_retries", 1))
+        if self.dispatch_retries < 0:
+            raise ValueError(
+                f"dispatch_retries must be >= 0, got {self.dispatch_retries}"
+            )
+        if self.dispatch_retries and jax.process_count() > 1:
+            # the train step is collective across processes: one process
+            # retrying a dispatch alone would desynchronize the others'
+            # collectives and hang the fleet — single-process only until
+            # a coordinated retry protocol exists (docs/RESILIENCE.md)
+            logger.info(
+                "dispatch_retries disabled under multi-process "
+                "(collective step; %d processes)", jax.process_count()
+            )
+            self.dispatch_retries = 0
+        self.prefetch_stall_timeout = trainer_cfg.get(
+            "prefetch_stall_timeout_s", None
+        )
+
         self._async_ckpt = None
         if self.async_checkpoint:
             from esr_tpu.training.async_checkpoint import AsyncCheckpointer
 
-            self._async_ckpt = AsyncCheckpointer()
+            self._async_ckpt = AsyncCheckpointer(
+                commit_retries=int(trainer_cfg.get("commit_retries", 2)),
+                commit_backoff_s=float(
+                    trainer_cfg.get("commit_backoff_s", 0.1)
+                ),
+            )
 
         # scan-fused validation (trainer.validate): route _valid through
         # the production make_multi_step/lax.scan machinery — chunk_windows
@@ -429,6 +493,10 @@ class Trainer:
             if restored_best is not None:
                 self.mnt_best = restored_best
 
+        # rollback-of-last-resort target: when the anomaly guard fires
+        # before ANY checkpoint committed, recovery restores the run-start
+        # state (a host-side reference; replicate() does not mutate it)
+        self._init_state = state if self._guard is not None else None
         self.state = replicate(state, self.mesh)
 
     # -- helpers -----------------------------------------------------------
@@ -745,6 +813,81 @@ class Trainer:
                 stop_training = True
         return stop_training, best
 
+    def _dispatch(self, fn, state, batch, err_specs=()):
+        """Bounded-retry step dispatch (docs/RESILIENCE.md): a transiently
+        failing dispatch (an injected ``dispatch_error``, a preempted-core
+        ``XlaRuntimeError``) retries up to ``trainer.dispatch_retries``
+        with the SAME staged batch — a dispatch-time failure precedes the
+        donated-buffer consumption, so a successful retry is
+        trajectory-identical; a mid-execution failure that already donated
+        surfaces as an error on the retry instead of being masked."""
+        if not err_specs and self.dispatch_retries == 0:
+            return fn(state, batch)
+        from esr_tpu.resilience.faults import InjectedFault
+        from esr_tpu.resilience.recovery import retry_with_backoff
+
+        err = list(err_specs)
+
+        def attempt():
+            if err:
+                raise InjectedFault(err.pop(0))
+            return fn(state, batch)
+
+        return retry_with_backoff(
+            attempt, retries=self.dispatch_retries, backoff_s=0.05,
+            site="train_step", event="recovery_dispatch_retry",
+        )
+
+    def _perform_rollback(self, rb: RollbackSignal) -> int:
+        """Restore the last VALID committed checkpoint (or the run-start
+        state) after the anomaly guard exhausted its bad-step budget.
+        Returns the iteration to resume from; the caller fast-forwards the
+        data stream there. ``trainer.max_rollbacks`` bounds the loop — a
+        deterministically diverging run must fail loudly, not oscillate
+        between rollback and the same NaN forever."""
+        from esr_tpu.resilience.recovery import (
+            emit_recovery,
+            restore_with_fallback,
+        )
+
+        if self._guard.rollbacks > self.max_rollbacks:
+            raise RuntimeError(
+                f"anomaly guard rolled back {self._guard.rollbacks} times "
+                f"(budget {self.max_rollbacks}); training diverges "
+                "deterministically — refusing to loop"
+            ) from rb
+        if self._async_ckpt is not None:
+            # barrier (never raise: a failed commit means we fall back to
+            # an older one, which is exactly what the restore below does)
+            self._async_ckpt.wait(raise_error=False)
+        state_host, start_iter, best, path = restore_with_fallback(
+            self.run.save_dir, self.state, self.run.config
+        )
+        if path is None:
+            if self._init_state is None:
+                raise RuntimeError(
+                    "rollback requested but no committed checkpoint and "
+                    "no run-start snapshot exists"
+                ) from rb
+            state_host = self._init_state
+            start_iter, best = self.start_iteration, None
+        self.state = replicate(state_host, self.mesh)
+        if best is not None:
+            self.mnt_best = best
+        self.not_improved_count = 0
+        self._guard.consecutive_bad = 0
+        emit_recovery(
+            "recovery_rollback", site="train_step", fault_id=rb.fault_id,
+            from_iteration=rb.at_iteration, to_iteration=start_iter,
+            bad_steps=rb.bad_steps, checkpoint=path,
+        )
+        logger.warning(
+            "rolled back to iteration %d (checkpoint %s) after %d "
+            "consecutive bad super-steps; replaying deterministically",
+            start_iter, path, rb.bad_steps,
+        )
+        return start_iter
+
     def _save(self, iteration: int, best: bool) -> None:
         # EVERY process participates: Orbax saves are collective under
         # jax.distributed (save_checkpoint writes meta/arrays from the
@@ -767,6 +910,7 @@ class Trainer:
                     "checkpoint_snapshot", snap_s,
                     iteration=int(iteration), best=bool(best),
                 )
+            self._release_init_snapshot()
             return
         save_checkpoint(
             self.run.save_dir,
@@ -776,6 +920,17 @@ class Trainer:
             self.mnt_best,
             save_best=best,
         )
+        self._release_init_snapshot()
+
+    def _release_init_snapshot(self) -> None:
+        """Free the rollback-of-last-resort run-start state once a
+        COMMITTED checkpoint exists on disk (sync save returned, or an
+        async commit fully landed) — holding a duplicate host TrainState
+        for the whole run would be pure dead weight after that."""
+        if self._init_state is None:
+            return
+        if self._async_ckpt is None or self._async_ckpt.commits > 0:
+            self._init_state = None
 
     # -- the loop ----------------------------------------------------------
 
@@ -831,7 +986,7 @@ class Trainer:
         last_scalars = {"loss": float("nan"), "mse": float("nan")}
 
         def consume(entry):
-            first, r, ep, metrics, vis_batch, bucket = entry
+            first, r, ep, metrics, vis_batch, bucket, nan_specs = entry
             # One host readback per SUPER-step (scalars only): the fused
             # path hands back {loss [r], loss_per_window [r, Wc], ...} in
             # a single small transfer; the single-step path (k_steps=1 or
@@ -851,6 +1006,23 @@ class Trainer:
                         for v in np.asarray(metrics["loss_per_window"])[:, -1]
                     ]
                     last_pred_dev = metrics["last_pred"]
+            if nan_specs:
+                # injected train_step/nan_loss fault: the super-step's
+                # readback scalars go non-finite (params untouched — the
+                # stand-in for a transient bad loss-scale/reduction, the
+                # skippable anomaly class); the guard below must catch it
+                losses = [float("nan")] * len(losses)
+                mses = [float("nan")] * len(mses)
+            if self._guard is not None and not self._guard.check(
+                losses, first,
+                fault_id=nan_specs[0].fault_id if nan_specs else None,
+            ):
+                # skip-and-log (docs/RESILIENCE.md): a non-finite
+                # super-step is excluded from trackers/writer/vis so one
+                # anomaly cannot poison the run's metric series; the guard
+                # already emitted recovery_skip_step (or raised
+                # RollbackSignal, unwinding to the rollback handler)
+                return
             for j in range(r):
                 k = first + j
                 loss, mse_loss = losses[j], mses[j]
@@ -936,8 +1108,18 @@ class Trainer:
                     start_iteration=self.start_iteration,
                     k_steps=self.k_steps,
                 )
+            # rollback bookkeeping (docs/RESILIENCE.md): which iteration
+            # each epoch started at, so a rollback can re-enter the RIGHT
+            # epoch and fast-forward its (seed, epoch)-deterministic batch
+            # stream to the checkpoint boundary — the replay consumes the
+            # identical batch sequence a fault-free run would have
+            epoch_starts: list = []
+            ff_skip = 0
             while not stop:
                 self.train_loader.set_epoch(epoch)
+                if not epoch_starts or epoch_starts[-1][0] != epoch:
+                    epoch_starts.append((epoch, iter_idx))
+                rb_caught = None
                 # host->device upload pipelined ahead of the consuming step;
                 # the ExitStack guarantees the producer thread stops even when
                 # the loop breaks mid-epoch (early stop, final iteration).
@@ -949,12 +1131,16 @@ class Trainer:
                 # stage_megabatch span is measured on the consumer thread.
                 with contextlib.ExitStack() as stack:
                     source = group_batches(self.train_loader, self.k_steps)
+                    if ff_skip:
+                        source = _fast_forward_groups(source, ff_skip)
+                        ff_skip = 0
                     if self.device_prefetch:
                         batches = stack.enter_context(DevicePrefetcher(
                             source,
                             self._stage_group_timed,
                             depth=self.device_prefetch,
                             join_timeout=self.prefetch_join_timeout,
+                            stall_timeout=self.prefetch_stall_timeout,
                         ))
                     else:
                         batches = ((g, None) for g in source)
@@ -984,6 +1170,20 @@ class Trainer:
                                 )
                             best = False
                             r = len(group)
+                            # train_step fault site (docs/RESILIENCE.md),
+                            # keyed by the super-step's first iteration:
+                            # nan_loss poisons THIS super-step's readback
+                            # (enacted in consume, where the scalars land);
+                            # dispatch_error raises at dispatch and is
+                            # absorbed by the bounded retry below
+                            _specs = _faults.fire("train_step", iter_idx)
+                            nan_specs = [
+                                s for s in _specs if s.kind == "nan_loss"
+                            ]
+                            err_specs = [
+                                s for s in _specs
+                                if s.kind == "dispatch_error"
+                            ]
                             if isinstance(staged, list):
                                 # k_steps=1, or the epoch-tail remainder
                                 # (< k_steps batches): r sequential single-step
@@ -991,14 +1191,17 @@ class Trainer:
                                 # the scanned program
                                 metrics = []
                                 for sb in staged:
-                                    self.state, m = self.train_step(
-                                        self.state, sb
+                                    self.state, m = self._dispatch(
+                                        self.train_step, self.state, sb,
+                                        err_specs,
                                     )
+                                    err_specs = []
                                     metrics.append(m)
                             else:
                                 # ONE dispatch for k_steps chained train steps
-                                self.state, metrics = self.multi_step(
-                                    self.state, staged
+                                self.state, metrics = self._dispatch(
+                                    self.multi_step, self.state, staged,
+                                    err_specs,
                                 )
                             first = iter_idx
                             last = iter_idx + r - 1
@@ -1021,7 +1224,7 @@ class Trainer:
                             pending.append(
                                 (first, r, epoch, metrics,
                                  group[-1] if keep_vis else None,
-                                 self._attr.current)
+                                 self._attr.current, nan_specs)
                             )
                             if len(pending) > self.train_lookahead:
                                 consume(pending.popleft())
@@ -1092,13 +1295,58 @@ class Trainer:
                                         self._save(last, False)
                                 stop = True
                                 break
+                        except RollbackSignal as rb:
+                            # the anomaly guard's bad-step budget ran out
+                            # (raised at the cadence-gated readback inside
+                            # consume/drain): unwind to the epoch level so
+                            # the ExitStack stops the prefetcher cleanly,
+                            # then restore + fast-forward below
+                            rb_caught = rb
+                            break
                         finally:
                             # wall-clock end of this super-step's loop body
                             # (idempotent; the bucket lives on in `pending`
                             # until the deferred readback resolves it)
                             self._attr.close()
+                if rb_caught is not None:
+                    # in-flight readbacks of the poisoned window are
+                    # discarded wholesale — everything after the rollback
+                    # target is about to be replayed
+                    pending.clear()
+                    resume_iter = self._perform_rollback(rb_caught)
+                    while (len(epoch_starts) > 1
+                           and epoch_starts[-1][1] > resume_iter):
+                        epoch_starts.pop()
+                    epoch, ep_start = epoch_starts[-1]
+                    if resume_iter < ep_start:
+                        # the rollback target predates this process's data
+                        # stream (a resumed run whose newest checkpoint
+                        # failed validation): the earlier batches cannot
+                        # be replayed — resume at the stream's start with
+                        # the restored (older) state, loudly
+                        logger.warning(
+                            "rollback target iteration %d predates this "
+                            "run's data stream (started at %d); replaying "
+                            "from the stream start — labels and data "
+                            "realign at the next checkpoint",
+                            resume_iter, ep_start,
+                        )
+                        resume_iter = ep_start
+                    ff_skip = resume_iter - ep_start
+                    iter_idx = resume_iter
+                    continue
                 epoch += 1
-            drain()
+            try:
+                drain()
+            except RollbackSignal:
+                # terminal-drain edge (early stop with a bad step still in
+                # flight): there is no loop left to replay into — drop the
+                # poisoned readbacks and keep the shutdown path alive
+                logger.error(
+                    "rollback requested during terminal drain; the final "
+                    "in-flight super-steps are excluded from metrics"
+                )
+                pending.clear()
             if self._async_ckpt is not None:
                 # barrier the final commit INSIDE the try: a failed
                 # background save must fail the run, not vanish with it

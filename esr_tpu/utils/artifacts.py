@@ -137,6 +137,30 @@ def probe_backend_bounded(
     return out
 
 
+def probe_backend_or_exit() -> Dict:
+    """Entry-point bring-up gate (docs/RESILIENCE.md): run
+    :func:`probe_backend_bounded` with the env-tunable budget
+    (``ESR_BACKEND_PROBE_TIMEOUT_S``, default 150;
+    ``ESR_BACKEND_PROBE_ATTEMPTS``, default 3) and ``sys.exit(2)`` with
+    the attempt log on a failed/hung bring-up — the observed wedged-
+    tunnel failure mode must never hang ``train.py``/``infer.py`` for
+    the full watchdog window. Returns the successful probe record."""
+    probe = probe_backend_bounded(
+        attempt_timeout_s=float(
+            os.environ.get("ESR_BACKEND_PROBE_TIMEOUT_S", 150.0)
+        ),
+        attempts=int(os.environ.get("ESR_BACKEND_PROBE_ATTEMPTS", 3)),
+        cache_path=os.path.join("artifacts", "DEVICE_PROBE.json"),
+    )
+    if not probe.get("ok", False):
+        print(
+            json.dumps({"error": "backend bring-up failed", **probe}),
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    return probe
+
+
 def emit_jsonl(log_path: str, rec: Dict) -> Dict:
     """UTC-stamp and manifest-stamp ``rec``, print it to stdout (flushed),
     append it to ``log_path`` (creating parent dirs; I/O errors on the file

@@ -93,6 +93,11 @@ def main():
     from esr_tpu.parallel.mesh import honor_platform_env
 
     honor_platform_env()
+    # bounded backend bring-up (docs/RESILIENCE.md): a wedged accelerator
+    # tunnel exits 2 with the attempt log instead of hanging the job
+    from esr_tpu.utils.artifacts import probe_backend_or_exit
+
+    probe_backend_or_exit()
     assert (flags.data_path is None) != (flags.data_list is None), (
         "pass exactly one of --data_path / --data_list"
     )

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Chaos smoke (docs/RESILIENCE.md): the scripted fault scenario — a
+# seeded FaultPlan over the prefetch / train-step / checkpoint-commit /
+# checkpoint-restore / serving-chunk sites — runs train -> restore ->
+# serve end-to-end on CPU, then `python -m esr_tpu.obs report` gates
+# fault -> recovery completeness with configs/slo_chaos.yml.
+#
+# Usage: scripts/chaos_smoke.sh [out_dir] [seed]
+# Exit: 0 all scenario checks + both SLO gates passed; non-zero otherwise.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-artifacts/chaos_smoke}"
+SEED="${2:-0}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+rm -rf "$OUT"
+python -m esr_tpu.resilience.chaos --out "$OUT" --seed "$SEED"
+
+# fault -> recovery completeness, per phase telemetry (train; restore+serve)
+python -m esr_tpu.obs report \
+  "$OUT"/logs/chaos/chaos/telemetry.jsonl --slo configs/slo_chaos.yml \
+  --out "$OUT"/train_report.json
+python -m esr_tpu.obs report \
+  "$OUT"/serve_telemetry.jsonl --slo configs/slo_chaos.yml \
+  --out "$OUT"/serve_report.json
+
+echo "chaos smoke OK: $OUT/CHAOS_SUMMARY.json"

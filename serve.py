@@ -67,6 +67,12 @@ def get_flags():
                         "loop never traces (inference/export.py)")
     p.add_argument("--max_wall", type=float, default=None,
                    help="hard wall-clock bound on the serving loop, s")
+    p.add_argument("--lane_quarantine_k", type=int, default=3,
+                   help="faults on one lane before it is drained and "
+                        "quarantined (docs/RESILIENCE.md)")
+    p.add_argument("--request_retries", type=int, default=1,
+                   help="times a fault-hit request is re-admitted before "
+                        "failing with a classified status")
 
     # dataset overrides (the infer.py set)
     p.add_argument("--scale", type=int, default=4)
@@ -184,6 +190,8 @@ def main():
             max_pending=flags.max_pending,
             preempt_quantum=flags.preempt_quantum,
             aot_programs=aot_programs,
+            lane_quarantine_k=flags.lane_quarantine_k,
+            request_retries=flags.request_retries,
         )
         summary = server.run(
             arrivals=schedule, max_wall_s=flags.max_wall

@@ -852,3 +852,111 @@ def test_checked_jit_result_parity_with_jax_jit():
     a = jax.jit(f)(jnp.arange(4.0))
     b = checked_jit(f)(jnp.arange(4.0))
     assert float(a) == float(b)
+
+
+# ---------------------------------------------------------------------------
+# ESR012 silent exception swallow
+
+
+def test_esr012_silent_swallow_in_loop_flagged():
+    src = (
+        "def serve(streams):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except Exception:\n"
+        "            continue\n"
+    )
+    assert "ESR012" in rules_hit(src)
+    bare = (
+        "def serve(q):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            q.step()\n"
+        "        except:\n"
+        "            pass\n"
+    )
+    assert "ESR012" in rules_hit(bare)
+
+
+def test_esr012_loud_handlers_not_flagged():
+    telemetry = (
+        "def serve(streams, sink):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except Exception as e:\n"
+        "            sink.counter('bad_stream')\n"
+    )
+    assert "ESR012" not in rules_hit(telemetry)
+    logged = (
+        "def serve(streams, logger):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except Exception as e:\n"
+        "            logger.warning('bad stream: %r', e)\n"
+    )
+    assert "ESR012" not in rules_hit(logged)
+    reraised = (
+        "def serve(streams):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except Exception as e:\n"
+        "            raise RuntimeError('stream') from e\n"
+    )
+    assert "ESR012" not in rules_hit(reraised)
+    recovery = (
+        "from esr_tpu.resilience.recovery import emit_recovery\n"
+        "def serve(streams):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except Exception as e:\n"
+        "            emit_recovery('recovery_x', site='serve_chunk')\n"
+    )
+    assert "ESR012" not in rules_hit(recovery)
+
+
+def test_esr012_scope_narrow_except_and_loopless_not_flagged():
+    narrow = (
+        "def serve(streams):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except StopIteration:\n"
+        "            continue\n"
+    )
+    assert "ESR012" not in rules_hit(narrow)
+    loopless = (
+        "def probe(x):\n"
+        "    try:\n"
+        "        return x.value()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    assert "ESR012" not in rules_hit(loopless)
+    nested_def = (
+        "def outer(xs):\n"
+        "    for x in xs:\n"
+        "        def cb():\n"
+        "            try:\n"
+        "                x()\n"
+        "            except Exception:\n"
+        "                return None\n"
+        "        cb()\n"
+    )
+    assert "ESR012" not in rules_hit(nested_def)
+
+
+def test_esr012_noqa_suppresses():
+    src = (
+        "def serve(streams):\n"
+        "    for s in streams:\n"
+        "        try:\n"
+        "            s.pull()\n"
+        "        except Exception:  # esr: noqa(ESR012)\n"
+        "            continue\n"
+    )
+    assert "ESR012" not in rules_hit(src)

@@ -179,3 +179,124 @@ def test_blocked_time_reduced_at_least_5x(tmp_path):
         ck.wait()
 
     assert min(sync_ms) / min(async_ms) >= 5.0, (sync_ms, async_ms)
+
+
+def test_garbage_meta_yml_falls_back_to_prior_commit(tmp_path, caplog):
+    """A present-but-garbage meta.yml (corrupted marker) must be treated
+    as uncommitted: find_latest_checkpoint skips it with a LOUD warning
+    and returns the prior intact commit — never trusts a broken marker."""
+    import logging
+
+    root = str(tmp_path / "ckpts")
+    state1, state2 = _state(1), _state(2)
+    save_checkpoint(root, state1, CONFIG, 1, 0.5)
+    time.sleep(0.02)
+    save_checkpoint(root, state2, CONFIG, 2, 0.4)
+
+    latest_meta = os.path.join(root, "checkpoint-iteration2", "meta.yml")
+    with open(latest_meta, "w") as f:
+        f.write("{[ this is not yaml ::\x00")
+
+    with caplog.at_level(logging.ERROR):
+        latest = find_latest_checkpoint(root)
+    assert latest == os.path.join(root, "checkpoint-iteration1")
+    assert any("corrupt meta.yml" in r.message for r in caplog.records)
+
+    restored, start, best = resume_checkpoint(latest, _state(9), CONFIG)
+    assert start == 2 and best == 0.5
+    _assert_tree_equal(restored, state1)
+
+
+def test_truncated_array_payload_falls_back_loudly(tmp_path, caplog):
+    """Truncated array bytes under the LATEST commit (marker intact):
+    the validated restore must fall back to the prior commit with a loud
+    warning — never load garbage silently (ISSUE 10 satellite)."""
+    import logging
+
+    from esr_tpu.resilience.faults import truncate_checkpoint_arrays
+    from esr_tpu.resilience.recovery import restore_with_fallback
+
+    root = str(tmp_path / "ckpts")
+    state1, state2 = _state(1), _state(2)
+    save_checkpoint(root, state1, CONFIG, 1, 0.5)
+    time.sleep(0.02)
+    save_checkpoint(root, state2, CONFIG, 2, 0.4)
+    # marker present, digest sidecar present — only the bytes are torn
+    latest = os.path.join(root, "checkpoint-iteration2")
+    assert truncate_checkpoint_arrays(latest) is not None
+    assert os.path.exists(os.path.join(latest, "meta.yml"))
+
+    with caplog.at_level(logging.WARNING):
+        restored, start, best, path = restore_with_fallback(
+            root, _state(9), CONFIG
+        )
+    assert path == os.path.join(root, "checkpoint-iteration1")
+    assert start == 2 and best == 0.5
+    _assert_tree_equal(restored, state1)
+    assert any("integrity validation" in r.message for r in caplog.records)
+
+
+def test_digest_sidecar_written_and_validates(tmp_path):
+    """Every committed checkpoint carries a digest.json sidecar of the
+    exact host snapshot its arrays were written from; restore recomputes
+    and matches it."""
+    from esr_tpu.resilience.recovery import (
+        read_digest,
+        state_digest,
+        validate_restored,
+    )
+
+    state = _state(3)
+    path = os.path.join(str(tmp_path), "checkpoint-iteration5")
+    save_checkpoint(str(tmp_path), state, CONFIG, 5, 0.1)
+    assert read_digest(path) == state_digest(
+        jax.tree.map(lambda x: np.asarray(x), state)
+    )
+    restored = restore_state(path, _state(9))
+    ok, reason = validate_restored(path, restored)
+    assert ok, reason
+
+
+def test_injected_commit_fault_retries_and_commits(tmp_path):
+    """The ckpt_commit fault site + bounded backoff retry: a failing
+    commit attempt (injected `fail`) retries and lands; a `torn` spec
+    leaves arrays-without-marker on the failed attempt, and the retry
+    overwrites it into a committed checkpoint."""
+    import json
+
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+    tel = str(tmp_path / "tel.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        plan = FaultPlan([
+            FaultSpec("ckpt_commit", 1, "fail"),
+            FaultSpec("ckpt_commit", 2, "torn"),
+        ])
+        ck = AsyncCheckpointer(commit_retries=2, commit_backoff_s=0.01)
+        root = str(tmp_path / "ck")
+        with installed(plan):
+            ck.save(root, _state(1), CONFIG, 1, 0.0)
+            ck.wait()
+            ck.save(root, _state(2), CONFIG, 2, 0.0)
+            ck.wait()
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    # both commits landed despite one injected failure each
+    assert find_latest_checkpoint(root) == os.path.join(
+        root, "checkpoint-iteration2"
+    )
+    _assert_tree_equal(
+        restore_state(os.path.join(root, "checkpoint-iteration1"),
+                      _state(9)), _state(1),
+    )
+    with open(tel) as f:
+        recs = [json.loads(line) for line in f]
+    retries = [r for r in recs if r.get("name") == "recovery_ckpt_retry"]
+    assert len(retries) == 2
+    assert {r["site"] for r in retries} == {"ckpt_commit"}
+    injected = [r for r in recs if r.get("name") == "fault_injected"]
+    assert {r["kind"] for r in injected} == {"fail", "torn"}

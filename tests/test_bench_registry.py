@@ -37,7 +37,7 @@ def test_stage_registry_names_order_and_timeouts():
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
         "mfu_ceiling", "program_audit", "e2e", "e2e_device_raster",
         "scaling", "breakdown", "infer_throughput", "ckpt_overlap",
-        "serve_loadgen",
+        "serve_loadgen", "chaos_recovery",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -174,6 +174,26 @@ def test_serve_loadgen_stage_registered_and_schema_pinned():
         "continuous_vs_cohort", "p50_window_ms", "p99_window_ms",
         "requests", "completed", "windows", "preemptions", "lanes",
         "arrival_rate_hz", "seed",
+    )
+
+
+def test_chaos_recovery_stage_registered_and_schema_pinned():
+    """The resilience-cost series (ISSUE 10): faults injected vs
+    recovered plus the wall-clock overhead of self-healing over the
+    fault-free twin, from the scripted chaos scenario
+    (esr_tpu.resilience.chaos). Host-bound by design, so it runs in
+    smoke (CPU) too; keys pinned so the series stays machine-comparable
+    across rounds."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "chaos_recovery"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_chaos_recovery
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.CHAOS_RECOVERY_KEYS == (
+        "faults_injected", "faults_recovered", "unrecovered",
+        "recovery_overhead_frac", "params_max_rel_diff", "sites", "ok",
+        "train_iterations", "serve_requests", "seed",
     )
 
 

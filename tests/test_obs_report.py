@@ -248,3 +248,72 @@ def test_cli_exit_codes(tmp_path):
     with open(slo_broken, "w") as f:
         f.write("rules:\n\t- metric: tab indent\n")
     assert run("report", tel, "--slo", slo_broken).returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# fault -> recovery completeness (ISSUE 10)
+
+
+def test_fault_completeness_matches_by_id_then_site():
+    from esr_tpu.obs.report import build_report
+
+    records = [
+        {"type": "event", "name": "fault_injected", "site": "train_step",
+         "kind": "nan_loss", "fault_id": "a"},
+        {"type": "event", "name": "fault_injected", "site": "prefetch",
+         "kind": "corrupt", "fault_id": "b"},
+        {"type": "event", "name": "fault_injected", "site": "serve_chunk",
+         "kind": "lane_fault", "fault_id": "c"},
+        # id-matched recovery for `a`
+        {"type": "event", "name": "recovery_skip_step",
+         "site": "train_step", "fault_id": "a"},
+        # site-matched: a corrupted prefetch batch surfaces at the train
+        # step's guard (the documented downstream answer site)
+        {"type": "event", "name": "recovery_rollback",
+         "site": "train_step", "fault_id": None},
+    ]
+    rep = build_report(records)
+    f = rep["faults"]
+    assert f["injected"] == 3
+    assert f["recovered"] == 2
+    assert f["unrecovered"] == 1
+    assert f["unrecovered_ids"] == ["c"]
+    assert f["by_site"]["serve_chunk"] == {"injected": 1, "recovered": 0}
+    assert f["by_site"]["prefetch"] == {"injected": 1, "recovered": 1}
+
+
+def test_fault_completeness_one_to_one_matching():
+    """Two faults cannot share one recovery event — completeness is
+    one-to-one, so a single recovery leaves the second fault exposed."""
+    from esr_tpu.obs.report import build_report
+
+    records = [
+        {"type": "event", "name": "fault_injected", "site": "prefetch",
+         "kind": "stall", "fault_id": "s1"},
+        {"type": "event", "name": "fault_injected", "site": "prefetch",
+         "kind": "stall", "fault_id": "s2"},
+        {"type": "event", "name": "recovery_prefetch_restart",
+         "site": "prefetch"},
+    ]
+    f = build_report(records)["faults"]
+    assert f["injected"] == 2 and f["recovered"] == 1
+    assert f["unrecovered"] == 1
+
+
+def test_shed_requests_skip_trace_completeness_but_count_status():
+    from esr_tpu.obs.report import build_report
+
+    records = [
+        {"type": "event", "name": "serve_request_done", "request": "r1",
+         "status": "shed", "completed": False, "trace_id": "t1"},
+        {"type": "event", "name": "serve_request_done", "request": "r2",
+         "status": "ok", "completed": True, "windows": 4,
+         "trace_id": "t2", "parent_id": "root2"},
+        {"type": "span", "name": "serve_request", "trace_id": "t2",
+         "span_id": "root2", "parent_id": None, "seconds": 1.0},
+    ]
+    rep = build_report(records)
+    assert rep["traces"]["requests"] == 1  # shed skipped
+    assert rep["traces"]["incomplete"] == 0
+    assert rep["serving"]["statuses"] == {"ok": 1, "shed": 1}
+    assert rep["serving"]["requests"] == 1

@@ -1,0 +1,409 @@
+"""esr_tpu.resilience unit invariants (tier-1, CPU, mostly jax-free).
+
+The fault plane: seeded determinism, fire-once consumption, zero-cost
+when disabled, telemetry pairing. The recovery half: anomaly-guard
+skip/rollback budget, bounded backoff retry, checkpoint digest +
+validated fallback restore, prefetcher stall watchdog (restart ->
+degrade), serving lane-health ledger. The end-to-end composition is
+``tests/test_chaos_smoke.py``'s job.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from esr_tpu.resilience import faults as flt
+from esr_tpu.resilience import recovery as rcv
+from esr_tpu.resilience.faults import FaultPlan, FaultSpec, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    flt.clear_plan()
+    yield
+    flt.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# fault plane
+
+
+def test_seeded_plan_is_deterministic_and_site_covering():
+    a = FaultPlan.seeded(7, n_faults=10)
+    b = FaultPlan.seeded(7, n_faults=10)
+    sa = sorted((s.site, s.index, s.kind) for v in a._pending.values()
+                for s in v)
+    sb = sorted((s.site, s.index, s.kind) for v in b._pending.values()
+                for s in v)
+    assert sa == sb
+    # round-robin site dealing: 10 faults over 5 sites covers every site
+    assert {s for s, _, _ in sa} == set(flt.SITES)
+    assert FaultPlan.seeded(8, n_faults=10)._pending != a._pending
+
+
+def test_spec_validates_site_and_kind():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultSpec("nope", 0, "stall")
+    with pytest.raises(ValueError, match="unknown kind"):
+        FaultSpec("prefetch", 0, "nan_loss")
+
+
+def test_fire_consumes_once_and_emits_paired_event(tmp_path):
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    plan = FaultPlan([FaultSpec("train_step", 3, "nan_loss")])
+    tel = str(tmp_path / "t.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        with flt.installed(plan):
+            assert flt.fire("train_step", 2) == ()
+            specs = flt.fire("train_step", 3, ctx_field="x")
+            assert len(specs) == 1 and specs[0].kind == "nan_loss"
+            assert specs[0].fault_id.startswith("train_step:3:nan_loss")
+            assert flt.fire("train_step", 3) == ()  # consumed
+        assert plan.summary()["injected"] == 1
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    recs = [json.loads(line) for line in open(tel)]
+    evs = [r for r in recs if r.get("name") == "fault_injected"]
+    assert len(evs) == 1
+    assert evs[0]["site"] == "train_step" and evs[0]["kind"] == "nan_loss"
+    assert evs[0]["fault_id"] == specs[0].fault_id
+    assert evs[0]["ctx_field"] == "x"
+
+
+def test_fire_with_no_plan_is_cheap():
+    """The zero-cost-when-disabled contract: a disabled hook is one
+    module-global None check. Bound is deliberately generous (shared CI
+    hosts) — the real ceiling is ~100ns/call."""
+    flt.clear_plan()
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        flt.fire("prefetch", i)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.5, f"{elapsed / n * 1e9:.0f} ns/call"
+
+
+def test_corrupt_batch_poisons_floats_only():
+    batch = {
+        "f": np.ones((4, 4), np.float32),
+        "i": np.arange(4, dtype=np.int32),
+    }
+    flt.corrupt_batch(batch)
+    assert np.isnan(batch["f"]).any()
+    assert not np.isnan(batch["f"]).all()  # fraction, not everything
+    assert (batch["i"] == np.arange(4)).all()
+
+
+def test_truncate_checkpoint_arrays_halves_largest_file(tmp_path):
+    state = tmp_path / "ck" / "state" / "d"
+    state.mkdir(parents=True)
+    (state / "small.bin").write_bytes(b"x" * 100)
+    (state / "big.bin").write_bytes(b"y" * 10_000)
+    hit = flt.truncate_checkpoint_arrays(str(tmp_path / "ck"))
+    assert hit.endswith("big.bin")
+    assert os.path.getsize(hit) == 5_000
+    assert os.path.getsize(state / "small.bin") == 100
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard
+
+
+def test_anomaly_guard_skip_then_rollback_budget():
+    g = rcv.AnomalyGuard(max_bad_steps=2)
+    assert g.check([0.5, 0.2], 0)
+    assert not g.check([float("nan")], 2)      # bad #1: skip
+    assert not g.check([float("inf")], 3)      # bad #2: skip
+    assert g.check([0.1], 4)                   # finite resets the streak
+    assert g.consecutive_bad == 0
+    assert not g.check([float("nan")], 5)
+    assert not g.check([float("nan")], 6)
+    with pytest.raises(rcv.RollbackSignal) as ei:
+        g.check([float("nan")], 7)             # bad #3: budget exhausted
+    assert ei.value.at_iteration == 7 and ei.value.bad_steps == 3
+    assert g.rollbacks == 1
+    assert set(g.skipped_iterations) == {2, 3, 5, 6, 7}
+
+
+def test_anomaly_guard_zero_budget_rolls_back_immediately():
+    g = rcv.AnomalyGuard(max_bad_steps=0)
+    with pytest.raises(rcv.RollbackSignal):
+        g.check([float("nan")], 1)
+
+
+def test_skip_emits_recovery_event(tmp_path):
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    tel = str(tmp_path / "t.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        g = rcv.AnomalyGuard(max_bad_steps=1)
+        g.check([float("nan")], 4, fault_id="f1")
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    recs = [json.loads(line) for line in open(tel)]
+    ev = [r for r in recs if r.get("name") == "recovery_skip_step"]
+    assert len(ev) == 1
+    assert ev[0]["site"] == "train_step" and ev[0]["fault_id"] == "f1"
+    assert ev[0]["iteration"] == 4
+
+
+# ---------------------------------------------------------------------------
+# bounded retry + classification
+
+
+def test_retry_with_backoff_retries_then_succeeds():
+    calls = []
+    sleeps = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("disk hiccup")
+        return "done"
+
+    out = rcv.retry_with_backoff(
+        flaky, retries=3, backoff_s=0.01, site="ckpt_commit",
+        event="recovery_ckpt_retry", sleep=sleeps.append,
+    )
+    assert out == "done" and len(calls) == 3
+    assert sleeps == [0.01, 0.02]  # exponential
+
+
+def test_retry_with_backoff_exhausted_reraises():
+    def always():
+        raise ValueError("persistent")
+
+    with pytest.raises(ValueError, match="persistent"):
+        rcv.retry_with_backoff(
+            always, retries=2, backoff_s=0.0001, site="ckpt_commit",
+            event="recovery_ckpt_retry", sleep=lambda s: None,
+        )
+
+
+def test_classify_error_taxonomy():
+    spec = FaultSpec("serve_chunk", 0, "lane_fault", fault_id="fid")
+    assert rcv.classify_error(InjectedFault(spec)) == "injected"
+    assert rcv.fault_id_of(InjectedFault(spec)) == "fid"
+    assert rcv.classify_error(FileNotFoundError("x")) == "io"
+    assert rcv.classify_error(ValueError("x")) == "bad_input"
+    assert rcv.classify_error(RuntimeError("XlaRuntimeError: dead")) == \
+        "runtime"
+    assert rcv.classify_error(RuntimeError("huh")) == "internal"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint digest + validated fallback
+
+
+def _state(seed, n=512):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal(n).astype(np.float32),
+        "step": np.int32(seed),
+    }
+
+
+def test_digest_roundtrip_and_mismatch(tmp_path):
+    s = _state(1)
+    d = rcv.state_digest(s)
+    assert d == rcv.state_digest(_state(1))
+    assert d != rcv.state_digest(_state(2))
+    rcv.write_digest(str(tmp_path), d)
+    assert rcv.read_digest(str(tmp_path)) == d
+    assert rcv.read_digest(str(tmp_path / "missing")) is None
+
+
+def test_validate_restored_digest_and_finiteness(tmp_path):
+    s = _state(1)
+    rcv.write_digest(str(tmp_path), rcv.state_digest(s))
+    ok, reason = rcv.validate_restored(str(tmp_path), s)
+    assert ok, reason
+    bad = dict(s, w=s["w"] + 1)
+    ok, reason = rcv.validate_restored(str(tmp_path), bad)
+    assert not ok and "digest" in reason
+    poisoned = dict(s, w=np.full_like(s["w"], np.nan))
+    ok, reason = rcv.validate_restored(str(tmp_path), poisoned)
+    assert not ok and "non-finite" in reason
+
+
+def test_restore_with_fallback_skips_corrupt_latest(tmp_path):
+    """Truncated array payload under the LATEST commit: the validated
+    restore must fall back to the prior commit, loudly, with a
+    recovery_restore_fallback event — never load garbage silently."""
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.training.checkpoint import save_checkpoint
+
+    cfg = {"model": {"name": "m"}, "optimizer": {"name": "o"}}
+    root = str(tmp_path / "ck")
+    s1, s2 = _state(1), _state(2)
+    save_checkpoint(root, s1, cfg, 1, 0.5)
+    time.sleep(0.02)  # mtime orders the candidates
+    save_checkpoint(root, s2, cfg, 2, 0.4)
+    flt.truncate_checkpoint_arrays(
+        os.path.join(root, "checkpoint-iteration2")
+    )
+
+    tel = str(tmp_path / "t.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        state, start, best, path = rcv.restore_with_fallback(
+            root, _state(9), cfg
+        )
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    assert path == os.path.join(root, "checkpoint-iteration1")
+    assert start == 2 and best == 0.5
+    np.testing.assert_array_equal(state["w"], s1["w"])
+    recs = [json.loads(line) for line in open(tel)]
+    ev = [r for r in recs if r.get("name") == "recovery_restore_fallback"]
+    assert len(ev) == 1 and ev[0]["site"] == "ckpt_restore"
+    assert ev[0]["path"].endswith("checkpoint-iteration2")
+
+
+def test_restore_with_fallback_fires_injected_truncation(tmp_path):
+    """The ckpt_restore fault site: a scheduled `truncate` spec corrupts
+    the candidate ON DISK before the restore attempt — real bytes — and
+    the fallback machinery recovers to the prior commit."""
+    from esr_tpu.training.checkpoint import save_checkpoint
+
+    cfg = {"model": {"name": "m"}, "optimizer": {"name": "o"}}
+    root = str(tmp_path / "ck")
+    save_checkpoint(root, _state(1), cfg, 1, 0.0)
+    time.sleep(0.02)
+    save_checkpoint(root, _state(2), cfg, 2, 0.0)
+    plan = FaultPlan([FaultSpec("ckpt_restore", 0, "truncate")])
+    with flt.installed(plan):
+        state, start, _, path = rcv.restore_with_fallback(
+            root, _state(9), cfg
+        )
+    assert plan.summary()["injected"] == 1
+    assert path.endswith("checkpoint-iteration1")
+    np.testing.assert_array_equal(state["w"], _state(1)["w"])
+
+
+# ---------------------------------------------------------------------------
+# prefetcher stall watchdog
+
+
+def _prefetch_all(pf):
+    out = []
+    for host, staged in pf:
+        out.append(staged)
+    return out
+
+
+def test_prefetcher_stall_watchdog_restarts_and_preserves_items(tmp_path):
+    from esr_tpu.data.loader import DevicePrefetcher
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    plan = FaultPlan([
+        FaultSpec("prefetch", 2, "stall", arg=1.2),
+    ])
+    tel = str(tmp_path / "t.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        with flt.installed(plan):
+            pf = DevicePrefetcher(
+                range(8), lambda x: x * 10, depth=2, stall_timeout=0.3,
+            )
+            items = _prefetch_all(pf)
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    assert items == [x * 10 for x in range(8)]  # nothing lost or reordered
+    assert pf.restarts == 1 and not pf.degraded
+    recs = [json.loads(line) for line in open(tel)]
+    names = [r.get("name") for r in recs]
+    assert "fault_injected" in names
+    assert "recovery_prefetch_restart" in names
+
+
+def test_prefetcher_double_stall_degrades_to_synchronous(tmp_path):
+    from esr_tpu.data.loader import DevicePrefetcher
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    plan = FaultPlan([
+        FaultSpec("prefetch", 1, "stall", arg=1.2),
+        FaultSpec("prefetch", 3, "stall", arg=1.2),
+    ])
+    tel = str(tmp_path / "t.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        with flt.installed(plan):
+            pf = DevicePrefetcher(
+                range(6), lambda x: x + 100, depth=2, stall_timeout=0.25,
+            )
+            items = _prefetch_all(pf)
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    assert sorted(items) == [x + 100 for x in range(6)]
+    assert pf.degraded
+    recs = [json.loads(line) for line in open(tel)]
+    names = [r.get("name") for r in recs]
+    assert "recovery_prefetch_restart" in names
+    assert "recovery_prefetch_degrade" in names
+
+
+def test_prefetcher_corrupt_fault_poisons_batch():
+    from esr_tpu.data.loader import DevicePrefetcher
+
+    plan = FaultPlan([FaultSpec("prefetch", 1, "corrupt")])
+    src = [{"x": np.ones(8, np.float32)} for _ in range(3)]
+    with flt.installed(plan):
+        pf = DevicePrefetcher(src, lambda b: b, depth=2)
+        staged = _prefetch_all(pf)
+    assert not np.isnan(staged[0]["x"]).any()
+    assert np.isnan(staged[1]["x"]).any()
+    assert not np.isnan(staged[2]["x"]).any()
+
+
+def test_prefetcher_without_watchdog_unchanged():
+    from esr_tpu.data.loader import DevicePrefetcher
+
+    pf = DevicePrefetcher(range(5), lambda x: -x, depth=2)
+    assert _prefetch_all(pf) == [0, -1, -2, -3, -4]
+    assert pf.restarts == 0 and not pf.degraded
+
+
+# ---------------------------------------------------------------------------
+# serving lane-health ledger
+
+
+def test_lane_health_thresholds():
+    lh = rcv.LaneHealth(quarantine_k=2)
+    assert lh.record(3) == 1
+    assert not lh.should_quarantine(3)
+    assert lh.record(3) == 2
+    assert lh.should_quarantine(3)
+    assert not lh.should_quarantine(0)
+    with pytest.raises(ValueError):
+        rcv.LaneHealth(quarantine_k=0)
+
+
+def test_scheduler_quarantine_excluded_from_binding_and_last_lane_guard():
+    from esr_tpu.serving import LaneScheduler, RequestClass, StreamRequest
+
+    sched = LaneScheduler(2)
+    sched.quarantine(0)
+    assert sched.healthy_lanes() == 1
+    with pytest.raises(ValueError, match="last healthy lane"):
+        sched.quarantine(1)
+    req = StreamRequest("r", "/p", RequestClass("c"))
+    sched.submit(req)
+    bound = sched.bind_free_lanes(0.0)
+    assert bound == [(1, req)]  # lane 0 never offered

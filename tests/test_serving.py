@@ -488,3 +488,189 @@ def test_aot_geometry_mismatch_rejected(
     srv.submit(recordings[0])
     with pytest.raises(KeyError, match="chunk_windows=4"):
         srv.run()
+
+
+# ---------------------------------------------------------------------------
+# resilience: typed error capture, lane quarantine, bounded retry (ISSUE 10)
+
+
+def test_bad_stream_status_and_error_kind_schema(
+    recordings, model_and_params, tmp_path
+):
+    """The typed replacement for the old blanket swallow: per-request
+    reports and serve_request_done events carry a pinned status +
+    error_kind, so shed / bad-stream / faulted / quarantine-exhausted are
+    distinguishable offline."""
+    import json
+
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    model, params = model_and_params
+    tel = str(tmp_path / "tel.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        srv = ServingEngine(
+            model, params, DATASET_CFG, lanes=2, classes=_classes(4),
+            default_class="only", preempt_quantum=0,
+        )
+        good = srv.submit(recordings[0])
+        bad = srv.submit(str(recordings[0]) + ".does-not-exist")
+        srv.run()
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+    rep_bad = srv.report(bad)
+    assert rep_bad["status"] == "bad_stream"
+    assert rep_bad["error_kind"] == "io"
+    assert rep_bad["retries"] == 0
+    rep_good = srv.report(good)
+    assert rep_good["status"] == "ok" and rep_good["error_kind"] is None
+
+    with open(tel) as f:
+        recs = [json.loads(line) for line in f]
+    done = {r["request"]: r for r in recs
+            if r.get("type") == "event" and r["name"] == "serve_request_done"}
+    # pinned event schema: every terminal event carries the classification
+    for rid, ev in done.items():
+        assert "status" in ev and "error_kind" in ev and "retries" in ev, ev
+    assert done[bad]["status"] == "bad_stream"
+    assert done[bad]["error_kind"] == "io"
+    assert done[good]["status"] == "ok"
+
+
+def test_lane_fault_quarantine_and_bounded_retry(
+    recordings, model_and_params, tmp_path
+):
+    """A lane faulting `lane_quarantine_k` times is drained and
+    quarantined; the faulted request is re-admitted once (stream
+    restarted, accumulators reset) and completes with full metrics."""
+    import json
+
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+    model, params = model_and_params
+    # fault-free reference for the retried stream's metrics
+    ref = ServingEngine(
+        model, params, DATASET_CFG, lanes=2, classes=_classes(4),
+        default_class="only", preempt_quantum=0,
+    )
+    r0 = ref.submit(recordings[0])
+    ref.run()
+    ref_rep = ref.report(r0)
+
+    plan = FaultPlan([FaultSpec("serve_chunk", 0, "lane_fault")])
+    tel = str(tmp_path / "tel.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        srv = ServingEngine(
+            model, params, DATASET_CFG, lanes=2, classes=_classes(4),
+            default_class="only", preempt_quantum=0,
+            lane_quarantine_k=1, request_retries=1,
+        )
+        rid = srv.submit(recordings[0])
+        other = srv.submit(recordings[1])
+        with installed(plan):
+            srv.run()
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+    rep = srv.report(rid)
+    assert rep["status"] == "ok" and rep["retries"] == 1
+    assert rep["n_windows"] == ref_rep["n_windows"]
+    for k in METRIC_KEYS:
+        assert rep[k] == pytest.approx(ref_rep[k], rel=1e-5), k
+    assert srv.report(other)["status"] == "ok"
+    assert srv.scheduler.quarantined  # the faulting lane is broken open
+    with open(tel) as f:
+        names = [json.loads(line).get("name") for line in f]
+    assert "fault_injected" in names
+    assert "recovery_lane_quarantine" in names
+    assert "recovery_request_retry" in names
+
+
+def test_lane_fault_without_retry_budget_fails_classified(
+    recordings, model_and_params
+):
+    from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+    model, params = model_and_params
+    srv = ServingEngine(
+        model, params, DATASET_CFG, lanes=2, classes=_classes(4),
+        default_class="only", preempt_quantum=0,
+        lane_quarantine_k=1, request_retries=0,
+    )
+    rid = srv.submit(recordings[0])
+    plan = FaultPlan([FaultSpec("serve_chunk", 0, "lane_fault")])
+    with installed(plan):
+        srv.run()
+    rep = srv.report(rid)
+    assert not rep["completed"]
+    assert rep["status"] == "quarantine_exhausted"
+    assert rep["error_kind"] == "injected"
+
+
+def test_preempt_signal_drains_and_resumes(recordings, model_and_params):
+    """A simulated preemption signal drains every bound lane (states
+    saved, requests requeued); the session completes with full window
+    counts — resumption is the existing bit-identical machinery."""
+    from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+    model, params = model_and_params
+    ref = ServingEngine(
+        model, params, DATASET_CFG, lanes=2, classes=_classes(3),
+        default_class="only", preempt_quantum=0,
+    )
+    ids = [ref.submit(p) for p in recordings[:2]]
+    ref.run()
+
+    srv = ServingEngine(
+        model, params, DATASET_CFG, lanes=2, classes=_classes(3),
+        default_class="only", preempt_quantum=0,
+    )
+    ids2 = [srv.submit(p) for p in recordings[:2]]
+    plan = FaultPlan([FaultSpec("serve_chunk", 2, "preempt_signal")])
+    with installed(plan):
+        srv.run()
+    for a, b in zip(ids, ids2):
+        ra, rb = ref.report(a), srv.report(b)
+        assert rb["status"] == "ok"
+        assert rb["n_windows"] == ra["n_windows"]
+        for k in METRIC_KEYS:
+            assert rb[k] == pytest.approx(ra[k], rel=1e-5), k
+    assert sum(srv.report(b)["preemptions"] for b in ids2) >= 1
+
+
+def test_shed_submit_emits_classified_terminal_event(
+    recordings, model_and_params, tmp_path
+):
+    import json
+
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    model, params = model_and_params
+    tel = str(tmp_path / "tel.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        srv = ServingEngine(
+            model, params, DATASET_CFG, lanes=1, classes=_classes(4),
+            default_class="only", max_pending=1, preempt_quantum=0,
+        )
+        srv.submit(recordings[0])
+        with pytest.raises(AdmissionFull):
+            srv.submit(recordings[1])
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    with open(tel) as f:
+        recs = [json.loads(line) for line in f]
+    shed = [r for r in recs if r.get("name") == "serve_request_done"
+            and r.get("status") == "shed"]
+    assert len(shed) == 1
+    assert shed[0]["error_kind"] == "backpressure"
+    assert shed[0]["completed"] is False

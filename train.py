@@ -54,6 +54,11 @@ def main():
     honor_platform_env()
     if args.multihost:
         initialize_multihost()
+    # bounded backend bring-up (docs/RESILIENCE.md): a wedged accelerator
+    # tunnel exits 2 with the attempt log instead of hanging the job
+    from esr_tpu.utils.artifacts import probe_backend_or_exit
+
+    probe_backend_or_exit()
 
     import jax
 
