@@ -55,6 +55,14 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   parser never honors: dead suppressions rot the ratchet. Detection is
   framework-side (``core.analyze_source``, after suppression
   bookkeeping); the class below only registers the name.
+- ESR013 unbounded-label-cardinality — a telemetry emission
+  (``.counter``/``.gauge``/``.span``/``.metric``/``.event``) whose NAME
+  is built from an f-string/``str.format``/``%`` over a runtime value (a
+  loop variable, a request id): every distinct value mints a new metric
+  family, so the live aggregator's per-family state (and any Prometheus
+  scrape) grows without bound. Names must be a fixed vocabulary; the
+  variable belongs in a payload field (``request=rid``), which the
+  aggregator deliberately does not key on.
 
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
@@ -871,6 +879,98 @@ class StaleNoqa(Rule):
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         return ()
+
+
+# telemetry emission methods whose first argument is a METRIC NAME — the
+# aggregation key of the live aggregator and every Prometheus scrape.
+# Payload kwargs (request=..., lane=...) are fields, not keys: high-
+# cardinality values are fine THERE, which is exactly where ESR013 sends
+# them.
+_EMIT_NAME_METHODS = {"counter", "gauge", "span", "metric", "event"}
+
+
+@register_rule
+class UnboundedLabelCardinality(Rule):
+    name = "ESR013"
+    slug = "unbounded-label-cardinality"
+    severity = "warning"
+    hint = (
+        "a metric NAME interpolated from a runtime value (f-string/"
+        ".format/% over a loop variable or request id) mints one "
+        "counter/gauge/sketch family per distinct value — the live "
+        "aggregator (obs/aggregate.py) and any /metrics scrape hold "
+        "per-family state forever, so per-request names are an unbounded "
+        "memory leak. Use a FIXED name from a static vocabulary and carry "
+        "the variable as a payload field (request=rid), or justify with "
+        "`# esr: noqa(ESR013)`"
+    )
+
+    @staticmethod
+    def _dynamic_parts(name_arg: ast.AST) -> List[ast.AST]:
+        """The non-constant expressions interpolated into a metric-name
+        argument, or [] when the name is static. Covers f-strings,
+        ``"...".format(...)``, and ``"..." % (...)``."""
+        if isinstance(name_arg, ast.JoinedStr):
+            return [
+                v.value
+                for v in name_arg.values
+                if isinstance(v, ast.FormattedValue)
+                and not isinstance(v.value, ast.Constant)
+            ]
+        if (
+            isinstance(name_arg, ast.Call)
+            and isinstance(name_arg.func, ast.Attribute)
+            and name_arg.func.attr == "format"
+            and isinstance(name_arg.func.value, ast.Constant)
+            and isinstance(name_arg.func.value.value, str)
+        ):
+            parts = list(name_arg.args) + [k.value for k in name_arg.keywords]
+            return [p for p in parts if not isinstance(p, ast.Constant)]
+        if (
+            isinstance(name_arg, ast.BinOp)
+            and isinstance(name_arg.op, ast.Mod)
+            and isinstance(name_arg.left, ast.Constant)
+            and isinstance(name_arg.left.value, str)
+        ):
+            right = name_arg.right
+            parts = (list(right.elts) if isinstance(right, ast.Tuple)
+                     else [right])
+            return [p for p in parts if not isinstance(p, ast.Constant)]
+        return []
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in _EMIT_NAME_METHODS):
+                continue
+            name_arg = None
+            if node.args:
+                name_arg = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+                        break
+            if name_arg is None:
+                continue
+            dynamic = self._dynamic_parts(name_arg)
+            if not dynamic:
+                continue
+            try:
+                interp = ", ".join(f"`{ast.unparse(d)}`" for d in dynamic)
+            except (ValueError, AttributeError):  # description only
+                interp = "a runtime expression"
+            yield self.finding(
+                ctx,
+                node,
+                f"metric name for `.{func.attr}(...)` is interpolated from "
+                f"{interp} — one metric family per distinct value "
+                "(unbounded live-aggregator/scrape cardinality); use a "
+                "fixed name and a payload field",
+            )
 
 
 @register_rule

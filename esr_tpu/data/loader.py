@@ -706,6 +706,29 @@ class DevicePrefetcher:
 
         self._trace_ctx = trace.capture()
         self._thread = self._spawn_producer()
+        # live-plane health export (obs v3, docs/OBSERVABILITY.md): the
+        # stall-watchdog ledger becomes a polled /healthz source. One
+        # trainer drives one prefetcher at a time, so the fixed name
+        # replaces any previous epoch's registration; close() unregisters.
+        # obs.http is stdlib-only — the data layer's no-jax rule (ESR004)
+        # holds.
+        from esr_tpu.obs.http import register_health_source
+
+        register_health_source("device_prefetch", self.health)
+
+    def health(self) -> dict:
+        """Component health for the live plane's ``/healthz``: a fired
+        stall watchdog (restart or degrade) marks the prefetcher
+        unhealthy — the host feed needed intervention."""
+        return {
+            "healthy": not self.degraded and self.restarts == 0,
+            "gets": self.gets,
+            "stalls": self.stalls,
+            "stall_s": round(self.stall_s, 6),
+            "restarts": self.restarts,
+            "degraded": self.degraded,
+            "queue_depth": self._q.qsize(),
+        }
 
     def _spawn_producer(self):
         import threading
@@ -944,6 +967,9 @@ class DevicePrefetcher:
         """Stop the producer and release queued staged batches."""
         import sys
 
+        from esr_tpu.obs.http import unregister_health_source
+
+        unregister_health_source("device_prefetch")
         self._stop.set()
 
         def drain():

@@ -24,7 +24,23 @@ One subsystem, now two halves:
   (inference/engine.py, serving/server.py), and the metric writers
   (utils/writer.py, utils/trackers.py).
 
-**Consuming** (offline, ``python -m esr_tpu.obs``):
+**Consuming, live** (obs v3 — in-process, opt-in; docs/OBSERVABILITY.md
+"The live plane"):
+
+- :mod:`esr_tpu.obs.aggregate` — :class:`LiveAggregator`: streaming
+  counters/gauges + mergeable log-bucketed quantile sketches
+  (:class:`QuantileSketch`, DDSketch-style) per span family, tapped into
+  the active sink's record stream, with windowed snapshots in the offline
+  reporter's dotted namespace;
+- :mod:`esr_tpu.obs.http` — dependency-free HTTP exposition over the
+  aggregator: ``/metrics`` (Prometheus v0.0.4), ``/healthz`` (component
+  health registry), ``/slo`` (live multi-window burn-rate evaluation of
+  ``configs/slo.yml``, 200/429/503);
+- :mod:`esr_tpu.obs.device` — ``DeviceWatermark`` memory gauges
+  (None-tolerant on CPU) and the bounded ``ProfilerCapture``
+  (``--profile-steps``) that stamps on-chip captures into the stream.
+
+**Consuming, offline** (``python -m esr_tpu.obs``):
 
 - :mod:`esr_tpu.obs.export` — telemetry.jsonl → Chrome trace-event /
   Perfetto JSON (one track per host thread, virtual tracks per lane and
@@ -42,6 +58,7 @@ host-side only — no ``obs`` call may appear inside jitted/scanned code
 """
 
 from esr_tpu.obs import trace
+from esr_tpu.obs.aggregate import LiveAggregator, QuantileSketch
 from esr_tpu.obs.sink import (
     SCHEMA_VERSION,
     TelemetrySink,
@@ -54,6 +71,8 @@ from esr_tpu.obs.spans import StepAttribution, StepSpans
 
 __all__ = [
     "SCHEMA_VERSION",
+    "LiveAggregator",
+    "QuantileSketch",
     "TelemetrySink",
     "active_sink",
     "config_fingerprint",

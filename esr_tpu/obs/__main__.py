@@ -7,6 +7,9 @@
   trace completeness) printed as JSON; with ``--slo`` the run is gated
   against declarative thresholds (obs/report.py).
 
+Both subcommands take ``--run-index N`` to select a run of an appended
+multi-run file (default ``-1`` = the last run; out-of-range exits 2).
+
 Exit codes: 0 ok / every SLO rule passed, 1 SLO violation, 2 usage or
 unreadable input (a broken gate must fail loudly, never pass silently).
 Full walkthrough: docs/OBSERVABILITY.md.
@@ -38,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
         "-o", "--out", default=None,
         help="output path (default: <telemetry>.trace.json)",
     )
+    ex.add_argument(
+        "--run-index", type=int, default=-1,
+        help="which run of an appended multi-run file (0-based; negative "
+             "counts from the end; default -1 = last run)",
+    )
 
     rp = sub.add_parser(
         "report", help="roll up a run and (optionally) gate it on an SLO"
@@ -51,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
         "-o", "--out", default=None,
         help="also write the JSON document to this path",
     )
+    rp.add_argument(
+        "--run-index", type=int, default=-1,
+        help="which run of an appended multi-run file (0-based; negative "
+             "counts from the end; default -1 = last run)",
+    )
     return p
 
 
@@ -61,8 +74,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         out = args.out or (args.telemetry + ".trace.json")
         try:
-            stats = export_file(args.telemetry, out)
-        except OSError as e:
+            stats = export_file(args.telemetry, out,
+                                run_index=args.run_index)
+        except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
         print(json.dumps(stats))
@@ -71,7 +85,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     from esr_tpu.obs.report import report_file
 
     try:
-        doc, code = report_file(args.telemetry, args.slo, args.out)
+        doc, code = report_file(args.telemetry, args.slo, args.out,
+                                run_index=args.run_index)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,213 @@
+"""Device-side visibility for the live plane (obs v3).
+
+Two producers that make on-chip state first-class telemetry instead of
+ad-hoc script output (docs/OBSERVABILITY.md "Device-side visibility"):
+
+- :class:`DeviceWatermark` — a polling thread emitting
+  ``device_mem_bytes_in_use`` / ``device_mem_peak_bytes`` gauges from
+  ``device.memory_stats()`` into the active sink (and, through the sink's
+  observer tap, the live aggregator — so ``/metrics`` exposes HBM
+  occupancy while a run is in flight). **None-tolerant on CPU**: backends
+  without memory stats poll once, observe the ``None``, emit a single
+  ``device_watermark_unavailable`` event, and stop — zero recurring cost
+  where the signal does not exist.
+- :class:`ProfilerCapture` — the ``--profile-steps N`` knob's body: wraps
+  ``jax.profiler.start_trace``/``stop_trace`` around the next ``N``
+  steps/chunks of the trainer or serving loop and stamps a
+  ``profiler_capture`` telemetry event carrying the artifact directory,
+  so an on-chip capture is a durable, discoverable record in the run's
+  evidence stream (the r5 verdict's missing captures were exactly this
+  kind of script-local state).
+
+Contract notes (the sink's rules apply): ``jax`` is imported lazily and
+only AFTER probing ``backends_are_initialized`` — these helpers are
+started by entry points that have already made backend contact, but must
+stay wedge-proof if constructed earlier; every failure path degrades to a
+warning + telemetry event, never an exception into the hot loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from esr_tpu.obs.sink import active_sink
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DeviceWatermark", "ProfilerCapture", "device_memory_stats"]
+
+
+def device_memory_stats(device_index: int = 0) -> Optional[Dict]:
+    """``jax.devices()[i].memory_stats()`` behind the wedge-proof probe:
+    returns None when no backend is initialized, the platform reports no
+    stats (CPU), or anything raises. Never initializes a backend."""
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return None
+        import jax
+
+        devs = jax.devices()
+        if not devs or device_index >= len(devs):
+            return None
+        stats = devs[device_index].memory_stats()
+        return dict(stats) if stats else None
+    except Exception:  # noqa: BLE001 - visibility is best-effort by contract
+        return None
+
+
+class DeviceWatermark:
+    """Poll device memory stats into the telemetry stream (module
+    docstring). ``start()`` spawns a daemon thread; ``stop()`` joins it.
+    ``poll_once()`` is the testable body."""
+
+    def __init__(self, sink=None, interval_s: float = 1.0,
+                 device_index: int = 0):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self._sink = sink
+        self.interval_s = float(interval_s)
+        self.device_index = int(device_index)
+        self.polls = 0
+        self.peak_bytes = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reported_unavailable = False
+
+    def _sink_now(self):
+        return self._sink if self._sink is not None else active_sink()
+
+    def poll_once(self) -> Optional[Dict]:
+        """One poll: emit the gauges when stats exist; on the first
+        stat-less poll emit ``device_watermark_unavailable`` (once) and
+        return None — the caller (or the thread loop) stops polling."""
+        self.polls += 1
+        stats = device_memory_stats(self.device_index)
+        sink = self._sink_now()
+        if stats is None:
+            if sink is not None and not self._reported_unavailable:
+                self._reported_unavailable = True
+                sink.event(
+                    "device_watermark_unavailable",
+                    device_index=self.device_index,
+                )
+            return None
+        in_use = int(stats.get("bytes_in_use", 0) or 0)
+        peak = int(
+            stats.get("peak_bytes_in_use", 0) or 0
+        ) or max(self.peak_bytes, in_use)
+        self.peak_bytes = max(self.peak_bytes, peak, in_use)
+        if sink is not None:
+            sink.gauge(
+                "device_mem_bytes_in_use", in_use,
+                device_index=self.device_index,
+            )
+            sink.gauge(
+                "device_mem_peak_bytes", self.peak_bytes,
+                device_index=self.device_index,
+                limit_bytes=stats.get("bytes_limit"),
+            )
+        return {"bytes_in_use": in_use, "peak_bytes": self.peak_bytes,
+                "bytes_limit": stats.get("bytes_limit")}
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.poll_once() is None:
+                return  # no stats on this backend: stop, loudly (event)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "DeviceWatermark":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="device-watermark"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(2.0, 2 * self.interval_s))
+            self._thread = None
+
+
+class ProfilerCapture:
+    """Bounded on-chip profiler capture: trace the next ``steps``
+    steps/chunks, then stop and stamp a ``profiler_capture`` event with
+    the artifact directory (module docstring).
+
+    Drive it from a host loop: ``maybe_start()`` before the loop,
+    ``step(n)`` after each super-step/chunk (stops itself at the budget),
+    ``stop()`` in the teardown ``finally`` (idempotent — covers loops
+    shorter than the budget). All failure paths log + stamp the event
+    with ``error`` instead of raising: a broken profiler must not take
+    the run down."""
+
+    def __init__(self, trace_dir: str, steps: int, sink=None,
+                 site: str = "train"):
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        self.trace_dir = trace_dir
+        self.steps = int(steps)
+        self.site = site
+        self._sink = sink
+        self.steps_covered = 0
+        self._active = False
+        self._done = False
+        self._error: Optional[str] = None
+
+    def maybe_start(self) -> bool:
+        if self._active or self._done:
+            return self._active
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        except Exception as e:  # noqa: BLE001 - capture is best-effort
+            self._error = repr(e)
+            self._done = True
+            logger.warning(
+                "profiler capture failed to start (%s): %r",
+                self.trace_dir, e,
+            )
+            self._emit()
+        return self._active
+
+    def step(self, n: int = 1) -> None:
+        if not self._active:
+            return
+        self.steps_covered += int(n)
+        if self.steps_covered >= self.steps:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        self._active = False
+        self._done = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - capture is best-effort
+            self._error = repr(e)
+            logger.warning("profiler capture failed to stop: %r", e)
+        self._emit()
+
+    def _emit(self) -> None:
+        sink = self._sink if self._sink is not None else active_sink()
+        if sink is None:
+            return
+        sink.event(
+            "profiler_capture",
+            dir=self.trace_dir,
+            steps=self.steps,
+            steps_covered=self.steps_covered,
+            site=self.site,
+            ok=self._error is None,
+            error=self._error,
+        )

@@ -53,26 +53,55 @@ _PID_REQUESTS = 3
 _PID_COUNTERS = 4
 
 
-def read_telemetry(path: str) -> Tuple[Optional[Dict], List[Dict], int]:
+def read_telemetry(
+    path: str, run_index: int = -1
+) -> Tuple[Optional[Dict], List[Dict], int]:
     """Parse one telemetry.jsonl → ``(manifest, records, torn_lines)``.
 
     - the manifest is the run's ``type: "manifest"`` header record (None
       for a file that lost its header — still readable);
-    - **appended multi-run files return the LAST run only**: the sink
-      opens its file in append mode, and every run's ``t``/``begin`` axis
-      restarts at zero — merging two runs would overlay their timelines
-      (inflating the reporter's serving wall and drawing two runs on top
-      of each other in Perfetto). Each subsequent manifest record starts
-      a fresh segment; earlier segments are discarded.
+    - **appended multi-run files return ONE run** (``run_index``, default
+      ``-1`` = the last — today's pinned behavior): the sink opens its
+      file in append mode, and every run's ``t``/``begin`` axis restarts
+      at zero — merging two runs would overlay their timelines (inflating
+      the reporter's serving wall and drawing two runs on top of each
+      other in Perfetto). Each manifest record starts a fresh segment;
+      ``run_index`` selects among them (negative indices count from the
+      end, list-style), and an out-of-range index raises ``ValueError``
+      naming how many runs the file holds — plumbed through
+      ``obs export --run-index`` / ``obs report --run-index`` so earlier
+      runs stay reachable;
     - v1 files (``schema_version: 1``, spans without trace fields) come
       back as-is; consumers treat missing trace fields as "unlinked";
     - unparseable lines are skipped and counted (``torn_lines``): a
       SIGKILL mid-write tears at most the final line because every record
       is flushed as it is written (obs/sink.py).
     """
+    # Streaming with bounded retention: only segments still REACHABLE by
+    # the requested index keep their parsed records (the last |run_index|
+    # for a negative index — one for the default -1, matching the old
+    # last-run-wins memory profile on arbitrarily long appended files;
+    # exactly the target segment for a non-negative index). Every other
+    # segment is parsed only enough to be counted.
+    keep_last = None if run_index >= 0 else -run_index
+    # (ordinal, manifest, records, torn) for retained segments only
+    segments: List[Tuple[int, Optional[Dict], List[Dict], int]] = []
+    ordinal = -1  # index of the open segment; -1 = none opened yet
     manifest: Optional[Dict] = None
     records: List[Dict] = []
     torn = 0
+
+    def _keep(idx: int) -> bool:
+        return keep_last is not None or idx == run_index
+
+    def _close_open() -> None:
+        if ordinal < 0:
+            return
+        if _keep(ordinal):
+            segments.append((ordinal, manifest, records, torn))
+            if keep_last is not None and len(segments) > keep_last:
+                segments.pop(0)
+
     # errors="replace": a SIGKILL can tear the final line mid-multibyte
     # character; strict decoding would raise UnicodeDecodeError before
     # json.loads ever ran, breaking the crash-safe contract — replacement
@@ -85,19 +114,39 @@ def read_telemetry(path: str) -> Tuple[Optional[Dict], List[Dict], int]:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                rec = None
+            if rec is not None and (
+                not isinstance(rec, dict) or "type" not in rec
+            ):
+                rec = None
+            if rec is not None and rec["type"] == "manifest":
+                # a new run appended to the same file: close the previous
+                # segment (headerless leading records form their own)
+                _close_open()
+                ordinal += 1
+                manifest, records, torn = rec, [], 0
+                continue
+            if ordinal < 0:
+                ordinal = 0  # headerless leading lines open segment 0
+                manifest, records, torn = None, [], 0
+            if rec is None:
                 torn += 1
-                continue
-            if not isinstance(rec, dict) or "type" not in rec:
-                torn += 1
-                continue
-            if rec["type"] == "manifest":
-                # a new run appended to the same file: last run wins
-                manifest = rec
-                records.clear()
-                torn = 0
-                continue
-            records.append(rec)
-    return manifest, records, torn
+            elif _keep(ordinal):
+                records.append(rec)
+    _close_open()
+    total = ordinal + 1
+    if total == 0:
+        return None, [], 0  # empty file: the pinned pre-multi-run shape
+    actual = run_index if run_index >= 0 else total + run_index
+    if not 0 <= actual < total:
+        raise ValueError(
+            f"run_index {run_index} out of range: {path!r} holds "
+            f"{total} run(s)"
+        )
+    for idx, man, recs, torn_n in segments:
+        if idx == actual:
+            return man, recs, torn_n
+    raise AssertionError("retained segment lookup cannot miss")
 
 
 def span_index(records: Iterable[Dict]) -> Dict[str, Dict]:
@@ -254,10 +303,12 @@ def to_chrome_trace(
     return out
 
 
-def export_file(in_path: str, out_path: str) -> Dict:
+def export_file(in_path: str, out_path: str, run_index: int = -1) -> Dict:
     """Read a telemetry.jsonl and write the Perfetto-loadable JSON;
-    returns ``{"events": n, "torn_lines": n, "out": path}``."""
-    manifest, records, torn = read_telemetry(in_path)
+    returns ``{"events": n, "torn_lines": n, "out": path}``.
+    ``run_index`` selects a run of an appended multi-run file
+    (:func:`read_telemetry`)."""
+    manifest, records, torn = read_telemetry(in_path, run_index=run_index)
     doc = to_chrome_trace(records, manifest)
     with open(out_path, "w") as f:
         json.dump(doc, f)

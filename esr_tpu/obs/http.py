@@ -1,0 +1,424 @@
+"""Dependency-free HTTP exposition for the live telemetry plane (obs v3).
+
+A ``http.server`` thread (stdlib-only, like all of ``esr_tpu.obs``)
+serving three endpoints a router, autoscaler, or human can poll while the
+run is in flight (docs/OBSERVABILITY.md "The live plane"):
+
+- ``/metrics`` — Prometheus text exposition format v0.0.4: every
+  aggregator counter (``*_total``), gauge, span-family sketch (rendered
+  as a summary: ``{quantile="0.5"|"0.99"}`` + ``_sum``/``_count``),
+  per-class window-latency summary, goodput, and serving totals. Metric
+  names are sanitized to ``[a-zA-Z0-9_:]``; label VALUES come only from
+  bounded vocabularies (span family, request class) — analysis rule
+  ESR013 polices the producer side so per-request names can never reach
+  this surface.
+- ``/healthz`` — process liveness + component health: every registered
+  health source (:func:`register_health_source` — the ``DevicePrefetcher``
+  stall watchdog, the serving tier's lane-quarantine ledger) is consulted;
+  HTTP 200 when all healthy, 503 when any is not. The body is JSON with
+  the per-source detail either way.
+- ``/slo`` — LIVE multi-window burn-rate evaluation of the same
+  ``configs/slo.yml`` the offline reporter gates on: the rules are
+  evaluated against the aggregator's fast-window snapshot AND its
+  slow-window snapshot (``windows=(60, 300)`` seconds by default).
+  Both windows violating → 503 (page: the error budget is burning at
+  sustained rate); exactly one violating → 429 (warn: transient spike or
+  recovering); neither → 200. A polling router sheds on 503, eases on
+  429 — the VirtualFlow-style fleet signal ROADMAP.md's autoscaler needs.
+
+Strictly opt-in: nothing constructs this server unless
+``trainer.live_telemetry`` / ``ServingEngine(live_port=...)`` /
+``serve.py --live-port`` asks for it, and it binds loopback by default.
+``port=0`` binds an ephemeral port (tests, multi-replica hosts); the
+bound port is readable at ``server.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "register_health_source",
+    "unregister_health_source",
+    "health_snapshot",
+    "LiveTelemetryServer",
+    "LivePlane",
+    "start_live_plane",
+]
+
+
+# ---------------------------------------------------------------------------
+# health registry: components report liveness without knowing who asks.
+# The exact pattern of obs.set_active_sink — process-global, explicit,
+# cheap. Each source is a callable returning a dict with at least
+# {"healthy": bool}; a raising source reports unhealthy (never raises
+# into the endpoint).
+
+_HEALTH_LOCK = threading.Lock()
+_HEALTH_SOURCES: Dict[str, Callable[[], Dict]] = {}
+
+
+def register_health_source(name: str, fn: Callable[[], Dict]) -> None:
+    """Register (or replace) a named component health callable."""
+    with _HEALTH_LOCK:
+        _HEALTH_SOURCES[name] = fn
+
+
+def unregister_health_source(name: str) -> None:
+    with _HEALTH_LOCK:
+        _HEALTH_SOURCES.pop(name, None)
+
+
+def health_snapshot() -> Tuple[bool, Dict[str, Dict]]:
+    """``(all_healthy, {source: detail})`` over every registered source."""
+    with _HEALTH_LOCK:
+        sources = dict(_HEALTH_SOURCES)
+    out: Dict[str, Dict] = {}
+    healthy = True
+    for name in sorted(sources):
+        try:
+            detail = dict(sources[name]())
+        except Exception as e:  # esr: noqa(ESR012)
+            # not silent: the failure IS the health signal — it surfaces
+            # as {"healthy": false, "error": ...} in the /healthz body
+            # and flips the endpoint to 503
+            detail = {"healthy": False, "error": repr(e)}
+        detail.setdefault("healthy", True)
+        out[name] = detail
+        healthy = healthy and bool(detail["healthy"])
+    return healthy, out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (v0.0.4)
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _pname(name: str) -> str:
+    out = _NAME_SANITIZE.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _label(value) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n"
+    )
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NaN"
+    try:
+        return repr(float(v))
+    except (TypeError, ValueError):
+        return "NaN"
+
+
+def render_prometheus(snapshot: Dict, prefix: str = "esr") -> str:
+    """An aggregator snapshot (``LiveAggregator.snapshot()``) → the
+    Prometheus v0.0.4 text page. Pure function — pinned parseable by
+    ``tests/test_obs_live.py``."""
+    lines = []
+
+    def emit(name, kind, samples, help_=None):
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in samples:
+            if labels:
+                body = ",".join(
+                    f'{k}="{_label(v)}"' for k, v in labels.items()
+                )
+                lines.append(f"{name}{{{body}}} {_fmt(value)}")
+            else:
+                lines.append(f"{name} {_fmt(value)}")
+
+    emit(f"{prefix}_records_total", "counter",
+         [({}, snapshot.get("records", 0))],
+         "telemetry records observed by the live aggregator")
+    for name, total in snapshot.get("counters", {}).items():
+        emit(f"{prefix}_{_pname(name)}_total", "counter", [({}, total)])
+    for name, value in snapshot.get("gauges", {}).items():
+        emit(f"{prefix}_{_pname(name)}", "gauge", [({}, value)])
+    events = snapshot.get("events", {})
+    if events:
+        emit(f"{prefix}_event_total", "counter",
+             [({"event": k}, v) for k, v in sorted(events.items())])
+    goodput = snapshot.get("goodput", {})
+    emit(f"{prefix}_goodput", "gauge", [({}, goodput.get("value"))],
+         "live goodput (attribution-weighted or chunk busy/wall)")
+    serving = snapshot.get("serving", {})
+    if serving:
+        for key in ("requests", "completed", "errors", "windows",
+                    "preemptions"):
+            emit(f"{prefix}_serving_{key}_total", "counter",
+                 [({}, serving.get(key, 0))])
+    # span-family sketches as summaries: bounded label vocabulary (span
+    # family names are static in the codebase — ESR013)
+    spans = snapshot.get("spans", {})
+    if spans:
+        name = f"{prefix}_span_seconds"
+        lines.append(f"# TYPE {name} summary")
+        for fam, rec in sorted(spans.items()):
+            for q, key in ((0.5, "p50_ms"), (0.99, "p99_ms")):
+                v = rec.get(key)
+                v = None if v is None else v / 1e3
+                lines.append(
+                    f'{name}{{span="{_label(fam)}",quantile="{q}"}} '
+                    f"{_fmt(v)}"
+                )
+            lines.append(
+                f'{name}_sum{{span="{_label(fam)}"}} '
+                f"{_fmt(rec.get('total_s'))}"
+            )
+            lines.append(
+                f'{name}_count{{span="{_label(fam)}"}} '
+                f"{_fmt(rec.get('count'))}"
+            )
+    classes = serving.get("classes", {}) if serving else {}
+    if classes:
+        name = f"{prefix}_serving_window_latency_seconds"
+        lines.append(f"# TYPE {name} summary")
+        for cls, rec in sorted(classes.items()):
+            for q, key in ((0.5, "window_latency_p50_ms"),
+                           (0.99, "window_latency_p99_ms")):
+                v = rec.get(key)
+                v = None if v is None else v / 1e3
+                lines.append(
+                    f'{name}{{cls="{_label(cls)}",quantile="{q}"}} '
+                    f"{_fmt(v)}"
+                )
+            lines.append(
+                f'{name}_count{{cls="{_label(cls)}"}} '
+                f"{_fmt(rec.get('windows'))}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the server
+
+
+class LiveTelemetryServer:
+    """The live plane's HTTP surface over one :class:`LiveAggregator`
+    (module docstring). ``start()`` binds and serves on a daemon thread;
+    ``close()`` shuts down. Never traces, never touches jax."""
+
+    def __init__(
+        self,
+        aggregator,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        slo_path: Optional[str] = None,
+        windows: Tuple[float, float] = (60.0, 300.0),
+    ):
+        self.aggregator = aggregator
+        self._host = host
+        self._want_port = int(port)
+        self.slo_path = slo_path
+        self._slo = None
+        if slo_path is not None:
+            from esr_tpu.obs.report import load_slo
+
+            self._slo = load_slo(slo_path)  # fail fast on a broken gate
+        if not (len(windows) == 2 and 0 < windows[0] <= windows[1]):
+            raise ValueError(
+                f"windows must be (fast_s, slow_s) with 0 < fast <= slow, "
+                f"got {windows!r}"
+            )
+        self.windows = (float(windows[0]), float(windows[1]))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- endpoint bodies (pure, testable without sockets) -------------------
+
+    def metrics_page(self) -> str:
+        return render_prometheus(self.aggregator.snapshot())
+
+    def healthz_doc(self) -> Tuple[int, Dict]:
+        healthy, sources = health_snapshot()
+        snap = self.aggregator.snapshot()
+        doc = {
+            "healthy": healthy,
+            "uptime_s": snap.get("uptime_s"),
+            "records": snap.get("records"),
+            "sources": sources,
+        }
+        return (200 if healthy else 503), doc
+
+    def _eval_window(self, window_s: float) -> Dict:
+        """One window's burn verdict. Absence of evidence is not a burn:
+        an EMPTY window (zero records — an idle replica) is "no data" as
+        a whole, and a rule whose metric is simply ABSENT from the window
+        (goodput between attribution records, serving classes before the
+        first resolve) is skipped-as-missing rather than violated. The
+        offline gate keeps its strict missing=violation semantics for
+        finished runs; a live WINDOW legitimately lacks subsystems that
+        did not emit during it, and scoring that as a sustained burn
+        would make the router contract (503 → drain) kill healthy
+        replicas on every traffic lull or cadence gap. A present-but-
+        non-finite metric (NaN) still violates."""
+        from esr_tpu.obs.report import evaluate_slo
+
+        snap = self.aggregator.snapshot(window_s=window_s)
+        if snap.get("records", 0) == 0:
+            return {"ok": True, "no_data": True, "violations": [],
+                    "missing": []}
+        _ok, verdicts = evaluate_slo(snap, self._slo)
+        missing = [v["name"] for v in verdicts
+                   if not v["ok"] and v["value"] is None]
+        violations = [v for v in verdicts
+                      if not v["ok"] and v["value"] is not None]
+        return {"ok": not violations, "no_data": False,
+                "violations": violations, "missing": missing}
+
+    def slo_doc(self) -> Tuple[int, Dict]:
+        if self._slo is None:
+            return 404, {"error": "no SLO file configured (--live-slo / "
+                                  "slo_path)"}
+        fast_s, slow_s = self.windows
+        fast = self._eval_window(fast_s)
+        slow = self._eval_window(slow_s)
+        if not fast["ok"] and not slow["ok"]:
+            status, verdict = 503, "page"       # sustained burn
+        elif not (fast["ok"] and slow["ok"]):
+            status, verdict = 429, "warn"       # spike or recovering
+        else:
+            status, verdict = 200, "ok"
+        return status, {
+            "verdict": verdict,
+            "slo": self.slo_path,
+            "windows_s": [fast_s, slow_s],
+            "fast": fast,
+            "slow": slow,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return (self._httpd.server_address[1]
+                if self._httpd is not None else None)
+
+    def start(self) -> "LiveTelemetryServer":
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+            def _send(self, status: int, body: str, ctype: str) -> None:
+                payload = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200, server.metrics_page(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        status, doc = server.healthz_doc()
+                        self._send(status, json.dumps(doc, indent=2),
+                                   "application/json")
+                    elif path == "/slo":
+                        status, doc = server.slo_doc()
+                        self._send(status, json.dumps(doc, indent=2),
+                                   "application/json")
+                    else:
+                        self._send(
+                            404,
+                            json.dumps({"endpoints": [
+                                "/metrics", "/healthz", "/slo"]}),
+                            "application/json",
+                        )
+                except Exception as e:  # noqa: BLE001 - endpoint must answer
+                    self._send(500, json.dumps({"error": repr(e)}),
+                               "application/json")
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._want_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True,
+            name="obs-live-http",
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class LivePlane:
+    """One attached live plane: aggregator tapped into a sink + the HTTP
+    server over it. ``close()`` detaches and shuts down (idempotent)."""
+
+    def __init__(self, sink, aggregator, server: LiveTelemetryServer):
+        self.sink = sink
+        self.aggregator = aggregator
+        self.server = server
+
+    @property
+    def port(self) -> Optional[int]:
+        return self.server.port
+
+    def close(self) -> None:
+        self.server.close()
+        if self.sink is not None:
+            self.aggregator.detach(self.sink)
+            self.sink = None
+
+
+def start_live_plane(
+    sink,
+    port: int = 0,
+    host: str = "127.0.0.1",
+    slo_path: Optional[str] = None,
+    windows: Tuple[float, float] = (60.0, 300.0),
+    rel_err: float = 0.01,
+) -> LivePlane:
+    """The one-call wiring every entry point uses: build a
+    :class:`~esr_tpu.obs.aggregate.LiveAggregator`, attach it to ``sink``,
+    and serve it. The caller owns ``close()`` (put it in the teardown
+    ``finally`` next to the sink's)."""
+    from esr_tpu.obs.aggregate import LiveAggregator
+
+    if sink is None:
+        raise ValueError(
+            "live telemetry requires an active TelemetrySink (the live "
+            "plane runs BESIDE the JSONL stream, never instead of it — "
+            "docs/OBSERVABILITY.md)"
+        )
+    aggregator = LiveAggregator(rel_err=rel_err).attach(sink)
+    server = LiveTelemetryServer(
+        aggregator, port=port, host=host, slo_path=slo_path,
+        windows=windows,
+    ).start()
+    return LivePlane(sink, aggregator, server)

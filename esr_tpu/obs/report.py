@@ -60,6 +60,7 @@ from esr_tpu.obs.export import _span_edges, read_telemetry
 
 __all__ = [
     "percentile",
+    "percentile_ms",
     "build_report",
     "load_slo",
     "evaluate_slo",
@@ -70,7 +71,14 @@ __all__ = [
 def percentile(values: Sequence[float], q: float) -> Optional[float]:
     """The ``q``-th percentile (0..100) with linear interpolation between
     order statistics — numpy.percentile's default method, implemented
-    stdlib-only and pinned against numpy in tests."""
+    stdlib-only and pinned against numpy in tests.
+
+    THE percentile definition of the whole telemetry surface: the offline
+    reporter, ``ServingEngine.report``/``summary`` (the live per-request
+    numbers), and the live aggregator's sketch interpolation all route
+    through this method so the three views can never drift on percentile
+    convention (the ``np.percentile``-vs-pure-python split this PR
+    removed)."""
     vals = sorted(float(v) for v in values)
     if not vals:
         return None
@@ -83,6 +91,16 @@ def percentile(values: Sequence[float], q: float) -> Optional[float]:
         return vals[lo]
     frac = rank - lo
     return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+def percentile_ms(
+    values_s: Sequence[float], q: float, ndigits: int = 3
+) -> Optional[float]:
+    """:func:`percentile` over seconds, reported in rounded milliseconds —
+    the shared seconds→ms convention of the serving summaries and the
+    reporter's span tables."""
+    p = percentile(values_s, q)
+    return None if p is None else round(p * 1e3, ndigits)
 
 
 def _pctl_ms(lat_s: Sequence[float]) -> Dict[str, Optional[float]]:
@@ -437,11 +455,15 @@ def report_file(
     telemetry_path: str,
     slo_path: Optional[str] = None,
     out_path: Optional[str] = None,
+    run_index: int = -1,
 ) -> Tuple[Dict, int]:
     """The CLI body: read, roll up, optionally gate; returns
     ``(document, exit_code)``. The document always contains the report;
-    with an SLO it adds ``{"slo": {"ok", "verdicts"}}``."""
-    manifest, records, torn = read_telemetry(telemetry_path)
+    with an SLO it adds ``{"slo": {"ok", "verdicts"}}``. ``run_index``
+    selects a run of an appended multi-run file (obs/export.py)."""
+    manifest, records, torn = read_telemetry(
+        telemetry_path, run_index=run_index
+    )
     report = build_report(records, manifest, torn_lines=torn)
     doc: Dict = {"report": report}
     code = 0

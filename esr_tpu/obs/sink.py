@@ -34,16 +34,19 @@ from __future__ import annotations
 import atexit
 import hashlib
 import json
+import logging
 import os
 import socket
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 # module-level, not per-record: trace.py never imports sink at module
 # scope (its sink lookup is lazy inside SpanHandle.end), so this creates
 # no cycle — and _trace_fields runs on EVERY record write
 from esr_tpu.obs.trace import current as _trace_current
+
+logger = logging.getLogger(__name__)
 
 # v2 (docs/OBSERVABILITY.md "Schema v2"): span records MAY carry trace
 # context (trace_id / span_id / parent_id), begin/end timestamps on the
@@ -146,6 +149,14 @@ class TelemetrySink:
         self._lock = threading.RLock()
         self._counts: Dict[str, float] = {}
         self.dropped = 0
+        # record observers (obs v3, docs/OBSERVABILITY.md "live plane"):
+        # each is called with every record dict right after it is built —
+        # the LiveAggregator's tap. Copy-on-write tuple so the hot write
+        # path iterates without taking the lock; observer exceptions are
+        # counted + warned once, never raised into the emitting loop.
+        self._observers: Tuple[Callable[[Dict], None], ...] = ()
+        self.observer_errors = 0
+        self._observer_warned = False
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -175,19 +186,52 @@ class TelemetrySink:
         try:
             line = json.dumps(rec)
         except (TypeError, ValueError):
-            line = json.dumps(
-                {**{k: rec[k] for k in ("t", "type", "name")},
-                 "unserializable": True}
-            )
+            rec = {**{k: rec[k] for k in ("t", "type", "name")},
+                   "unserializable": True}
+            line = json.dumps(rec)
+        written = False
         with self._lock:
             if self._f is None or self._f.closed:
                 self.dropped += 1
-                return
-            try:
-                self._f.write(line + "\n")
-                self._f.flush()
-            except (OSError, ValueError):
-                self.dropped += 1
+            else:
+                try:
+                    self._f.write(line + "\n")
+                    self._f.flush()
+                    written = True
+                except (OSError, ValueError):
+                    self.dropped += 1
+        # observers see EXACTLY the records that landed in the JSONL
+        # (including the unserializable fallback) — a dropped record
+        # (closed sink, full disk) must not advance the live view, or
+        # live and offline rollups silently diverge
+        if written:
+            for observer in self._observers:
+                try:
+                    observer(rec)
+                except Exception:  # noqa: BLE001 - live must not kill I/O
+                    self.observer_errors += 1
+                    if not self._observer_warned:
+                        self._observer_warned = True
+                        logger.warning(
+                            "telemetry observer %r raised; counting "
+                            "further failures silently "
+                            "(sink.observer_errors)", observer,
+                        )
+
+    # -- record observers (obs v3 live plane) ------------------------------
+
+    def add_observer(self, fn: Callable[[Dict], None]) -> None:
+        """Register ``fn`` to receive every record dict this sink writes
+        (called on the emitting thread, after the record is built and
+        before the file write). The live plane's tap
+        (``obs.aggregate.LiveAggregator.attach``)."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers = self._observers + (fn,)
+
+    def remove_observer(self, fn: Callable[[Dict], None]) -> None:
+        with self._lock:
+            self._observers = tuple(o for o in self._observers if o != fn)
 
     # -- v2 trace plumbing -------------------------------------------------
 

@@ -248,7 +248,14 @@ class LaneScheduler:
                 f"refusing to quarantine lane {lane}: it is the last "
                 "healthy lane (circuit breaker saturated)"
             )
-        self.quarantined.add(lane)
+        # REBIND, never mutate: the live plane's /healthz source reads
+        # this set from the HTTP thread (sorted/iteration); an in-place
+        # .add() racing that read raises "set changed size during
+        # iteration", which the health registry would report as a false
+        # unhealthy — and under the router contract (503 -> drain) a
+        # transient read race must never drain a healthy replica.
+        # Attribute rebinding is atomic; readers iterate their snapshot.
+        self.quarantined = self.quarantined | {lane}
 
     def healthy_lanes(self) -> int:
         return self.num_lanes - len(self.quarantined)

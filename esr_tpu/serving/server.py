@@ -25,6 +25,16 @@ recurrent state, on-device metric sums); what changes is who feeds it:
   per-REQUEST reports with window-latency series (p50/p99 — the SLO
   evidence).
 
+Live plane (obs v3, opt-in via ``live_port``/``serve.py --live-port``):
+a :class:`~esr_tpu.obs.aggregate.LiveAggregator` taps the active sink's
+record stream and an HTTP thread serves ``/metrics`` (Prometheus),
+``/healthz`` (lane-quarantine + prefetcher health), and ``/slo`` (live
+multi-window burn-rate verdict on the same SLO YAML the offline gate
+uses) — the per-replica signal the future fleet router polls
+(docs/SERVING.md "The fleet signal"). ``--profile-steps N`` wraps the
+first N chunk dispatches in a ``jax.profiler`` capture stamped as a
+``profiler_capture`` event. Both default off.
+
 Telemetry (docs/OBSERVABILITY.md): a ``serve_admit`` span per binding
 (admission latency, fresh vs resume), a ``serve_chunk`` span per chunk
 (occupancy, valid windows, fused depth, queue depth, windows/s),
@@ -66,6 +76,7 @@ from esr_tpu.inference.engine import (
     make_chunk_fn,
 )
 from esr_tpu.obs import active_sink, trace
+from esr_tpu.obs.report import percentile_ms
 from esr_tpu.resilience import faults as _faults
 from esr_tpu.resilience.recovery import (
     LaneHealth,
@@ -160,6 +171,10 @@ class ServingEngine:
         aot_programs: Optional[Dict[int, str]] = None,
         lane_quarantine_k: int = 3,
         request_retries: int = 1,
+        live_port: Optional[int] = None,
+        live_slo: Optional[str] = None,
+        profile_steps: int = 0,
+        profile_dir: Optional[str] = None,
     ):
         self.model = model
         self.params = params
@@ -210,10 +225,57 @@ class ServingEngine:
         self._last_resolve_t: Optional[float] = None
         self._windows_total = 0
 
+        # live telemetry plane (obs v3, docs/OBSERVABILITY.md): OPT-IN via
+        # live_port (None = off, 0 = ephemeral) — a LiveAggregator tapped
+        # into the active sink plus the /metrics + /healthz + /slo HTTP
+        # thread a router/autoscaler polls mid-run. Runs BESIDE the JSONL
+        # stream, so it requires one: serve.py installs the sink before
+        # constructing the engine.
+        self.live = None
+        if live_port is not None:
+            from esr_tpu.obs.http import (
+                register_health_source,
+                start_live_plane,
+            )
+
+            self.live = start_live_plane(
+                active_sink(), port=int(live_port), slo_path=live_slo,
+            )
+            # lane-quarantine health: the circuit-breaker ledger is the
+            # serving tier's liveness signal — any quarantined lane flips
+            # /healthz to 503 (a drained replica needs operator action)
+            register_health_source("serving_lanes", self._lane_health_doc)
+        # bounded on-chip capture (obs/device.py): trace the first
+        # profile_steps dispatched chunks, stamp a profiler_capture event
+        self._profiler = None
+        if int(profile_steps) > 0:
+            from esr_tpu.obs.device import ProfilerCapture
+
+            self._profiler = ProfilerCapture(
+                profile_dir or "serve_profile", int(profile_steps),
+                site="serving",
+            )
+
     # -- time ----------------------------------------------------------------
 
     def _now(self) -> float:
         return time.perf_counter() - self._t0
+
+    # -- live-plane health ---------------------------------------------------
+
+    def _lane_health_doc(self) -> Dict:
+        """The ``serving_lanes`` /healthz source, called from the HTTP
+        thread: grab ONE snapshot of the quarantine set (the scheduler
+        rebinds, never mutates, so the snapshot object is stable) and
+        report off it."""
+        quarantined = self.scheduler.quarantined
+        return {
+            "healthy": not quarantined,
+            "lanes": self.lanes,
+            "quarantined": sorted(quarantined),
+            "healthy_lanes": self.lanes - len(quarantined),
+            "queue_depth": self.scheduler.queue_depth(),
+        }
 
     # -- programs / device state ---------------------------------------------
 
@@ -688,10 +750,16 @@ class ServingEngine:
             "inp_mid": jnp.asarray(arrays[2]),
             "valid": jnp.asarray(valid),
         }
+        if self._profiler is not None:
+            self._profiler.maybe_start()
         t_dispatch = time.monotonic()
         self._states, sums, _stacked = program(
             self.params, self._states, jnp.asarray(reset_keep), windows
         )
+        if self._profiler is not None:
+            # one profiled unit per dispatched chunk; the capture stops
+            # itself (and stamps profiler_capture) at the budget
+            self._profiler.step(1)
         if self._first_dispatch_t is None:
             self._first_dispatch_t = self._now()
         for m in meta:
@@ -859,19 +927,38 @@ class ServingEngine:
                     time.sleep(min(wait, idle_slice_s))
         while self._pending:
             self._resolve(self._pending.popleft())
+        if self._profiler is not None:
+            # a session shorter than the capture budget still lands its
+            # profiler_capture record (stop is idempotent)
+            self._profiler.stop()
         return self.summary()
+
+    def close_live(self) -> None:
+        """Tear down the opt-in live plane (idempotent): unregister the
+        lane-health source, detach the aggregator, stop the HTTP thread,
+        and close any open profiler capture."""
+        if self._profiler is not None:
+            self._profiler.stop()
+        if self.live is not None:
+            from esr_tpu.obs.http import unregister_health_source
+
+            unregister_health_source("serving_lanes")
+            live, self.live = self.live, None
+            live.close()
 
     # -- reports -------------------------------------------------------------
 
     @staticmethod
     def _pctl(lat_s: Sequence[float]) -> Tuple[Optional[float], Optional[float]]:
+        # THE shared percentile helper (obs/report.percentile_ms): live
+        # serving summaries, the offline reporter, and the live
+        # aggregator's sketch interpolation all use one definition, so
+        # the three views can never drift on percentile method (this
+        # used np.percentile while the reporter was pure-python — same
+        # linear interpolation, but two implementations to diverge)
         if not lat_s:
             return None, None
-        arr = np.asarray(lat_s, np.float64) * 1e3
-        return (
-            round(float(np.percentile(arr, 50)), 3),
-            round(float(np.percentile(arr, 99)), 3),
-        )
+        return percentile_ms(lat_s, 50), percentile_ms(lat_s, 99)
 
     def report(self, request_id: str) -> Dict:
         """Per-request report: metric means (engine schema keys), window
@@ -947,10 +1034,7 @@ class ServingEngine:
             ),
             "p50_window_ms": p50,
             "p99_window_ms": p99,
-            "admit_p50_ms": (
-                round(float(np.percentile(np.asarray(admit) * 1e3, 50)), 3)
-                if admit else None
-            ),
+            "admit_p50_ms": percentile_ms(admit, 50),
             "classes": {},
         }
         for name, lat in sorted(by_cls.items()):

@@ -383,6 +383,42 @@ class Trainer:
             # NOT activated here: train() installs it (and its finally
             # deactivates it), so a Trainer constructed but never trained
             # can never leak the process-active sink to unrelated runs
+
+        # live telemetry plane (obs v3, docs/OBSERVABILITY.md): OPT-IN
+        # (default off — no existing entry point changes behavior).
+        # trainer.live_telemetry accepts true (ephemeral port), an int
+        # port, or a mapping {port, slo, windows, rel_err}. Requires the
+        # JSONL sink (the live plane runs beside it, never instead).
+        lt = trainer_cfg.get("live_telemetry", False)
+        self.live_cfg = None
+        # identity checks, not truthiness: live_telemetry: 0 means
+        # "ephemeral port", not "off"; non-main hosts run silent like the
+        # sink
+        if lt is not False and lt is not None and self.is_main:
+            if self.sink is None:
+                raise ValueError(
+                    "trainer.live_telemetry requires trainer.telemetry "
+                    "(the live plane taps the JSONL sink's record stream)"
+                )
+            if lt is True:
+                lt = {}
+            elif isinstance(lt, int) and not isinstance(lt, bool):
+                lt = {"port": int(lt)}
+            elif not isinstance(lt, dict):
+                raise ValueError(
+                    f"trainer.live_telemetry must be bool, port int, or a "
+                    f"mapping, got {lt!r}"
+                )
+            self.live_cfg = {
+                "port": int(lt.get("port", 0)),
+                "slo": lt.get("slo"),
+                "windows": tuple(lt.get("windows", (60.0, 300.0))),
+                "rel_err": float(lt.get("rel_err", 0.01)),
+                "watermark_interval_s": float(
+                    lt.get("watermark_interval_s", 1.0)
+                ),
+            }
+        self.live_plane = None  # set for the duration of train()
         # sink=False (not None) when telemetry is off: None would fall back
         # to the process-active sink, letting a leftover sink from another
         # run capture a trainer that explicitly opted out
@@ -452,6 +488,21 @@ class Trainer:
             )
 
         self.profile_cfg = trainer_cfg.get("profile", {}) or {}
+        # bounded on-chip capture (obs/device.py ProfilerCapture,
+        # train.py --profile-steps): trace the first N super-step
+        # ITERATIONS of this run and stamp a profiler_capture event with
+        # the artifact dir — mutually exclusive with the run-long
+        # trainer.profile hook (two open jax.profiler traces collide)
+        self.profile_steps = int(trainer_cfg.get("profile_steps", 0) or 0)
+        if self.profile_steps < 0:
+            raise ValueError(
+                f"profile_steps must be >= 0, got {self.profile_steps}"
+            )
+        if self.profile_steps and self.profile_cfg.get("enabled", False):
+            raise ValueError(
+                "trainer.profile_steps and trainer.profile.enabled are "
+                "mutually exclusive (one jax.profiler trace at a time)"
+            )
         self.start_iteration = 0
 
         # resume (reference :172-173, :687-725); "auto" = most recently saved
@@ -1078,6 +1129,8 @@ class Trainer:
 
         completed = False
         run_span = None
+        live_watermark = None
+        profiler = None
         try:
             if self.sink is not None:
                 from esr_tpu.obs import set_active_sink
@@ -1108,6 +1161,44 @@ class Trainer:
                     start_iteration=self.start_iteration,
                     k_steps=self.k_steps,
                 )
+                if self.live_cfg is not None:
+                    # the opt-in live plane (obs v3): aggregator tapped
+                    # into this run's sink + the /metrics-/healthz-/slo
+                    # HTTP thread, plus the device-memory watermark
+                    # poller (gauges flow through the same tap). The
+                    # bound port is stamped as a live_telemetry event so
+                    # pollers discover ephemeral (port 0) bindings from
+                    # the stream itself.
+                    from esr_tpu.obs.device import DeviceWatermark
+                    from esr_tpu.obs.http import start_live_plane
+
+                    self.live_plane = start_live_plane(
+                        self.sink,
+                        port=self.live_cfg["port"],
+                        slo_path=self.live_cfg["slo"],
+                        windows=self.live_cfg["windows"],
+                        rel_err=self.live_cfg["rel_err"],
+                    )
+                    self.sink.event(
+                        "live_telemetry", port=self.live_plane.port,
+                        slo=self.live_cfg["slo"],
+                    )
+                    live_watermark = DeviceWatermark(
+                        sink=self.sink,
+                        interval_s=self.live_cfg["watermark_interval_s"],
+                    ).start()
+            if self.profile_steps and self.is_main:
+                from esr_tpu.obs.device import ProfilerCapture
+
+                profiler = ProfilerCapture(
+                    self.profile_cfg.get(
+                        "trace_dir", self.run.log_dir + "/profile"
+                    ),
+                    self.profile_steps,
+                    sink=self.sink,
+                    site="train",
+                )
+                profiler.maybe_start()
             # rollback bookkeeping (docs/RESILIENCE.md): which iteration
             # each epoch started at, so a rollback can re-enter the RIGHT
             # epoch and fast-forward its (seed, epoch)-deterministic batch
@@ -1212,6 +1303,11 @@ class Trainer:
                             # the true trained count, matching checkpoints
                             iter_idx = last + 1
                             self._attr.note(first, r)
+                            if profiler is not None:
+                                # one profiled unit per trained iteration;
+                                # the capture stops itself (stamping
+                                # profiler_capture) at the budget
+                                profiler.step(r)
                             # cadences snap to super-step boundaries: due when
                             # ANY covered iteration hits the configured multiple
                             keep_vis = (
@@ -1366,6 +1462,16 @@ class Trainer:
                 self._async_ckpt.wait(raise_error=False)
             if profiling:
                 jax.profiler.stop_trace()
+            if profiler is not None:
+                # idempotent: a loop shorter than the capture budget
+                # still lands the profiler_capture record before the
+                # sink closes
+                profiler.stop()
+            if live_watermark is not None:
+                live_watermark.stop()
+            if self.live_plane is not None:
+                self.live_plane.close()
+                self.live_plane = None
             if self.writer is not None:
                 self.writer.close()
             if self.sink is not None:

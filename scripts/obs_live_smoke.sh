@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Live-telemetry-plane smoke: a loadgen serving session with --live-port
+# semantics (ServingEngine(live_port=0)) on CPU must answer
+#   /metrics   Prometheus v0.0.4 text with the serving span families,
+#   /healthz   component health (prefetcher watchdog, lane quarantine),
+#   /slo       live multi-window burn-rate verdict on configs/slo.yml
+# WHILE the session is in flight, and the final live snapshot must agree
+# with `python -m esr_tpu.obs report` over the written telemetry.jsonl
+# within the quantile sketch's declared relative error.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_obs_live_smoke.py)
+# as a standalone gate; endpoint table + sketch error bound:
+# docs/OBSERVABILITY.md "The live plane".
+#
+# Usage: scripts/obs_live_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_live_smoke.py -q "$@"

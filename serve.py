@@ -74,6 +74,19 @@ def get_flags():
                    help="times a fault-hit request is re-admitted before "
                         "failing with a classified status")
 
+    # the live telemetry plane (obs v3, docs/OBSERVABILITY.md): opt-in
+    p.add_argument("--live-port", type=int, default=None, metavar="PORT",
+                   help="serve live telemetry (/metrics, /healthz, /slo) "
+                        "on this port during the session (0 = ephemeral; "
+                        "default off)")
+    p.add_argument("--live-slo", type=str, default="configs/slo.yml",
+                   help="SLO YAML the live /slo endpoint burn-rate-"
+                        "evaluates (with --live-port)")
+    p.add_argument("--profile-steps", type=int, default=0, metavar="N",
+                   help="capture a jax.profiler device trace over the "
+                        "first N dispatched chunks and stamp a "
+                        "profiler_capture telemetry event")
+
     # dataset overrides (the infer.py set)
     p.add_argument("--scale", type=int, default=4)
     p.add_argument("--seqn", type=int, default=3)
@@ -182,6 +195,7 @@ def main():
 
     sink = TelemetrySink(os.path.join(flags.output_path, "telemetry.jsonl"))
     prev = set_active_sink(sink)
+    server = None
     try:
         server = ServingEngine(
             model, params, dataset_config, seqn=flags.seqn,
@@ -192,11 +206,24 @@ def main():
             aot_programs=aot_programs,
             lane_quarantine_k=flags.lane_quarantine_k,
             request_retries=flags.request_retries,
+            live_port=flags.live_port,
+            live_slo=(flags.live_slo if flags.live_port is not None
+                      else None),
+            profile_steps=flags.profile_steps,
+            profile_dir=os.path.join(flags.output_path, "profile"),
         )
+        if server.live is not None:
+            print(
+                f"# live telemetry: http://127.0.0.1:{server.live.port}"
+                "/{metrics,healthz,slo}",
+                file=sys.stderr,
+            )
         summary = server.run(
             arrivals=schedule, max_wall_s=flags.max_wall
         )
     finally:
+        if server is not None:
+            server.close_live()
         set_active_sink(prev)
         sink.close()
 

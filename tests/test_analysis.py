@@ -960,3 +960,61 @@ def test_esr012_noqa_suppresses():
         "            continue\n"
     )
     assert "ESR012" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# ESR013 unbounded label cardinality
+
+
+def test_esr013_fstring_metric_name_flagged():
+    src = (
+        "def serve(sink, reqs):\n"
+        "    for r in reqs:\n"
+        "        sink.counter(f'served_{r.request_id}')\n"
+    )
+    assert "ESR013" in rules_hit(src)
+
+
+def test_esr013_format_and_percent_names_flagged():
+    fmt = (
+        "def f(sink, rid):\n"
+        "    sink.gauge('depth_{}'.format(rid), 1)\n"
+    )
+    assert "ESR013" in rules_hit(fmt)
+    pct = (
+        "def f(sink, rid):\n"
+        "    sink.span('latency_%s' % rid, 0.1)\n"
+    )
+    assert "ESR013" in rules_hit(pct)
+    kw = (
+        "def f(sink, rid):\n"
+        "    sink.metric(name=f'loss_{rid}', value=1.0)\n"
+    )
+    assert "ESR013" in rules_hit(kw)
+
+
+def test_esr013_fixed_names_with_payload_fields_clean():
+    # the prescribed pattern: fixed vocabulary name, variable as payload
+    payload = (
+        "def serve(sink, reqs):\n"
+        "    for r in reqs:\n"
+        "        sink.counter('served', request=r.request_id)\n"
+        "        sink.span('serve_chunk_part', 0.1, cls=r.cls.name)\n"
+    )
+    assert "ESR013" not in rules_hit(payload)
+    # constant-only interpolation is static — no cardinality
+    const = "def f(sink):\n    sink.event(f'phase_{1}')\n"
+    assert "ESR013" not in rules_hit(const)
+    # a variable NAME argument is a different shape (tracker tags flow
+    # through variables legitimately) — only literal interpolation at the
+    # emission site is the rule's hazard
+    var = "def f(sink, tag):\n    sink.metric(tag, 1.0)\n"
+    assert "ESR013" not in rules_hit(var)
+
+
+def test_esr013_noqa_suppresses():
+    src = (
+        "def f(sink, rid):\n"
+        "    sink.counter(f'x_{rid}')  # esr: noqa(ESR013)\n"
+    )
+    assert "ESR013" not in rules_hit(src)

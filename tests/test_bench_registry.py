@@ -35,9 +35,9 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
-        "mfu_ceiling", "program_audit", "e2e", "e2e_device_raster",
-        "scaling", "breakdown", "infer_throughput", "ckpt_overlap",
-        "serve_loadgen", "chaos_recovery",
+        "mfu_ceiling", "program_audit", "obs_live", "e2e",
+        "e2e_device_raster", "scaling", "breakdown", "infer_throughput",
+        "ckpt_overlap", "serve_loadgen", "chaos_recovery",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -121,6 +121,26 @@ def test_scan_goodput_schema_pinned_and_probe_reports():
         # the sink-less twin measures the same loop without telemetry
         wall_plain, none = bench._goodput_probe(run, None, 3, None)
         assert none is None and wall_plain > 0
+
+
+def test_obs_live_stage_registered_and_schema_pinned():
+    """ISSUE 11: the live-telemetry-plane cost stage — aggregator tap
+    overhead, sketch-vs-exact max relative error, endpoint poll p50 —
+    runs in smoke (host-bound by design) and keeps a pinned schema. The
+    scan_compute goodput probe now measures the sink WITH the
+    LiveAggregator attached, so the <2% tracing-overhead bound covers the
+    obs v3 production configuration."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "obs_live"]
+    assert len(entry) == 1
+    _, runner, timeout, in_smoke = entry[0]
+    assert in_smoke is True
+    assert timeout >= 300
+    assert bench.OBS_LIVE_KEYS == (
+        "aggregator_overhead_frac", "aggregator_overhead_ok",
+        "sketch_rel_err_bound", "sketch_max_rel_err", "sketch_ok",
+        "endpoint_p50_poll_ms", "endpoints_ok", "records",
+        "span_families", "seed",
+    )
 
 
 def test_infer_throughput_stage_registered_and_schema_pinned():

@@ -1,0 +1,297 @@
+"""Live-plane smoke (tier-1, also driven by ``scripts/obs_live_smoke.sh``):
+a loadgen serving session with ``live_port`` enabled on an ephemeral port
+must answer ``/metrics`` + ``/healthz`` + ``/slo`` WHILE the session is in
+flight on CPU, and its final live snapshot must agree with ``obs report``
+over the written telemetry.jsonl within the sketch's declared relative
+error (ISSUE 11 acceptance / docs/OBSERVABILITY.md "The live plane").
+
+Default-off is part of the contract: an engine constructed without
+``live_port`` binds no socket and registers no health source.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.obs import TelemetrySink, set_active_sink
+from esr_tpu.obs.export import read_telemetry
+from esr_tpu.obs.report import build_report
+from esr_tpu.serving import (
+    RequestClass,
+    ServingEngine,
+    make_stream_corpus,
+    poisson_schedule,
+)
+
+LANES = 2
+N_STREAMS = 5
+REL_ERR = 0.01
+CLASSES = {
+    "interactive": RequestClass("interactive", chunk_windows=2),
+    "standard": RequestClass("standard", chunk_windows=4),
+}
+
+# basech=5 is deliberately unique among the serving suites: chunk programs
+# are cached process-wide keyed on the model dataclass + geometry
+# (server._PROGRAM_CACHE), and sharing a key with test_serve_smoke /
+# test_obs_report_smoke would pre-warm their sessions and flip their
+# load-dependent assertions
+DATASET_CFG = {
+    "scale": 2,
+    "ori_scale": "down4",
+    "time_bins": 1,
+    "mode": "events",
+    "window": 1024,
+    "sliding_window": 512,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def live_run(tmp_path_factory):
+    """One live-plane serving session; returns
+    (telemetry_path, live_snapshot, summary, midrun_polls)."""
+    import jax
+
+    tmp = tmp_path_factory.mktemp("obs_live_smoke")
+    paths = make_stream_corpus(
+        str(tmp / "streams"), n=N_STREAMS, seed=0,
+        events_schedule=(1200, 3600),
+    )
+    model = DeepRecurrNet(inch=2, basech=5, num_frame=3)
+    x = np.zeros((1, 3, 32, 32, 2), np.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), x, model.init_states(1, 32, 32)
+    )
+    schedule = poisson_schedule(
+        paths, rate_hz=20.0, seed=0, classes=("standard", "interactive"),
+    )
+    tel_path = str(tmp / "telemetry.jsonl")
+    sink = TelemetrySink(tel_path)
+    prev = set_active_sink(sink)
+    server = None
+    polls = {"metrics": [], "healthz": [], "slo": []}
+    try:
+        server = ServingEngine(
+            model, params, DATASET_CFG, lanes=LANES, classes=CLASSES,
+            default_class="standard", max_pending=16, preempt_quantum=2,
+            live_port=0, live_slo="configs/slo.yml",
+        )
+        assert server.live is not None and server.live.port
+        base = f"http://127.0.0.1:{server.live.port}"
+
+        result = {}
+
+        def drive():
+            result["summary"] = server.run(
+                arrivals=schedule, max_wall_s=300
+            )
+
+        t = threading.Thread(target=drive, name="serve-loop")
+        t.start()
+        # poll the endpoints WHILE the session runs (the engine's state
+        # is never touched from this thread — only the HTTP surface)
+        while t.is_alive():
+            for ep in polls:
+                status, body = _get(f"{base}/{ep}", timeout=10)
+                polls[ep].append((status, body))
+            t.join(timeout=0.05)
+        t.join()
+        assert "summary" in result, "serving thread died"
+        # the plane stays pollable after drain, until close_live()
+        status, body = _get(f"{base}/metrics")
+        polls["metrics"].append((status, body))
+        snapshot = server.live.aggregator.snapshot()
+    finally:
+        if server is not None:
+            server.close_live()
+        set_active_sink(prev)
+        sink.close()
+    return tel_path, snapshot, result["summary"], polls
+
+
+def test_endpoints_answer_mid_run(live_run):
+    _, _, summary, polls = live_run
+    assert summary["completed"] == N_STREAMS
+    for ep in ("metrics", "healthz", "slo"):
+        assert polls[ep], f"no {ep} polls landed mid-run"
+    # every poll answered with a real verdict, never a 5xx handler error
+    for ep, got in polls.items():
+        for status, _ in got:
+            assert status in (200, 429, 503), (ep, status)
+    # the final /metrics scrape (post-drain, healthy session) is a 200
+    # Prometheus page carrying the serving families
+    status, body = polls["metrics"][-1]
+    assert status == 200
+    assert "# TYPE esr_span_seconds summary" in body
+    assert 'esr_span_seconds{span="serve_chunk"' in body
+    assert "esr_serving_requests_total" in body
+    # healthz converged healthy (no quarantine in a fault-free run)
+    status, body = polls["healthz"][-1]
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["healthy"] and "serving_lanes" in doc["sources"]
+    # the live SLO verdict over a healthy finished session is ok
+    status, body = polls["slo"][-1]
+    assert status == 200
+    assert json.loads(body)["verdict"] == "ok"
+
+
+def test_final_live_snapshot_matches_offline_report(live_run):
+    tel_path, snapshot, summary, _ = live_run
+    manifest, records, torn = read_telemetry(tel_path)
+    assert torn == 0
+    offline = build_report(records, manifest)
+
+    assert snapshot["serving"]["requests"] == \
+        offline["serving"]["requests"] == N_STREAMS
+    assert snapshot["serving"]["errors"] == offline["serving"]["errors"]
+    assert snapshot["serving"]["windows"] == offline["serving"]["windows"]
+    assert snapshot["traces"]["incomplete"] == \
+        offline["traces"]["incomplete"] == 0
+    assert snapshot["events"] == offline["events"]
+    assert snapshot["counters"] == offline["counters"]
+    assert snapshot["goodput"]["source"] == offline["goodput"]["source"]
+    assert snapshot["goodput"]["value"] == pytest.approx(
+        offline["goodput"]["value"], rel=1e-3
+    )
+    # per-span-family and per-class percentiles within sketch tolerance
+    assert set(snapshot["spans"]) == set(offline["spans"])
+    for fam, ol in offline["spans"].items():
+        lv = snapshot["spans"][fam]
+        assert lv["count"] == ol["count"], fam
+        for key in ("p50_ms", "p99_ms"):
+            if ol[key] == 0:
+                assert lv[key] == 0
+            else:
+                assert lv[key] == pytest.approx(ol[key], rel=REL_ERR), (
+                    fam, key,
+                )
+    for cls, ol in offline["serving"]["classes"].items():
+        lv = snapshot["serving"]["classes"][cls]
+        assert lv["windows"] == ol["windows"]
+        for key in ("window_latency_p50_ms", "window_latency_p99_ms"):
+            assert lv[key] == pytest.approx(ol[key], rel=REL_ERR), (
+                cls, key,
+            )
+    # and the live session summary agrees with the stream on volume
+    assert summary["windows"] == offline["serving"]["windows"]
+
+
+def test_live_plane_is_default_off(tmp_path):
+    """No live_port → no socket, no aggregator, no health source — the
+    existing entry points change nothing without the knob."""
+    from esr_tpu.obs.http import health_snapshot
+
+    model = DeepRecurrNet(inch=2, basech=5, num_frame=3)
+    engine = ServingEngine(model, None, DATASET_CFG, lanes=LANES)
+    assert engine.live is None
+    healthy, sources = health_snapshot()
+    assert "serving_lanes" not in sources
+    engine.close_live()  # no-op, never raises
+
+
+def _tiny_train_config(tmp_path, live):
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"rec{i}.h5")
+        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6,
+                           seed=i)
+        paths.append(p)
+    datalist = str(tmp_path / "datalist.txt")
+    with open(datalist, "w") as f:
+        f.write("\n".join(paths) + "\n")
+    dataset = {
+        "scale": 2, "ori_scale": "down4", "time_bins": 1,
+        "mode": "events", "window": 128, "sliding_window": 64,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {"sequence_length": 4, "seqn": 3, "step_size": 2,
+                     "pause": {"enabled": False}},
+    }
+    loader = {
+        "path_to_datalist_txt": datalist, "batch_size": 8,
+        "shuffle": True, "drop_last": True, "prefetch": 0,
+        "dataset": dataset,
+    }
+    return {
+        "experiment": "obs_live_train",
+        "model": {"name": "DeepRecurrNet",
+                  "args": {"inch": 2, "basech": 2, "num_frame": 3}},
+        "optimizer": {"name": "Adam",
+                      "args": {"lr": 1e-3, "weight_decay": 1e-4,
+                               "amsgrad": True}},
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": str(tmp_path / "out"),
+            "iteration_based_train": {"enabled": True, "iterations": 1,
+                                      "train_log_step": 1},
+            "monitor": "off", "tensorboard": False,
+            "telemetry": True,
+            "live_telemetry": live,
+        },
+        "train_dataloader": loader,
+    }
+
+
+def test_trainer_live_telemetry_opt_in(tmp_path):
+    """trainer.live_telemetry: 0 serves the plane on an ephemeral port
+    for the duration of train(), stamps the bound port as a
+    live_telemetry event, runs the device watermark poller (CPU:
+    None-tolerant, one unavailable event), and tears the plane down in
+    the teardown finally."""
+    from esr_tpu.config.parser import RunConfig
+    from esr_tpu.training.trainer import Trainer
+
+    config = _tiny_train_config(tmp_path, live=0)
+    trainer = Trainer(RunConfig(config, runid="live0", seed=0))
+    assert trainer.live_cfg is not None
+    trainer.train()
+    assert trainer.live_plane is None  # closed in the finally
+    tel = str(tmp_path / "out" / "logs" / "obs_live_train" / "live0"
+              / "telemetry.jsonl")
+    import os
+
+    assert os.path.exists(tel)
+    _, records, _ = read_telemetry(tel)
+    events = {r["name"]: r for r in records if r["type"] == "event"}
+    assert "live_telemetry" in events
+    assert isinstance(events["live_telemetry"]["port"], int)
+    assert events["live_telemetry"]["port"] > 0
+    # CPU backend: the watermark observed the missing stats exactly once
+    assert "device_watermark_unavailable" in events
+    assert events["train_end"]["completed"] is True
+
+
+def test_trainer_live_telemetry_default_off(tmp_path):
+    from esr_tpu.config.parser import RunConfig
+    from esr_tpu.training.trainer import Trainer
+
+    config = _tiny_train_config(tmp_path, live=False)
+    trainer = Trainer(RunConfig(config, runid="live_off", seed=0))
+    assert trainer.live_cfg is None
+    assert trainer.live_plane is None

@@ -46,11 +46,38 @@ def get_args():
         action="store_true",
         help="call jax.distributed.initialize() before building the mesh",
     )
+    p.add_argument(
+        "--live-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve live telemetry (/metrics, /healthz, /slo) on this "
+             "port while training (0 = ephemeral; default off) — "
+             "shorthand for -o 'trainer;live_telemetry=PORT' "
+             "(docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--profile-steps",
+        type=int,
+        default=None,
+        metavar="N",
+        help="capture a jax.profiler device trace over the first N "
+             "iterations and stamp a profiler_capture telemetry event "
+             "with the artifact dir (shorthand for "
+             "-o 'trainer;profile_steps=N')",
+    )
     return p.parse_args()
 
 
 def main():
     args = get_args()
+    # the live-plane flags are config shorthands: appended as ordinary
+    # overrides so they land in the effective config (and its
+    # fingerprint) like any other knob
+    if args.live_port is not None:
+        args.override.append(f"trainer;live_telemetry={args.live_port}")
+    if args.profile_steps is not None:
+        args.override.append(f"trainer;profile_steps={args.profile_steps}")
     honor_platform_env()
     if args.multihost:
         initialize_multihost()
