@@ -61,6 +61,13 @@ class EventWindowDataset:
         self.augment_cfg = config.get("data_augment", DEFAULT_AUGMENT)
         self.add_noise = config.get("add_noise", {"enabled": False})
         self.custom_resolution = config.get("custom_resolution", None)
+        # activity-mask plane (docs/PERF.md "activity-sparse compute"):
+        # tile size of the per-window `inp_activity` sidecar — one cell
+        # per `tile x tile` input block (default 8 = the flagship model's
+        # down_scale, so one cell per DCN-bottleneck pixel)
+        self.activity_tile = int(
+            (config.get("activity") or {}).get("tile", 8)
+        )
 
         ladder = resolve_scale_ladder(
             self.recording.sensor_resolution,
@@ -273,6 +280,7 @@ class EventWindowDataset:
         "inp_bicubic_cnt", "inp_bicubic_stack",
         "inp_near_cnt", "inp_near_stack",
         "inp_scaled_cnt", "inp_scaled_stack",
+        "inp_activity",
         "inp_down_cnt", "inp_down_scaled_cnt",
         "gt_stack", "gt_cnt", "gt_img", "gt_inp_size_img", "frame",
         "inp_norm_events", "inp_events_valid",
@@ -397,6 +405,13 @@ class EventWindowDataset:
                 cache["gt_inp_size_img"] = gt_img_inp
             return cache["gt_img"], cache["gt_inp_size_img"]
 
+        def scaled_cnt():
+            if "inp_scaled_cnt" not in cache:
+                cache["inp_scaled_cnt"] = self._scaled(
+                    norm_ev(), self.gt_resolution, "cnt"
+                )
+            return cache["inp_scaled_cnt"]
+
         def unsupervised():
             if "inp_down_cnt" not in cache:
                 down_cnt, down_scaled = self._unsupervised(norm_ev())
@@ -429,8 +444,15 @@ class EventWindowDataset:
             "inp_bicubic_stack": lambda: _resize(inp_stack(), (kh, kw), "bicubic"),
             "inp_near_cnt": lambda: _resize(inp_cnt(), (kh, kw), "nearest"),
             "inp_near_stack": lambda: _resize(inp_stack(), (kh, kw), "nearest"),
-            "inp_scaled_cnt": lambda: self._scaled(norm_ev(), self.gt_resolution, "cnt"),
+            "inp_scaled_cnt": scaled_cnt,
             "inp_scaled_stack": lambda: self._scaled(norm_ev(), self.gt_resolution, "stack"),
+            # per-tile activity sidecar of the model-input counts — "the
+            # same pass" contract: a pure reduction of the count image the
+            # encoder just built (never a second scan over the events),
+            # mirrored on-device by ops.encodings.events_to_channels_activity
+            "inp_activity": lambda: NE.tile_activity_np(
+                scaled_cnt(), self.activity_tile
+            ),
             "inp_down_cnt": lambda: unsupervised()[0],
             "inp_down_scaled_cnt": lambda: unsupervised()[1],
             "gt_stack": lambda: self._stack(gt_ev(), self.gt_resolution),

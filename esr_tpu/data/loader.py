@@ -198,6 +198,25 @@ def collate_megabatch(batches: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndar
     return {k_: np.stack([b[k_] for b in batches]) for k_ in keys}
 
 
+def window_activity(inp_window: np.ndarray, tile: int = 8) -> float:
+    """Active-tile fraction of one model-input window — the host-side
+    gating statistic shared by :class:`LanePackedChunks` and the serving
+    tier's ``RecordingStream`` (docs/PERF.md "activity-sparse compute").
+
+    ``inp_window``: ``[seqn, H, W, C]`` (or ``[H, W, C]``) non-negative
+    count frames; frames are summed so a tile is active iff ANY frame of
+    the window touched it. Pure numpy (ESR004)."""
+    from esr_tpu.data.np_encodings import (
+        activity_fraction_np,
+        tile_activity_np,
+    )
+
+    counts = np.asarray(inp_window, np.float32)
+    if counts.ndim > 3:
+        counts = counts.reshape((-1,) + counts.shape[-3:]).sum(axis=0)
+    return activity_fraction_np(tile_activity_np(counts, tile))
+
+
 def overlapping_windows(batch: Dict[str, np.ndarray], seqn: int) -> List[Dict[str, np.ndarray]]:
     """Reference-shaped view: (B, L, …) → list of (L−seqn+1) dicts of
     (B, seqn, …) overlapping windows (``h5dataloader.py:229-233``)."""
@@ -274,6 +293,15 @@ class LanePackedChunks:
       — the per-window model input, the GT count image of the middle
       frame, the LR middle-frame counts (bicubic-baseline input), and the
       float validity mask;
+    - ``activity``: ``(W, B)`` — per-window active-tile fraction of the
+      model input (``np_encodings.tile_activity_np`` over the summed
+      seqn-frame counts at ``activity_tile`` granularity), with padding
+      validity FOLDED IN: a zero-padded (``valid = 0``) window reports
+      activity 0.0, so padded windows ride the same activity gating as
+      genuinely idle ones instead of being dense compute
+      (docs/PERF.md "activity-sparse compute"). Host-side sidecar only —
+      it is NOT staged into the device feed, so traced/AOT chunk
+      programs are byte-identical with or without it;
     - ``reset_keep``: ``(B,)`` — 1 where the lane continues its recording,
       0 where its recurrent state must be zeroed (refill / idle);
     - ``meta``: per-lane ``{"recording", "path", "windows"}`` (or None for
@@ -286,9 +314,14 @@ class LanePackedChunks:
         config: Dict,
         lanes: int = 4,
         chunk_windows: int = 8,
+        activity_tile: int = 8,
     ):
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if activity_tile < 1:
+            raise ValueError(
+                f"activity_tile must be >= 1, got {activity_tile}"
+            )
         if chunk_windows < 1:
             raise ValueError(
                 f"chunk_windows must be >= 1, got {chunk_windows}"
@@ -305,6 +338,7 @@ class LanePackedChunks:
         )
         self.lanes = int(lanes)
         self.chunk_windows = int(chunk_windows)
+        self.activity_tile = int(activity_tile)
         self.seqn = int(config["sequence"].get("seqn", 3))
         self.mid_idx = (self.seqn - 1) // 2
         # probe the shared ladder once; every lane loader must match it
@@ -395,11 +429,17 @@ class LanePackedChunks:
                 np.zeros((W, B) + s, np.float32) for s in shapes
             ]
             valid = np.zeros((W, B), np.float32)
+            # padded slots stay 0.0: padding-validity is folded into the
+            # activity mask by construction (class docstring)
+            activity = np.zeros((W, B), np.float32)
             for i, wins in enumerate(per_lane):
                 for t, win in enumerate(wins):
                     for arr, a in zip(arrays, win):
                         arr[t, i] = a
                     valid[t, i] = 1.0
+                    activity[t, i] = window_activity(
+                        win[0], self.activity_tile
+                    )
             yield {
                 "windows": {
                     "inp_scaled": arrays[0],
@@ -407,6 +447,7 @@ class LanePackedChunks:
                     "inp_mid": arrays[2],
                     "valid": valid,
                 },
+                "activity": activity,
                 "reset_keep": reset_keep,
                 "meta": meta,
             }

@@ -53,6 +53,45 @@ def events_to_channels_np(
     return np.stack([pos, neg], axis=-1)
 
 
+def tile_activity_np(counts: np.ndarray, tile: int = 8) -> np.ndarray:
+    """Host twin of :func:`esr_tpu.ops.encodings.tile_activity`: per-tile
+    activity sums of a ``[H, W, ...]`` count image → ``[ceil(H/tile),
+    ceil(W/tile)]`` f32. Bit-identical to the jnp twin (integer counts in
+    f32 sum exactly on both sides) — pinned by ``tests/test_encodings.py``.
+    A tile is ACTIVE iff its sum is ``> 0``."""
+    if tile < 1:
+        raise ValueError(f"tile must be >= 1, got {tile}")
+    h, w = counts.shape[0], counts.shape[1]
+    c = counts.reshape(h, w, -1).sum(axis=-1)
+    ht = -(-h // tile)
+    wt = -(-w // tile)
+    c = np.pad(c, ((0, ht * tile - h), (0, wt * tile - w)))
+    return (
+        c.reshape(ht, tile, wt, tile).sum(axis=(1, 3)).astype(np.float32)
+    )
+
+
+def activity_fraction_np(act: np.ndarray) -> float:
+    """Fraction of active tiles of a :func:`tile_activity_np` map — the
+    host-side scheduler-gating statistic (``RequestClass.min_activity``
+    compares against this)."""
+    return float((np.asarray(act) > 0).mean()) if np.asarray(act).size else 0.0
+
+
+def events_to_channels_activity_np(
+    xs: np.ndarray,
+    ys: np.ndarray,
+    ps: np.ndarray,
+    sensor_size: Tuple[int, int],
+    tile: int = 8,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Count image + per-tile activity sidecar in one pass (host twin of
+    ``ops.encodings.events_to_channels_activity``): the activity map is a
+    free per-tile reduction of the counts the encoder just summed."""
+    cnt = events_to_channels_np(xs, ys, ps, sensor_size)
+    return cnt, tile_activity_np(cnt, tile)
+
+
 def events_to_stack_np(
     xs: np.ndarray,
     ys: np.ndarray,

@@ -24,9 +24,20 @@ def synthesize_streams(
     rungs: Sequence[str] = ("ori", "down2", "down4", "down8", "down16"),
     num_sources: int = 6,
     rng: Optional[np.random.Generator] = None,
+    burst_frac: float = 1.0,
+    burst_events_frac: float = 0.98,
 ) -> Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Event streams per rung; ``base_events`` events at the coarsest rung,
-    scaled by factor² at finer rungs so scale²·N GT windowing holds."""
+    scaled by factor² at finer rungs so scale²·N GT windowing holds.
+
+    ``burst_frac < 1`` makes the scene BURSTY (the activity-sparse test
+    profile, docs/PERF.md): ``burst_events_frac`` of the events land in
+    the first ``burst_frac`` of the duration and the sparse remainder
+    trails out to the full duration, so time-mode windowing over the
+    stream yields an active head followed by near-idle tail windows —
+    the half-idle corpus the idle-window gating bench/smoke measure
+    against. ``burst_frac = 1`` (default) keeps the uniform profile."""
+    assert 0.0 < burst_frac <= 1.0, burst_frac
     rng = rng or np.random.default_rng(0)
     H, W = sensor_resolution
     fmax = max(_LADDER_FACTORS[r] for r in rungs)
@@ -40,7 +51,15 @@ def synthesize_streams(
         f = _LADDER_FACTORS[rung]
         h, w = round(H / f), round(W / f)
         n = int(base_events * (fmax / f) ** 2)
-        ts = np.sort(rng.random(n)) * duration
+        u = rng.random(n)
+        if burst_frac < 1.0:
+            n_burst = int(n * burst_events_frac)
+            # burst head + sparse keep-alive tail reaching ~duration, so
+            # the stream's time span stays the full duration (time-mode
+            # windows genuinely cover the quiet region)
+            u[:n_burst] *= burst_frac
+            u[n_burst:] = burst_frac + u[n_burst:] * (1.0 - burst_frac)
+        ts = np.sort(u) * duration
         which = rng.integers(0, num_sources, n)
         pos = src_xy[which] + src_v[which] * (ts / duration)[:, None]
         pos += rng.normal(0, 0.02, (n, 2))  # sensor jitter
@@ -80,16 +99,21 @@ def write_synthetic_h5(
     duration: float = 1.0,
     rungs: Sequence[str] = ("ori", "down2", "down4", "down8", "down16"),
     seed: int = 0,
+    burst_frac: float = 1.0,
+    burst_events_frac: float = 0.995,
 ) -> str:
     """Write a recording in the reference layout
     (``generate_dataset/tools/event_packagers.py:119+``): per-rung
     ``{prefix}_events/{xs,ys,ts,ps}`` groups, ``ori_images/image%09d`` frames
-    with ``timestamp`` attrs, ``sensor_resolution`` file attr."""
+    with ``timestamp`` attrs, ``sensor_resolution`` file attr.
+    ``burst_frac < 1`` writes the bursty (idle-tail) activity profile —
+    see :func:`synthesize_streams`."""
     import h5py
 
     rng = np.random.default_rng(seed)
     streams = synthesize_streams(
-        sensor_resolution, base_events, duration, rungs, rng=rng
+        sensor_resolution, base_events, duration, rungs, rng=rng,
+        burst_frac=burst_frac, burst_events_frac=burst_events_frac,
     )
     H, W = sensor_resolution
     with h5py.File(path, "w") as f:

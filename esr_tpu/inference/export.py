@@ -52,6 +52,11 @@ def _portable_dcn(model, platforms: Tuple[str, ...]):
         updates["dcn_impl"] = "jnp"
     if getattr(model, "dcn_impl_fwd", None) in ("auto", "pallas"):
         updates["dcn_impl_fwd"] = "jnp"
+    # activity predication is a Pallas-only feature; on the jnp
+    # formulation it is already a no-op, but neutralize it anyway so the
+    # portable artifact's model config reads dense
+    if getattr(model, "dcn_sparse", False):
+        updates["dcn_sparse"] = False
     return model.clone(**updates) if updates else model
 
 

@@ -169,6 +169,12 @@ class STFusion(nn.Module):
     # None defers to dcn_impl; the two directions gate independently in
     # 'auto' (ops/dcn.py resolve_dcn_impl)
     dcn_impl_fwd: Optional[str] = None
+    # activity-sparse compute (docs/PERF.md, ISSUE 12): predicate the
+    # Pallas DCN kernels on per-image activity so all-zero tile blocks
+    # skip their gather+MXU loops. Numerically invisible by construction
+    # (ops/dcn.py deform_conv2d_auto) and a no-op on the jnp path, so it
+    # only ever engages behind the per-direction Mosaic gates.
+    dcn_sparse: bool = False
 
     def setup(self):
         assert self.has_dcnatten or self.has_scaleaggre
@@ -226,7 +232,10 @@ class STFusion(nn.Module):
     def mid_idx(self) -> int:
         return (self.num_frame - 1) // 2
 
-    def _fuse(self, feat0: Array, feat1: Array, train: bool) -> Array:
+    def _fuse(
+        self, feat0: Array, feat1: Array, train: bool,
+        activity: Optional[Array] = None,
+    ) -> Array:
         """Deformable-align ``feat0`` to ``feat1`` and gate-fuse
         (reference ``model.py:208-231``)."""
         c = feat0.shape[-1]
@@ -247,6 +256,7 @@ class STFusion(nn.Module):
             deform_conv2d_auto(
                 feat0, offsets, mask, self.dcn_weight, self.dcn_bias,
                 impl=impl, direction=direction,
+                sparse=self.dcn_sparse, activity=activity,
             )
         )
         feat = apply_seq(self.post_dcn, jnp.concatenate([aligned, feat1], axis=-1), train)
@@ -258,12 +268,14 @@ class STFusion(nn.Module):
         y1 = feat1 * sk[..., 1:2] * ck[..., c:]
         return apply_seq(self.dcn_fusion, jnp.concatenate([y0, y1], axis=-1), train)
 
-    def _dense_fuse(self, x: Array, train: bool) -> Array:
+    def _dense_fuse(
+        self, x: Array, train: bool, activity: Optional[Array] = None
+    ) -> Array:
         """Fuse N frames into one (reference ``model.py:233-251``)."""
         b, n, h, w, c = x.shape
         if self.has_dcnatten:
             outs = [
-                self._fuse(x[:, i], x[:, self.mid_idx], train)
+                self._fuse(x[:, i], x[:, self.mid_idx], train, activity)
                 for i in range(n)
                 if i != self.mid_idx
             ]
@@ -287,12 +299,19 @@ class STFusion(nn.Module):
         return self.recons[scale_idx](x, train)
 
     def __call__(
-        self, x: Array, feats_list: Sequence[Array], train: bool = False
+        self, x: Array, feats_list: Sequence[Array], train: bool = False,
+        activity: Optional[Array] = None,
     ) -> Array:
-        """``x: [B, N, H, W, C]``; ``feats_list[i]: [B*N, 2^i*H, 2^i*W, C/2^i]``."""
+        """``x: [B, N, H, W, C]``; ``feats_list[i]: [B*N, 2^i*H, 2^i*W, C/2^i]``.
+
+        ``activity`` (optional ``[B]``): the window's rasterization-time
+        activity annotation, combined conservatively with the
+        input-derived predication mask when ``dcn_sparse`` is on
+        (``deform_conv2d_auto`` docstring) — it can only veto skipping,
+        never cause it, so a wrong annotation cannot change numerics."""
         b, n, h, w, c = x.shape
         assert n == self.num_frame
-        out = self._dense_fuse(x, train)
+        out = self._dense_fuse(x, train, activity)
         for idx, feats in enumerate(feats_list):
             fh, fw, fc = feats.shape[-3:]
             out = self._scale_aggre(
@@ -327,6 +346,9 @@ class DeepRecurrNet(nn.Module):
     dcn_impl: str = "auto"
     # forward-direction (train=False) DCN impl override; None = dcn_impl
     dcn_impl_fwd: Optional[str] = None
+    # activity-sparse DCN predication (STFusion.dcn_sparse; default off —
+    # zero change to every existing traced program)
+    dcn_sparse: bool = False
 
     down_scale: int = 8
 
@@ -346,7 +368,7 @@ class DeepRecurrNet(nn.Module):
             channels=c, num_frame=self.num_frame, norm=self.norm,
             activation=self.activation, has_dcnatten=self.has_dcnatten,
             has_scaleaggre=self.has_scaleaggre, dcn_impl=self.dcn_impl,
-            dcn_impl_fwd=self.dcn_impl_fwd,
+            dcn_impl_fwd=self.dcn_impl_fwd, dcn_sparse=self.dcn_sparse,
         )
         self.tail = ConvLayer(
             self.inch, 3, padding=1, activation="relu", norm=self.norm
@@ -362,7 +384,8 @@ class DeepRecurrNet(nn.Module):
         return (z, z)
 
     def __call__(
-        self, x: Array, states: States, train: bool = False
+        self, x: Array, states: States, train: bool = False,
+        activity: Optional[Array] = None,
     ) -> Tuple[Array, States]:
         b, n, h, w, cin = x.shape
         spec = model_util.compute_pad(h, w, self.down_scale, self.down_scale)
@@ -379,7 +402,7 @@ class DeepRecurrNet(nn.Module):
 
         seq = bottleneck.reshape(b, n, bh, bw, bc)
         seq, states = self.time_propagate(seq, states, train)
-        out = self.spacetime_fuse(seq, feats_list, train)
+        out = self.spacetime_fuse(seq, feats_list, train, activity)
         out = self.tail(out, train)
 
         if need_crop:

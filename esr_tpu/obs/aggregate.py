@@ -181,7 +181,8 @@ class _State:
         "class_windows", "chunk_busy", "chunk_begin", "chunk_end",
         "chunk_kinds", "attr_records", "attr_wall", "attr_wall_x_goodput",
         "requests", "completed_requests", "failed_requests", "statuses",
-        "windows_total", "trace_requests", "trace_complete",
+        "windows_total", "chunk_windows_valid", "windows_skipped",
+        "trace_requests", "trace_complete",
         "faults_injected", "recovery_events",
     )
 
@@ -205,6 +206,8 @@ class _State:
         self.failed_requests = 0
         self.statuses: Dict[str, int] = {}
         self.windows_total = 0
+        self.chunk_windows_valid = 0
+        self.windows_skipped = 0
         self.trace_requests = 0
         self.trace_complete = 0
         self.faults_injected = 0
@@ -331,9 +334,23 @@ class LiveAggregator:
             st.chunk_end = (end if st.chunk_end is None
                             else max(st.chunk_end, end))
             st.chunk_kinds.add(name)
+            # activity gating (ISSUE 12): mirror the offline reporter's
+            # computed-vs-skipped tally (serve_chunk ONLY — infer_chunk
+            # windows are not serving compute) so
+            # serving.active_window_frac evaluates identically live
+            # and offline
+            if name == "serve_chunk":
+                st.chunk_windows_valid += int(rec.get("windows", 0) or 0)
+                st.windows_skipped += int(
+                    rec.get("skipped_windows", 0) or 0
+                )
 
     def _observe_event(self, st: _State, name: str, rec: Dict) -> None:
         st.events[name] = st.events.get(name, 0) + 1
+        if name == "serve_gating_flush":
+            # trailing gated windows with no chunk span to ride
+            # (serving/server.py drain path) — keep live == offline
+            st.windows_skipped += int(rec.get("skipped", 0) or 0)
         if name == "fault_injected":
             st.faults_injected += 1
         elif name.startswith("recovery_"):
@@ -428,6 +445,12 @@ class LiveAggregator:
             "errors": st.failed_requests,
             "statuses": {k: st.statuses[k] for k in sorted(st.statuses)},
             "windows": st.windows_total,
+            "windows_skipped": st.windows_skipped,
+            "active_window_frac": (
+                round(st.chunk_windows_valid
+                      / (st.chunk_windows_valid + st.windows_skipped), 6)
+                if (st.chunk_windows_valid + st.windows_skipped) else None
+            ),
             "preemptions": st.events.get("serve_preempt", 0),
             "backpressure": st.counters.get("serve_backpressure", 0.0),
             "classes": {
@@ -496,6 +519,8 @@ def _merge_state(dst: _State, src: _State) -> None:
     for k, v in src.statuses.items():
         dst.statuses[k] = dst.statuses.get(k, 0) + v
     dst.windows_total += src.windows_total
+    dst.chunk_windows_valid += src.chunk_windows_valid
+    dst.windows_skipped += src.windows_skipped
     dst.trace_requests += src.trace_requests
     dst.trace_complete += src.trace_complete
     dst.faults_injected += src.faults_injected

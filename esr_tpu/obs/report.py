@@ -247,6 +247,8 @@ def build_report(
     chunk_edges: List[Tuple[float, float]] = []
     chunk_busy = 0.0
     chunk_kinds: set = set()
+    chunk_windows_valid = 0
+    windows_skipped = 0
     requests_done = 0
     requests_failed = 0
     windows_total = 0
@@ -270,10 +272,24 @@ def build_report(
                 chunk_edges.append(_span_edges(rec))
                 chunk_busy += float(rec.get("seconds", 0.0) or 0.0)
                 chunk_kinds.add(name)
+                # activity gating (ISSUE 12): windows the SERVING
+                # scheduler served with zero lane compute. serve_chunk
+                # only — folding infer_chunk windows into the computed
+                # side would report active_window_frac 1.0 for
+                # inference-only files and understate serving savings
+                if name == "serve_chunk":
+                    chunk_windows_valid += int(rec.get("windows", 0) or 0)
+                    windows_skipped += int(
+                        rec.get("skipped_windows", 0) or 0
+                    )
         elif kind == "counter":
             counters[name] = float(rec.get("total", 0.0) or 0.0)
         elif kind == "event":
             event_counts[name] = event_counts.get(name, 0) + 1
+            if name == "serve_gating_flush":
+                # gated windows from after the last dispatched chunk
+                # (serving/server.py): no span carries them
+                windows_skipped += int(rec.get("skipped", 0) or 0)
             if name == _REQUEST_TERMINAL:
                 status = rec.get("status") or (
                     "ok" if rec.get("completed", False) else "bad_stream"
@@ -335,6 +351,15 @@ def build_report(
         "errors": requests_failed,
         "statuses": {k: statuses[k] for k in sorted(statuses)},
         "windows": windows_total,
+        # how much compute activity gating saved (docs/PERF.md): idle
+        # windows served without a dispatch, and the computed fraction —
+        # 1.0 (or None when no chunks) means gating removed nothing
+        "windows_skipped": windows_skipped,
+        "active_window_frac": (
+            round(chunk_windows_valid
+                  / (chunk_windows_valid + windows_skipped), 6)
+            if (chunk_windows_valid + windows_skipped) else None
+        ),
         "preemptions": event_counts.get("serve_preempt", 0),
         "backpressure": counters.get("serve_backpressure", 0.0),
         "classes": {

@@ -196,6 +196,9 @@ def deform_conv2d_auto(
     dilation: int = 1,
     impl: str = "auto",
     direction: str = "train",
+    sparse: bool = False,
+    activity: Optional[jax.Array] = None,
+    tile_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dispatch between the jnp formulation and the fused Pallas kernels.
 
@@ -214,6 +217,21 @@ def deform_conv2d_auto(
     ``pallas_fwd_compiles``. Either way ``'auto'`` can never silently
     depend on a kernel the resident compiler rejects, and the traced
     decision is logged under ``(direction, HxW)``.
+
+    Activity-sparse compute (docs/PERF.md, ISSUE 12): ``sparse=True``
+    derives the provably-invisible per-image predication mask
+    (:func:`~esr_tpu.ops.dcn_pallas.dcn_image_activity` — an all-zero
+    input image's output is zero for ANY offsets) and predicates the
+    Pallas kernels on it; an all-zero tile block skips its gather+MXU
+    loop entirely. ``activity`` (optional ``[B]``, e.g. the data plane's
+    rasterization-time sidecar) is combined CONSERVATIVELY — a block is
+    skipped only when BOTH the input-derived mask and the caller's
+    activity call it idle, so a wrong caller annotation can only reduce
+    skipping, never change numerics. ``tile_mask`` passes an explicit
+    ``[B]``/``[B, n_tiles]`` bitmap through verbatim (expert callers with
+    per-tile evidence own its correctness). The jnp path ignores all
+    three (dense by definition), so predication rides ONLY behind the
+    per-direction Mosaic gates that ``'auto'`` already consults.
     """
     assert direction in DCN_DIRECTIONS, direction
     if impl == "auto":
@@ -225,16 +243,31 @@ def deform_conv2d_auto(
         # decision.
         _DISPATCH_LOG[_dispatch_key(direction, x.shape[1], x.shape[2])] = impl
     if impl == "pallas":
+        tm = tile_mask
+        if tm is None and sparse:
+            from esr_tpu.ops.dcn_pallas import dcn_image_activity
+
+            tm = dcn_image_activity(x)
+            if activity is not None:
+                # conservative OR of the two activity views: skip only
+                # when both say idle — the derived mask alone already
+                # implies the input is zero, so adding caller activity
+                # can only KEEP tiles, never skip a live one
+                tm = jnp.maximum(
+                    tm, (activity.reshape(-1) > 0).astype(jnp.float32)
+                )
         if direction == "fwd":
             from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas_fwd
 
             return deform_conv2d_pallas_fwd(
-                x, offsets, mask, weight, bias, stride, padding, dilation
+                x, offsets, mask, weight, bias, stride, padding, dilation,
+                tile_mask=tm,
             )
         from esr_tpu.ops.dcn_pallas import deform_conv2d_pallas
 
         return deform_conv2d_pallas(
-            x, offsets, mask, weight, bias, stride, padding, dilation
+            x, offsets, mask, weight, bias, stride, padding, dilation,
+            tile_mask=tm,
         )
     if impl == "jnp":
         return deform_conv2d(

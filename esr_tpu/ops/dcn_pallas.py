@@ -275,31 +275,14 @@ def _separable_corner_decomposition(
     )
 
 
-def _dcn_fwd_kernel(
-    xg_ref, yi_ref, wy_ref, xi_ref, wx_ref, wt_ref, out_ref,
+def _dcn_fwd_tile_acc(
+    xg_ref, yi_ref, wy_ref, xi_ref, wx_ref, wt_ref,
     *, dg, cg, k, h_pad, w_pad, no_tile, cout,
 ):
-    """DCNv4-style fused forward: one (batch image, output tile) per
-    program, ``fori_loop`` over (group, tap) pairs, ONE f32 accumulator
-    tile in VMEM, no ``(dg, k, HW)`` sampled-patch matrix ever built.
-
-    Per pair the 2006.05238 line-buffer factorization replaces the
-    ``[HW, No]`` one-hot of :func:`_dcn_kernel` with:
-
-    - ``A [Wp, No]``: x-axis one-hot (2 corners) weighted by the x-lerp —
-      built with 2 vector compares over ``Wp`` rows, not 4 over ``H*W``;
-    - ``T = rows·A`` where ``rows [Cg·Hp, Wp]`` is the group's image with
-      H folded into the row axis — the x-gather for EVERY input line of
-      EVERY group channel in one well-shaped MXU contraction (the
-      channel-group axis is vectorized into M instead of looping corners);
-    - ``B [Hp, No]``: y-axis lerp (mask-premultiplied) applied as an
-      elementwise multiply + 8-sublane reduction over H — ``Cg·Hp·No``
-      VPU work vs the old ``4·HW·No`` compare cascade;
-    - ``acc += W_{g,k}·V`` into the single output accumulator.
-
-    Sampling weights are the raw sigmoid modulation — unnormalized, per
-    DCNv4 (arxiv 2401.06197): no softmax over taps anywhere.
-    """
+    """The DCNv4-style fused-forward tile body (docstring on
+    :func:`_dcn_fwd_kernel`), returning the accumulated ``[Cout,
+    no_tile]`` tile — shared verbatim by the dense kernel and the
+    activity-predicated variant so predication can never fork the math."""
     from jax.experimental import pallas as pl
 
     HIGH = jax.lax.Precision.HIGHEST
@@ -335,9 +318,108 @@ def _dcn_fwd_kernel(
             precision=HIGH, preferred_element_type=jnp.float32,
         )
 
-    out_ref[0] = jax.lax.fori_loop(
+    return jax.lax.fori_loop(
         0, dg * k, body, jnp.zeros((cout, no_tile), jnp.float32)
     )
+
+
+def _dcn_fwd_kernel(
+    xg_ref, yi_ref, wy_ref, xi_ref, wx_ref, wt_ref, out_ref,
+    *, dg, cg, k, h_pad, w_pad, no_tile, cout,
+):
+    """DCNv4-style fused forward: one (batch image, output tile) per
+    program, ``fori_loop`` over (group, tap) pairs, ONE f32 accumulator
+    tile in VMEM, no ``(dg, k, HW)`` sampled-patch matrix ever built.
+
+    Per pair the 2006.05238 line-buffer factorization replaces the
+    ``[HW, No]`` one-hot of :func:`_dcn_kernel` with:
+
+    - ``A [Wp, No]``: x-axis one-hot (2 corners) weighted by the x-lerp —
+      built with 2 vector compares over ``Wp`` rows, not 4 over ``H*W``;
+    - ``T = rows·A`` where ``rows [Cg·Hp, Wp]`` is the group's image with
+      H folded into the row axis — the x-gather for EVERY input line of
+      EVERY group channel in one well-shaped MXU contraction (the
+      channel-group axis is vectorized into M instead of looping corners);
+    - ``B [Hp, No]``: y-axis lerp (mask-premultiplied) applied as an
+      elementwise multiply + 8-sublane reduction over H — ``Cg·Hp·No``
+      VPU work vs the old ``4·HW·No`` compare cascade;
+    - ``acc += W_{g,k}·V`` into the single output accumulator.
+
+    Sampling weights are the raw sigmoid modulation — unnormalized, per
+    DCNv4 (arxiv 2401.06197): no softmax over taps anywhere.
+    """
+    out_ref[0] = _dcn_fwd_tile_acc(
+        xg_ref, yi_ref, wy_ref, xi_ref, wx_ref, wt_ref,
+        dg=dg, cg=cg, k=k, h_pad=h_pad, w_pad=w_pad,
+        no_tile=no_tile, cout=cout,
+    )
+
+
+def _dcn_fwd_kernel_masked(
+    am_ref, xg_ref, yi_ref, wy_ref, xi_ref, wx_ref, wt_ref, out_ref,
+    *, dg, cg, k, h_pad, w_pad, no_tile, cout,
+):
+    """Activity-predicated twin of :func:`_dcn_fwd_kernel` (DCNv4's
+    dynamic-sparsity reading, arxiv 2401.06197; region-skipping per arxiv
+    2006.05238): ``am_ref`` is the scalar-prefetched ``[B, n_tiles]``
+    tile-activity bitmap in SMEM, and an inactive (batch image, output
+    tile) program skips the whole gather + MXU contraction loop and
+    zero-fills its accumulator tile instead — numerically invisible by
+    the mask's contract (every value the tile's gathers could touch is
+    zero, so the dense result IS the zero tile; judged by the same
+    ``dcn_*_parity_ok`` ladders as the dense kernels)."""
+    from jax.experimental import pallas as pl
+
+    active = am_ref[pl.program_id(0), pl.program_id(1)] > 0
+
+    @pl.when(active)
+    def _compute():
+        out_ref[0] = _dcn_fwd_tile_acc(
+            xg_ref, yi_ref, wy_ref, xi_ref, wx_ref, wt_ref,
+            dg=dg, cg=cg, k=k, h_pad=h_pad, w_pad=w_pad,
+            no_tile=no_tile, cout=cout,
+        )
+
+    @pl.when(jnp.logical_not(active))
+    def _skip():
+        out_ref[0] = jnp.zeros((cout, no_tile), jnp.float32)
+
+
+def _tile_mask_grid(tile_mask: jax.Array, b: int, n_tiles: int) -> jax.Array:
+    """Normalize a caller activity mask onto a kernel's ``(b, n_tiles)``
+    grid: ``[B]`` per-image activity broadcasts over every output tile
+    (the idle-window case — an all-zero input image zeroes ALL its
+    tiles); ``[B, n_tiles]`` passes through for callers with per-tile
+    evidence. Returns the int32 bitmap the kernels branch on."""
+    am = jnp.asarray(tile_mask)
+    if am.ndim == 1:
+        am = jnp.broadcast_to(am[:, None], (b, n_tiles))
+    if am.shape != (b, n_tiles):
+        raise ValueError(
+            f"tile_mask shape {am.shape} does not match the kernel grid "
+            f"({b}, {n_tiles}); pass [B] per-image activity or the exact "
+            f"[B, n_tiles] per-output-tile bitmap"
+        )
+    return (am > 0).astype(jnp.int32)
+
+
+def dcn_image_activity(x: jax.Array) -> jax.Array:
+    """``[B]`` f32 per-image activity: 1.0 where ANY input value is
+    nonzero. This is the provably-invisible predication mask — an
+    all-zero input image's deformable-conv output (pre-bias) is zero for
+    EVERY possible offset/modulation, so skipping all its tile programs
+    cannot change a single output bit. The activity-mask plane's
+    ``sparse`` auto-dispatch derives it at trace time (one tiny
+    reduction, XLA-fused with the staging elementwise work).
+
+    NaN inputs count as ACTIVE: ``max(|x|) > 0`` is False for a NaN max,
+    which would otherwise classify a NaN-poisoned image as idle and
+    replace its (correctly NaN) dense output with clean zeros — exactly
+    the kind of silent divergence masking the numerically-invisible
+    contract forbids. A NaN image must flow through the dense path and
+    surface loudly."""
+    m = jnp.max(jnp.abs(x), axis=tuple(range(1, x.ndim)))
+    return ((m > 0) | jnp.isnan(m)).astype(jnp.float32)
 
 
 def _pallas_forward_fused(
@@ -349,10 +431,14 @@ def _pallas_forward_fused(
     padding: int,
     dilation: int,
     interpret: bool,
+    tile_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Host-side staging for :func:`_dcn_fwd_kernel` (the DCNv4-style
     forward). Layout: the image is pre-transposed to ``[B, C·Hp, Wp]`` so
-    each group's ``[Cg·Hp, Wp]`` line block is one contiguous row slice."""
+    each group's ``[Cg·Hp, Wp]`` line block is one contiguous row slice.
+    ``tile_mask`` (optional, [B] or [B, n_tiles]) routes the
+    activity-predicated kernel; ``None`` builds the EXACT dense program
+    shipped before the activity plane existed."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -382,40 +468,56 @@ def _pallas_forward_fused(
     # weight HWIO -> [dg, K, Cout, Cg]
     wt = weight.reshape(k, dg, cg, cout).transpose(1, 0, 3, 2)
 
-    kernel = functools.partial(
-        _dcn_fwd_kernel,
-        dg=dg, cg=cg, k=k, h_pad=h_pad, w_pad=w_pad,
-        no_tile=no_tile, cout=cout,
-    )
     pair_spec = pl.BlockSpec(
         (1, dg, 2, k, no_tile), lambda i, t: (i, 0, 0, 0, t),
         memory_space=pltpu.VMEM,
     )
+    in_specs = [
+        pl.BlockSpec((1, cin * h_pad, w_pad), lambda i, t: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pair_spec, pair_spec, pair_spec, pair_spec,
+        pl.BlockSpec((dg, k, cout, cg), lambda i, t: (0, 0, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [xg, yi, wy, xi, wx, wt]
+    if tile_mask is None:
+        kernel = functools.partial(
+            _dcn_fwd_kernel,
+            dg=dg, cg=cg, k=k, h_pad=h_pad, w_pad=w_pad,
+            no_tile=no_tile, cout=cout,
+        )
+    else:
+        kernel = functools.partial(
+            _dcn_fwd_kernel_masked,
+            dg=dg, cg=cg, k=k, h_pad=h_pad, w_pad=w_pad,
+            no_tile=no_tile, cout=cout,
+        )
+        # the whole bitmap rides SMEM (scalar memory): the per-program
+        # branch scalar is prefetched, never a VMEM tile load
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+        operands = [_tile_mask_grid(tile_mask, b, n_tiles)] + operands
     out_t = pl.pallas_call(
         kernel,
         grid=(b, n_tiles),
-        in_specs=[
-            pl.BlockSpec((1, cin * h_pad, w_pad), lambda i, t: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pair_spec, pair_spec, pair_spec, pair_spec,
-            pl.BlockSpec((dg, k, cout, cg), lambda i, t: (0, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, cout, no_tile), lambda i, t: (i, 0, t),
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((b, cout, no_pad), jnp.float32),
         interpret=interpret,
-    )(xg, yi, wy, xi, wx, wt)
+    )(*operands)
 
     return out_t[:, :, :no].transpose(0, 2, 1).reshape(b, ho, wo, cout)
 
 
-def _dcn_kernel(xt_ref, idx_ref, wgt_ref, wt_ref, out_ref, *, dg, cg, k, hw_pad, no_tile, cout):
-    """One (batch image, output tile) per program; ``fori_loop`` over the
-    flattened (group, tap) pairs keeps VMEM to one S matrix at a time and
-    writes the f32 accumulator exactly once."""
+def _dcn_tile_acc(
+    xt_ref, idx_ref, wgt_ref, wt_ref, *, dg, cg, k, hw_pad, no_tile, cout
+):
+    """The one-hot-gather tile body of :func:`_dcn_kernel`, returning the
+    accumulated ``[Cout, no_tile]`` tile — shared verbatim by the dense
+    kernel and the activity-predicated variant so predication can never
+    fork the math."""
     from jax.experimental import pallas as pl
 
     HIGH = jax.lax.Precision.HIGHEST
@@ -441,9 +543,47 @@ def _dcn_kernel(xt_ref, idx_ref, wgt_ref, wt_ref, out_ref, *, dg, cg, k, hw_pad,
             precision=HIGH, preferred_element_type=jnp.float32,
         )
 
-    out_ref[0] = jax.lax.fori_loop(
+    return jax.lax.fori_loop(
         0, dg * k, body, jnp.zeros((cout, no_tile), jnp.float32)
     )
+
+
+def _dcn_kernel(xt_ref, idx_ref, wgt_ref, wt_ref, out_ref, *, dg, cg, k, hw_pad, no_tile, cout):
+    """One (batch image, output tile) per program; ``fori_loop`` over the
+    flattened (group, tap) pairs keeps VMEM to one S matrix at a time and
+    writes the f32 accumulator exactly once."""
+    out_ref[0] = _dcn_tile_acc(
+        xt_ref, idx_ref, wgt_ref, wt_ref,
+        dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout,
+    )
+
+
+def _dcn_kernel_masked(
+    am_ref, xt_ref, idx_ref, wgt_ref, wt_ref, out_ref,
+    *, dg, cg, k, hw_pad, no_tile, cout,
+):
+    """Activity-predicated twin of :func:`_dcn_kernel` — the
+    train-direction half of the block-predication plane (docstring on
+    :func:`_dcn_fwd_kernel_masked`): inactive (image, tile) programs skip
+    the ``dg*k`` gather+contraction loop and zero-fill the accumulator.
+    Predication covers the PRIMAL forward only — the backward stays
+    dense, because ``gx`` of a zero input block is NOT zero (it is the
+    col2im transport of the upstream cotangent into that block), so
+    skipping it there would not be numerically invisible."""
+    from jax.experimental import pallas as pl
+
+    active = am_ref[pl.program_id(0), pl.program_id(1)] > 0
+
+    @pl.when(active)
+    def _compute():
+        out_ref[0] = _dcn_tile_acc(
+            xt_ref, idx_ref, wgt_ref, wt_ref,
+            dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout,
+        )
+
+    @pl.when(jnp.logical_not(active))
+    def _skip():
+        out_ref[0] = jnp.zeros((cout, no_tile), jnp.float32)
 
 
 def _pallas_forward(
@@ -455,6 +595,7 @@ def _pallas_forward(
     padding: int,
     dilation: int,
     interpret: bool,
+    tile_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -484,24 +625,35 @@ def _pallas_forward(
     # weight HWIO -> [dg, K, Cout, Cg]
     wt = weight.reshape(k, dg, cg, cout).transpose(1, 0, 3, 2)
 
-    kernel = functools.partial(
-        _dcn_kernel, dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout
-    )
+    in_specs = [
+        pl.BlockSpec((1, cin, hw_pad), lambda i, t: (i, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
+        pl.BlockSpec((dg, k, cout, cg), lambda i, t: (0, 0, 0, 0), memory_space=pltpu.VMEM),
+    ]
+    operands = [xt, idx, wgt, wt]
+    if tile_mask is None:
+        # the EXACT dense program shipped before the activity plane
+        kernel = functools.partial(
+            _dcn_kernel, dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout
+        )
+    else:
+        kernel = functools.partial(
+            _dcn_kernel_masked,
+            dg=dg, cg=cg, k=k, hw_pad=hw_pad, no_tile=no_tile, cout=cout,
+        )
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+        operands = [_tile_mask_grid(tile_mask, b, n_tiles)] + operands
     out_t = pl.pallas_call(
         kernel,
         grid=(b, n_tiles),
-        in_specs=[
-            pl.BlockSpec((1, cin, hw_pad), lambda i, t: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, dg, 4, k, no_tile), lambda i, t: (i, 0, 0, 0, t), memory_space=pltpu.VMEM),
-            pl.BlockSpec((dg, k, cout, cg), lambda i, t: (0, 0, 0, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, cout, no_tile), lambda i, t: (i, 0, t), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((b, cout, no_pad), jnp.float32),
         interpret=interpret,
-    )(xt, idx, wgt, wt)
+    )(*operands)
 
     # [B, Cout, Nop] -> [B, Ho, Wo, Cout]
     return out_t[:, :, :no].transpose(0, 2, 1).reshape(b, ho, wo, cout)
@@ -525,6 +677,7 @@ def on_tpu_backend() -> bool:
 def dcn_parity_errors(
     x, off, mask, wt, interpret: bool = False,
     matmul_precision: Optional[str] = "highest",
+    tile_mask: Optional[jax.Array] = None,
 ) -> dict:
     """Forward + all-four-cotangent parity of the fused kernel against the
     jnp formulation at the given inputs. Used by BOTH the production
@@ -543,6 +696,12 @@ def dcn_parity_errors(
     Returns ``{"fwd_max_err", "fwd_scale", "gx_rel_err", "goff_rel_err",
     "gmask_rel_err", "gw_rel_err"}`` (absolute fwd error; per-cotangent
     max-abs error over the jnp cotangent's max-abs scale).
+
+    ``tile_mask`` (activity-sparse compute, ISSUE 12) applies block
+    predication to the PALLAS side only — the jnp reference stays dense —
+    so a truthful mask must leave every error inside the same ladder:
+    predication is proven numerically invisible by the same criterion
+    that gates the dense kernels.
     """
     import contextlib
 
@@ -561,10 +720,13 @@ def dcn_parity_errors(
 
             return f
 
-        out = deform_conv2d_pallas(x, off, mask, wt, interpret=interpret)
+        out = deform_conv2d_pallas(
+            x, off, mask, wt, interpret=interpret, tile_mask=tile_mask
+        )
         ref = _dcn_jnp.deform_conv2d(x, off, mask, wt)
         gp = jax.grad(
-            loss(lambda *a: deform_conv2d_pallas(*a, interpret=interpret)),
+            loss(lambda *a: deform_conv2d_pallas(
+                *a, interpret=interpret, tile_mask=tile_mask)),
             argnums=(0, 1, 2, 3),
         )(x, off, mask, wt)
         gj = jax.grad(
@@ -655,13 +817,16 @@ def dcn_fwd_parity_ok(
 def dcn_fwd_parity_errors(
     x, off, mask, wt, interpret: bool = False,
     matmul_precision: Optional[str] = "highest",
+    tile_mask: Optional[jax.Array] = None,
 ) -> dict:
     """Forward-only parity of the DCNv4-style fused kernel
     (:func:`deform_conv2d_pallas_fwd`) against the jnp formulation —
     the same measurement :func:`dcn_parity_errors` makes for the
     train-direction kernel, restricted to the forward fields. Used by
     BOTH the production forward-dispatch gate (tiny shape) and bench.py's
-    ``dcn_fwd_ab`` stage (flagship shape)."""
+    ``dcn_fwd_ab`` stage (flagship shape). ``tile_mask`` predicates the
+    pallas side only (jnp stays dense), so activity masking is judged by
+    the same scale-normalized ladder as the dense kernel."""
     import contextlib
 
     prec_ctx = (
@@ -669,7 +834,9 @@ def dcn_fwd_parity_errors(
         if matmul_precision else contextlib.nullcontext()
     )
     with prec_ctx:
-        out = deform_conv2d_pallas_fwd(x, off, mask, wt, interpret=interpret)
+        out = deform_conv2d_pallas_fwd(
+            x, off, mask, wt, interpret=interpret, tile_mask=tile_mask
+        )
         ref = _dcn_jnp.deform_conv2d(x, off, mask, wt)
     return {
         "fwd_max_err": float(jnp.max(jnp.abs(out - ref))),
@@ -995,16 +1162,25 @@ def deform_conv2d_pallas_fwd(
     padding: int = 1,
     dilation: int = 1,
     interpret: Optional[bool] = None,
+    tile_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """DCNv4-style fused forward (:func:`_dcn_fwd_kernel`) — the
     serving-direction fast path. Same signature and dtype contract as
     :func:`deform_conv2d_pallas`; differentiable for completeness (the
     VJP delegates to the SAME fused backward as the train-direction op),
     but train-direction dispatch keeps :func:`deform_conv2d_pallas` so
-    train numerics are byte-for-byte untouched by this kernel."""
+    train numerics are byte-for-byte untouched by this kernel.
+
+    ``tile_mask`` (optional f32, ``[B]`` or ``[B, n_tiles]``): activity
+    bitmap for block predication — inactive (image, tile) programs skip
+    their gather+MXU loop (:func:`_dcn_fwd_kernel_masked`). The caller
+    asserts that everything a masked-off tile could sample is zero;
+    :func:`dcn_image_activity` derives the always-safe per-image form.
+    ``None`` (default) builds the byte-identical dense program."""
     interp = _auto_interpret() if interpret is None else interpret
     out = _pallas_forward_fused(
-        x, offsets, mask, weight, stride, padding, dilation, interp
+        x, offsets, mask, weight, stride, padding, dilation, interp,
+        tile_mask=tile_mask,
     )
     if bias is not None:
         out = out + bias
@@ -1022,12 +1198,19 @@ def deform_conv2d_pallas(
     padding: int = 1,
     dilation: int = 1,
     interpret: Optional[bool] = None,
+    tile_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Drop-in replacement for :func:`esr_tpu.ops.dcn.deform_conv2d` with the
     fused Pallas forward. ``interpret=None`` auto-selects interpreter mode on
-    CPU backends (tests) and compiled Mosaic on TPU."""
+    CPU backends (tests) and compiled Mosaic on TPU. ``tile_mask`` enables
+    activity block predication of the PRIMAL forward only (the fused
+    backward stays dense — ``gx`` of a zero block is not zero); ``None``
+    builds the byte-identical dense program."""
     interp = _auto_interpret() if interpret is None else interpret
-    out = _pallas_forward(x, offsets, mask, weight, stride, padding, dilation, interp)
+    out = _pallas_forward(
+        x, offsets, mask, weight, stride, padding, dilation, interp,
+        tile_mask=tile_mask,
+    )
     if bias is not None:
         out = out + bias
     # Accumulation is f32 inside the kernel; the public output follows the
@@ -1218,15 +1401,21 @@ def dcn_backward_impl(impl: str) -> None:
     _BACKWARD_IMPL = impl
 
 
-def _fwd(x, offsets, mask, weight, bias, stride, padding, dilation, interpret):
+def _fwd(x, offsets, mask, weight, bias, stride, padding, dilation,
+         interpret, tile_mask):
     out = deform_conv2d_pallas(
-        x, offsets, mask, weight, bias, stride, padding, dilation, interpret
+        x, offsets, mask, weight, bias, stride, padding, dilation,
+        interpret, tile_mask,
     )
-    return out, (x, offsets, mask, weight, bias)
+    return out, (x, offsets, mask, weight, bias, tile_mask)
 
 
 def _bwd(stride, padding, dilation, interpret, res, g):
-    x, offsets, mask, weight, bias = res
+    x, offsets, mask, weight, bias, tile_mask = res
+    # the mask is a non-differentiable activity annotation: its cotangent
+    # is identically zero (predication only ever skips tiles whose dense
+    # result is zero, so the primal is mask-independent by construction)
+    gtm = None if tile_mask is None else jnp.zeros_like(tile_mask)
 
     if _BACKWARD_IMPL == "jnp":
 
@@ -1239,7 +1428,7 @@ def _bwd(stride, padding, dilation, interpret, res, g):
 
         primal, vjp = jax.vjp(ref_fn, x, offsets, mask, weight, bias)
         gx, goff, gmask, gw, gb = vjp(g.astype(primal.dtype))
-        return gx, goff, gmask, gw, (gb if bias is not None else None)
+        return gx, goff, gmask, gw, (gb if bias is not None else None), gtm
 
     interp = _auto_interpret() if interpret is None else interpret
     gx, goff, gmask, gw = _pallas_backward(
@@ -1250,18 +1439,19 @@ def _bwd(stride, padding, dilation, interpret, res, g):
         if bias is not None
         else None
     )
-    return gx, goff, gmask, gw, gb
+    return gx, goff, gmask, gw, gb, gtm
 
 
 deform_conv2d_pallas.defvjp(_fwd, _bwd)
 
 
 def _fwd_v4(x, offsets, mask, weight, bias, stride, padding, dilation,
-            interpret):
+            interpret, tile_mask):
     out = deform_conv2d_pallas_fwd(
-        x, offsets, mask, weight, bias, stride, padding, dilation, interpret
+        x, offsets, mask, weight, bias, stride, padding, dilation,
+        interpret, tile_mask,
     )
-    return out, (x, offsets, mask, weight, bias)
+    return out, (x, offsets, mask, weight, bias, tile_mask)
 
 
 # The DCNv4-style forward shares the train-direction op's fused backward

@@ -264,6 +264,53 @@ def events_to_stack(
     return out.at[yi, xi, bin_idx].add(vals, mode="drop")
 
 
+def tile_activity(counts: Array, tile: int = 8) -> Array:
+    """Per-tile activity sums of a count image — the activity-mask plane's
+    device-side derivation (docs/PERF.md "activity-sparse compute").
+
+    ``counts``: ``[H, W, ...]`` non-negative per-pixel event counts (any
+    trailing channel axes are folded in). Returns ``[ceil(H/tile),
+    ceil(W/tile)]`` f32 per-tile summed counts; a tile is ACTIVE iff its
+    sum is ``> 0``. The reduction is exact (counts are small integers in
+    f32, far below the 2^24 mantissa bound), so this twin and
+    :func:`esr_tpu.data.np_encodings.tile_activity_np` agree
+    bit-for-bit — pinned by ``tests/test_encodings.py``.
+
+    ``tile`` defaults to the flagship model's ``down_scale`` (8): one
+    activity cell per DCN-bottleneck pixel.
+    """
+    if tile < 1:
+        raise ValueError(f"tile must be >= 1, got {tile}")
+    h, w = counts.shape[0], counts.shape[1]
+    c = counts.reshape(h, w, -1).sum(axis=-1)
+    ht = -(-h // tile)
+    wt = -(-w // tile)
+    c = jnp.pad(c, ((0, ht * tile - h), (0, wt * tile - w)))
+    return c.reshape(ht, tile, wt, tile).sum(axis=(1, 3)).astype(jnp.float32)
+
+
+def activity_fraction(act: Array) -> Array:
+    """Fraction of active tiles of a :func:`tile_activity` map (any
+    shape; scalar f32 in [0, 1]) — the scheduler-gating statistic."""
+    return (act > 0).astype(jnp.float32).mean()
+
+
+def events_to_channels_activity(
+    xs: Array,
+    ys: Array,
+    ps: Array,
+    sensor_size: Tuple[int, int],
+    valid: Optional[Array] = None,
+    tile: int = 8,
+) -> Tuple[Array, Array]:
+    """Count image + per-tile activity sidecar in one pass: the encoder
+    already sums per-pixel event counts, so the activity map is a free
+    per-tile reduction of the counts it just built (never a second pass
+    over the events). Returns ``([H, W, 2] counts, [Ht, Wt] activity)``."""
+    cnt = events_to_channels(xs, ys, ps, sensor_size, valid)
+    return cnt, tile_activity(cnt, tile)
+
+
 def events_to_mask(
     xs: Array,
     ys: Array,

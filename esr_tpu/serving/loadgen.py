@@ -48,6 +48,7 @@ def make_stream_corpus(
     base_events: Tuple[int, int] = (1024, 4096),
     num_frames: int = 6,
     events_schedule: Optional[Sequence[int]] = None,
+    burst_schedule: Optional[Sequence[float]] = None,
 ) -> List[str]:
     """``n`` short recordings with seeded, deliberately unequal lengths.
 
@@ -56,15 +57,22 @@ def make_stream_corpus(
     ``events_schedule`` overrides the draw with an explicit cycled list
     (e.g. ``[400, 4000]`` for alternating short interactive / long bulk
     streams — the raggedness profile the bench's cohort comparison uses).
-    Both are ``kind="synthetic"``-only: the ESIM path's length knob is
-    the seeded ``num_frames`` draw, so passing ``events_schedule`` with
-    ``kind="simulate"`` raises instead of silently losing the requested
-    raggedness profile."""
-    if kind == "simulate" and events_schedule:
+    ``burst_schedule`` cycles per-recording ``burst_frac`` values
+    (``data.synthetic.synthesize_streams``) — e.g. ``[0.4, 1.0]`` for an
+    idle-heavy corpus alternating bursty (active head, near-idle tail
+    under time-mode windowing) and uniformly active streams: the
+    activity-gating bench/smoke profile (docs/PERF.md). All three are
+    ``kind="synthetic"``-only: the ESIM path's length knob is the seeded
+    ``num_frames`` draw, so passing them with ``kind="simulate"`` raises
+    instead of silently losing the requested profile."""
+    if kind == "simulate" and (events_schedule or burst_schedule):
         raise ValueError(
-            "events_schedule applies only to kind='synthetic'; simulate "
-            "recordings vary length via the seeded num_frames draw "
-            f"(got events_schedule={list(events_schedule)!r})"
+            "events_schedule/burst_schedule apply only to "
+            "kind='synthetic'; simulate recordings vary via the seeded "
+            f"num_frames draw (got events_schedule="
+            f"{list(events_schedule) if events_schedule else None!r}, "
+            f"burst_schedule="
+            f"{list(burst_schedule) if burst_schedule else None!r})"
         )
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
@@ -82,6 +90,10 @@ def make_stream_corpus(
                 path, sensor_resolution,
                 base_events=ev,
                 num_frames=num_frames, seed=seed * 1000 + i,
+                burst_frac=(
+                    float(burst_schedule[i % len(burst_schedule)])
+                    if burst_schedule else 1.0
+                ),
             )
         elif kind == "simulate":
             from esr_tpu.tools.simulate import (

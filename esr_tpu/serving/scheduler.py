@@ -62,16 +62,31 @@ class RequestClass:
     ``chunk_windows`` is the latency/throughput knob — the maximum windows
     scan-fused per dispatch while a stream of this class is lane-bound.
     ``preemptible=False`` pins a stream to its lane once bound (it is
-    never offered by :meth:`LaneScheduler.preempt_candidates`)."""
+    never offered by :meth:`LaneScheduler.preempt_candidates`).
+
+    ``min_activity`` is the activity-gating knob (docs/PERF.md
+    "activity-sparse compute", ISSUE 12): a window whose rasterized
+    active-tile fraction falls below it is SKIPPED at chunk-build time —
+    consumed from the stream with near-zero lane compute, never packed
+    into a device dispatch, while the stream's recurrent state is carried
+    forward untouched (a skipped window never enters the scan, so the
+    state a later active window sees is identical to never having had
+    the idle window). 0.0 (default) disables gating — every window is
+    dense compute, exactly the pre-ISSUE-12 behavior."""
 
     name: str
     chunk_windows: int = 8
     preemptible: bool = True
+    min_activity: float = 0.0
 
     def __post_init__(self):
         if self.chunk_windows < 1:
             raise ValueError(
                 f"chunk_windows must be >= 1, got {self.chunk_windows}"
+            )
+        if not 0.0 <= self.min_activity <= 1.0:
+            raise ValueError(
+                f"min_activity must be in [0, 1], got {self.min_activity}"
             )
 
 
@@ -120,6 +135,9 @@ class StreamRequest:
     # accounting
     inflight: int = 0              # dispatched chunks not yet resolved
     windows_done: int = 0
+    # idle windows consumed by activity gating (RequestClass.min_activity)
+    # — served with near-zero lane compute, never dispatched
+    windows_skipped: int = 0
     chunks_since_bind: int = 0
     preemptions: int = 0
     first_bind_t: Optional[float] = None

@@ -107,15 +107,18 @@ _PROGRAM_CACHE: Dict[tuple, object] = {}
 class RecordingStream:
     """Host-side window source for ONE stream (numpy-only, stream order).
 
-    Yields the engine's window tuples ``(inp_scaled, gt_mid, inp_mid)``
-    — the per-window model input, the GT count image of the middle frame,
-    and the LR middle-frame counts (bicubic-baseline input). The iterator
-    is *pausable by construction*: the serving tier holds it (plus a
-    one-window peek) across preemptions, so a resumed stream continues at
-    exactly the next unserved window.
+    Yields the engine's window tuples ``(inp_scaled, gt_mid, inp_mid,
+    activity)`` — the per-window model input, the GT count image of the
+    middle frame, the LR middle-frame counts (bicubic-baseline input),
+    and the window's active-tile fraction (``data.loader.window_activity``
+    over the already-rasterized input counts — the scheduler-gating
+    statistic ``RequestClass.min_activity`` compares against). The
+    iterator is *pausable by construction*: the serving tier holds it
+    (plus a one-window peek) across preemptions, so a resumed stream
+    continues at exactly the next unserved window.
     """
 
-    def __init__(self, path: str, config: Dict):
+    def __init__(self, path: str, config: Dict, activity_tile: int = 8):
         cfg = dict(config)
         # the chunk program consumes only these three streams; selecting
         # item_keys skips building the unused encodings (same contract as
@@ -124,18 +127,24 @@ class RecordingStream:
         self.path = path
         self.seqn = int(cfg["sequence"].get("seqn", 3))
         self.mid_idx = (self.seqn - 1) // 2
+        self.activity_tile = int(activity_tile)
         self._loader = InferenceSequenceLoader(path, cfg)
         self.inp_resolution = tuple(self._loader.inp_resolution)
         self.gt_resolution = tuple(self._loader.gt_resolution)
         self._it = self._windows()
 
     def _windows(self):
+        from esr_tpu.data.loader import window_activity
+
         for batch in self._loader:
+            inp_scaled = np.asarray(
+                batch["inp_scaled_cnt"][0, : self.seqn], np.float32
+            )
             yield (
-                np.asarray(batch["inp_scaled_cnt"][0, : self.seqn],
-                           np.float32),
+                inp_scaled,
                 np.asarray(batch["gt_cnt"][0, self.mid_idx], np.float32),
                 np.asarray(batch["inp_cnt"][0, self.mid_idx], np.float32),
+                window_activity(inp_scaled, self.activity_tile),
             )
 
     def __iter__(self):
@@ -171,6 +180,7 @@ class ServingEngine:
         aot_programs: Optional[Dict[int, str]] = None,
         lane_quarantine_k: int = 3,
         request_retries: int = 1,
+        activity_tile: int = 8,
         live_port: Optional[int] = None,
         live_slo: Optional[str] = None,
         profile_steps: int = 0,
@@ -224,6 +234,23 @@ class ServingEngine:
         self._first_dispatch_t: Optional[float] = None
         self._last_resolve_t: Optional[float] = None
         self._windows_total = 0
+        # activity gating (docs/PERF.md "activity-sparse compute"):
+        # granularity of RecordingStream's per-window activity statistic
+        self.activity_tile = int(activity_tile)
+        # lanes whose NEXT dispatched chunk must reset the recurrent
+        # state (fresh binds). Persistent across pump rounds — under
+        # activity gating a freshly bound lane can spend whole rounds
+        # skipping idle windows without dispatching, and the reset
+        # obligation must survive until the first real dispatch (the
+        # old per-round `_fresh_lanes` set would have leaked the
+        # previous occupant's state into the new stream).
+        self._lane_needs_reset: set = set()
+        # gated windows skipped in rounds that dispatched no chunk,
+        # carried onto the next serve_chunk span — or flushed as a
+        # `serve_gating_flush` event at drain when no later chunk ever
+        # dispatches — so telemetry-level skip accounting (spans +
+        # flush events) always sums to the request-level totals
+        self._skipped_carry = 0
 
         # live telemetry plane (obs v3, docs/OBSERVABILITY.md): OPT-IN via
         # live_port (None = off, 0 = ephemeral) — a LiveAggregator tapped
@@ -414,7 +441,8 @@ class ServingEngine:
             if req.source is None:
                 try:
                     req.source = RecordingStream(
-                        req.path, self.dataset_config
+                        req.path, self.dataset_config,
+                        activity_tile=self.activity_tile,
                     )
                     self._ensure_device(req.source)
                     if (req.source.inp_resolution,
@@ -467,9 +495,12 @@ class ServingEngine:
                     queue_depth=self.scheduler.queue_depth(),
                 )
             # a resumed lane KEEPS its (just injected) state; a fresh one
-            # is zeroed by the program's reset mask
+            # is zeroed by the program's reset mask at its FIRST real
+            # dispatch (persistent set: gated rounds may pass first)
             if action == "fresh":
-                self._fresh_lanes.add(lane)
+                self._lane_needs_reset.add(lane)
+            else:
+                self._lane_needs_reset.discard(lane)
 
     def _finish(self, req: StreamRequest) -> None:
         sink = active_sink()
@@ -524,7 +555,13 @@ class ServingEngine:
             req = sched.lanes[lane]
             if req is None:
                 continue
-            req.saved_state = extract_lane_state(self._states, lane)
+            # a freshly bound lane that never dispatched (all its windows
+            # gated so far) still holds the PREVIOUS occupant's device
+            # state — save nothing so it resumes as a fresh (zeroed) bind
+            req.saved_state = (
+                None if lane in self._lane_needs_reset
+                else extract_lane_state(self._states, lane)
+            )
             sched.evict(lane)
             drained += 1
             if sink is not None:
@@ -583,6 +620,7 @@ class ServingEngine:
             req.saved_state = None
             req.ended = False
             req.windows_done = 0
+            req.windows_skipped = 0
             req.chunks_since_bind = 0
             req.window_latencies = []
             self._acc[req.request_id] = {
@@ -608,27 +646,39 @@ class ServingEngine:
         if req.inflight == 0:
             self._finish(req)
 
-    def _pull(self, req: StreamRequest, w: int) -> List[tuple]:
+    def _pull(self, req: StreamRequest, w: int) -> Tuple[List[tuple], int]:
         """Up to ``w`` windows from a lane's stream, with the engine's
         one-window lookahead so a stream whose length is an exact multiple
         of ``w`` frees its lane NOW instead of costing a fully-masked
-        chunk."""
+        chunk.
+
+        Activity gating (docs/PERF.md, ISSUE 12): windows whose
+        rasterized activity falls below ``req.cls.min_activity`` are
+        consumed from the stream but never packed — the idle-window case
+        costs host rasterization only, zero lane compute, and the lane's
+        recurrent state is untouched by them (they never enter the scan).
+        Returns ``(packed windows, skipped count)``."""
+        min_act = req.cls.min_activity
         wins: List[tuple] = []
+        skipped = 0
         while len(wins) < w:
             if req.peek is not None:
-                wins.append(req.peek)
-                req.peek = None
+                win, req.peek = req.peek, None
+            else:
+                try:
+                    win = next(req.source)
+                except StopIteration:
+                    req.ended = True
+                    return wins, skipped
+            if min_act > 0.0 and win[3] < min_act:
+                skipped += 1
                 continue
-            try:
-                wins.append(next(req.source))
-            except StopIteration:
-                req.ended = True
-                return wins
+            wins.append(win)
         try:
             req.peek = next(req.source)
         except StopIteration:
             req.ended = True
-        return wins
+        return wins, skipped
 
     def pump(self) -> str:
         """One scheduling round: bind free lanes, build + dispatch one
@@ -637,7 +687,6 @@ class ServingEngine:
         queue — pending readbacks are flushed before reporting drained).
         """
         now = self._now()
-        self._fresh_lanes: set = set()
         self._bind(now)
         sched = self.scheduler
         sink = active_sink()
@@ -657,6 +706,18 @@ class ServingEngine:
             if sched.drained():
                 while self._pending:
                     self._resolve(self._pending.popleft())
+                if self._skipped_carry:
+                    # the session's LAST windows were all gated and no
+                    # later chunk exists to carry them on its span:
+                    # flush the residue as a dedicated event so the
+                    # offline/live windows_skipped rollups still sum to
+                    # the request-level totals
+                    if sink is not None:
+                        sink.event(
+                            "serve_gating_flush",
+                            skipped=self._skipped_carry,
+                        )
+                    self._skipped_carry = 0
                 return "drained"
             # queued requests remain but every bind this round failed
             # (bad streams released their lanes mid-bind); the next round
@@ -692,6 +753,7 @@ class ServingEngine:
         per_lane: List[List[tuple]] = [[] for _ in range(self.lanes)]
         meta: List[Optional[Dict]] = [None] * self.lanes
         reset_keep = np.zeros(self.lanes, np.float32)
+        chunk_skipped = 0
         for lane in range(self.lanes):
             req = sched.lanes[lane]
             if req is None:
@@ -700,7 +762,7 @@ class ServingEngine:
                 if _lane_faults:
                     # enact one scheduled lane fault on this bound lane
                     raise _faults.InjectedFault(_lane_faults.pop(0))
-                wins = self._pull(req, w)
+                wins, skipped = self._pull(req, w)
             except Exception as e:  # esr: noqa(ESR012)
                 # a faulting lane/stream fails (or retries) ITS request,
                 # never the serving loop: _lane_fault is the loud typed
@@ -708,6 +770,9 @@ class ServingEngine:
                 # terminal status) + circuit breaker
                 self._lane_fault(lane, req, e)
                 continue
+            if skipped:
+                req.windows_skipped += skipped
+                chunk_skipped += skipped
             per_lane[lane] = wins
             if wins:
                 meta[lane] = {
@@ -717,12 +782,20 @@ class ServingEngine:
                     # were reset) and must not fold into the fresh run
                     "retries": req.retries,
                 }
-                # continuing lanes keep state; fresh binds are zeroed
-                reset_keep[lane] = 0.0 if lane in self._fresh_lanes else 1.0
+                # continuing lanes keep state; fresh binds are zeroed at
+                # their first REAL dispatch (persistent needs-reset set —
+                # gated rounds may pass between bind and dispatch)
+                reset_keep[lane] = (
+                    0.0 if lane in self._lane_needs_reset else 1.0
+                )
 
         if all(m is None for m in meta):
-            # every bound stream was empty (zero-window recordings):
-            # release and report them without a dispatch
+            # every bound stream was empty this round — zero-window
+            # recordings, or streams whose every pulled window was gated
+            # (their skip counts carry onto the next dispatched chunk's
+            # span): release the ended ones and report them without a
+            # dispatch; gated-but-live lanes continue next round
+            self._skipped_carry += chunk_skipped
             for lane in range(self.lanes):
                 req = sched.lanes[lane]
                 if req is not None and req.ended:
@@ -733,14 +806,16 @@ class ServingEngine:
 
         if self._shapes is None:
             first = next(wins[0] for wins in per_lane if wins)
-            self._shapes = tuple(a.shape for a in first)
+            # the window tuple is (inp_scaled, gt, inp_mid, activity) —
+            # only the three arrays are packed; activity is host-side
+            self._shapes = tuple(a.shape for a in first[:3])
         arrays = [
             np.zeros((w, self.lanes) + s, np.float32) for s in self._shapes
         ]
         valid = np.zeros((w, self.lanes), np.float32)
         for lane, wins in enumerate(per_lane):
             for t, win in enumerate(wins):
-                for arr, a in zip(arrays, win):
+                for arr, a in zip(arrays, win[:3]):
                     arr[t, lane] = a
                 valid[t, lane] = 1.0
 
@@ -756,6 +831,11 @@ class ServingEngine:
         self._states, sums, _stacked = program(
             self.params, self._states, jnp.asarray(reset_keep), windows
         )
+        # the reset rode this dispatch; the lanes that packed windows
+        # have consumed their fresh-bind obligation
+        for lane, wins in enumerate(per_lane):
+            if wins:
+                self._lane_needs_reset.discard(lane)
         if self._profiler is not None:
             # one profiled unit per dispatched chunk; the capture stops
             # itself (and stamps profiler_capture) at the budget
@@ -773,9 +853,13 @@ class ServingEngine:
             "w": w,
             "occupancy": sched.occupancy(),
             "queue_depth": sched.queue_depth(),
+            # gated windows consumed building THIS chunk, plus any from
+            # dispatch-less rounds since the last chunk
+            "skipped": chunk_skipped + self._skipped_carry,
             "t_build": t_build,
             "t_dispatch": t_dispatch,
         })
+        self._skipped_carry = 0
         self._chunk_idx += 1
 
         # -- boundary housekeeping: free ended lanes, then preempt under
@@ -793,7 +877,12 @@ class ServingEngine:
                     self._finish(req)
         for lane in sched.preempt_candidates():
             req = sched.lanes[lane]
-            req.saved_state = extract_lane_state(self._states, lane)
+            # same never-dispatched guard as _preempt_drain: a fresh lane
+            # that only ever skipped gated windows has no state to save
+            req.saved_state = (
+                None if lane in self._lane_needs_reset
+                else extract_lane_state(self._states, lane)
+            )
             sched.evict(lane)
             if sink is not None:
                 sink.event(
@@ -859,6 +948,7 @@ class ServingEngine:
                 self._finish(req)
         self._windows_total += total_valid
         seconds = t_res - entry["t_dispatch"]
+        skipped = int(entry.get("skipped", 0))
         if sink is not None:
             sink.span(
                 "serve_chunk", seconds,
@@ -868,6 +958,10 @@ class ServingEngine:
                 chunk=entry["chunk"], lanes=self.lanes,
                 occupancy=entry["occupancy"],
                 chunk_windows=entry["w"], windows=total_valid,
+                # idle windows activity-gated away while building this
+                # chunk (docs/OBSERVABILITY.md): served with zero lane
+                # compute — the per-chunk evidence of what gating saved
+                skipped_windows=skipped,
                 queue_depth=entry["queue_depth"],
                 requests=[
                     m["request"].request_id if m else None
@@ -876,6 +970,16 @@ class ServingEngine:
                 windows_per_sec=round(total_valid / seconds, 3)
                 if seconds > 0 else None,
             )
+            # the live/offline gauge of how much compute gating saved:
+            # computed windows over all served (computed + skipped)
+            served = total_valid + skipped
+            if served:
+                sink.gauge(
+                    "serve_active_window_frac",
+                    round(total_valid / served, 6),
+                    chunk=entry["chunk"], windows=total_valid,
+                    skipped=skipped,
+                )
 
     def run(
         self,
@@ -972,6 +1076,9 @@ class ServingEngine:
             "path": req.path,
             "request_class": req.cls.name,
             "n_windows": n,
+            # idle windows consumed by activity gating (min_activity):
+            # served with zero lane compute, excluded from metric means
+            "n_windows_skipped": req.windows_skipped,
             "completed": completed,
             "error": req.error,
             "status": req.status or ("ok" if completed else None),
@@ -1002,6 +1109,7 @@ class ServingEngine:
         admit: List[float] = []
         completed = 0
         preemptions = 0
+        skipped = 0
         statuses: Dict[str, int] = {}
         for req in self._requests.values():
             all_lat.extend(req.window_latencies)
@@ -1009,6 +1117,7 @@ class ServingEngine:
                 req.window_latencies
             )
             preemptions += req.preemptions
+            skipped += req.windows_skipped
             if req.error is None and req.ended and req.inflight == 0:
                 completed += 1
             status = req.status or "live"
@@ -1028,9 +1137,22 @@ class ServingEngine:
             "quarantined_lanes": sorted(self.scheduler.quarantined),
             "preemptions": preemptions,
             "windows": self._windows_total,
+            # activity gating (docs/PERF.md): skipped = idle windows
+            # served with zero lane compute; served windows/s counts
+            # them (a gated idle stream is SERVED faster, not shorter)
+            "windows_skipped": skipped,
+            "active_window_frac": (
+                round(self._windows_total
+                      / (self._windows_total + skipped), 6)
+                if (self._windows_total + skipped) else None
+            ),
             "wall_s": round(wall, 6) if wall else None,
             "windows_per_sec": (
                 round(self._windows_total / wall, 3) if wall else None
+            ),
+            "served_windows_per_sec": (
+                round((self._windows_total + skipped) / wall, 3)
+                if wall else None
             ),
             "p50_window_ms": p50,
             "p99_window_ms": p99,

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Activity-sparse compute smoke (ISSUE 12): a seeded half-idle corpus
+# (bursty streams with near-idle tails + uniformly active streams) served
+# END TO END on CPU, dense twin vs activity-masked run —
+#
+#   - masked run skips idle windows (skipped_windows > 0) with full
+#     per-request / summary / serve_chunk-span accounting;
+#   - masking is numerically invisible: fully-active streams match the
+#     dense twin <= 1e-5, and the masked run matches a per-window
+#     reference twin (state carried across skips) <= 1e-5;
+#   - the inp_activity sidecar threads through collate_sequences /
+#     collate_megabatch;
+#   - `python -m esr_tpu.obs report --slo configs/slo.yml` exits 0 on
+#     the masked run's telemetry.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_sparse_smoke.py)
+# as a standalone gate; design + knobs: docs/PERF.md "activity-sparse
+# compute", docs/SERVING.md, docs/CONFIG.md.
+#
+# Usage: scripts/sparse_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_sparse_smoke.py -q "$@"

@@ -54,8 +54,11 @@ def get_flags():
                    help="virtual lanes = physical batch size")
     p.add_argument("--classes", type=str,
                    default="interactive:2,standard:8,bulk:16",
-                   help="request classes as name:chunk_windows[,...]; "
-                        "arrivals deal round-robin across them")
+                   help="request classes as "
+                        "name:chunk_windows[:min_activity][,...]; "
+                        "arrivals deal round-robin across them; "
+                        "min_activity in [0,1] activity-gates idle "
+                        "windows (docs/PERF.md, default 0 = dense)")
     p.add_argument("--default_class", type=str, default="standard")
     p.add_argument("--max_pending", type=int, default=64,
                    help="admission queue capacity (backpressure beyond)")
@@ -105,12 +108,17 @@ def parse_classes(spec: str):
 
     out = {}
     for part in spec.split(","):
-        name, _, w = part.strip().partition(":")
+        name, _, rest = part.strip().partition(":")
+        w, _, min_act = rest.partition(":")
         if not name or not w:
             raise ValueError(
-                f"bad --classes entry {part!r} (want name:chunk_windows)"
+                f"bad --classes entry {part!r} "
+                "(want name:chunk_windows[:min_activity])"
             )
-        out[name] = RequestClass(name, chunk_windows=int(w))
+        out[name] = RequestClass(
+            name, chunk_windows=int(w),
+            min_activity=float(min_act) if min_act else 0.0,
+        )
     return out
 
 

@@ -35,9 +35,10 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
-        "mfu_ceiling", "program_audit", "obs_live", "e2e",
-        "e2e_device_raster", "scaling", "breakdown", "infer_throughput",
-        "ckpt_overlap", "serve_loadgen", "chaos_recovery",
+        "dcn_sparse_ab", "mfu_ceiling", "program_audit", "obs_live",
+        "e2e", "e2e_device_raster", "scaling", "breakdown",
+        "infer_throughput", "ckpt_overlap", "serve_loadgen",
+        "chaos_recovery",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -193,7 +194,14 @@ def test_serve_loadgen_stage_registered_and_schema_pinned():
         "windows_per_sec", "cohort_windows_per_sec",
         "continuous_vs_cohort", "p50_window_ms", "p99_window_ms",
         "requests", "completed", "windows", "preemptions", "lanes",
-        "arrival_rate_hz", "seed",
+        "arrival_rate_hz", "seed", "idle_gate",
+    )
+    # the idle-window-gating cell (ISSUE 12): dense vs activity-gated
+    # serving on an idle-heavy corpus, served-windows/s speedup
+    assert bench.SERVE_IDLE_GATE_KEYS == (
+        "dense_windows_per_sec", "gated_windows_per_sec", "gate_speedup",
+        "windows", "windows_skipped", "active_window_frac",
+        "min_activity", "streams",
     )
 
 
@@ -239,6 +247,46 @@ def test_dcn_fwd_ab_stage_registered_and_schema_pinned():
     assert bench.stage_dcn_fwd_ab() == {
         "skipped": "cpu backend (interpreter timing is meaningless)"
     }
+
+
+def test_dcn_sparse_ab_stage_registered_schema_pinned_and_smoke_runs():
+    """The activity-sparse DCN series (ISSUE 12): dense-vs-predicated
+    timings at seeded sparsity levels 0/50/90% plus per-corpus activity
+    histograms. The stage runs in smoke — on CPU the timings are
+    recorded as skipped (interpreter timing is meaningless) but the
+    PARITY verdict and the sparsity histograms are real, so the
+    activity-distribution series starts accumulating in BENCH_*.json
+    from this PR, before the first on-chip capture."""
+
+    class _Ctx:
+        smoke = True
+
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "dcn_sparse_ab"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_dcn_sparse_ab
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.DCN_SPARSE_AB_KEYS == (
+        "levels", "dense_ms", "predicated_ms", "speedup", "parity_ok",
+        "timing", "hist_bins", "hist_synthetic", "hist_esim",
+        "hist_synthetic_windows", "hist_esim_windows", "activity_tile",
+        "seed",
+    )
+    rec = bench.stage_dcn_sparse_ab(_Ctx())
+    assert tuple(rec.keys()) == bench.DCN_SPARSE_AB_KEYS
+    assert rec["levels"] == [0.0, 0.5, 0.9]
+    # predication must be numerically invisible even in CPU smoke
+    assert rec["parity_ok"] is True
+    assert rec["timing"].startswith("skipped")  # CPU: no fake timings
+    assert rec["dense_ms"] == [None, None, None]
+    # the synthetic histogram is always real (host-side rasterization):
+    # ten bins, at least one window counted, idle-heavy corpus puts mass
+    # in the low-activity bins
+    assert len(rec["hist_bins"]) == 11
+    assert rec["hist_synthetic_windows"] > 0
+    assert sum(rec["hist_synthetic"]) == rec["hist_synthetic_windows"]
+    assert sum(rec["hist_synthetic"][:3]) > 0  # bursty tails counted
 
 
 def test_mfu_ceiling_stage_registered_schema_pinned_and_runs_offline():

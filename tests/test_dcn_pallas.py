@@ -493,3 +493,207 @@ def test_fused_backward_through_train_scan():
 
     assert results["pallas"][0] == pytest.approx(results["jnp"][0], rel=1e-5)
     assert results["pallas"][1] == pytest.approx(results["jnp"][1], rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Activity-sparse block predication (ISSUE 12): masking must be numerically
+# INVISIBLE — judged by the same dcn_parity_ok/dcn_fwd_parity_ok ladders
+# that gate the dense kernels — and the tile_mask=None path must stay the
+# byte-identical dense program.
+# ---------------------------------------------------------------------------
+
+
+def _half_idle_inputs(b=4, h=4, w=6, cin=16, cout=16, dg=2, seed=0):
+    """A batch where images 1 and 3 carry ZERO events (all-zero input) —
+    the idle-window shape the activity plane predicates away."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((b, h, w, cin)).astype(np.float32)
+    x[1] = 0.0
+    x[3] = 0.0
+    offsets = jnp.asarray(
+        rng.standard_normal((b, h, w, dg, 9, 2)) * 2.0, jnp.float32
+    )
+    mask = jax.nn.sigmoid(
+        jnp.asarray(rng.standard_normal((b, h, w, dg, 9)), jnp.float32)
+    )
+    weight = jnp.asarray(
+        rng.standard_normal((3, 3, cin, cout)) * 0.1, jnp.float32
+    )
+    bias = jnp.asarray(rng.standard_normal(cout), jnp.float32)
+    return jnp.asarray(x), offsets, mask, weight, bias
+
+
+def test_image_activity_mask_derivation():
+    from esr_tpu.ops.dcn_pallas import dcn_image_activity
+
+    x, *_ = _half_idle_inputs()
+    np.testing.assert_array_equal(
+        np.asarray(dcn_image_activity(x)), [1.0, 0.0, 1.0, 0.0]
+    )
+
+
+def test_predicated_train_kernel_parity_via_gate_ladder():
+    """Predication on a truthful mask passes the SAME scale-normalized
+    parity criterion as the dense train-direction kernel — forward AND
+    all four cotangents (the backward stays dense by design)."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    x, off, mask, wt, _ = _half_idle_inputs()
+    tm = DP.dcn_image_activity(x)
+    errs = DP.dcn_parity_errors(x, off, mask, wt, interpret=True,
+                                tile_mask=tm)
+    assert DP.dcn_parity_ok(errs, tol=1e-3), errs
+
+
+def test_predicated_fwd_kernel_parity_via_gate_ladder():
+    from esr_tpu.ops import dcn_pallas as DP
+
+    x, off, mask, wt, _ = _half_idle_inputs(seed=1)
+    tm = DP.dcn_image_activity(x)
+    errs = DP.dcn_fwd_parity_errors(x, off, mask, wt, interpret=True,
+                                    tile_mask=tm)
+    assert DP.dcn_fwd_parity_ok(errs, tol=1e-3), errs
+
+
+def test_predicated_output_bitwise_equals_dense_and_zero_fills():
+    """On a truthful mask the predicated program is BITWISE the dense one
+    (skipped tiles were zero anyway), for both kernels, with bias riding
+    on top of the zero-filled accumulator exactly as on the dense path;
+    a per-tile [B, n_tiles] mask grid takes the same path."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    x, off, mask, wt, bias = _half_idle_inputs(seed=2)
+    tm = DP.dcn_image_activity(x)
+    for op in (DP.deform_conv2d_pallas, DP.deform_conv2d_pallas_fwd):
+        dense = op(x, off, mask, wt, bias, interpret=True)
+        pred = op(x, off, mask, wt, bias, interpret=True, tile_mask=tm)
+        np.testing.assert_array_equal(np.asarray(pred), np.asarray(dense))
+    # idle images produce exactly bias (zero accumulator + bias)
+    pred = DP.deform_conv2d_pallas(
+        x, off, mask, wt, bias, interpret=True, tile_mask=tm
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pred)[1],
+        np.broadcast_to(np.asarray(bias), np.asarray(pred)[1].shape),
+    )
+    # explicit per-tile grid: same result through _dcn_kernel_masked
+    n_tiles = DP._tiling(x.shape[1] * x.shape[2],
+                         x.shape[1] * x.shape[2])[3]
+    grid = jnp.tile(tm[:, None], (1, n_tiles))
+    pred2 = DP.deform_conv2d_pallas(
+        x, off, mask, wt, bias, interpret=True, tile_mask=grid
+    )
+    np.testing.assert_array_equal(np.asarray(pred2), np.asarray(pred))
+
+
+def test_predicated_backward_matches_dense_backward():
+    """Gradients through the predicated forward equal the dense op's
+    (the VJP delegates to the SAME dense fused backward; the tile_mask
+    cotangent is identically zero)."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    x, off, mask, wt, _ = _half_idle_inputs(seed=3)
+    tm = DP.dcn_image_activity(x)
+
+    def loss(fn):
+        return lambda *a: (fn(*a) ** 2).sum()
+
+    g_dense = jax.grad(
+        loss(lambda *a: DP.deform_conv2d_pallas(*a, interpret=True)),
+        argnums=(0, 1, 2, 3),
+    )(x, off, mask, wt)
+    g_pred = jax.grad(
+        loss(lambda *a: DP.deform_conv2d_pallas(
+            *a, interpret=True, tile_mask=tm)),
+        argnums=(0, 1, 2, 3),
+    )(x, off, mask, wt)
+    for a, b in zip(g_pred, g_dense):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_auto_sparse_dispatch_derives_mask_and_stays_exact():
+    """deform_conv2d_auto(sparse=True): forced-pallas dispatch derives
+    the per-image mask at trace time and matches the dense jnp reference;
+    a caller activity annotation combines CONSERVATIVELY (it can veto
+    skipping but never cause it), and the jnp path ignores sparse."""
+    x, off, mask, wt, bias = _half_idle_inputs(seed=4)
+    ref = deform_conv2d(x, off, mask, wt, bias)
+    for activity in (None, jnp.array([1.0, 1.0, 0.0, 0.0])):
+        out = deform_conv2d_auto(
+            x, off, mask, wt, bias, impl="pallas", direction="fwd",
+            sparse=True, activity=activity,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
+        )
+    # wrong-but-conservative annotation: activity=1 on a zero image only
+    # disables its skip — still exact
+    out = deform_conv2d_auto(
+        x, off, mask, wt, bias, impl="pallas", direction="train",
+        sparse=True, activity=jnp.ones((4,), jnp.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
+    )
+    # on CPU 'auto' resolves jnp and sparse must be a clean no-op
+    out_jnp = deform_conv2d_auto(
+        x, off, mask, wt, bias, impl="jnp", sparse=True
+    )
+    np.testing.assert_array_equal(np.asarray(out_jnp), np.asarray(ref))
+
+
+def test_tile_mask_grid_validation():
+    from esr_tpu.ops.dcn_pallas import _tile_mask_grid
+
+    grid = _tile_mask_grid(jnp.array([1.0, 0.0]), 2, 3)
+    np.testing.assert_array_equal(
+        np.asarray(grid), [[1, 1, 1], [0, 0, 0]]
+    )
+    with pytest.raises(ValueError, match="tile_mask shape"):
+        _tile_mask_grid(jnp.ones((3, 2)), 2, 3)
+
+
+def test_model_dcn_sparse_knob_is_numerically_invisible():
+    """DeepRecurrNet(dcn_sparse=True) + a window activity annotation
+    produce bit-identical outputs to the dense model on CPU (jnp
+    dispatch ignores sparse; the knob only engages behind the Mosaic
+    gates on TPU)."""
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    kwargs = dict(inch=2, basech=2, num_frame=3)
+    dense = DeepRecurrNet(**kwargs)
+    sparse = DeepRecurrNet(dcn_sparse=True, **kwargs)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(size=(2, 3, 16, 16, 2)), jnp.float32)
+    states = dense.init_states(2, 16, 16)
+    params = dense.init(jax.random.PRNGKey(0), x, states)
+    out_d, st_d = dense.apply(params, x, states)
+    out_s, st_s = sparse.apply(
+        params, x, states, activity=jnp.array([1.0, 0.0])
+    )
+    np.testing.assert_array_equal(np.asarray(out_s), np.asarray(out_d))
+    for a, b in zip(jax.tree.leaves(st_s), jax.tree.leaves(st_d)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_nan_image_counts_as_active_and_stays_dense():
+    """A NaN-poisoned image must NOT be classified idle (max(|x|) > 0 is
+    False for a NaN max): predication would replace its correctly-NaN
+    dense output with clean zeros — silent divergence masking. NaN
+    images flow through the dense path and surface loudly."""
+    from esr_tpu.ops import dcn_pallas as DP
+
+    x, off, mask, wt, _ = _half_idle_inputs(seed=5)
+    x = np.array(x)
+    x[1, 0, 0, 0] = np.nan  # zero image 1 gains one NaN pixel
+    xj = jnp.asarray(x)
+    np.testing.assert_array_equal(
+        np.asarray(DP.dcn_image_activity(xj)), [1.0, 1.0, 1.0, 0.0]
+    )
+    out = DP.deform_conv2d_pallas_fwd(
+        xj, off, mask, wt, interpret=True,
+        tile_mask=DP.dcn_image_activity(xj),
+    )
+    assert np.isnan(np.asarray(out)[1]).any()  # the NaN surfaced
+    # image 3 (genuinely zero) is still predicated away
+    np.testing.assert_array_equal(np.asarray(out)[3], 0.0)

@@ -228,6 +228,76 @@ def test_batched_cnt2event():
     assert np.array(valid).sum(1).tolist() == expect.tolist()
 
 
+def test_activity_sidecar_np_jnp_bit_identical():
+    # The activity-mask plane's twin contract (ISSUE 12): the numpy
+    # encoder's per-tile activity sidecar and the jitted jnp twin agree
+    # BIT-FOR-BIT on seeded streams (counts are small integers in f32, so
+    # both reductions are exact).
+    from esr_tpu.data import np_encodings as NE
+
+    for seed, (h, w), tile in ((0, (32, 48), 8), (1, (13, 17), 4),
+                               (2, (8, 8), 8)):
+        xs, ys, ts, ps, _ = _rand_events(400, h, w, seed=seed)
+        cnt_np, act_np = NE.events_to_channels_activity_np(
+            xs, ys, ps, (h, w), tile=tile
+        )
+        cnt_j, act_j = E.events_to_channels_activity(
+            jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w), tile=tile
+        )
+        assert act_np.shape == (-(-h // tile), -(-w // tile))
+        np.testing.assert_array_equal(np.array(cnt_j), cnt_np)
+        np.testing.assert_array_equal(np.array(act_j), act_np)
+        # the sidecar is a pure reduction of the counts it rides with
+        np.testing.assert_array_equal(
+            act_np, NE.tile_activity_np(cnt_np, tile)
+        )
+
+
+def test_activity_sidecar_all_empty_and_single_hot_pixel():
+    from esr_tpu.data import np_encodings as NE
+
+    h, w, tile = 16, 24, 8
+    empty = np.zeros((0,), np.float32)
+    cnt_np, act_np = NE.events_to_channels_activity_np(
+        empty, empty, empty, (h, w), tile=tile
+    )
+    cnt_j, act_j = E.events_to_channels_activity(
+        jnp.array(empty), jnp.array(empty), jnp.array(empty), (h, w),
+        tile=tile,
+    )
+    np.testing.assert_array_equal(np.array(act_j), act_np)
+    assert act_np.sum() == 0.0
+    assert NE.activity_fraction_np(act_np) == 0.0
+    assert float(E.activity_fraction(act_j)) == 0.0
+
+    # one hot pixel: exactly ONE active tile, and it is the right one
+    xs = np.array([11.0], np.float32)
+    ys = np.array([9.0], np.float32)
+    ps = np.array([1.0], np.float32)
+    _, act_np = NE.events_to_channels_activity_np(xs, ys, ps, (h, w), tile=tile)
+    _, act_j = E.events_to_channels_activity(
+        jnp.array(xs), jnp.array(ys), jnp.array(ps), (h, w), tile=tile
+    )
+    np.testing.assert_array_equal(np.array(act_j), act_np)
+    assert (act_np > 0).sum() == 1 and act_np[1, 1] == 1.0
+    assert NE.activity_fraction_np(act_np) == 1.0 / 6.0
+
+
+def test_tile_activity_ragged_edges_count_once():
+    # H/W not multiples of tile: edge tiles cover the remainder, zero
+    # padding contributes nothing, and total mass is conserved.
+    from esr_tpu.data import np_encodings as NE
+
+    rng = np.random.default_rng(3)
+    cnt = rng.integers(0, 3, (10, 13, 2)).astype(np.float32)
+    act = NE.tile_activity_np(cnt, tile=4)
+    assert act.shape == (3, 4)
+    assert act.sum() == cnt.sum()
+    np.testing.assert_array_equal(
+        np.array(E.tile_activity(jnp.array(cnt), tile=4)), act
+    )
+
+
 def test_scaled_coords():
     # LR coords on an HR grid: the SR input transform (h5dataset.py:520-537).
     xs = jnp.array([0.0, 1.0, 2.0, 3.0])

@@ -144,6 +144,47 @@ def test_exact_multiple_length_frees_lane_without_idle_chunk(recordings):
     assert all(c["windows"]["valid"].sum() > 0 for c in chunks)
 
 
+def test_lane_packer_activity_mask_folds_padding(recordings):
+    """ISSUE 12 satellite: the per-window ``activity`` sidecar carries the
+    active-tile fraction for every REAL window and exactly 0.0 for
+    zero-padded slots — ragged tails and idle lanes ride the same gating
+    as genuinely idle windows — and an exact-multiple recording's full
+    chunks are fully active (no phantom padding row)."""
+    from esr_tpu.data.loader import window_activity
+
+    chunks = list(
+        LanePackedChunks(recordings, DATASET_CFG, lanes=2, chunk_windows=2)
+    )
+    saw_padding = False
+    for c in chunks:
+        act = c["activity"]
+        valid = c["windows"]["valid"]
+        assert act.shape == valid.shape
+        # padding-validity folded in: masked slot => activity 0.0
+        np.testing.assert_array_equal(act[valid == 0.0], 0.0)
+        saw_padding = saw_padding or bool((valid == 0.0).any())
+        # real windows: the sidecar equals the shared host statistic of
+        # the packed input (synthetic streams are active, so > 0)
+        for t, lane in zip(*np.nonzero(valid)):
+            expect = window_activity(
+                c["windows"]["inp_scaled"][t, lane], tile=8
+            )
+            assert act[t, lane] == expect > 0.0
+    assert saw_padding  # the unequal-length corpus exercised ragged tails
+
+    # exact-multiple tail: the full final chunk of recording 0 is fully
+    # active AND the lane frees without an all-padding (all-zero-activity)
+    # idle chunk (the one-window-lookahead contract, activity view)
+    n0 = _window_counts(recordings[:1])[0]
+    exact = list(
+        LanePackedChunks(
+            recordings[:2], DATASET_CFG, lanes=1, chunk_windows=n0
+        )
+    )
+    assert (exact[0]["activity"] > 0.0).all()
+    assert all((c["activity"] > 0.0).any() for c in exact)
+
+
 def _assert_result_parity(seq, eng, rtol=1e-5):
     """Engine result == sequential-harness result, schema and values.
 

@@ -386,12 +386,12 @@ def test_zero_window_stream_finishes_with_terminal_event(
     real_cls = server_mod.RecordingStream
 
     class _Stub(real_cls):
-        def __init__(self, path, config):
+        def __init__(self, path, config, **kwargs):
             if path.endswith("empty.marker"):
-                super().__init__(recordings[0], config)
+                super().__init__(recordings[0], config, **kwargs)
                 self._it = iter(())
             else:
-                super().__init__(path, config)
+                super().__init__(path, config, **kwargs)
 
     monkeypatch.setattr(server_mod, "RecordingStream", _Stub)
 
@@ -674,3 +674,172 @@ def test_shed_submit_emits_classified_terminal_event(
     assert len(shed) == 1
     assert shed[0]["error_kind"] == "backpressure"
     assert shed[0]["completed"] is False
+
+
+# ---------------------------------------------------------------------------
+# activity-gated idle windows (ISSUE 12, docs/PERF.md "activity-sparse
+# compute"): RequestClass.min_activity skips idle windows at chunk-build
+# time — zero lane compute, state untouched, full accounting.
+
+
+TIME_CFG = {
+    "scale": 2,
+    "ori_scale": "down8",
+    "time_bins": 1,
+    "mode": "time",
+    "window": 0.08,
+    "sliding_window": 0.04,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def idle_heavy_recordings(tmp_path_factory):
+    """Half-idle corpus: bursty streams (active head, near-idle tail
+    under time-mode windowing) alternating with uniformly active ones."""
+    tmp = tmp_path_factory.mktemp("idle_heavy")
+    paths = []
+    for i, bf in enumerate([0.35, 1.0, 0.35, 1.0]):
+        p = str(tmp / f"rec{i}.h5")
+        write_synthetic_h5(
+            p, (64, 64), base_events=900, num_frames=6, seed=10 + i,
+            burst_frac=bf,
+        )
+        paths.append(p)
+    return paths
+
+
+def test_request_class_min_activity_validation():
+    assert RequestClass("a").min_activity == 0.0
+    assert RequestClass("a", min_activity=0.3).min_activity == 0.3
+    with pytest.raises(ValueError, match="min_activity"):
+        RequestClass("a", min_activity=1.5)
+    with pytest.raises(ValueError, match="min_activity"):
+        RequestClass("a", min_activity=-0.1)
+
+
+def test_recording_stream_yields_activity_sidecar(idle_heavy_recordings):
+    from esr_tpu.data.loader import window_activity
+    from esr_tpu.serving.server import RecordingStream
+
+    rs = RecordingStream(
+        idle_heavy_recordings[0], TIME_CFG, activity_tile=4
+    )
+    wins = list(rs)
+    assert len(wins) > 0
+    for win in wins:
+        assert len(win) == 4
+        assert 0.0 <= win[3] <= 1.0
+        # the sidecar IS the shared host statistic of the packed input
+        assert win[3] == window_activity(win[0], tile=4)
+    # a bursty stream is active up front and near-idle behind
+    assert wins[0][3] > 0.3 and min(w[3] for w in wins) < 0.3
+
+
+def test_gated_run_skips_idle_windows_with_full_accounting(
+    idle_heavy_recordings, model_and_params, tmp_path
+):
+    """A min_activity class serves the idle-heavy corpus with
+    skipped_windows > 0; per-request, summary, and serve_chunk-span skip
+    accounting all agree; the computed-window total matches the dense
+    run's active-window subset; and every request still completes."""
+    import json
+
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+
+    model, params = model_and_params
+    classes = {
+        "gated": RequestClass("gated", chunk_windows=2, min_activity=0.3)
+    }
+    tel = str(tmp_path / "tel.jsonl")
+    sink = TelemetrySink(tel)
+    prev = set_active_sink(sink)
+    try:
+        srv = ServingEngine(
+            model, params, TIME_CFG, lanes=2, classes=classes,
+            default_class="gated", preempt_quantum=0, activity_tile=4,
+        )
+        rids = [srv.submit(p) for p in idle_heavy_recordings]
+        summary = srv.run()
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+    assert summary["completed"] == len(idle_heavy_recordings)
+    assert summary["windows_skipped"] > 0
+    assert summary["windows"] > 0
+    total = summary["windows"] + summary["windows_skipped"]
+    assert summary["active_window_frac"] == pytest.approx(
+        summary["windows"] / total, abs=1e-6
+    )
+    assert summary["served_windows_per_sec"] >= summary["windows_per_sec"]
+
+    # per-request accounting: computed + skipped = the stream's windows
+    per_req_skipped = 0
+    for rid, path in zip(rids, idle_heavy_recordings):
+        rep = srv.report(rid)
+        assert rep["completed"] and rep["status"] == "ok"
+        n_stream = len(InferenceSequenceLoader(path, TIME_CFG))
+        assert rep["n_windows"] + rep["n_windows_skipped"] == n_stream
+        per_req_skipped += rep["n_windows_skipped"]
+    assert per_req_skipped == summary["windows_skipped"]
+
+    # telemetry-level evidence: serve_chunk skipped_windows (+ any
+    # trailing serve_gating_flush residue) sums to the same total, and
+    # the serve_active_window_frac gauge rode along
+    records = [json.loads(line) for line in open(tel)][1:]
+    chunk_spans = [
+        r for r in records
+        if r.get("type") == "span" and r.get("name") == "serve_chunk"
+    ]
+    flushed = sum(
+        r.get("skipped", 0) for r in records
+        if r.get("type") == "event"
+        and r.get("name") == "serve_gating_flush"
+    )
+    assert (sum(r["skipped_windows"] for r in chunk_spans) + flushed
+            == per_req_skipped)
+    assert sum(r["windows"] for r in chunk_spans) == summary["windows"]
+    gauges = [
+        r for r in records
+        if r.get("type") == "gauge"
+        and r.get("name") == "serve_active_window_frac"
+    ]
+    assert gauges and all(0.0 <= g["value"] <= 1.0 for g in gauges)
+
+
+def test_gated_vs_dense_same_results_on_fully_active_corpus(
+    recordings, model_and_params
+):
+    """On a corpus with NO sub-threshold windows, a gated class must be
+    indistinguishable from dense serving: zero skips, identical
+    per-request metric means (gating only ever removes idle windows)."""
+    model, params = model_and_params
+
+    def run(min_act):
+        classes = {
+            "c": RequestClass("c", chunk_windows=2, min_activity=min_act)
+        }
+        srv = ServingEngine(
+            model, params, DATASET_CFG, lanes=2, classes=classes,
+            default_class="c", preempt_quantum=0,
+        )
+        rids = [srv.submit(p) for p in recordings[:2]]
+        srv.run()
+        return {rid: srv.report(rid) for rid in rids}
+
+    dense = run(0.0)
+    gated = run(1e-6)  # below any real window's activity
+    for (rd, gd) in zip(dense.values(), gated.values()):
+        assert gd["n_windows_skipped"] == 0
+        assert gd["n_windows"] == rd["n_windows"]
+        for k in METRIC_KEYS:
+            assert gd[k] == rd[k], k

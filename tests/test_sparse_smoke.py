@@ -1,0 +1,339 @@
+"""Activity-sparse compute smoke (tier-1, CPU; also driven standalone by
+``scripts/sparse_smoke.sh``) — ISSUE 12's end-to-end gate.
+
+A seeded half-idle corpus (bursty streams: active head, near-idle tail
+under time-mode windowing, alternating with uniformly active streams) is
+served twice through the continuous-batching tier:
+
+- **dense twin**: ``min_activity = 0`` — every window is dense compute
+  (the pre-ISSUE-12 behavior);
+- **masked run**: ``min_activity = 0.3`` — idle windows are gated at
+  chunk-build time (consumed with zero lane compute, recurrent state
+  carried forward untouched).
+
+The acceptance contract (docs/PERF.md "activity-sparse compute"):
+
+- the masked run SKIPS windows (``skipped_windows > 0``) and every
+  request still completes with full accounting (computed + skipped =
+  the stream's window count);
+- masking is numerically invisible where the dense path is exercised:
+  fully-active streams report metrics matching the dense twin ≤ 1e-5
+  (their window sets are identical — gating removed nothing);
+- the masked run matches an independent per-window REFERENCE twin (the
+  engine's own chunk program driven one window at a time at lanes=1,
+  skipping exactly the sub-threshold windows with state untouched)
+  ≤ 1e-5 on metric means and EXACTLY on skipped counts — the engine's
+  gating semantics equal "the idle window was never there";
+- the data plane's activity sidecar threads through collate:
+  ``inp_activity`` rides ``collate_sequences``/``collate_megabatch``
+  with the documented shapes;
+- ``python -m esr_tpu.obs report --slo configs/slo.yml`` exits 0 on the
+  masked run's telemetry (gating breaks no trace-completeness or
+  serving-health invariant).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from esr_tpu.data.synthetic import write_synthetic_h5
+from esr_tpu.inference.engine import METRIC_KEYS, make_chunk_fn
+from esr_tpu.models.esr import DeepRecurrNet
+from esr_tpu.obs import TelemetrySink, set_active_sink
+from esr_tpu.serving import RequestClass, ServingEngine
+from esr_tpu.serving.server import RecordingStream
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLO_PATH = os.path.join(REPO_ROOT, "configs", "slo.yml")
+
+MIN_ACTIVITY = 0.3
+ACTIVITY_TILE = 4
+LANES = 2
+CHUNK_WINDOWS = 2
+
+# bursty (0.35) and uniform (1.0) streams — the half-idle corpus
+BURST_FRACS = [0.35, 1.0, 0.35, 1.0]
+
+DATASET_CFG = {
+    "scale": 2,
+    "ori_scale": "down8",
+    "time_bins": 1,
+    "mode": "time",
+    "window": 0.08,
+    "sliding_window": 0.04,
+    "need_gt_events": True,
+    "need_gt_frame": False,
+    "data_augment": {"enabled": False, "augment": [], "augment_prob": []},
+    "sequence": {
+        "sequence_length": 4,
+        "seqn": 3,
+        "step_size": None,
+        "pause": {"enabled": False},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sparse_smoke")
+    paths = []
+    for i, bf in enumerate(BURST_FRACS):
+        p = str(tmp / f"rec{i}.h5")
+        write_synthetic_h5(
+            p, (64, 64), base_events=900, num_frames=6, seed=20 + i,
+            burst_frac=bf,
+        )
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    x = np.zeros((1, 3, 16, 16, 2), np.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), x, model.init_states(1, 16, 16)
+    )
+    return model, params
+
+
+def _serve(model, params, corpus, min_activity, tel_path=None):
+    classes = {
+        "c": RequestClass(
+            "c", chunk_windows=CHUNK_WINDOWS, min_activity=min_activity
+        )
+    }
+    sink = TelemetrySink(tel_path) if tel_path else None
+    prev = set_active_sink(sink) if sink else None
+    try:
+        srv = ServingEngine(
+            model, params, DATASET_CFG, lanes=LANES, classes=classes,
+            default_class="c", preempt_quantum=0,
+            activity_tile=ACTIVITY_TILE,
+        )
+        rids = [srv.submit(p) for p in corpus]
+        summary = srv.run()
+    finally:
+        if sink:
+            set_active_sink(prev)
+            sink.close()
+    return {rid: srv.report(rid) for rid in rids}, summary
+
+
+@pytest.fixture(scope="module")
+def smoke_runs(corpus, model_and_params, tmp_path_factory):
+    model, params = model_and_params
+    tel = str(tmp_path_factory.mktemp("tel") / "telemetry.jsonl")
+    dense, dense_summary = _serve(model, params, corpus, 0.0)
+    masked, masked_summary = _serve(
+        model, params, corpus, MIN_ACTIVITY, tel_path=tel
+    )
+    return dense, dense_summary, masked, masked_summary, tel
+
+
+def _reference_masked(model, params, path):
+    """The per-window twin: the engine's OWN chunk program at lanes=1,
+    chunk_windows=1, one dispatch per computed window, skipping exactly
+    the sub-threshold windows with the recurrent state untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    stream = RecordingStream(path, DATASET_CFG, activity_tile=ACTIVITY_TILE)
+    kh, kw = stream.gt_resolution
+    run1 = jax.jit(make_chunk_fn(model, 1, 1, kh, kw))
+    states = jax.tree.map(jnp.array, model.init_states(1, kh, kw))
+    sums = {k: 0.0 for k in METRIC_KEYS}
+    n = 0
+    skipped = 0
+    reset_keep = jnp.zeros((1,), jnp.float32)  # fresh stream: reset once
+    for win in stream:
+        if win[3] < MIN_ACTIVITY:
+            skipped += 1  # the state is NOT touched for a gated window
+            continue
+        windows = {
+            "inp_scaled": jnp.asarray(win[0][None, None]),
+            "gt": jnp.asarray(win[1][None, None]),
+            "inp_mid": jnp.asarray(win[2][None, None]),
+            "valid": jnp.ones((1, 1), jnp.float32),
+        }
+        states, s, _ = run1(params, states, reset_keep, windows)
+        reset_keep = jnp.ones((1,), jnp.float32)
+        for k in METRIC_KEYS:
+            sums[k] += float(s[k][0])
+        n += 1
+    return (
+        {k: (sums[k] / n if n else 0.0) for k in METRIC_KEYS}, n, skipped
+    )
+
+
+def test_masked_run_skips_and_completes(smoke_runs, corpus):
+    dense, dense_summary, masked, masked_summary, _ = smoke_runs
+    assert dense_summary["windows_skipped"] == 0
+    assert masked_summary["windows_skipped"] > 0
+    assert masked_summary["completed"] == len(corpus)
+    # full accounting: served windows identical across the two runs
+    assert (masked_summary["windows"] + masked_summary["windows_skipped"]
+            == dense_summary["windows"])
+    assert masked_summary["active_window_frac"] < 1.0
+
+
+def test_dense_path_parity_where_exercised(smoke_runs):
+    """Fully-active streams (no window gated) must report metrics
+    matching the dense twin ≤ 1e-5 — gating touched nothing they ran."""
+    dense, _, masked, _, _ = smoke_runs
+    checked = 0
+    for (rid_d, rep_d), (rid_m, rep_m) in zip(
+        sorted(dense.items()), sorted(masked.items())
+    ):
+        assert rep_d["path"] == rep_m["path"]
+        if rep_m["n_windows_skipped"] == 0:
+            checked += 1
+            assert rep_m["n_windows"] == rep_d["n_windows"]
+            for k in METRIC_KEYS:
+                np.testing.assert_allclose(
+                    rep_m[k], rep_d[k], rtol=1e-5, atol=1e-7, err_msg=k
+                )
+    assert checked >= 1  # the corpus has fully-active streams
+
+
+def test_masked_run_matches_per_window_reference_twin(
+    smoke_runs, corpus, model_and_params
+):
+    """Engine gating == 'the idle window was never there': per-request
+    metric means match the one-window-at-a-time reference twin ≤ 1e-5
+    and the skipped counts match exactly (state warmth included — the
+    twin carries its recurrent state across skips by construction)."""
+    model, params = model_and_params
+    _, _, masked, _, _ = smoke_runs
+    by_path = {rep["path"]: rep for rep in masked.values()}
+    saw_skips = 0
+    for path in corpus:
+        means, n, skipped = _reference_masked(model, params, path)
+        rep = by_path[path]
+        assert rep["n_windows"] == n
+        assert rep["n_windows_skipped"] == skipped
+        saw_skips += skipped
+        for k in METRIC_KEYS:
+            np.testing.assert_allclose(
+                rep[k], means[k], rtol=1e-5, atol=1e-7, err_msg=k
+            )
+    assert saw_skips > 0
+
+
+def test_activity_sidecar_threads_through_collate(corpus):
+    """The data plane's threading contract: ``inp_activity`` (per-tile
+    map at ``activity.tile`` granularity) rides the generic collate path
+    into ``(B, L, Ht, Wt)`` batches and ``(k, B, L, Ht, Wt)``
+    megabatches, zero where the window is empty."""
+    from esr_tpu.data.dataset import SequenceDataset
+    from esr_tpu.data.loader import collate_megabatch, collate_sequences
+    from esr_tpu.data.np_encodings import tile_activity_np
+
+    cfg = dict(DATASET_CFG)
+    cfg["item_keys"] = ["inp_scaled_cnt", "inp_activity"]
+    cfg["activity"] = {"tile": ACTIVITY_TILE}
+    ds = SequenceDataset(corpus[0], cfg)
+    seqs = [ds.get_item(0, seed=1), ds.get_item(0, seed=2)]
+    batch = collate_sequences(seqs)
+    L = cfg["sequence"]["sequence_length"]
+    kh, kw = 16, 16
+    t = ACTIVITY_TILE
+    assert batch["inp_activity"].shape == (2, L, kh // t, kw // t)
+    # the sidecar is exactly the tile reduction of the counts it rides
+    np.testing.assert_array_equal(
+        batch["inp_activity"][0, 0],
+        tile_activity_np(batch["inp_scaled_cnt"][0, 0], t),
+    )
+    mega = collate_megabatch([batch, batch])
+    assert mega["inp_activity"].shape == (2, 2, L, kh // t, kw // t)
+
+
+def test_obs_report_slo_gate_passes_on_masked_run(smoke_runs, tmp_path):
+    """The masked run's telemetry passes the shipped SLO gate: traces
+    complete, no failed requests, goodput derivable — gating broke no
+    serving-health invariant (exit 0 from the CLI subprocess)."""
+    *_, tel = smoke_runs
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "esr_tpu.obs", "report", tel,
+         "--slo", SLO_PATH],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["slo"]["ok"] is True
+    # the offline reporter exposes what gating saved (satellite 4):
+    # skipped windows rebuilt from the serve_chunk spans alone
+    serving = doc["report"]["serving"]
+    assert serving["windows_skipped"] > 0
+    assert 0.0 < serving["active_window_frac"] < 1.0
+
+
+def test_report_skip_rollup_ignores_infer_chunks_and_folds_flush():
+    """The offline reporter's serving skip rollup counts serve_chunk
+    spans + serve_gating_flush events ONLY: infer_chunk windows are not
+    serving compute (an inference-only file must report no gating
+    figures), and trailing gated windows flushed at drain still sum."""
+    from esr_tpu.obs.report import build_report
+
+    records = [
+        {"type": "span", "name": "serve_chunk", "seconds": 0.1, "t": 1.0,
+         "begin": 0.9, "end": 1.0, "windows": 6, "skipped_windows": 2},
+        {"type": "span", "name": "infer_chunk", "seconds": 0.1, "t": 2.0,
+         "begin": 1.9, "end": 2.0, "windows": 50},
+        {"type": "event", "name": "serve_gating_flush", "t": 3.0,
+         "skipped": 3},
+    ]
+    rep = build_report(records)
+    assert rep["serving"]["windows_skipped"] == 5
+    assert rep["serving"]["active_window_frac"] == pytest.approx(
+        6 / 11, abs=1e-6
+    )
+    # inference-only: no serving gating figures fabricated
+    rep2 = build_report([records[1]])
+    assert rep2["serving"]["windows_skipped"] == 0
+    assert rep2["serving"]["active_window_frac"] is None
+
+
+def test_trailing_gated_windows_flush_at_drain(
+    model_and_params, tmp_path_factory
+):
+    """A stream whose FINAL windows are all gated (nothing dispatches
+    after them) must still land its skips in telemetry: the drain path
+    emits a serve_gating_flush event and spans+flush == request totals,
+    live == offline."""
+    from esr_tpu.obs.export import read_telemetry
+    from esr_tpu.obs.report import build_report
+
+    model, params = model_and_params
+    tmp = tmp_path_factory.mktemp("flush")
+    # one bursty stream: active head, gated tail — the tail windows are
+    # consumed AFTER its last dispatched chunk
+    path = str(tmp / "rec.h5")
+    write_synthetic_h5(
+        path, (64, 64), base_events=900, num_frames=6, seed=40,
+        burst_frac=0.35,
+    )
+    tel = str(tmp / "tel.jsonl")
+    masked, summary = _serve(model, params, [path], MIN_ACTIVITY, tel)
+    assert summary["windows_skipped"] > 0
+    manifest, records, _ = read_telemetry(tel)
+    spans = sum(
+        r.get("skipped_windows", 0) for r in records
+        if r.get("type") == "span" and r.get("name") == "serve_chunk"
+    )
+    flush = sum(
+        r.get("skipped", 0) for r in records
+        if r.get("type") == "event"
+        and r.get("name") == "serve_gating_flush"
+    )
+    assert spans + flush == summary["windows_skipped"]
+    rep = build_report(records, manifest)
+    assert rep["serving"]["windows_skipped"] == summary["windows_skipped"]
