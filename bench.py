@@ -1193,9 +1193,13 @@ def stage_mfu_ceiling():
 PROGRAM_AUDIT_KEYS = (
     "programs", "clean", "total_findings", "rules_version",
 )
-# per-program sub-record: static contracts + growth trackers
+# per-program sub-record: static contracts + growth trackers.
+# flops_by_dtype (ISSUE 13): executed contraction FLOPs keyed
+# "input->accumulator" dtype — bf16 adoption per program is a tracked
+# bench series (a real ladder rung moves flops out of float32->float32),
+# not a claim.
 PROGRAM_AUDIT_PROGRAM_KEYS = (
-    "flops", "peak_bytes", "cast_count", "findings",
+    "flops", "flops_by_dtype", "peak_bytes", "cast_count", "findings",
 )
 
 
@@ -1214,6 +1218,7 @@ def stage_program_audit():
     programs = {
         a.name: dict(zip(PROGRAM_AUDIT_PROGRAM_KEYS, (
             a.profile.get("flops", 0.0),
+            a.profile.get("flops_by_dtype", {}),
             a.profile.get("peak_bytes", 0),
             a.profile.get("cast_count", 0),
             len(a.findings),
@@ -2137,6 +2142,133 @@ def stage_obs_live(ctx):
     return res
 
 
+# The numerics_overhead stage record schema, pinned by test_bench_registry
+# (ISSUE 13): the A/B cost of the numerics plane's in-graph probes on the
+# production train step, scan-slope method so the per-call floor cancels.
+NUMERICS_OVERHEAD_KEYS = (
+    "per_step_ms_off", "per_step_ms_on", "overhead_frac", "overhead_ok",
+    "n_tags", "probe_off_identical", "k_lo", "k_hi",
+)
+
+
+def _scan_steps_runner_probed(step_fn, batch, k):
+    """K PROBED train steps inside one executable, scalar outputs.
+
+    Identical to :func:`_scan_steps_runner` except the numerics stats
+    vectors are digested into the sync readback too — exactly how the
+    production trainer consumes them at its cadence-gated readback.
+    Without that, XLA would DCE the probe reductions and the A/B would
+    time two identical programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.training.multistep import make_multi_step
+
+    multi = make_multi_step(step_fn, k, reuse_batch=True)
+
+    @jax.jit
+    def run(s):
+        s2, metrics = multi(s, batch)
+        digest = sum(jnp.sum(lf) for lf in jax.tree.leaves(s2.params))
+        ndigest = sum(
+            jnp.sum(v) for v in metrics["numerics"].values()
+        )
+        return metrics["loss"][-1], digest, ndigest
+
+    return run
+
+
+def stage_numerics_overhead(ctx):
+    """Probe-on vs probe-off step time for the numerics plane (ISSUE 13).
+
+    Both sides use the scan-slope method (the headline's own timing), so
+    dispatch/readback floors cancel and the delta is pure probe compute:
+    ~15 small on-device reductions per window against the step's conv
+    forward+backward. The four executables (off/on x k_lo/k_hi) are
+    compiled once and timed INTERLEAVED with min-of-rounds merging:
+    measuring one whole side and then the other puts minutes of host
+    drift (thermal, watcher probes) straight into the ratio — seen
+    inverting a sub-1% true overhead into a >2% reading on a shared CPU
+    — while interleaving samples all four within the same contention
+    window each round and min() is sound because contention only ever
+    ADDS time (the ``_slope_time_flops`` argument). The acceptance bound
+    is <2% (``overhead_ok``); the stage also pins that the probe-OFF
+    program is bitwise-identical (lowered-text equality) to a build
+    whose model never armed the probes — the default path must not pay,
+    or change, anything."""
+    import dataclasses
+
+    import jax
+
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    model_on = dataclasses.replace(ctx.model, numerics=True)
+    step_on = make_train_step(
+        model_on, ctx.opt, seqn=ctx.seqn, numerics=True
+    )
+    # a WIDER slope than the other scan stages on purpose: the probe
+    # delta is sub-1% of step time, so the (k_hi - k_lo) denominator is
+    # the signal-to-noise lever — at (2, 4) a ~50 ms contention blip on
+    # one 11 s call reads as ~2% "overhead"; at (2, 8) the same blip is
+    # a third of that
+    k_lo, k_hi = (2, 8) if ctx.smoke else (4, 16)
+    rounds = 4 if ctx.smoke else 3
+
+    state = TrainState.create(ctx.params_scan, ctx.opt)
+    compiled = {}
+    for side, runner, fn in (
+        ("off", _scan_steps_runner, ctx.step_fn),
+        ("on", _scan_steps_runner_probed, step_on),
+    ):
+        for k in (k_lo, k_hi):
+            comp = runner(fn, ctx.batch, k).lower(state).compile()
+            _ = [float(x) for x in comp(state)]  # warm
+            compiled[(side, k)] = comp
+
+    times = {key: float("inf") for key in compiled}
+    for _ in range(rounds):
+        for key, comp in compiled.items():
+            t0 = time.perf_counter()
+            _ = [float(x) for x in comp(state)]
+            times[key] = min(times[key], time.perf_counter() - t0)
+
+    per = {}
+    for side in ("off", "on"):
+        lo, hi = times[(side, k_lo)], times[(side, k_hi)]
+        if hi <= lo:
+            raise RuntimeError(
+                f"non-positive {side}-side slope from timings {times} "
+                "(contended run?)"
+            )
+        per[side] = (hi - lo) / (k_hi - k_lo)
+    overhead = per["on"] / per["off"] - 1.0
+    n_tags = len(
+        jax.eval_shape(step_on, state, ctx.batch)[1]["numerics"]
+    )
+
+    # bitwise-identity pin: numerics=False must neutralize the plane
+    # completely — the lowered program of the production (probe-off)
+    # step equals the one built from the probe-armed model with the
+    # knob flipped back off
+    model_off = dataclasses.replace(model_on, numerics=False)
+    step_off = make_train_step(model_off, ctx.opt, seqn=ctx.seqn)
+    text_prod = jax.jit(ctx.step_fn).lower(state, ctx.batch).as_text()
+    text_off = jax.jit(step_off).lower(state, ctx.batch).as_text()
+
+    res = dict(zip(NUMERICS_OVERHEAD_KEYS, (
+        round(per["off"] * 1e3, 3),
+        round(per["on"] * 1e3, 3),
+        round(overhead, 4),
+        bool(overhead < 0.02),
+        n_tags,
+        bool(text_prod == text_off),
+        k_lo,
+        k_hi,
+    ), strict=True))
+    EXTRA["numerics_overhead"] = dict(res)
+    return res
+
+
 # Declarative stage registry — the single source of truth main() iterates
 # (tier-1's test_bench_registry imports it to pin names/order/timeouts, so
 # a wiring regression — a stage dropped, renamed, or starved of timeout —
@@ -2182,6 +2314,10 @@ STAGE_REGISTRY = [
     # by design, runs in smoke (and BEFORE the loader-heavy stages so no
     # leftover component health source can color its /healthz check)
     ("obs_live", stage_obs_live, 600, True),
+    # the numerics plane's cost cell (ISSUE 13): probe-on vs probe-off
+    # step time via the scan-slope method + the probe-off bitwise-
+    # identity pin — compute-bound, runs (and must hold <2%) in smoke
+    ("numerics_overhead", stage_numerics_overhead, 900, True),
     # smoke = plumbing check on CPU; skip the slow loader stages
     ("e2e", stage_e2e, 900, False),
     ("e2e_device_raster",

@@ -44,9 +44,11 @@ and applies the JX rule family:
 
 Each audit also emits a static profile — executed-FLOPs estimate (scan
 trip counts multiplied through; same 2·M·K·N contraction math as
-``esr_tpu.utils.roofline``), peak-residency bytes (linear liveness scan),
-cast count — so the bench's ``program_audit`` stage can track program
-growth across rounds.
+``esr_tpu.utils.roofline``), a per-dtype FLOPs breakdown
+(``flops_by_dtype``, keyed ``input->accumulator`` dtype so bf16 adoption
+is a tracked bench series instead of a claim), peak-residency bytes
+(linear liveness scan), cast count — so the bench's ``program_audit``
+stage can track program growth across rounds.
 
 Findings reuse the existing :class:`~esr_tpu.analysis.core.Finding` /
 baseline-ratchet machinery: ``path`` is ``jaxpr://<program>``, ``code``
@@ -517,15 +519,30 @@ def _peak_bytes(jaxpr) -> int:
 
 def _profile(jaxpr, walked: List[_WalkedEqn]) -> Dict[str, Any]:
     flops = 0.0
+    # executed FLOPs keyed by the contraction's OUTPUT (accumulator)
+    # dtype — the quantity JX001 polices. bf16 adoption becomes a
+    # tracked bench series (`flops_by_dtype` in the program_audit stage)
+    # instead of a claim: a real precision-ladder rung moves contraction
+    # flops from the float32 bucket into bf16-input/f32-accumulate ones.
+    flops_by_dtype: Dict[str, float] = {}
     casts = 0
     n_eqns = 0
     for w in walked:
         n_eqns += 1
         name = w.eqn.primitive.name
-        if name == "dot_general":
-            flops += w.weight * _dot_flops(w.eqn)
-        elif name == "conv_general_dilated":
-            flops += w.weight * _conv_flops(w.eqn)
+        if name in ("dot_general", "conv_general_dilated"):
+            fl = w.weight * (
+                _dot_flops(w.eqn) if name == "dot_general"
+                else _conv_flops(w.eqn)
+            )
+            flops += fl
+            # key: input dtype -> output dtype, e.g. "bfloat16->float32"
+            # (a clean ladder rung) vs "bfloat16->bfloat16" (a JX001
+            # violation) vs "float32->float32" (not yet climbed)
+            in_dt = _dtype_name(w.eqn.invars[0].aval)
+            out_dt = _dtype_name(w.eqn.outvars[0].aval)
+            key = f"{in_dt}->{out_dt}"
+            flops_by_dtype[key] = flops_by_dtype.get(key, 0.0) + fl
         elif name == "convert_element_type":
             casts += w.weight
     input_bytes = sum(
@@ -537,6 +554,9 @@ def _profile(jaxpr, walked: List[_WalkedEqn]) -> Dict[str, Any]:
     )
     return {
         "flops": flops,
+        "flops_by_dtype": {
+            k: flops_by_dtype[k] for k in sorted(flops_by_dtype)
+        },
         "peak_bytes": _peak_bytes(jaxpr),
         "cast_count": casts,
         "n_eqns": n_eqns,

@@ -64,6 +64,18 @@ in SURVEY/ROADMAP post-mortems of jax_graft systems:
   variable belongs in a payload field (``request=rid``), which the
   aggregator deliberately does not key on.
 
+- ESR014 unsanctioned-narrowing-cast — a LITERAL narrowing dtype cast
+  (``.astype("bfloat16")`` / ``.astype(jnp.float16)`` /
+  ``jnp.bfloat16(x)``) in model or training code outside the sanctioned
+  cast helpers: the precision ladder lands behind the JX001 jaxpr gate
+  and the drift harness (docs/PERF.md), so a hard-coded narrow cast
+  buried in a layer bypasses both — it can neither be audited per
+  program nor attributed per layer. Precision policy flows through the
+  config knobs (``trainer.precision`` → ``compute_dtype``,
+  ``transfer_dtype``) whose casts are dtype-VARIABLE at the cast site;
+  variables are exempt, as are functions whose underscore-split name
+  tokens mark them a cast helper (``cast``/``quantize``/``dtype``).
+
 Every rule fires only where its hazard is real (traced context, data layer,
 flax ``__call__``), keeping the default run clean enough to gate CI.
 """
@@ -970,6 +982,110 @@ class UnboundedLabelCardinality(Rule):
                 f"{interp} — one metric family per distinct value "
                 "(unbounded live-aggregator/scrape cardinality); use a "
                 "fixed name and a payload field",
+            )
+
+
+# dtype names a literal cast may NOT narrow to outside a sanctioned
+# helper; float8 variants are matched by prefix
+_NARROW_DTYPES = {"bfloat16", "float16", "half", "int8", "uint8", "int4",
+                  "uint4"}
+_NARROW_PREFIXES = ("float8",)
+# numpy-ish modules whose dtype constructors double as cast calls
+_NARROW_CTOR_BASES = {"jnp", "np", "numpy", "jax.numpy", "ml_dtypes"}
+# enclosing-function name TOKENS marking a sanctioned cast helper
+# (precision policy concentrated in one reviewable place — the jaxpr
+# auditor sees its output; the drift harness attributes it). Matched
+# against underscore-split name tokens, NOT substrings: `broadcast_mask`
+# must not be sanctioned by the 'cast' inside 'broadcast'.
+_CAST_HELPER_TOKENS = {"cast", "quantize", "dtype"}
+
+
+@register_rule
+class UnsanctionedNarrowingCast(Rule):
+    name = "ESR014"
+    slug = "unsanctioned-narrowing-cast"
+    severity = "warning"
+    hint = (
+        "a literal narrow-dtype cast in model/training code bypasses the "
+        "precision-ladder gates: the jaxpr auditor (JX001) audits the "
+        "PROGRAM a config-driven compute_dtype produces, and the drift "
+        "harness attributes per-layer error to the same knob — a "
+        "hard-coded .astype('bfloat16') is invisible to both. Route the "
+        "dtype through a config-driven variable (trainer.precision / "
+        "compute_dtype), move the cast into a *cast*/*quantize* helper, "
+        "or justify with `# esr: noqa(ESR014)`"
+    )
+
+    @staticmethod
+    def _in_scope(ctx: ModuleContext) -> bool:
+        parts = ctx.path.replace("\\", "/").split("/")
+        return "models" in parts[:-1] or "training" in parts[:-1]
+
+    @staticmethod
+    def _narrow_name(name: str) -> bool:
+        return name in _NARROW_DTYPES or name.startswith(_NARROW_PREFIXES)
+
+    def _narrow_literal(self, node: ast.AST) -> str:
+        """The narrow dtype a LITERAL expression names, or ''. Dynamic
+        expressions (``compute_dtype``, ``x.dtype``) return '' — the
+        sanctioned config-driven casts are exactly those."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if self._narrow_name(node.value) else ""
+        dotted = _dotted(node)
+        if dotted:
+            leaf = dotted.rsplit(".", 1)[-1]
+            if self._narrow_name(leaf):
+                return leaf
+        return ""
+
+    def _sanctioned(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        fn = ctx.enclosing_function(node)
+        while fn is not None:
+            tokens = set(getattr(fn, "name", "").lower().split("_"))
+            if tokens & _CAST_HELPER_TOKENS:
+                return True
+            fn = ctx.enclosing_function(fn)
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            narrow = ""
+            what = ""
+            if isinstance(func, ast.Attribute) and func.attr == "astype":
+                # positional or keyword form: x.astype('bf16') and
+                # x.astype(dtype='bf16') are the same documented hazard
+                dtype_arg = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "dtype"), None
+                )
+                if dtype_arg is not None:
+                    narrow = self._narrow_literal(dtype_arg)
+                    what = f".astype({narrow!r})"
+            elif isinstance(func, ast.Attribute) and node.args:
+                dotted = _dotted(func)
+                if dotted:
+                    base, _, leaf = dotted.rpartition(".")
+                    if base in _NARROW_CTOR_BASES and self._narrow_name(
+                        leaf
+                    ):
+                        narrow = leaf
+                        what = f"{dotted}(...)"
+            if not narrow:
+                continue
+            if self._sanctioned(ctx, node):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"literal narrowing cast {what} in model/training code "
+                "outside a sanctioned cast helper — the precision ladder "
+                "lands behind JX001 and the drift harness, which only "
+                "see config-driven dtypes",
             )
 
 

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from esr_tpu.ops.dcn import dcn_offsets_from_conv, deform_conv2d_auto
+from esr_tpu.ops.numerics import probe as numerics_probe
 from esr_tpu.models.layers import (
     apply_seq,
     ConvLayer,
@@ -175,6 +176,18 @@ class STFusion(nn.Module):
     # (ops/dcn.py deform_conv2d_auto) and a no-op on the jnp path, so it
     # only ever engages behind the per-direction Mosaic gates.
     dcn_sparse: bool = False
+    # numerics plane (docs/OBSERVABILITY.md "The numerics plane"): sow
+    # tensor-stats taps at the DCN seams (offsets/mask/aligned output)
+    # and per-decoder-scale. Default off — no probe op is ever traced.
+    numerics: bool = False
+    numerics_mode: str = "stats"
+    numerics_break: Optional[str] = None
+
+    def _probe(self, tag: str, x: Array) -> Array:
+        return numerics_probe(
+            self, tag, x, enabled=self.numerics, mode=self.numerics_mode,
+            break_tag=self.numerics_break,
+        )
 
     def setup(self):
         assert self.has_dcnatten or self.has_scaleaggre
@@ -243,6 +256,8 @@ class STFusion(nn.Module):
             apply_seq(self.offset_conv, jnp.concatenate([feat0, feat1], axis=-1), train)
         )
         offsets, mask = dcn_offsets_from_conv(raw, self.deformable_groups, 9)
+        offsets = self._probe("dcn_offsets", offsets)
+        mask = self._probe("dcn_mask", mask)
         # Direction-aware dispatch: a train=True call is the grad-carrying
         # direction (fused fwd+VJP kernel pair); train=False is the
         # inference/serving-hot forward, where the DCNv4-style fused
@@ -259,6 +274,7 @@ class STFusion(nn.Module):
                 sparse=self.dcn_sparse, activity=activity,
             )
         )
+        aligned = self._probe("dcn_out", aligned)
         feat = apply_seq(self.post_dcn, jnp.concatenate([aligned, feat1], axis=-1), train)
         sk = self.spatial_kernel(feat, train)  # [B, H, W, 2]
         # channel gate: spatial max-pool -> MLP -> sigmoid, [B, 2C]
@@ -317,6 +333,7 @@ class STFusion(nn.Module):
             out = self._scale_aggre(
                 out, feats.reshape(b, n, fh, fw, fc), idx, train
             )
+            out = self._probe(f"dec{idx}", out)
         return out
 
 
@@ -349,8 +366,26 @@ class DeepRecurrNet(nn.Module):
     # activity-sparse DCN predication (STFusion.dcn_sparse; default off —
     # zero change to every existing traced program)
     dcn_sparse: bool = False
+    # the numerics plane (ISSUE 13, docs/OBSERVABILITY.md): in-graph
+    # tensor-stats probes at the natural seams — head, per-encoder-stage,
+    # ConvGRU states, DCN offsets/mask/output, per-decoder-scale, tail.
+    # Default OFF: no probe op is ever traced, so every existing program
+    # is bitwise-identical (pinned in tests/test_obs_numerics.py).
+    # `numerics_mode="raw"` sows the raw tensors instead of their stats —
+    # the drift-attribution harness's twin-diff mode, never production.
+    # `numerics_break` routes ONE tagged tensor through the harness's
+    # precision-breaking cancellation fixture (ops/numerics.py).
+    numerics: bool = False
+    numerics_mode: str = "stats"
+    numerics_break: Optional[str] = None
 
     down_scale: int = 8
+
+    def _probe(self, tag: str, x: Array) -> Array:
+        return numerics_probe(
+            self, tag, x, enabled=self.numerics, mode=self.numerics_mode,
+            break_tag=self.numerics_break,
+        )
 
     def setup(self):
         c = self.down_scale * self.basech
@@ -369,6 +404,8 @@ class DeepRecurrNet(nn.Module):
             activation=self.activation, has_dcnatten=self.has_dcnatten,
             has_scaleaggre=self.has_scaleaggre, dcn_impl=self.dcn_impl,
             dcn_impl_fwd=self.dcn_impl_fwd, dcn_sparse=self.dcn_sparse,
+            numerics=self.numerics, numerics_mode=self.numerics_mode,
+            numerics_break=self.numerics_break,
         )
         self.tail = ConvLayer(
             self.inch, 3, padding=1, activation="relu", norm=self.norm
@@ -396,14 +433,25 @@ class DeepRecurrNet(nn.Module):
 
         flat = x.reshape(b * n, ph, pw, cin)
         flat = self.head(flat, train)
+        flat = self._probe("head_out", flat)
         feats_list = self.feat_extract(flat, train)
+        # encoder stages come back deepest-first: enc0 = 8b@H/8 (the
+        # bottleneck), enc1 = 4b@H/4, enc2 = 2b@H/2
+        feats_list = [
+            self._probe(f"enc{i}", f) for i, f in enumerate(feats_list)
+        ]
         bottleneck = feats_list[0]
         bh, bw, bc = bottleneck.shape[-3:]
 
         seq = bottleneck.reshape(b, n, bh, bw, bc)
         seq, states = self.time_propagate(seq, states, train)
+        states = (
+            self._probe("gru_fwd", states[0]),
+            self._probe("gru_bwd", states[1]),
+        )
         out = self.spacetime_fuse(seq, feats_list, train, activity)
         out = self.tail(out, train)
+        out = self._probe("tail_out", out)
 
         if need_crop:
             out = model_util.crop_image(out, spec, scale=1)

@@ -40,6 +40,19 @@ One subsystem, now two halves:
   (None-tolerant on CPU) and the bounded ``ProfilerCapture``
   (``--profile-steps``) that stamps on-chip captures into the stream.
 
+**The numerics plane** (obs v4 — docs/OBSERVABILITY.md "The numerics
+plane"):
+
+- :mod:`esr_tpu.obs.numerics` — the host half of the value-telemetry
+  dual: per-tag stats-vector readback (merged under the same reduce law
+  as the on-device accumulation in ``esr_tpu.ops.numerics``), the
+  ``numerics`` JSONL record rollup shared verbatim between the offline
+  reporter and the live aggregator (``numerics.finite_frac`` gates both
+  through one SLO YAML), layer-named anomaly attribution for the
+  AnomalyGuard's rollback events, the ``/healthz`` numerics source, and
+  the precision-drift attribution harness
+  (``python -m esr_tpu.obs drift``).
+
 **Consuming, offline** (``python -m esr_tpu.obs``):
 
 - :mod:`esr_tpu.obs.export` — telemetry.jsonl → Chrome trace-event /

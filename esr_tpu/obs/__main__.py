@@ -1,17 +1,24 @@
-"""CLI: ``python -m esr_tpu.obs <export|report> ...``.
+"""CLI: ``python -m esr_tpu.obs <export|report|drift> ...``.
 
 - ``export telemetry.jsonl [-o trace.json]`` — Chrome trace-event /
   Perfetto JSON (open in ``ui.perfetto.dev``; obs/export.py).
 - ``report telemetry.jsonl [--slo configs/slo.yml] [-o report.json]`` —
   offline rollup (goodput, per-span p50/p99, per-class window latency,
-  trace completeness) printed as JSON; with ``--slo`` the run is gated
-  against declarative thresholds (obs/report.py).
+  trace completeness, numerics) printed as JSON; with ``--slo`` the run
+  is gated against declarative thresholds (obs/report.py).
+- ``drift [--dtype bf16] [--break-tag TAG] [--fail-on-drift]`` — the
+  precision-drift attribution harness (obs v4, obs/numerics.py): one
+  seeded batch through an f32-reference and a candidate-dtype twin of
+  the probed model, per-tag rel-error ladder naming the first layer
+  exceeding tolerance. With ``--fail-on-drift`` an offender exits 1 —
+  the CI shape of the precision-ladder gate (docs/PERF.md).
 
-Both subcommands take ``--run-index N`` to select a run of an appended
+export/report take ``--run-index N`` to select a run of an appended
 multi-run file (default ``-1`` = the last run; out-of-range exits 2).
 
-Exit codes: 0 ok / every SLO rule passed, 1 SLO violation, 2 usage or
-unreadable input (a broken gate must fail loudly, never pass silently).
+Exit codes: 0 ok / every SLO rule passed, 1 SLO violation (or drift
+offender under ``--fail-on-drift``), 2 usage or unreadable input (a
+broken gate must fail loudly, never pass silently).
 Full walkthrough: docs/OBSERVABILITY.md.
 """
 
@@ -64,6 +71,43 @@ def build_parser() -> argparse.ArgumentParser:
         help="which run of an appended multi-run file (0-based; negative "
              "counts from the end; default -1 = last run)",
     )
+
+    dr = sub.add_parser(
+        "drift",
+        help="precision-drift attribution: f32 vs candidate-dtype twin, "
+             "per-layer rel-error ladder (docs/OBSERVABILITY.md)",
+    )
+    dr.add_argument(
+        "--dtype", default="bfloat16",
+        help="candidate dtype for the twin (default bfloat16)",
+    )
+    dr.add_argument("--basech", type=int, default=8,
+                    help="model base channel count (default 8)")
+    dr.add_argument("--hw", type=int, default=32,
+                    help="square spatial size of the seeded batch")
+    dr.add_argument("--frames", type=int, default=3,
+                    help="window length / num_frame (default 3)")
+    dr.add_argument("--batch", type=int, default=1)
+    dr.add_argument("--seed", type=int, default=0)
+    dr.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="per-tag rel-error threshold naming an offender "
+             "(default 0.25 — well above honest bf16 layer noise, well "
+             "below a genuinely broken layer)",
+    )
+    dr.add_argument(
+        "--break-tag", default=None, metavar="TAG",
+        help="arm the seeded precision-breaking fixture at this probe "
+             "tag (the harness must then finger exactly it)",
+    )
+    dr.add_argument(
+        "--fail-on-drift", action="store_true",
+        help="exit 1 when any tag exceeds tolerance (CI gate shape)",
+    )
+    dr.add_argument(
+        "-o", "--out", default=None,
+        help="also write the JSON document to this path",
+    )
     return p
 
 
@@ -80,6 +124,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         print(json.dumps(stats))
+        return 0
+
+    if args.cmd == "drift":
+        from esr_tpu.obs.numerics import run_drift
+
+        try:
+            doc = run_drift(
+                dtype=args.dtype, basech=args.basech, hw=args.hw,
+                frames=args.frames, batch=args.batch, seed=args.seed,
+                tolerance=args.tolerance, break_tag=args.break_tag,
+            )
+        except (TypeError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.out is not None:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=2)
+        print(json.dumps(doc, indent=2))
+        if args.fail_on_drift and doc["first_offender"] is not None:
+            return 1
         return 0
 
     from esr_tpu.obs.report import report_file

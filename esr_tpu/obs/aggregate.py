@@ -58,6 +58,11 @@ from typing import Dict, Optional
 # sketch error
 from esr_tpu.obs.report import _round
 
+# the numerics plane's per-tag accumulation + section rendering is ONE
+# implementation shared with the offline reporter (obs/numerics.py) —
+# the live/offline parity contract extended to value telemetry
+from esr_tpu.obs import numerics as _numerics
+
 __all__ = ["QuantileSketch", "LiveAggregator"]
 
 
@@ -183,7 +188,7 @@ class _State:
         "requests", "completed_requests", "failed_requests", "statuses",
         "windows_total", "chunk_windows_valid", "windows_skipped",
         "trace_requests", "trace_complete",
-        "faults_injected", "recovery_events",
+        "faults_injected", "recovery_events", "numerics",
     )
 
     def __init__(self, rel_err: float):
@@ -212,6 +217,9 @@ class _State:
         self.trace_complete = 0
         self.faults_injected = 0
         self.recovery_events = 0
+        # the numerics plane's per-tag worst-case table (obs/numerics.py
+        # ingest/merge_states/rollup — shared with the offline reporter)
+        self.numerics: Dict[str, Dict] = {}
 
     def sketch_for(self, table: Dict[str, QuantileSketch], name: str,
                    rel_err: float) -> QuantileSketch:
@@ -304,6 +312,8 @@ class LiveAggregator:
                 self._observe_span(st, name, rec)
             elif kind == "event":
                 self._observe_event(st, name, rec)
+            elif kind == "numerics":
+                _numerics.ingest(st.numerics, rec)
             elif kind == "attribution":
                 wall = float(rec.get("wall_s", 0.0) or 0.0)
                 good = float(rec.get("goodput", 0.0) or 0.0)
@@ -483,6 +493,7 @@ class LiveAggregator:
                 "injected": st.faults_injected,
                 "recovery_events": st.recovery_events,
             },
+            "numerics": _numerics.rollup(st.numerics),
         }
 
 
@@ -525,3 +536,4 @@ def _merge_state(dst: _State, src: _State) -> None:
     dst.trace_complete += src.trace_complete
     dst.faults_injected += src.faults_injected
     dst.recovery_events += src.recovery_events
+    _numerics.merge_states(dst.numerics, src.numerics)

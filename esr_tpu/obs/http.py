@@ -182,6 +182,21 @@ def render_prometheus(snapshot: Dict, prefix: str = "esr") -> str:
                 f'{name}_count{{span="{_label(fam)}"}} '
                 f"{_fmt(rec.get('count'))}"
             )
+    # the numerics plane (obs v4): bounded tag vocabulary (the static
+    # probe catalog — ESR013-safe), worst-case per-tag readings
+    num = snapshot.get("numerics", {}) or {}
+    if num.get("tags"):
+        emit(f"{prefix}_numerics_finite_frac", "gauge",
+             [({}, num.get("finite_frac"))],
+             "worst per-tag finite fraction across the probed tensors")
+        emit(f"{prefix}_numerics_nonfinite_total", "counter",
+             [({"tag": t}, rec.get("nonfinite"))
+              for t, rec in sorted(num["tags"].items())])
+        for key in ("max_abs", "finite_frac", "underflow_frac",
+                    "overflow_frac"):
+            emit(f"{prefix}_numerics_tag_{key}", "gauge",
+                 [({"tag": t}, rec.get(key))
+                  for t, rec in sorted(num["tags"].items())])
     classes = serving.get("classes", {}) if serving else {}
     if classes:
         name = f"{prefix}_serving_window_latency_seconds"
@@ -392,6 +407,7 @@ class LivePlane:
     def close(self) -> None:
         self.server.close()
         if self.sink is not None:
+            unregister_health_source("numerics")
             self.aggregator.detach(self.sink)
             self.sink = None
 
@@ -417,6 +433,14 @@ def start_live_plane(
             "docs/OBSERVABILITY.md)"
         )
     aggregator = LiveAggregator(rel_err=rel_err).attach(sink)
+    # the numerics plane's component health (obs v4): /healthz flips to
+    # 503 the moment any probed tag reports non-finite elements — the
+    # value-telemetry dual of the prefetcher stall / lane-quarantine
+    # sources. Registered for EVERY live plane (trainer and serving
+    # tier alike); healthy while no probes report.
+    from esr_tpu.obs.numerics import numerics_health_source
+
+    register_health_source("numerics", numerics_health_source(aggregator))
     server = LiveTelemetryServer(
         aggregator, port=port, host=host, slo_path=slo_path,
         windows=windows,

@@ -1,0 +1,401 @@
+"""The numerics plane, host side (obs v4 — docs/OBSERVABILITY.md).
+
+Obs v1–v3 observe *time* (spans, traces, live sketches); this module is
+their dual for *values*: it consumes the on-device tensor-statistics
+probes (``esr_tpu.ops.numerics`` — the jnp half that rides the traced
+programs) and turns them into
+
+- ``numerics`` JSONL records (one per tag at the trainer's existing
+  ``train_log_step`` cadence — the cadence-gated readback stays the only
+  host sync);
+- a shared live/offline rollup section (:func:`rollup`) in the
+  reporter's dotted namespace, so ``configs/slo.yml`` can gate on
+  ``numerics.finite_frac`` identically against a finished telemetry
+  file and a live ``/slo`` window (the v3 parity contract);
+- layer-named anomaly attribution (:func:`first_offending_tag`) — the
+  AnomalyGuard's rollback events carry the first model seam whose
+  activations went non-finite instead of just "nan_loss";
+- the precision-drift attribution harness (:func:`run_drift`, CLI
+  ``python -m esr_tpu.obs drift``): one seeded batch through an
+  f32-reference and a candidate-dtype twin of the same model, diffed
+  per probe tag, naming the first layer exceeding tolerance.
+
+Module-level imports stay stdlib+numpy-free of jax (the obs contract:
+importable from the NumPy-only data layer and accelerator-free CI
+hosts); jax enters only lazily inside the drift harness.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# mirror of esr_tpu.ops.numerics.STAT_FIELDS/REDUCE_KINDS, duplicated so
+# this module never imports the jnp half at module scope; pinned equal by
+# tests/test_obs_numerics.py
+STAT_FIELDS = (
+    "rms", "max_abs", "mean", "nonfinite", "underflow", "overflow", "count",
+)
+REDUCE_KINDS = ("max", "max", "last", "sum", "max", "max", "sum")
+NSTATS = len(STAT_FIELDS)
+
+# the probe-tag catalog in MODEL ORDER (docs/OBSERVABILITY.md "The
+# numerics plane"): input-to-output through DeepRecurrNet's seams, then
+# the training-side taps. "First offending tag" resolution walks this
+# order, so the named layer is the EARLIEST seam the anomaly reached —
+# the causal head of the poison, not a downstream symptom.
+TAG_ORDER = (
+    "head_out",
+    "enc0", "enc1", "enc2",
+    "gru_fwd", "gru_bwd",
+    "dcn_offsets", "dcn_mask", "dcn_out",
+    "dec0", "dec1", "dec2",
+    "tail_out",
+    "loss", "grad_norm",
+)
+
+
+def order_tags(tags) -> List[str]:
+    """``tags`` sorted in catalog order; unknown tags (future models)
+    follow alphabetically after the known catalog."""
+    known = {t: i for i, t in enumerate(TAG_ORDER)}
+    return sorted(tags, key=lambda t: (known.get(t, len(TAG_ORDER)), t))
+
+
+# ---------------------------------------------------------------------------
+# readback: stats vectors (numpy) -> merged per-tag vectors -> record fields
+
+
+def merge_host(acc, new):
+    """NumPy twin of ``ops.numerics.merge_stat_vectors`` (pinned equal in
+    tests): accumulate one stats vector into another under the per-field
+    reduce law (max for extrema, sum for counts, last for ``mean``)."""
+    import numpy as np
+
+    acc = np.asarray(acc, np.float32)
+    new = np.asarray(new, np.float32)
+    out = np.where(
+        [k == "max" for k in REDUCE_KINDS],
+        np.maximum(acc, new),
+        np.where([k == "sum" for k in REDUCE_KINDS], acc + new, new),
+    )
+    return out.astype(np.float32)
+
+
+def merge_readback(numerics) -> Dict[str, "object"]:
+    """Collapse a super-step's numerics readback to one vector per tag.
+
+    Accepts either the fused super-step form — ``{tag: [k, NSTATS]}``
+    (``lax.scan`` stacks the k chained steps' vectors) — or the
+    single-step-list form the epoch tail produces (``[{tag: [NSTATS]},
+    ...]``). Host-side numpy only; runs inside the trainer's existing
+    cadence-gated readback."""
+    import numpy as np
+
+    if isinstance(numerics, (list, tuple)):
+        merged: Dict[str, object] = {}
+        for entry in numerics:
+            for tag, vec in entry.items():
+                vec = np.asarray(vec, np.float32)
+                merged[tag] = (
+                    vec if tag not in merged else merge_host(merged[tag], vec)
+                )
+        return merged
+    out: Dict[str, object] = {}
+    for tag, stacked in numerics.items():
+        arr = np.asarray(stacked, np.float32)
+        if arr.ndim == 1:
+            out[tag] = arr
+            continue
+        acc = arr[0]
+        for row in arr[1:]:
+            acc = merge_host(acc, row)
+        out[tag] = acc
+    return out
+
+
+def finite_frac(nonfinite: float, count: float) -> Optional[float]:
+    """THE finite-fraction convention of the whole plane (records, the
+    offline report, the live snapshot, /healthz, the SLO rule): ``None``
+    with no data, and NEVER exactly 1.0 while any non-finite element was
+    counted — plain ``round(1 - tiny/huge, 6)`` rounds back up to 1.0
+    and would pass the ``min: 1.0`` SLO gate with NaNs present."""
+    if count <= 0:
+        return None
+    if nonfinite <= 0:
+        return 1.0
+    return min(round(1.0 - nonfinite / count, 6), 0.999999)
+
+
+def stats_fields(vec) -> Dict[str, float]:
+    """One merged stats vector -> the JSONL record payload (field names
+    from :data:`STAT_FIELDS` plus the derived ``finite_frac``)."""
+    import numpy as np
+
+    vec = np.asarray(vec, np.float64)
+    fields = {name: round(float(v), 6) for name, v in zip(STAT_FIELDS, vec)}
+    fields["finite_frac"] = finite_frac(
+        fields["nonfinite"], fields["count"]
+    )
+    return fields
+
+
+def first_offending_tag(numerics: Optional[Dict]) -> Optional[str]:
+    """The earliest catalog tag whose merged stats carry non-finite
+    elements — the layer-named attribution the AnomalyGuard stamps onto
+    ``recovery_skip_step`` / ``recovery_rollback`` events. ``None`` when
+    no probes are present or every tag is clean (the guard then falls
+    back to the plain "nan_loss" story)."""
+    import numpy as np
+
+    if not numerics:
+        return None
+    idx = STAT_FIELDS.index("nonfinite")
+    for tag in order_tags(numerics):
+        vec = np.asarray(numerics[tag], np.float64)
+        if vec.shape[-1] == NSTATS and float(vec[idx]) > 0:
+            return tag
+    return None
+
+
+def poison_tag(numerics: Dict, tag: str = "loss") -> Dict:
+    """Enact an injected ``nan_loss`` fault on the numerics readback:
+    mark every probed element of ``tag`` non-finite, exactly where the
+    fault plane poisons the loss scalars (trainer ``consume``) — so the
+    chaos gate's layer-named rollback works for simulated faults too."""
+    import numpy as np
+
+    out = dict(numerics)
+    vec = np.array(
+        out.get(tag, np.zeros(NSTATS, np.float32)), np.float32, copy=True
+    )
+    count = max(float(vec[STAT_FIELDS.index("count")]), 1.0)
+    vec[STAT_FIELDS.index("count")] = count
+    vec[STAT_FIELDS.index("nonfinite")] = count
+    out[tag] = vec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the shared live/offline rollup: per-tag accumulation states -> section.
+# Both the offline reporter (obs/report.py) and the LiveAggregator
+# (obs/aggregate.py) keep `{tag: state-dict}` tables and feed every
+# `numerics` record through ingest(); rollup() renders the one section
+# shape both expose, so a single SLO YAML evaluates either view
+# (the obs v3 live/offline parity contract).
+
+
+def new_tag_state() -> Dict[str, float]:
+    return {
+        "records": 0,
+        "rms": 0.0,
+        "max_abs": 0.0,
+        "nonfinite": 0.0,
+        "count": 0.0,
+        "underflow": 0.0,
+        "overflow": 0.0,
+    }
+
+
+def ingest(states: Dict[str, Dict], rec: Dict) -> None:
+    """Fold one ``numerics`` record (as written by ``sink.numerics``)
+    into a per-tag state table. Extrema keep their max, counts sum —
+    the same law as the on-device accumulation."""
+    tag = rec.get("name", "?")
+    st = states.get(tag)
+    if st is None:
+        st = states[tag] = new_tag_state()
+    st["records"] += 1
+    for key in ("rms", "max_abs", "underflow", "overflow"):
+        try:
+            st[key] = max(st[key], float(rec.get(key, 0.0) or 0.0))
+        except (TypeError, ValueError):
+            pass
+    for key in ("nonfinite", "count"):
+        try:
+            st[key] += float(rec.get(key, 0.0) or 0.0)
+        except (TypeError, ValueError):
+            pass
+
+
+def merge_states(dst: Dict[str, Dict], src: Dict[str, Dict]) -> None:
+    """Merge one state table into another (the live plane's epoch-ring
+    merge) — same per-field law as :func:`ingest`."""
+    for tag, st in src.items():
+        mine = dst.get(tag)
+        if mine is None:
+            dst[tag] = dict(st)
+            continue
+        mine["records"] += st["records"]
+        for key in ("rms", "max_abs", "underflow", "overflow"):
+            mine[key] = max(mine[key], st[key])
+        for key in ("nonfinite", "count"):
+            mine[key] += st[key]
+
+
+def rollup(states: Dict[str, Dict]) -> Dict:
+    """The report/snapshot ``numerics`` section: per-tag worst-case
+    readings plus the headline ``finite_frac`` (the worst tag's) the
+    shipped SLO rule gates on. Always present; empty-but-typed when the
+    run carried no probes (``finite_frac: None`` + ``allow_missing``)."""
+    tags_out = {}
+    worst_tag = None
+    worst_frac = None
+    nonfinite_total = 0.0
+    for tag in order_tags(states):
+        st = states[tag]
+        frac = finite_frac(st["nonfinite"], st["count"])
+        tags_out[tag] = {
+            "records": st["records"],
+            "rms": round(st["rms"], 6),
+            "max_abs": round(st["max_abs"], 6),
+            "nonfinite": st["nonfinite"],
+            "count": st["count"],
+            "finite_frac": frac,
+            "underflow_frac": round(st["underflow"], 6),
+            "overflow_frac": round(st["overflow"], 6),
+        }
+        nonfinite_total += st["nonfinite"]
+        if frac is not None and (worst_frac is None or frac < worst_frac):
+            worst_frac, worst_tag = frac, tag
+    return {
+        "records": sum(st["records"] for st in states.values()),
+        "finite_frac": worst_frac,
+        "worst_tag": worst_tag,
+        "nonfinite_total": nonfinite_total,
+        "tags": tags_out,
+    }
+
+
+def numerics_health_source(aggregator):
+    """A ``/healthz`` component source over a live aggregator: healthy
+    while every probed tag stays fully finite (or no probes have
+    reported). Registered by ``obs.http.start_live_plane`` so both the
+    trainer's and the serving tier's live planes expose it."""
+
+    def source() -> Dict:
+        num = aggregator.snapshot().get("numerics", {}) or {}
+        frac = num.get("finite_frac")
+        return {
+            "healthy": frac is None or frac >= 1.0,
+            "finite_frac": frac,
+            "worst_tag": num.get("worst_tag"),
+            "tags": len(num.get("tags", {})),
+        }
+
+    return source
+
+
+# ---------------------------------------------------------------------------
+# the precision-drift attribution harness (`python -m esr_tpu.obs drift`)
+
+
+def _rel_error(ref, cand) -> float:
+    """Norm-relative error between a reference tap and its candidate
+    twin: ``||ref - cand|| / (||ref|| + eps)`` in f64, max over the
+    tap's firings (raw-mode taps are tuples — one entry per sow)."""
+    import numpy as np
+
+    refs = ref if isinstance(ref, (tuple, list)) else (ref,)
+    cands = cand if isinstance(cand, (tuple, list)) else (cand,)
+    worst = 0.0
+    for a, b in zip(refs, cands):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        denom = float(np.linalg.norm(a.ravel())) + 1e-12
+        worst = max(
+            worst, float(np.linalg.norm((a - b).ravel())) / denom
+        )
+    return worst
+
+
+def run_drift(
+    dtype: str = "bfloat16",
+    basech: int = 8,
+    hw: int = 32,
+    frames: int = 3,
+    batch: int = 1,
+    seed: int = 0,
+    tolerance: float = 0.25,
+    break_tag: Optional[str] = None,
+    inch: int = 2,
+) -> Dict:
+    """Run one seeded batch through an f32-reference and a
+    candidate-dtype twin of the SAME probed model, diff the raw taps per
+    tag, and emit the per-layer rel-error ladder naming the first seam
+    exceeding ``tolerance``.
+
+    ``break_tag`` arms the seeded precision-breaking fixture
+    (``ops.numerics.numerics_breaker`` — a ``(x+256)-256`` cancellation
+    executed in each twin's own dtype): exact-ish in f32, destructive in
+    bf16, so the harness must finger exactly that layer — the tier-1
+    acceptance check for the whole attribution path. The breaker runs in
+    the tagged tensor's OWN compute dtype, so a seam that stays f32 even
+    in the candidate twin (the decoder scales — the upsample path
+    upcasts) honestly does not drift: attribution reflects where reduced
+    precision actually reaches.
+
+    Device-free of any accelerator assumption (CPU tier-1 runs it); the
+    candidate twin casts params, inputs, and recurrent states to
+    ``dtype`` so every conv/matmul executes at the candidate width,
+    mirroring how ``trainer.precision: bf16`` casts for the apply.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.ops.numerics import flatten_probes
+
+    cand_dtype = jnp.dtype(dtype)
+    model = DeepRecurrNet(
+        inch=inch, basech=basech, num_frame=frames,
+        numerics=True, numerics_mode="raw", numerics_break=break_tag,
+    )
+    x = jax.random.normal(
+        jax.random.PRNGKey(seed), (batch, frames, hw, hw, inch),
+        jnp.float32,
+    )
+    states = model.init_states(batch, hw, hw)
+    variables = model.init(jax.random.PRNGKey(seed + 1), x, states)
+    params = {"params": variables["params"]}
+
+    def taps(p, xx, ss):
+        (_out, _st), mut = model.apply(
+            p, xx, ss, train=False, mutable=["numerics"]
+        )
+        return mut["numerics"]
+
+    ref = flatten_probes(jax.device_get(taps(params, x, states)))
+
+    def cast(tree):
+        return jax.tree.map(lambda a: a.astype(cand_dtype), tree)
+
+    cand = flatten_probes(jax.device_get(
+        taps(cast(params), x.astype(cand_dtype), cast(states))
+    ))
+
+    ladder = []
+    first = None
+    for tag in order_tags(ref):
+        rel = _rel_error(ref[tag], cand[tag])
+        exceeds = rel > tolerance
+        ladder.append({
+            "tag": tag,
+            "rel_err": round(rel, 6),
+            "exceeds": exceeds,
+        })
+        if exceeds and first is None:
+            first = tag
+    return {
+        "dtype": str(cand_dtype),
+        "reference": "float32",
+        "tolerance": tolerance,
+        "seed": seed,
+        "model": {
+            "name": "DeepRecurrNet", "basech": basech, "hw": hw,
+            "frames": frames, "batch": batch, "inch": inch,
+        },
+        "break_tag": break_tag,
+        "first_offender": first,
+        "n_exceeding": sum(1 for e in ladder if e["exceeds"]),
+        "ladder": ladder,
+    }

@@ -35,6 +35,12 @@ empty-but-typed when the run had no such activity):
   every ``fault_injected`` event matched one-to-one against
   ``recovery_*`` events (by ``fault_id``, then by ``site``); the chaos
   gate requires ``unrecovered == 0``.
+- ``numerics`` — the numerics plane's rollup (obs v4): per probe tag the
+  worst-case max-abs/rms/underflow/overflow readings and the headline
+  ``finite_frac`` (the worst tag's finite fraction), built by the SAME
+  ``obs.numerics.ingest``/``rollup`` pair the live aggregator uses, so
+  ``numerics.finite_frac`` in ``configs/slo.yml`` gates a finished file
+  and a live ``/slo`` window identically.
 
 SLO YAML (``configs/slo.yml``)::
 
@@ -253,6 +259,9 @@ def build_report(
     requests_failed = 0
     windows_total = 0
     statuses: Dict[str, int] = {}
+    numerics_states: Dict[str, Dict] = {}
+
+    from esr_tpu.obs import numerics as _numerics
 
     for rec in records:
         kind = rec.get("type")
@@ -301,6 +310,8 @@ def build_report(
                 windows_total += int(rec.get("windows", 0) or 0)
                 if not rec.get("completed", False):
                     requests_failed += 1
+        elif kind == "numerics":
+            _numerics.ingest(numerics_states, rec)
         elif kind == "attribution":
             attributions.append(rec)
 
@@ -387,6 +398,7 @@ def build_report(
         "serving": serving,
         "traces": _trace_completeness(records),
         "faults": _fault_completeness(records),
+        "numerics": _numerics.rollup(numerics_states),
     }
 
 

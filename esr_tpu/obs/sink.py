@@ -295,6 +295,19 @@ class TelemetrySink:
         payload.setdefault("thread", threading.current_thread().name)
         self._write("span", name, self._trace_fields(payload))
 
+    def numerics(self, tag: str, stats: Dict, step=None, **fields) -> None:
+        """One probe tag's merged tensor statistics at the cadence-gated
+        readback (obs v4, docs/OBSERVABILITY.md "The numerics plane").
+        ``tag`` comes from the static probe catalog
+        (``esr_tpu.obs.numerics.TAG_ORDER``) — a bounded vocabulary, like
+        span family names (ESR013); ``stats`` is the
+        ``obs.numerics.stats_fields`` payload (rms, max_abs, mean,
+        nonfinite, underflow, overflow, count, finite_frac)."""
+        self._write(
+            "numerics", tag,
+            self._trace_fields({"step": step, **stats, **fields}),
+        )
+
     def attribution(self, fields: Dict) -> None:
         """A per-super-step wall-clock attribution record (obs/spans.py);
         field order is curated by the producer and preserved."""

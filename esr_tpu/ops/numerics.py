@@ -1,0 +1,222 @@
+"""Device-side tensor-statistics probes (the numerics plane, ISSUE 13).
+
+The in-graph half of ``esr_tpu.obs``'s numerics plane
+(docs/OBSERVABILITY.md "The numerics plane"): a compact f32 stats vector
+computed ENTIRELY on device for every tagged tensor, cheap enough to ride
+the existing scan carries and the existing cadence-gated metrics readback
+— no new host syncs, ever. The host-side consumers (record emission,
+rollups, the drift harness, layer-named rollback attribution) live in
+``esr_tpu.obs.numerics``; this module is jnp-only so it can be called
+from traced model/training code (the same split as ``ops/encodings`` vs
+``data/np_encodings`` — jit-able compute in ``ops``, host logic outside).
+
+The stats vector (:data:`STAT_FIELDS`, one f32 per field):
+
+====================  ======  ==============================================
+field                 reduce  meaning
+====================  ======  ==============================================
+``rms``               max     sqrt(mean(x^2)) over FINITE elements
+``max_abs``           max     max |x| over finite elements
+``mean``              last    mean over finite elements (sign-carrying)
+``nonfinite``         sum     COUNT of non-finite elements (nan/inf)
+``underflow``         max     fraction of finite NONZERO elements with
+                              ``|x| < finfo(dtype).tiny`` — values the
+                              probed dtype is already flushing toward zero
+``overflow``          max     fraction of finite elements within one decade
+                              of ``finfo(dtype).max`` — overflow proximity
+``count``             sum     total elements probed (finite_frac =
+                              ``1 - nonfinite / count`` on the host side)
+====================  ======  ==============================================
+
+The ``reduce`` column is the accumulation law across probe firings (the
+window-scan carry, repeated taps inside one apply, the K-step megabatch
+axis): extrema keep their running max, counts sum, ``mean`` keeps the
+most recent firing. :func:`merge_stat_vectors` implements it for traced
+code; ``esr_tpu.obs.numerics.merge_host`` is the numpy twin applied at
+readback — the pair is pinned equal by ``tests/test_obs_numerics.py``.
+
+Probe points are flax ``self.sow('numerics', tag, ...)`` taps
+(:func:`probe`), default-off behind the model's ``numerics`` knob: with
+the knob off no stats op is ever traced, so probe-off programs are
+bitwise-identical to a build without the plane (pinned).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+# the sow collection every probe writes into (read back with
+# ``mutable=[NUMERICS_COLLECTION]`` — see training/train_step.py)
+NUMERICS_COLLECTION = "numerics"
+
+STAT_FIELDS = (
+    "rms", "max_abs", "mean", "nonfinite", "underflow", "overflow", "count",
+)
+# per-field accumulation law across probe firings (module docstring)
+REDUCE_KINDS = ("max", "max", "last", "sum", "max", "max", "sum")
+NSTATS = len(STAT_FIELDS)
+
+# boolean masks over STAT_FIELDS, as plain tuples so both the jnp and the
+# numpy merge twins index them without a device constant
+_MAX_MASK = tuple(k == "max" for k in REDUCE_KINDS)
+_SUM_MASK = tuple(k == "sum" for k in REDUCE_KINDS)
+
+
+def tensor_stats(x) -> jnp.ndarray:
+    """The f32 stats vector (:data:`STAT_FIELDS`) of one tensor, on device.
+
+    Non-finite elements are COUNTED (``nonfinite``) and masked out of the
+    moments, so rms/max_abs stay informative on a partially-poisoned
+    tensor instead of going NaN with it. Underflow/overflow thresholds
+    come from the PROBED dtype's ``finfo`` — a bf16 activation is judged
+    against bf16's ``tiny``/``max``, which is exactly what makes the
+    per-layer readings comparable across the precision ladder.
+    """
+    import jax
+
+    # probes are pure OBSERVERS: sever them from AD entirely. Without
+    # this, rms' sqrt at an all-zero tensor (the zero-initialized DCN
+    # offsets) has an infinite derivative, and reverse-mode multiplies
+    # it by the (zero) cotangent — 0 * inf = NaN poisoning every grad.
+    x = jax.lax.stop_gradient(jnp.asarray(x))
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
+    info = jnp.finfo(x.dtype)
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    safe = jnp.where(finite, xf, 0.0)
+    absx = jnp.abs(safe)
+    n = jnp.float32(x.size)
+    n_finite = jnp.sum(finite.astype(jnp.float32))
+    denom = jnp.maximum(n_finite, 1.0)
+    rms = jnp.sqrt(jnp.sum(safe * safe) / denom)
+    max_abs = jnp.max(absx)
+    mean = jnp.sum(safe) / denom
+    # count the BAD elements directly — differencing `n - n_finite`
+    # silently reads 0 past 2**24 elements (f32 ulp swallows a small NaN
+    # count against a production-scale tensor size); a direct sum of the
+    # 0/1 mask keeps small counts exact at any tensor size
+    nonfinite = jnp.sum((~finite).astype(jnp.float32))
+    tiny = jnp.float32(info.tiny)
+    near_max = jnp.float32(info.max) / 10.0
+    nonzero = finite & (absx > 0.0)
+    n_nonzero = jnp.maximum(jnp.sum(nonzero.astype(jnp.float32)), 1.0)
+    underflow = jnp.sum(
+        (nonzero & (absx < tiny)).astype(jnp.float32)
+    ) / n_nonzero
+    overflow = jnp.sum(
+        (finite & (absx >= near_max)).astype(jnp.float32)
+    ) / denom
+    return jnp.stack(
+        [rms, max_abs, mean, nonfinite, underflow, overflow, n]
+    )
+
+
+def zero_stats() -> jnp.ndarray:
+    """The accumulation identity: zeros merge as a no-op under every
+    reduce kind (max against non-negative fields, sum, and ``last`` where
+    the new value always wins)."""
+    return jnp.zeros((NSTATS,), jnp.float32)
+
+
+def merge_stat_vectors(acc, new):
+    """Accumulate one probe firing into a running stats vector, per the
+    :data:`REDUCE_KINDS` law. Shapes broadcast, so the same function
+    reduces a ``[k, NSTATS]`` stacked axis via ``functools.reduce``."""
+    acc = jnp.asarray(acc, jnp.float32)
+    new = jnp.asarray(new, jnp.float32)
+    max_mask = jnp.asarray(_MAX_MASK)
+    sum_mask = jnp.asarray(_SUM_MASK)
+    return jnp.where(
+        max_mask,
+        jnp.maximum(acc, new),
+        jnp.where(sum_mask, acc + new, new),
+    )
+
+
+def numerics_breaker(x):
+    """The drift harness's seeded precision-breaking transform: a
+    catastrophic-cancellation pass ``(x + 256) - 256`` executed in the
+    tensor's OWN dtype. In f32 it perturbs typical activations by
+    ~``2**-15`` relative; in bf16 (8 mantissa bits) the 256-offset grid
+    has step 2.0, so the layer's values are destroyed — a layer that is
+    fine in f32 and broken in bf16, by construction. Only the drift
+    harness (``python -m esr_tpu.obs drift --break-tag``) ever sets the
+    model knob that routes through here."""
+    c = jnp.asarray(256.0, jnp.asarray(x).dtype)
+    return (x + c) - c
+
+
+def probe(
+    module,
+    tag: str,
+    x,
+    *,
+    enabled: bool,
+    mode: str = "stats",
+    break_tag: Optional[str] = None,
+):
+    """Tap tensor ``x`` under ``tag`` via ``module.sow`` and return it.
+
+    - ``enabled=False`` (the default everywhere): returns ``x`` untouched
+      and traces NOTHING — the probe-off program is bitwise-identical to
+      a build without the plane.
+    - ``mode="stats"`` (production): sows :func:`tensor_stats` with the
+      :func:`merge_stat_vectors` reduce, so a tag fired multiple times in
+      one apply (the per-frame DCN taps) accumulates under the same law
+      as the scan carry.
+    - ``mode="raw"`` (the drift harness ONLY): sows the raw tensor with
+      flax's default tuple-append, so the f32/candidate twins can be
+      diffed value-by-value per tag.
+    - ``break_tag`` routes the tagged tensor through
+      :func:`numerics_breaker` IN PATH (downstream compute sees the
+      broken values) — the seeded fixture the drift harness must finger.
+
+    ``module`` is any flax module; when the ``'numerics'`` collection is
+    not mutable in the enclosing ``apply`` the sow is a flax no-op and
+    the (dead) stats are DCE'd by XLA.
+    """
+    if not enabled:
+        return x
+    if break_tag is not None and break_tag == tag:
+        x = numerics_breaker(x)
+    if mode == "raw":
+        import jax
+
+        module.sow(NUMERICS_COLLECTION, tag, jax.lax.stop_gradient(x))
+    else:
+        module.sow(
+            NUMERICS_COLLECTION, tag, tensor_stats(x),
+            reduce_fn=merge_stat_vectors, init_fn=zero_stats,
+        )
+    return x
+
+
+def flatten_probes(tree) -> dict:
+    """Flatten a sown ``'numerics'`` collection to ``{tag: value}``.
+
+    Sow paths nest by module (``{'spacetime_fuse': {'dcn_out': vec}}``);
+    tags are globally unique by construction (the catalog in
+    ``esr_tpu.obs.numerics.TAG_ORDER``), so the leaf key alone is the
+    tag. A collision raises at trace time — it means two modules chose
+    the same tag name, which would silently merge unrelated layers."""
+    from collections.abc import Mapping
+
+    out: dict = {}
+
+    def walk(node):
+        for key, val in node.items():
+            if isinstance(val, Mapping):
+                walk(val)
+            else:
+                if key in out:
+                    raise ValueError(
+                        f"duplicate numerics probe tag {key!r} — tags "
+                        "must be globally unique across the model"
+                    )
+                out[key] = val
+
+    walk(dict(tree))
+    return out

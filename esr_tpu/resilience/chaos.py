@@ -135,6 +135,11 @@ def train_config(out_root: str, datalist: str) -> Dict:
             "commit_retries": 2,
             "commit_backoff_s": 0.05,
             "prefetch_stall_timeout_s": STALL_TIMEOUT_S,
+            # the numerics plane rides the chaos gate (obs v4): probes
+            # are pure observers (twin parity is unchanged — pinned by
+            # the params/loss checks below), and the corrupt-megabatch
+            # fault's rollback must carry a layer-named bad_tag
+            "numerics": True,
         },
         "train_dataloader": loader,
         "valid_dataloader": None,
@@ -205,6 +210,11 @@ def _run_train(config: Dict, runid: str, seed: int,
         "skipped_iterations": (
             sorted(set(trainer._guard.skipped_iterations))
             if trainer._guard else []
+        ),
+        # layer-named anomaly attribution (obs v4): the most recent bad
+        # super-step's first offending probe tag
+        "last_bad_tag": (
+            trainer._guard.last_bad_tag if trainer._guard else None
         ),
     }
 
@@ -373,6 +383,13 @@ def run_scenario(out_dir: str, seed: int = 0) -> Dict:
             "enough_faults": tf["injected"] + sf["injected"] >= 5,
             "enough_sites": len(sites) >= 4,
             "restore_fell_back": bool(restore["fell_back"]),
+            # the rollback must be layer-named (obs v4): a corrupted
+            # megabatch poisons the model input, so the guard's numerics
+            # readback names a real model seam (not just "nan_loss")
+            "rollback_carries_tag": (
+                chaos["rollbacks"] == 0
+                or chaos["last_bad_tag"] is not None
+            ),
             "statuses_classified": (
                 len(statuses) > 0 and None not in statuses
             ),

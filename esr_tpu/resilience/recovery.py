@@ -136,17 +136,26 @@ def retry_with_backoff(
 class RollbackSignal(Exception):
     """Raised by :class:`AnomalyGuard` when the bad-step budget is
     exhausted; the trainer's loop catches it, restores the last valid
-    committed checkpoint, and fast-forwards the data stream."""
+    committed checkpoint, and fast-forwards the data stream.
+
+    ``bad_tag`` carries the numerics plane's layer attribution when
+    probes were enabled (obs v4): the FIRST model seam whose activations
+    went non-finite (``esr_tpu.obs.numerics.first_offending_tag``), so
+    the ``recovery_rollback`` event names where the poison entered
+    instead of just "loss went non-finite"."""
 
     def __init__(self, at_iteration: int, bad_steps: int,
-                 fault_id: Optional[str] = None):
+                 fault_id: Optional[str] = None,
+                 bad_tag: Optional[str] = None):
+        where = f" (first offending tag: {bad_tag})" if bad_tag else ""
         super().__init__(
             f"{bad_steps} consecutive non-finite super-steps "
-            f"(last at iteration {at_iteration}); rolling back"
+            f"(last at iteration {at_iteration}){where}; rolling back"
         )
         self.at_iteration = int(at_iteration)
         self.bad_steps = int(bad_steps)
         self.fault_id = fault_id
+        self.bad_tag = bad_tag
 
 
 class AnomalyGuard:
@@ -163,6 +172,13 @@ class AnomalyGuard:
       valid committed checkpoint and replays — *self-heal*).
 
     ``max_bad_steps=0`` rolls back on the first bad super-step.
+
+    With the numerics plane enabled (``trainer.numerics``,
+    docs/OBSERVABILITY.md) the trainer passes the super-step's merged
+    per-tag probe readback into :meth:`check`; a bad step then carries
+    the FIRST offending model seam (``bad_tag``) on its
+    ``recovery_skip_step`` / ``recovery_rollback`` events and in
+    :attr:`last_bad_tag` — layer-named rollback instead of "nan_loss".
     """
 
     def __init__(self, max_bad_steps: int = 2):
@@ -174,37 +190,49 @@ class AnomalyGuard:
         self.consecutive_bad = 0
         self.skipped_iterations: List[int] = []
         self.rollbacks = 0
+        # the most recent bad super-step's layer attribution (None when
+        # probes are off or every tag was clean)
+        self.last_bad_tag: Optional[str] = None
 
     def check(
         self,
         losses: List[float],
         first_iteration: int,
         fault_id: Optional[str] = None,
+        numerics: Optional[Dict] = None,
     ) -> bool:
-        """True when every loss is finite (metrics may be recorded)."""
+        """True when every loss is finite (metrics may be recorded).
+        ``numerics``: the super-step's merged ``{tag: stats vector}``
+        probe readback (host numpy; already part of the cadence-gated
+        readback — no new sync)."""
         import math
 
         if all(math.isfinite(v) for v in losses):
             self.consecutive_bad = 0
             return True
+        from esr_tpu.obs.numerics import first_offending_tag
+
+        bad_tag = first_offending_tag(numerics)
+        self.last_bad_tag = bad_tag
         self.consecutive_bad += 1
         covered = list(range(first_iteration, first_iteration + len(losses)))
         self.skipped_iterations.extend(covered)
         if self.consecutive_bad > self.max_bad_steps:
             self.rollbacks += 1
             raise RollbackSignal(
-                first_iteration, self.consecutive_bad, fault_id=fault_id
+                first_iteration, self.consecutive_bad, fault_id=fault_id,
+                bad_tag=bad_tag,
             )
         emit_recovery(
             "recovery_skip_step", site="train_step", fault_id=fault_id,
             iteration=first_iteration, iterations=covered,
             consecutive_bad=self.consecutive_bad,
-            budget=self.max_bad_steps,
+            budget=self.max_bad_steps, bad_tag=bad_tag,
         )
         logger.warning(
-            "non-finite loss at super-step %d (losses=%s); skipped "
-            "(%d/%d bad before rollback)",
-            first_iteration, losses, self.consecutive_bad,
+            "non-finite loss at super-step %d (losses=%s, first offending "
+            "tag=%s); skipped (%d/%d bad before rollback)",
+            first_iteration, losses, bad_tag, self.consecutive_bad,
             self.max_bad_steps,
         )
         return False

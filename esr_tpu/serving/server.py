@@ -28,10 +28,12 @@ recurrent state, on-device metric sums); what changes is who feeds it:
 Live plane (obs v3, opt-in via ``live_port``/``serve.py --live-port``):
 a :class:`~esr_tpu.obs.aggregate.LiveAggregator` taps the active sink's
 record stream and an HTTP thread serves ``/metrics`` (Prometheus),
-``/healthz`` (lane-quarantine + prefetcher health), and ``/slo`` (live
-multi-window burn-rate verdict on the same SLO YAML the offline gate
-uses) — the per-replica signal the future fleet router polls
-(docs/SERVING.md "The fleet signal"). ``--profile-steps N`` wraps the
+``/healthz`` (lane-quarantine + prefetcher health + the obs v4
+``numerics`` source — any probed tensor going non-finite flips a
+serving replica to 503, the value-telemetry dual of lane quarantine),
+and ``/slo`` (live multi-window burn-rate verdict on the same SLO YAML
+the offline gate uses) — the per-replica signal the future fleet router
+polls (docs/SERVING.md "The fleet signal"). ``--profile-steps N`` wraps the
 first N chunk dispatches in a ``jax.profiler`` capture stamped as a
 ``profiler_capture`` event. Both default off.
 

@@ -136,6 +136,7 @@ def make_train_step(
     remat: bool = False,
     compute_dtype: Optional[Any] = None,
     rasterize: Optional[Callable] = None,
+    numerics: bool = False,
 ) -> Callable:
     """Build the jit-able train step.
 
@@ -148,6 +149,17 @@ def make_train_step(
     forward/backward convs at the MXU's native width (params are CAST for the
     apply, master copies and optimizer state stay f32, losses accumulate in
     f32). The reference trains pure f32; bf16 is the TPU-first option.
+
+    ``numerics`` (the numerics plane, docs/OBSERVABILITY.md): read the
+    model's sown tensor-stats probes back per window, accumulate them
+    across the BPTT window scan IN THE CARRY (running max for extrema,
+    sums for counts — ``ops/numerics.py``), and add ``loss`` /
+    ``grad_norm`` taps — the whole bundle rides the existing metrics
+    readback as ``metrics["numerics"]`` (``{tag: f32[NSTATS]}``), so the
+    cadence-gated readback stays the ONLY host sync. Requires a model
+    built with ``numerics=True`` (the probes live in the model); with
+    ``numerics=False`` (default) this factory's traced program is
+    bitwise-identical to a build without the plane (pinned).
 
     Returns ``(state, metrics) = train_step(state, batch)``.
     """
@@ -165,9 +177,28 @@ def make_train_step(
             variables, window, states, train=True, mutable=["batch_stats"]
         )
 
+    # numerics twins: same apply with the 'numerics' sow collection
+    # mutable, handing the per-window probe tree back alongside the
+    # prediction. Separate defs (not a runtime branch) so the default-off
+    # program traces byte-identically.
+    def _fwd_plain_num(variables, window, states):
+        (pred, states), mut = model.apply(
+            variables, window, states, train=True, mutable=["numerics"]
+        )
+        return pred, states, mut["numerics"]
+
+    def _fwd_bn_num(variables, window, states):
+        (pred, states), mut = model.apply(
+            variables, window, states, train=True,
+            mutable=["batch_stats", "numerics"],
+        )
+        return pred, states, mut
+
     if remat:
         _fwd_plain = jax.checkpoint(_fwd_plain)
         _fwd_bn = jax.checkpoint(_fwd_bn)
+        _fwd_plain_num = jax.checkpoint(_fwd_plain_num)
+        _fwd_bn_num = jax.checkpoint(_fwd_bn_num)
 
     def loss_fn(param_col, stats, batch):
         if rasterize is not None:
@@ -192,47 +223,123 @@ def make_train_step(
         # of stacking every window's output
         pred0 = jnp.zeros_like(gt[:, 0], dtype=jnp.float32)
 
+        if numerics:
+            # probe-tag structure from a device-free shape trace of one
+            # window forward, so the scan carry's accumulator pytree is
+            # known before the scan body traces
+            from esr_tpu.ops.numerics import (
+                flatten_probes,
+                merge_stat_vectors,
+                zero_stats,
+            )
+
+            if stats is None:
+                probes_shape = jax.eval_shape(
+                    _fwd_plain_num, {"params": param_col},
+                    inp[:, :seqn], states0,
+                )[2]
+            else:
+                probes_shape = jax.eval_shape(
+                    _fwd_bn_num,
+                    {"params": param_col, "batch_stats": stats},
+                    inp[:, :seqn], states0,
+                )[2]["numerics"]
+            acc0 = {
+                tag: zero_stats() for tag in flatten_probes(probes_shape)
+            }
+
+        # `numerics` is a static python bool, so the probe branches below
+        # are resolved at trace time: the default-off program is
+        # byte-identical to a build without the plane (lowered-text pin
+        # in tests/test_obs_numerics.py and the bench numerics_overhead
+        # cell). One body per BN variant — the window slice / forward /
+        # f32 loss math exists once per path, never per knob.
         if stats is None:
 
             def body(carry, i):
-                states, _ = carry
+                if numerics:
+                    states, _, acc = carry
+                else:
+                    states, _ = carry
                 window, gtw = slice_window(i)
-                pred, states = _fwd_plain(
-                    {"params": param_col}, window, states
-                )
+                if numerics:
+                    pred, states, sown = _fwd_plain_num(
+                        {"params": param_col}, window, states
+                    )
+                    stats_i = flatten_probes(sown)
+                    acc = {
+                        t: merge_stat_vectors(acc[t], stats_i[t])
+                        for t in acc
+                    }
+                else:
+                    pred, states = _fwd_plain(
+                        {"params": param_col}, window, states
+                    )
                 predf = pred.astype(jnp.float32)  # loss math in f32
                 err = predf - gtw
-                return (states, predf), (err**2).mean()
+                carry = (
+                    (states, predf, acc) if numerics else (states, predf)
+                )
+                return carry, (err**2).mean()
 
-            (_, last_pred), losses = jax.lax.scan(
-                body, (states0, pred0), idxs
+            carry0 = (
+                (states0, pred0, acc0) if numerics else (states0, pred0)
             )
+            out_carry, losses = jax.lax.scan(body, carry0, idxs)
+            last_pred = out_carry[1]
+            probe_acc = out_carry[2] if numerics else None
             new_stats = None
         else:
             # BN models: running stats update on every window forward (torch
             # updates per forward() call inside the reference's BPTT loop),
             # so the stats ride the scan carry alongside the GRU states.
             def body(carry, i):
-                states, st, _ = carry
+                if numerics:
+                    states, st, _, acc = carry
+                else:
+                    states, st, _ = carry
                 window, gtw = slice_window(i)
-                (pred, states), mut = _fwd_bn(
-                    {"params": param_col, "batch_stats": st}, window, states
-                )
+                if numerics:
+                    pred, states, mut = _fwd_bn_num(
+                        {"params": param_col, "batch_stats": st},
+                        window, states,
+                    )
+                    stats_i = flatten_probes(mut["numerics"])
+                    acc = {
+                        t: merge_stat_vectors(acc[t], stats_i[t])
+                        for t in acc
+                    }
+                else:
+                    (pred, states), mut = _fwd_bn(
+                        {"params": param_col, "batch_stats": st},
+                        window, states,
+                    )
                 predf = pred.astype(jnp.float32)
                 err = predf - gtw
-                return (states, mut["batch_stats"], predf), (err**2).mean()
+                carry = (
+                    (states, mut["batch_stats"], predf, acc)
+                    if numerics else (states, mut["batch_stats"], predf)
+                )
+                return carry, (err**2).mean()
 
-            (_, new_stats, last_pred), losses = jax.lax.scan(
-                body, (states0, stats, pred0), idxs
+            carry0 = (
+                (states0, stats, pred0, acc0)
+                if numerics else (states0, stats, pred0)
             )
+            out_carry, losses = jax.lax.scan(body, carry0, idxs)
+            new_stats = out_carry[1]
+            last_pred = out_carry[2]
+            probe_acc = out_carry[3] if numerics else None
         # reference accumulates the SUM of per-window MSEs before backward
-        return losses.sum(), (losses, last_pred, new_stats)
+        return losses.sum(), (losses, last_pred, new_stats, probe_acc)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
         param_col, stats = _split_vars(state.params)
-        (loss, (losses, last_pred, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(param_col, stats, batch)
+        (loss, (losses, last_pred, new_stats, probe_acc)), grads = (
+            jax.value_and_grad(loss_fn, has_aux=True)(
+                param_col, stats, batch
+            )
+        )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, param_col
         )
@@ -244,12 +351,24 @@ def make_train_step(
             opt_state,
             state.step + 1,
         )
+        grad_norm = optax.global_norm(grads)
         metrics = {
             "loss": loss,
             "loss_per_window": losses,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
             "last_pred": last_pred,
         }
+        if numerics:
+            from esr_tpu.ops.numerics import tensor_stats
+
+            # the training-side taps join the model's: the window-summed
+            # per-window losses and the global grad norm, in the same
+            # stats-vector format so one readback path serves all tags
+            metrics["numerics"] = {
+                **probe_acc,
+                "loss": tensor_stats(losses),
+                "grad_norm": tensor_stats(grad_norm),
+            }
         return new_state, metrics
 
     return train_step

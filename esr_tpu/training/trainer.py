@@ -283,8 +283,28 @@ class Trainer:
                 seed=run.seed,
             )
 
+        # the numerics plane (obs v4, docs/OBSERVABILITY.md): default OFF
+        # — probes change nothing (traced programs stay bitwise-identical,
+        # pinned). When on, the model is built with its probe taps armed
+        # (model args `numerics`), the train step reads them back through
+        # the EXISTING cadence-gated metrics readback, per-tag `numerics`
+        # records land in the JSONL sink at the train_log_step cadence,
+        # and the anomaly guard's rollback events carry the first
+        # offending tag (layer-named rollback).
+        self.numerics = bool(trainer_cfg.get("numerics", False))
+
         # model + optimizer
-        self.model = build_model(config["model"])
+        model_cfg = config["model"]
+        if self.numerics:
+            import copy
+
+            model_cfg = copy.deepcopy(model_cfg)
+            # `args:` may be an explicitly-empty YAML block (None) —
+            # build_model tolerates that shape, so this must too
+            model_cfg["args"] = {
+                **(model_cfg.get("args") or {}), "numerics": True,
+            }
+        self.model = build_model(model_cfg)
         self.optimizer, self.schedule = build_optimizer(
             config["optimizer"], config.get("lr_scheduler"), lr_change_rate
         )
@@ -309,7 +329,7 @@ class Trainer:
         base_step = make_train_step(
             self.model, self.optimizer, self.seqn,
             remat=remat, compute_dtype=compute_dtype,
-            rasterize=rasterize,
+            rasterize=rasterize, numerics=self.numerics,
         )
         self.train_step = make_parallel_train_step(base_step, self.mesh)
         # K-step fusion (the r4 dispatch-floor fix): chain k_steps train
@@ -345,6 +365,12 @@ class Trainer:
         dummy = np.zeros((1, self.seqn, kh, kw, self.model.inch), np.float32)
         states = self.model.init_states(1, kh, kw)
         params = self.model.init(jax.random.PRNGKey(run.seed), dummy, states)
+        if isinstance(params, dict) and "numerics" in params:
+            # model.init runs with every collection mutable, so the probe
+            # taps sow one throwaway 'numerics' entry; it must not ride
+            # the TrainState (checkpoints, digests, donation) — probes
+            # are read back per step via mutable apply, never carried
+            params = {k: v for k, v in params.items() if k != "numerics"}
         state = TrainState.create(params, self.optimizer)
 
         # monitor config (reference :149-157)
@@ -546,8 +572,15 @@ class Trainer:
 
         # rollback-of-last-resort target: when the anomaly guard fires
         # before ANY checkpoint committed, recovery restores the run-start
-        # state (a host-side reference; replicate() does not mutate it)
-        self._init_state = state if self._guard is not None else None
+        # state. Deep-copied to HOST numpy: replicate()'s device_put can
+        # alias the original buffers when the sharding already matches
+        # (single-device CPU always does), and the first super-step then
+        # DONATES them — a bare reference would hand the rollback a
+        # deleted-array skeleton.
+        self._init_state = (
+            jax.tree.map(lambda x: np.array(x), state)
+            if self._guard is not None else None
+        )
         self.state = replicate(state, self.mesh)
 
     # -- helpers -----------------------------------------------------------
@@ -931,11 +964,13 @@ class Trainer:
             "recovery_rollback", site="train_step", fault_id=rb.fault_id,
             from_iteration=rb.at_iteration, to_iteration=start_iter,
             bad_steps=rb.bad_steps, checkpoint=path,
+            bad_tag=getattr(rb, "bad_tag", None),
         )
         logger.warning(
             "rolled back to iteration %d (checkpoint %s) after %d "
-            "consecutive bad super-steps; replaying deterministically",
-            start_iter, path, rb.bad_steps,
+            "consecutive bad super-steps (first offending tag: %s); "
+            "replaying deterministically",
+            start_iter, path, rb.bad_steps, getattr(rb, "bad_tag", None),
         )
         return start_iter
 
@@ -1036,8 +1071,17 @@ class Trainer:
         pending: deque = deque()
         last_scalars = {"loss": float("nan"), "mse": float("nan")}
 
+        if self.numerics:
+            from esr_tpu.obs.numerics import (
+                merge_readback,
+                order_tags,
+                poison_tag,
+                stats_fields,
+            )
+
         def consume(entry):
             first, r, ep, metrics, vis_batch, bucket, nan_specs = entry
+            num_host = None
             # One host readback per SUPER-step (scalars only): the fused
             # path hands back {loss [r], loss_per_window [r, Wc], ...} in
             # a single small transfer; the single-step path (k_steps=1 or
@@ -1050,6 +1094,12 @@ class Trainer:
                     losses = [float(m["loss"]) for m in metrics]
                     mses = [float(m["loss_per_window"][-1]) for m in metrics]
                     last_pred_dev = metrics[-1]["last_pred"]
+                    if self.numerics:
+                        # part of the SAME cadence-gated readback — tiny
+                        # [NSTATS] vectors per tag, no extra sync point
+                        num_host = merge_readback(
+                            [m["numerics"] for m in metrics]
+                        )
                 else:
                     losses = [float(v) for v in np.asarray(metrics["loss"])]
                     mses = [
@@ -1057,6 +1107,8 @@ class Trainer:
                         for v in np.asarray(metrics["loss_per_window"])[:, -1]
                     ]
                     last_pred_dev = metrics["last_pred"]
+                    if self.numerics:
+                        num_host = merge_readback(metrics["numerics"])
             if nan_specs:
                 # injected train_step/nan_loss fault: the super-step's
                 # readback scalars go non-finite (params untouched — the
@@ -1064,9 +1116,16 @@ class Trainer:
                 # skippable anomaly class); the guard below must catch it
                 losses = [float("nan")] * len(losses)
                 mses = [float("nan")] * len(mses)
+                if num_host is not None:
+                    # the numerics view of the injected fault: the loss
+                    # tap is marked non-finite where the scalars were
+                    # poisoned, so the layer-named rollback path works
+                    # for simulated anomalies exactly like real ones
+                    num_host = poison_tag(num_host, "loss")
             if self._guard is not None and not self._guard.check(
                 losses, first,
                 fault_id=nan_specs[0].fault_id if nan_specs else None,
+                numerics=num_host,
             ):
                 # skip-and-log (docs/RESILIENCE.md): a non-finite
                 # super-step is excluded from trackers/writer/vis so one
@@ -1098,6 +1157,20 @@ class Trainer:
                         mse_loss,
                         loss,
                         lr,
+                    )
+            if (
+                self.sink is not None
+                and num_host is not None
+                and any(k % self.train_log_step == 0 for k in
+                        range(first, first + r))
+            ):
+                # one `numerics` record per probe tag, behind the SAME
+                # train_log_step cadence as the loss line — the values
+                # were already read back above; this is pure host I/O
+                for tag in order_tags(num_host):
+                    self.sink.numerics(
+                        tag, stats_fields(num_host[tag]),
+                        step=first + r - 1,
                     )
             if self.writer is not None and vis_batch is not None:
                 # host-sync audit: a device->host transfer of one

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Numerics-plane smoke (ISSUE 13, docs/OBSERVABILITY.md "The numerics
+# plane"): a 2-super-step CPU train with in-graph probes on must
+#   - land `numerics` records in the JSONL sink at the log cadence,
+#   - expose the esr_numerics_* families on the live /metrics page and
+#     the `numerics` component source on /healthz,
+#   - turn an injected nan_loss fault into a LAYER-NAMED rollback
+#     (recovery_rollback carries the offending probe tag),
+#   - pass `python -m esr_tpu.obs report --slo configs/slo.yml` (the
+#     numerics.finite_frac rule evaluates),
+# and the bench numerics_overhead cell must measure probe overhead <2%
+# of step time with the probe-off program bitwise-identical.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_numerics_smoke.py)
+# as a standalone gate.
+#
+# Usage: scripts/numerics_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_numerics_smoke.py tests/test_obs_numerics.py -q "$@"

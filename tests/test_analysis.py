@@ -1018,3 +1018,85 @@ def test_esr013_noqa_suppresses():
         "    sink.counter(f'x_{rid}')  # esr: noqa(ESR013)\n"
     )
     assert "ESR013" not in rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# ESR014 unsanctioned narrowing cast
+
+
+def test_esr014_literal_narrowing_casts_fire_in_model_and_training_code():
+    src = "def f(x):\n    return x.astype('bfloat16')\n"
+    assert "ESR014" in rules_hit(
+        src, path="esr_tpu/models/m.py", rel_path="esr_tpu/models/m.py"
+    )
+    assert "ESR014" in rules_hit(
+        src, path="esr_tpu/training/t.py", rel_path="esr_tpu/training/t.py"
+    )
+    dotted = (
+        "import jax.numpy as jnp\n"
+        "def f(x):\n    return x.astype(jnp.float16)\n"
+    )
+    assert "ESR014" in rules_hit(
+        dotted, path="esr_tpu/models/m.py", rel_path="esr_tpu/models/m.py"
+    )
+    ctor = (
+        "import jax.numpy as jnp\n"
+        "def f(x):\n    return jnp.bfloat16(x)\n"
+    )
+    assert "ESR014" in rules_hit(
+        ctor, path="esr_tpu/models/m.py", rel_path="esr_tpu/models/m.py"
+    )
+    # keyword form is the same hazard (review finding, PR 13)
+    kw = "def f(x):\n    return x.astype(dtype='bfloat16')\n"
+    assert "ESR014" in rules_hit(
+        kw, path="esr_tpu/models/m.py", rel_path="esr_tpu/models/m.py"
+    )
+
+
+def test_esr014_scoped_to_model_training_layers_only():
+    # the serving/data/ops layers cast for wire formats and kernels —
+    # the rule polices only where the precision ladder's gates look
+    src = "def f(x):\n    return x.astype('bfloat16')\n"
+    for path in ("esr_tpu/serving/s.py", "esr_tpu/data/d.py",
+                 "esr_tpu/ops/o.py", "mod.py"):
+        assert "ESR014" not in rules_hit(src, path=path, rel_path=path)
+
+
+def test_esr014_sanctioned_shapes_clean():
+    model = "esr_tpu/models/m.py"
+    # widening is not narrowing
+    widen = (
+        "import jax.numpy as jnp\n"
+        "def f(x):\n    return x.astype(jnp.float32)\n"
+    )
+    assert "ESR014" not in rules_hit(widen, path=model, rel_path=model)
+    # dtype-VARIABLE casts are the config-driven sanctioned path
+    # (trainer.precision -> compute_dtype)
+    dynamic = "def f(x, compute_dtype):\n    return x.astype(compute_dtype)\n"
+    assert "ESR014" not in rules_hit(dynamic, path=model, rel_path=model)
+    roundtrip = "def f(x, y):\n    return x.astype(y.dtype)\n"
+    assert "ESR014" not in rules_hit(roundtrip, path=model, rel_path=model)
+    # cast helpers concentrate precision policy — sanctioned by name
+    helper = (
+        "def cast_to_compute(x):\n    return x.astype('bfloat16')\n"
+    )
+    assert "ESR014" not in rules_hit(helper, path=model, rel_path=model)
+    quant = "def quantize_int8(x):\n    return x.astype('int8')\n"
+    assert "ESR014" not in rules_hit(quant, path=model, rel_path=model)
+    to_dtype = "def to_dtype(x):\n    return x.astype('bfloat16')\n"
+    assert "ESR014" not in rules_hit(to_dtype, path=model, rel_path=model)
+    # helper matching is TOKEN-wise, not substring: the 'cast' inside
+    # 'broadcast' must NOT sanction a narrowing cast (review finding)
+    broadcast = (
+        "def broadcast_mask(x):\n    return x.astype('bfloat16')\n"
+    )
+    assert "ESR014" in rules_hit(broadcast, path=model, rel_path=model)
+
+
+def test_esr014_noqa_suppresses():
+    model = "esr_tpu/models/m.py"
+    src = (
+        "def f(x):\n"
+        "    return x.astype('bfloat16')  # esr: noqa(ESR014)\n"
+    )
+    assert "ESR014" not in rules_hit(src, path=model, rel_path=model)

@@ -36,6 +36,7 @@ def test_stage_registry_names_order_and_timeouts():
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
         "dcn_sparse_ab", "mfu_ceiling", "program_audit", "obs_live",
+        "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
         "infer_throughput", "ckpt_overlap", "serve_loadgen",
         "chaos_recovery",
@@ -330,7 +331,7 @@ def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
         "programs", "clean", "total_findings", "rules_version",
     )
     assert bench.PROGRAM_AUDIT_PROGRAM_KEYS == (
-        "flops", "peak_bytes", "cast_count", "findings",
+        "flops", "flops_by_dtype", "peak_bytes", "cast_count", "findings",
     )
     rec = bench.stage_program_audit()
     assert tuple(rec.keys()) == bench.PROGRAM_AUDIT_KEYS
@@ -341,8 +342,35 @@ def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
         assert prog["flops"] > 0, pname
         assert prog["peak_bytes"] > 0, pname
         assert prog["findings"] == 0, pname
+        # per-dtype breakdown (ISSUE 13): keyed "input->accumulator",
+        # sums back to the total, and the not-yet-climbed ladder keeps
+        # every production contraction in the f32 bucket
+        by_dtype = prog["flops_by_dtype"]
+        assert by_dtype, pname
+        assert all("->" in k for k in by_dtype), pname
+        assert sum(by_dtype.values()) == pytest.approx(
+            prog["flops"], rel=1e-6
+        ), pname
+        assert "float32->float32" in by_dtype, pname
     assert rec["clean"] is True and rec["total_findings"] == 0
     assert rec["rules_version"].startswith("jx:")
+
+
+def test_numerics_overhead_stage_registered_and_schema_pinned():
+    """ISSUE 13: the numerics plane's cost cell — probe-on vs probe-off
+    step time (scan-slope, per-call floor cancels) plus the probe-off
+    bitwise-identity pin — is registered, runs in smoke, and keeps a
+    pinned schema. The stage itself executes in the numerics smoke gate
+    (tests/test_numerics_smoke.py) where a CPU step exists to time."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "numerics_overhead"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.NUMERICS_OVERHEAD_KEYS == (
+        "per_step_ms_off", "per_step_ms_on", "overhead_frac",
+        "overhead_ok", "n_tags", "probe_off_identical", "k_lo", "k_hi",
+    )
 
 
 def test_backend_up_bounded_probe_success_and_cache(tmp_path):

@@ -1,0 +1,223 @@
+"""The numerics smoke gate (ISSUE 13, tier-1, CPU).
+
+One tiny probed training run — 2 fused super-steps (k_steps=2) on a
+synthetic corpus with ``trainer.numerics`` on and an injected
+``nan_loss`` fault — proves the plane end to end:
+
+- ``numerics`` records land in the JSONL sink at the train_log_step
+  cadence, one per probe tag, with the full stats payload;
+- the live plane exposes them: ``/metrics`` carries the
+  ``esr_numerics_*`` families and ``/healthz`` gains the ``numerics``
+  component source;
+- the injected non-finite step produces a ROLLBACK whose
+  ``recovery_rollback`` event carries the offending tag (the ``loss``
+  tap — the injection poisons the readback scalars, and the numerics
+  view poisons with them), and the run still completes and recovers;
+- ``python -m esr_tpu.obs report --slo configs/slo.yml`` exits 0 over
+  the run's telemetry (the ``numerics.finite_frac`` rule evaluates);
+- the bench ``numerics_overhead`` cell runs on this host: probe
+  overhead under its 2% bound and the probe-off program bitwise
+  identical (``scripts/numerics_smoke.sh`` is the standalone gate).
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from esr_tpu.resilience.chaos import build_corpus, dataset_config
+from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+ITERATIONS = 4
+K_STEPS = 2
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _smoke_config(out_root: str, datalist: str) -> dict:
+    loader = {
+        "path_to_datalist_txt": datalist,
+        "batch_size": 8,
+        "shuffle": True,
+        "drop_last": True,
+        "prefetch": 0,
+        "dataset": dataset_config(),
+    }
+    return {
+        "experiment": "numerics_smoke",
+        "model": {
+            "name": "DeepRecurrNet",
+            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+        },
+        "optimizer": {
+            "name": "Adam",
+            "args": {"lr": 1e-3, "weight_decay": 1e-4, "amsgrad": True},
+        },
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": out_root,
+            "iteration_based_train": {
+                "enabled": True,
+                "iterations": ITERATIONS,
+                "save_period": 10**9,
+                "train_log_step": 1,
+                "valid_step": 10**9,
+                "lr_change_rate": 4000,
+            },
+            "monitor": "off",
+            "tensorboard": False,
+            "vis": {"enabled": False},
+            "k_steps": K_STEPS,
+            "numerics": True,
+            # rollback on the FIRST bad super-step: the injected
+            # nan_loss must produce a layer-named recovery_rollback
+            "max_bad_steps": 0,
+            "max_rollbacks": 2,
+        },
+        "train_dataloader": loader,
+        "valid_dataloader": None,
+    }
+
+
+@pytest.fixture(scope="module")
+def smoke_run(tmp_path_factory):
+    import copy
+
+    from esr_tpu.config.parser import RunConfig
+    from esr_tpu.obs.http import start_live_plane
+    from esr_tpu.training.trainer import Trainer
+
+    out = str(tmp_path_factory.mktemp("numerics_smoke"))
+    datalist = build_corpus(os.path.join(out, "corpus"))
+    config = _smoke_config(out, datalist)
+    run = RunConfig(copy.deepcopy(config), runid="numerics", seed=0)
+    trainer = Trainer(run)
+    # the live plane over the trainer's own sink (the same wiring
+    # trainer.live_telemetry performs; owned here so the endpoints stay
+    # up for the assertions after train() returns)
+    plane = start_live_plane(trainer.sink, port=0)
+    # nan_loss at the SECOND super-step (iterations 2..3)
+    plan = FaultPlan([FaultSpec("train_step", 2, "nan_loss")])
+    try:
+        with installed(plan):
+            trainer.train()
+        telemetry = os.path.join(run.log_dir, "telemetry.jsonl")
+        records = [json.loads(line) for line in open(telemetry)]
+        metrics_page = urllib.request.urlopen(
+            f"http://127.0.0.1:{plane.port}/metrics", timeout=10
+        ).read().decode()
+        try:
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{plane.port}/healthz", timeout=10
+            )
+            health_code, health_doc = health.status, json.load(health)
+        except urllib.error.HTTPError as e:  # 503 still carries the body
+            health_code, health_doc = e.code, json.load(e)
+    finally:
+        plane.close()
+    return dict(
+        trainer=trainer, telemetry=telemetry, records=records,
+        metrics_page=metrics_page, health_code=health_code,
+        health_doc=health_doc, plan=plan,
+    )
+
+
+def test_numerics_records_present_at_cadence(smoke_run):
+    from esr_tpu.obs.numerics import TAG_ORDER
+
+    num = [r for r in smoke_run["records"] if r.get("type") == "numerics"]
+    assert num, "no numerics records in the telemetry stream"
+    tags = {r["name"] for r in num}
+    assert tags == set(TAG_ORDER)
+    for rec in num:
+        for key in ("rms", "max_abs", "mean", "nonfinite", "underflow",
+                    "overflow", "count", "finite_frac", "step"):
+            assert key in rec, (rec["name"], key)
+    # train_log_step=1 -> every clean super-step emits one record per
+    # tag; the poisoned super-step is guard-excluded (skip-and-log)
+    steps = {r["step"] for r in num}
+    assert len(steps) >= 2
+
+
+def test_injected_nan_step_produces_layer_named_rollback(smoke_run):
+    assert smoke_run["plan"].pending_count() == 0  # the fault fired
+    rollbacks = [
+        r for r in smoke_run["records"]
+        if r.get("type") == "event" and r.get("name") == "recovery_rollback"
+    ]
+    assert len(rollbacks) == 1
+    # the injection poisons the readback scalars; its numerics view is
+    # the loss tap — the rollback event must name it
+    assert rollbacks[0]["bad_tag"] == "loss"
+    assert smoke_run["trainer"]._guard.rollbacks == 1
+    assert smoke_run["trainer"]._guard.last_bad_tag == "loss"
+    # fault -> recovery completeness holds for the whole file
+    from esr_tpu.obs.report import build_report
+
+    faults = build_report(smoke_run["records"])["faults"]
+    assert faults["injected"] == 1
+    assert faults["unrecovered"] == 0
+
+
+def test_live_metrics_expose_numerics_families(smoke_run):
+    page = smoke_run["metrics_page"]
+    assert "esr_numerics_finite_frac" in page
+    assert 'esr_numerics_tag_max_abs{tag="head_out"}' in page
+    assert 'esr_numerics_nonfinite_total{tag="loss"}' in page
+
+
+def test_healthz_carries_numerics_source(smoke_run):
+    doc = smoke_run["health_doc"]
+    assert "numerics" in doc["sources"]
+    num = doc["sources"]["numerics"]
+    # the poisoned super-step was guard-excluded before any record was
+    # emitted, so the exposed stream is fully finite -> healthy
+    assert num["healthy"] is True
+    assert num["finite_frac"] == 1.0
+    assert smoke_run["health_code"] == 200
+
+
+def test_obs_report_slo_gate_exits_zero(smoke_run):
+    from esr_tpu.obs.report import report_file
+
+    doc, code = report_file(
+        smoke_run["telemetry"],
+        slo_path=os.path.join(REPO_ROOT, "configs", "slo.yml"),
+    )
+    assert code == 0, doc.get("slo")
+    num = doc["report"]["numerics"]
+    assert num["finite_frac"] == 1.0
+    assert num["records"] > 0
+
+
+@pytest.mark.slow
+def test_bench_numerics_overhead_cell(monkeypatch):
+    """The bench cell at the bench's own smoke geometry: probe overhead
+    under the 2% bound (scan-slope — the per-call floor cancels) and the
+    probe-off program bitwise-identical to a build without the plane.
+
+    slow-marked (4 scan-step compiles + 2 full lowers, minutes on CPU):
+    ``scripts/numerics_smoke.sh`` — the standalone numerics gate — runs
+    it; tier-1 covers the stage registration/schema
+    (test_bench_registry) and the bitwise/observer pins
+    (test_obs_numerics) without paying the compiles twice."""
+    monkeypatch.setenv("ESR_BENCH_SMOKE", "1")
+    import bench
+
+    ctx = bench._Ctx()
+    rec = bench.stage_numerics_overhead(ctx)
+    assert tuple(rec.keys()) == bench.NUMERICS_OVERHEAD_KEYS
+    assert rec["probe_off_identical"] is True
+    assert rec["n_tags"] == 15
+    assert rec["per_step_ms_off"] > 0
+    if rec["overhead_frac"] >= 0.02:
+        # the _slope_time_flops house rule: contention only ever ADDS
+        # time, so one independent re-measure with a min-merge is sound
+        # evidence and cheap (no recompiles inside the stage) — don't
+        # let one noisy window on a shared CPU torch the gate. Measured
+        # true overhead is ~0.5%; the noise envelope is ~±1.5%.
+        rec2 = bench.stage_numerics_overhead(ctx)
+        rec = min((rec, rec2), key=lambda r: r["overhead_frac"])
+    # the ISSUE 13 acceptance bound: <2% of step time on CPU smoke
+    assert rec["overhead_frac"] < 0.02, rec
+    assert rec["overhead_ok"] is True

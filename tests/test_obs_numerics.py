@@ -1,0 +1,519 @@
+"""The numerics plane (obs v4, ISSUE 13) — tier-1 coverage.
+
+- stats-vector correctness on crafted tensors: exact non-finite counts,
+  underflow/overflow fractions against the probed dtype's own finfo
+  constants;
+- the device (jnp) and host (numpy) accumulation twins agree, and
+  scan-carry accumulation across the BPTT window scan equals a
+  per-window host reference;
+- probe-off programs are bitwise-identical (lowered-text pin) and
+  probe-ON steps leave params/losses bitwise untouched — probes are
+  pure observers;
+- the drift harness fingers a seeded bf16-breaking layer, and a clean
+  bf16 twin names nobody;
+- the AnomalyGuard's skip/rollback events carry the first offending
+  probe tag (layer-named rollback);
+- the JSONL `numerics` record type rolls up identically offline
+  (obs report) and live (LiveAggregator snapshot / Prometheus page),
+  and `numerics.finite_frac` gates through the shipped SLO machinery.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.obs import numerics as obs_num
+from esr_tpu.ops import numerics as ops_num
+
+
+# ---------------------------------------------------------------------------
+# stats-vector correctness
+
+
+def test_stat_field_catalogs_pinned_equal():
+    """The host mirror (obs/numerics.py, jax-free at import) must never
+    drift from the device definition (ops/numerics.py)."""
+    assert obs_num.STAT_FIELDS == ops_num.STAT_FIELDS
+    assert obs_num.REDUCE_KINDS == ops_num.REDUCE_KINDS
+    assert obs_num.NSTATS == ops_num.NSTATS == len(ops_num.STAT_FIELDS)
+
+
+def _field(vec, name):
+    return float(np.asarray(vec)[ops_num.STAT_FIELDS.index(name)])
+
+
+def test_tensor_stats_exact_nonfinite_count_and_moments():
+    x = np.array([1.0, -2.0, np.nan, np.inf, -np.inf, 3.0], np.float32)
+    vec = np.asarray(ops_num.tensor_stats(jnp.asarray(x)))
+    assert _field(vec, "count") == 6.0
+    assert _field(vec, "nonfinite") == 3.0
+    # moments over the FINITE elements only
+    finite = np.array([1.0, -2.0, 3.0])
+    assert _field(vec, "rms") == pytest.approx(
+        float(np.sqrt((finite**2).mean())), rel=1e-6
+    )
+    assert _field(vec, "max_abs") == pytest.approx(3.0)
+    assert _field(vec, "mean") == pytest.approx(finite.mean(), rel=1e-6)
+
+
+def test_tensor_stats_underflow_overflow_vs_dtype_constants():
+    """f16 has a tiny of ~6.1e-5 and a max of 65504: craft exact
+    fractions on each side of both thresholds."""
+    info = np.finfo(np.float16)
+    x = np.array(
+        [
+            float(info.tiny) / 4.0,   # subnormal: underflow
+            float(info.tiny) / 2.0,   # subnormal: underflow
+            1.0,                      # healthy
+            0.0,                      # exact zero: excluded from underflow
+            float(info.max) / 2.0,    # within a decade of max: overflow
+            float(info.max) / 100.0,  # more than a decade below: fine
+            2.0,                      # healthy
+            3.0,                      # healthy
+        ],
+        np.float16,
+    )
+    vec = np.asarray(ops_num.tensor_stats(jnp.asarray(x)))
+    # 2 of the 7 NONZERO elements sit below tiny
+    assert _field(vec, "underflow") == pytest.approx(2.0 / 7.0, rel=1e-6)
+    # 1 of the 8 finite elements sits within a decade of max
+    assert _field(vec, "overflow") == pytest.approx(1.0 / 8.0, rel=1e-6)
+    assert _field(vec, "nonfinite") == 0.0
+    assert _field(vec, "count") == 8.0
+
+
+def test_tensor_stats_thresholds_follow_probed_dtype():
+    """The same values judged as f32 are neither under- nor overflowing:
+    thresholds come from the probed dtype, not a global constant."""
+    x32 = np.array([1e-6, 1.0, 5e4], np.float32)
+    vec32 = np.asarray(ops_num.tensor_stats(jnp.asarray(x32)))
+    assert _field(vec32, "underflow") == 0.0
+    assert _field(vec32, "overflow") == 0.0
+    vec16 = np.asarray(
+        ops_num.tensor_stats(jnp.asarray(x32.astype(np.float16)))
+    )
+    assert _field(vec16, "underflow") > 0.0   # 1e-6 < f16 tiny
+    assert _field(vec16, "overflow") > 0.0    # 5e4 within a decade of max
+
+
+def test_tensor_stats_counts_survive_f32_scale():
+    """The non-finite count must stay exact PAST 2**24 elements: the
+    naive `size - sum(finite)` difference loses a small NaN count to
+    f32 ulp at production tensor sizes (review finding, PR 13)."""
+    n = (1 << 24) + 64  # past the f32 integer-exact range
+    x = np.ones(n, np.float32)
+    x[123] = np.nan
+    x[45678] = np.inf
+    x[n - 1] = -np.inf
+    vec = np.asarray(ops_num.tensor_stats(jnp.asarray(x)))
+    assert _field(vec, "nonfinite") == 3.0
+
+
+def test_finite_frac_never_rounds_up_to_one():
+    """1 NaN in 2M elements must NOT read as finite_frac == 1.0 (the
+    `min: 1.0` SLO rule and /healthz would pass with NaNs present)."""
+    assert obs_num.finite_frac(0.0, 0.0) is None
+    assert obs_num.finite_frac(0.0, 100.0) == 1.0
+    frac = obs_num.finite_frac(1.0, 2_000_000.0)
+    assert frac is not None and frac < 1.0
+    # through the rollup too: one poisoned element among millions still
+    # violates the shipped numerics-finite rule and flips health
+    states = {}
+    obs_num.ingest(states, {
+        "type": "numerics", "name": "head_out",
+        "rms": 1.0, "max_abs": 1.0, "nonfinite": 1.0,
+        "count": 2_000_000.0, "underflow": 0.0, "overflow": 0.0,
+    })
+    num = obs_num.rollup(states)
+    assert num["finite_frac"] < 1.0
+    assert num["worst_tag"] == "head_out"
+
+
+def test_merge_twins_agree_and_follow_reduce_law():
+    rng = np.random.default_rng(0)
+    a = np.abs(rng.standard_normal(ops_num.NSTATS)).astype(np.float32)
+    b = np.abs(rng.standard_normal(ops_num.NSTATS)).astype(np.float32)
+    dev = np.asarray(ops_num.merge_stat_vectors(a, b))
+    host = obs_num.merge_host(a, b)
+    np.testing.assert_array_equal(dev, host)
+    for i, kind in enumerate(ops_num.REDUCE_KINDS):
+        if kind == "max":
+            assert dev[i] == max(a[i], b[i])
+        elif kind == "sum":
+            assert dev[i] == np.float32(a[i] + b[i])
+        else:  # "last"
+            assert dev[i] == b[i]
+
+
+def test_merge_readback_stacked_and_list_forms_agree():
+    rng = np.random.default_rng(1)
+    vecs = np.abs(rng.standard_normal((3, ops_num.NSTATS))).astype(
+        np.float32
+    )
+    stacked = obs_num.merge_readback({"t": vecs})["t"]
+    listed = obs_num.merge_readback([{"t": v} for v in vecs])["t"]
+    np.testing.assert_array_equal(stacked, listed)
+    # and both equal a manual fold
+    manual = vecs[0]
+    for v in vecs[1:]:
+        manual = obs_num.merge_host(manual, v)
+    np.testing.assert_array_equal(stacked, manual)
+
+
+# ---------------------------------------------------------------------------
+# the probed model + train step (shared fixture: compiles once).
+# The four tests below compile two full train steps (~50 s on CPU), so
+# they are slow-marked: `scripts/numerics_smoke.sh` — the standalone
+# numerics gate — runs them on every invocation, and the bench
+# `numerics_overhead` cell re-pins the probe-off lowered-text identity
+# at the bench's own geometry. Tier-1 keeps every device-free pin in
+# this file plus the end-to-end probed-trainer smoke
+# (tests/test_numerics_smoke.py) inside the 870 s budget.
+
+
+@pytest.fixture(scope="module")
+def step_env():
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.training.optim import make_optimizer
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    b, L, seqn, hw = 2, 5, 3, 16
+    rng = np.random.default_rng(0)
+    batch = {
+        "inp": jnp.asarray(
+            rng.standard_normal((b, L, hw, hw, 2)), jnp.float32
+        ),
+        "gt": jnp.asarray(
+            rng.standard_normal((b, L, hw, hw, 2)), jnp.float32
+        ),
+    }
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    model_off = DeepRecurrNet(inch=2, basech=4, num_frame=seqn)
+    model_on = DeepRecurrNet(
+        inch=2, basech=4, num_frame=seqn, numerics=True
+    )
+    states = model_off.init_states(b, hw, hw)
+    variables = model_off.init(
+        jax.random.PRNGKey(0), batch["inp"][:, :seqn], states
+    )
+    params = {"params": variables["params"]}
+    state0 = TrainState.create(params, opt)
+    step_off = make_train_step(model_off, opt, seqn)
+    step_on = make_train_step(model_on, opt, seqn, numerics=True)
+    s_off, m_off = jax.jit(step_off)(state0, batch)
+    s_on, m_on = jax.jit(step_on)(state0, batch)
+    return dict(
+        b=b, L=L, seqn=seqn, hw=hw, batch=batch, opt=opt,
+        model_off=model_off, model_on=model_on, params=params,
+        state0=state0, step_off=step_off, step_on=step_on,
+        s_off=s_off, m_off=m_off, s_on=s_on, m_on=m_on,
+    )
+
+
+@pytest.mark.slow
+def test_probe_tags_cover_the_catalog(step_env):
+    tags = set(step_env["m_on"]["numerics"])
+    assert tags == set(obs_num.TAG_ORDER)
+
+
+@pytest.mark.slow
+def test_probes_are_pure_observers_bitwise(step_env):
+    """Probe-ON must not perturb training by even one ulp: params and
+    every scalar metric are bitwise-identical to the probe-off step."""
+    for a, b in zip(
+        jax.tree.leaves(step_env["s_off"].params),
+        jax.tree.leaves(step_env["s_on"].params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(step_env["m_off"]["loss"]) == float(
+        step_env["m_on"]["loss"]
+    )
+    assert float(step_env["m_off"]["grad_norm"]) == float(
+        step_env["m_on"]["grad_norm"]
+    )
+
+
+@pytest.mark.slow
+def test_probe_off_program_bitwise_identical_pin(step_env):
+    """numerics=False must neutralize the plane COMPLETELY: the lowered
+    program of a step built from the probe-armed model with the knob
+    flipped off equals the production probe-off program, byte for
+    byte."""
+    import dataclasses
+
+    from esr_tpu.training.train_step import make_train_step
+
+    model_off2 = dataclasses.replace(step_env["model_on"], numerics=False)
+    step_off2 = make_train_step(model_off2, step_env["opt"],
+                                step_env["seqn"])
+    t_prod = jax.jit(step_env["step_off"]).lower(
+        step_env["state0"], step_env["batch"]
+    ).as_text()
+    t_off2 = jax.jit(step_off2).lower(
+        step_env["state0"], step_env["batch"]
+    ).as_text()
+    assert t_prod == t_off2
+
+
+@pytest.mark.slow
+def test_scan_carry_accumulation_matches_per_window_reference(step_env):
+    """The in-scan accumulation (running max / sums in the BPTT carry)
+    must equal applying the model window-by-window on the host and
+    merging with the numpy twin."""
+    model = step_env["model_on"]
+    batch, seqn = step_env["batch"], step_env["seqn"]
+    L = step_env["L"]
+    states = model.init_states(
+        step_env["b"], step_env["hw"], step_env["hw"]
+    )
+    acc = None
+    for i in range(L - seqn + 1):
+        window = batch["inp"][:, i:i + seqn]
+        (_pred, states), mut = model.apply(
+            step_env["params"], window, states, train=True,
+            mutable=["numerics"],
+        )
+        per = {
+            t: np.asarray(v)
+            for t, v in ops_num.flatten_probes(
+                jax.device_get(mut["numerics"])
+            ).items()
+        }
+        acc = per if acc is None else {
+            t: obs_num.merge_host(acc[t], per[t]) for t in acc
+        }
+    got = step_env["m_on"]["numerics"]
+    for tag, ref in acc.items():
+        np.testing.assert_allclose(
+            np.asarray(got[tag]), ref, rtol=1e-5, atol=1e-6,
+            err_msg=tag,
+        )
+
+
+def test_multistep_stacks_and_host_merge_collapses():
+    """The K-step fusion stacks per-step numerics on a leading k axis
+    (plain lax.scan semantics) and the host merge collapses it under the
+    reduce law. Proven on a tiny synthetic step carrying real
+    tensor_stats vectors — the full-model composition is covered by the
+    numerics smoke (k_steps=2 production trainer)."""
+    from esr_tpu.training.multistep import make_multi_step
+
+    def tiny_step(state, batch):
+        x = batch["x"] * (state + 1.0)
+        metrics = {
+            "loss": x.sum(),
+            "numerics": {"tap": ops_num.tensor_stats(x)},
+        }
+        return state + 1.0, metrics
+
+    multi = make_multi_step(tiny_step, 3, reuse_batch=True)
+    _s, m = multi(
+        jnp.float32(0.0), {"x": jnp.arange(4, dtype=jnp.float32)}
+    )
+    stacked = np.asarray(m["numerics"]["tap"])
+    assert stacked.shape == (3, ops_num.NSTATS)
+    merged = obs_num.merge_readback({"tap": stacked})["tap"]
+    assert merged.shape == (ops_num.NSTATS,)
+    idx = ops_num.STAT_FIELDS.index
+    # counts SUM across the chained steps, extrema keep the running max,
+    # mean keeps the final step's value
+    assert merged[idx("count")] == stacked[:, idx("count")].sum() == 12.0
+    assert merged[idx("max_abs")] == stacked[:, idx("max_abs")].max()
+    assert merged[idx("mean")] == stacked[-1, idx("mean")]
+
+
+# ---------------------------------------------------------------------------
+# drift harness
+
+
+@pytest.mark.parametrize("break_tag,expect", [
+    (None, None),
+    ("enc1", "enc1"),
+])
+def test_drift_harness_fingers_seeded_bf16_breaking_layer(
+    break_tag, expect
+):
+    doc = obs_num.run_drift(
+        basech=4, hw=16, tolerance=0.25, break_tag=break_tag
+    )
+    assert doc["first_offender"] == expect
+    ladder_tags = [e["tag"] for e in doc["ladder"]]
+    assert ladder_tags == obs_num.order_tags(ladder_tags)
+    if break_tag is None:
+        # honest bf16 stays well under tolerance on every layer
+        assert all(e["rel_err"] < 0.25 for e in doc["ladder"])
+    else:
+        by_tag = {e["tag"]: e for e in doc["ladder"]}
+        assert by_tag["enc1"]["rel_err"] > 0.9
+        # upstream of the breaker stays clean — attribution is causal
+        assert by_tag["head_out"]["rel_err"] < 0.05
+        assert by_tag["enc0"]["rel_err"] < 0.05
+
+
+def test_drift_cli_subcommand_json_and_exit_codes(capsys):
+    from esr_tpu.obs.__main__ import main
+
+    code = main([
+        "drift", "--basech", "4", "--hw", "16",
+        "--break-tag", "enc2", "--fail-on-drift",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert code == 1
+    assert doc["first_offender"] == "enc2"
+    assert doc["dtype"] == "bfloat16"
+
+
+def test_drift_breaker_in_an_f32_resident_seam_is_honestly_clean():
+    """The breaker executes in the tensor's OWN compute dtype: the
+    decoder scales run f32 even in the bf16 twin (the upsample path
+    upcasts), so a breaker there cancels exactly in both twins and the
+    ladder stays clean — attribution reflects where reduced precision
+    actually reaches, not where the fixture was pointed."""
+    doc = obs_num.run_drift(basech=4, hw=16, break_tag="dec1")
+    assert doc["first_offender"] is None
+
+
+# ---------------------------------------------------------------------------
+# layer-named anomaly attribution
+
+
+def _vec(nonfinite=0.0, count=10.0):
+    v = np.zeros(ops_num.NSTATS, np.float32)
+    v[ops_num.STAT_FIELDS.index("nonfinite")] = nonfinite
+    v[ops_num.STAT_FIELDS.index("count")] = count
+    return v
+
+
+def test_first_offending_tag_walks_model_order():
+    num = {"dec2": _vec(3.0), "enc1": _vec(1.0), "tail_out": _vec(0.0)}
+    assert obs_num.first_offending_tag(num) == "enc1"
+    assert obs_num.first_offending_tag({"t": _vec(0.0)}) is None
+    assert obs_num.first_offending_tag(None) is None
+    assert obs_num.first_offending_tag({}) is None
+
+
+def test_poison_tag_marks_every_probed_element_nonfinite():
+    num = obs_num.poison_tag({"loss": _vec(0.0, count=3.0)}, "loss")
+    assert obs_num.first_offending_tag(num) == "loss"
+    assert _field(num["loss"], "nonfinite") == 3.0
+
+
+def test_anomaly_guard_skip_and_rollback_carry_bad_tag():
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.resilience.recovery import AnomalyGuard, RollbackSignal
+    import tempfile, os
+
+    guard = AnomalyGuard(max_bad_steps=1)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "t.jsonl")
+        sink = TelemetrySink(path)
+        prev = set_active_sink(sink)
+        try:
+            bad = {"gru_fwd": _vec(2.0), "loss": _vec(1.0)}
+            assert guard.check([float("nan")], 3, numerics=bad) is False
+            assert guard.last_bad_tag == "gru_fwd"
+            with pytest.raises(RollbackSignal) as exc:
+                guard.check([float("nan")], 4, numerics=bad)
+            assert exc.value.bad_tag == "gru_fwd"
+            assert "gru_fwd" in str(exc.value)
+        finally:
+            set_active_sink(prev)
+            sink.close()
+        recs = [json.loads(line) for line in open(path)]
+        skip = [r for r in recs if r.get("name") == "recovery_skip_step"]
+        assert skip and skip[0]["bad_tag"] == "gru_fwd"
+
+
+# ---------------------------------------------------------------------------
+# record type -> offline report / live snapshot / Prometheus / SLO
+
+
+def _emit_records(sink):
+    healthy = obs_num.stats_fields(
+        np.array([0.5, 2.0, 0.1, 0.0, 0.0, 0.0, 100.0], np.float32)
+    )
+    poisoned = obs_num.stats_fields(
+        np.array([0.5, 2.0, 0.1, 4.0, 0.01, 0.0, 100.0], np.float32)
+    )
+    sink.numerics("head_out", healthy, step=2)
+    sink.numerics("head_out", healthy, step=4)
+    sink.numerics("dcn_out", poisoned, step=4)
+
+
+def test_numerics_record_offline_report_and_slo_gate(tmp_path):
+    from esr_tpu.obs import TelemetrySink
+    from esr_tpu.obs.report import build_report, evaluate_slo, load_slo
+    from esr_tpu.obs.export import read_telemetry
+
+    path = str(tmp_path / "telemetry.jsonl")
+    sink = TelemetrySink(path)
+    _emit_records(sink)
+    sink.close()
+    _man, records, _torn = read_telemetry(path)
+    report = build_report(records)
+    num = report["numerics"]
+    assert num["records"] == 3
+    assert num["tags"]["head_out"]["finite_frac"] == 1.0
+    assert num["tags"]["head_out"]["count"] == 200.0
+    assert num["tags"]["dcn_out"]["nonfinite"] == 4.0
+    assert num["tags"]["dcn_out"]["finite_frac"] == pytest.approx(0.96)
+    assert num["worst_tag"] == "dcn_out"
+    assert num["finite_frac"] == pytest.approx(0.96)
+    # the shipped SLO rule gates on it (and a healthy run passes)
+    import os
+
+    slo = load_slo(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "slo.yml",
+    ))
+    rules = [r for r in slo["rules"] if r["metric"] == "numerics.finite_frac"]
+    assert rules and rules[0].get("allow_missing") is True
+    ok, verdicts = evaluate_slo(report, {"rules": rules})
+    assert ok is False  # 0.96 < 1.0 — the poisoned tag violates
+    clean = build_report([r for r in records
+                          if r.get("name") != "dcn_out"])
+    ok2, _ = evaluate_slo(clean, {"rules": rules})
+    assert ok2 is True
+
+
+def test_live_aggregator_snapshot_matches_offline_rollup(tmp_path):
+    """The v3 live/offline parity contract extended to value telemetry:
+    same records, same rollup section, exactly."""
+    from esr_tpu.obs import LiveAggregator, TelemetrySink
+    from esr_tpu.obs.report import build_report
+    from esr_tpu.obs.export import read_telemetry
+
+    path = str(tmp_path / "telemetry.jsonl")
+    sink = TelemetrySink(path)
+    agg = LiveAggregator().attach(sink)
+    _emit_records(sink)
+    sink.close()
+    _man, records, _ = read_telemetry(path)
+    offline = build_report(records)["numerics"]
+    live = agg.snapshot()["numerics"]
+    assert live == offline
+
+
+def test_prometheus_page_and_health_source(tmp_path):
+    from esr_tpu.obs import LiveAggregator, TelemetrySink
+    from esr_tpu.obs.http import render_prometheus
+    from esr_tpu.obs.numerics import numerics_health_source
+
+    sink = TelemetrySink(str(tmp_path / "t.jsonl"))
+    agg = LiveAggregator().attach(sink)
+    source = numerics_health_source(agg)
+    # no probes yet: healthy, no data
+    assert source()["healthy"] is True
+    _emit_records(sink)
+    sink.close()
+    page = render_prometheus(agg.snapshot())
+    assert "esr_numerics_finite_frac 0.96" in page
+    assert 'esr_numerics_nonfinite_total{tag="dcn_out"} 4.0' in page
+    assert 'esr_numerics_tag_max_abs{tag="head_out"} 2.0' in page
+    health = source()
+    assert health["healthy"] is False
+    assert health["worst_tag"] == "dcn_out"
+    assert health["finite_frac"] == pytest.approx(0.96)
