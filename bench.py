@@ -1236,6 +1236,43 @@ def stage_program_audit():
     return res
 
 
+CONCURRENCY_AUDIT_KEYS = (
+    "threads_modeled", "callback_entries", "locks", "lock_edges",
+    "shared_attrs", "findings_by_rule", "clean", "rules_version",
+)
+
+
+def stage_concurrency_audit():
+    """Host-concurrency contracts (ISSUE 14): the whole-program
+    thread/lock-discipline audit (``esr_tpu.analysis.concurrency``, CX
+    rule catalog) over the package — spawn sites, callback entries,
+    locks, acquisition edges, cross-domain shared attributes, and the
+    per-rule finding counts. Pure AST, jax-free, seconds-fast: runs (and
+    must stay CLEAN) in smoke, so the concurrent host surface is a
+    tracked bench series exactly like program_audit's jaxpr contracts."""
+    from esr_tpu.analysis.concurrency import (
+        audit_concurrency,
+        rules_signature,
+    )
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    audit = audit_concurrency(
+        [os.path.join(root, "esr_tpu")], relative_to=root
+    )
+    m = audit.model
+    res = dict(zip(CONCURRENCY_AUDIT_KEYS, (
+        m["threads_modeled"], m["callback_entries"], m["locks"],
+        m["lock_edges"], m["shared_attrs"], m["findings_by_rule"],
+        len(audit.findings) == 0, rules_signature(),
+    ), strict=True))
+    EXTRA["concurrency_audit"] = {
+        "clean": res["clean"],
+        "threads_modeled": res["threads_modeled"],
+        "shared_attrs": res["shared_attrs"],
+    }
+    return res
+
+
 def stage_scaling(ctx, batches=None):
     """Per-chip batch scaling curve (VERDICT r2: is the small MFU
     small-batch arithmetic intensity or a pipeline problem?).
@@ -2309,6 +2346,10 @@ STAGE_REGISTRY = [
     # (device-free make_jaxpr/lower over the production registry — runs
     # in smoke; the same audit `python -m esr_tpu.analysis --jaxpr` gates)
     ("program_audit", lambda ctx: stage_program_audit(), 600, True),
+    # host-concurrency contracts: the thread/lock-discipline audit over
+    # the package (pure AST, jax-free — runs and must stay clean in
+    # smoke); the concurrent host surface becomes a tracked series
+    ("concurrency_audit", lambda ctx: stage_concurrency_audit(), 300, True),
     # the live telemetry plane's cost trio: aggregator tap overhead,
     # sketch-vs-exact max relative error, endpoint poll p50 — host-bound
     # by design, runs in smoke (and BEFORE the loader-heavy stages so no

@@ -1,6 +1,6 @@
 """CLI: ``python -m esr_tpu.analysis [options] [paths]`` (= ``esr-analyze``).
 
-Two gates behind one exit code:
+Three gates behind one exit code:
 
 - the **AST lint** over ``paths`` (files/directories), against
   ``--baseline``;
@@ -8,11 +8,16 @@ Two gates behind one exit code:
   programs (``esr_tpu.analysis.programs``, or any module named by
   ``--jaxpr-registry`` that exposes ``PROGRAMS``), against
   ``--jaxpr-baseline``. This half imports jax and traces programs
-  device-free — still CPU/CI safe, just not import-free.
+  device-free — still CPU/CI safe, just not import-free;
+- the **host-concurrency audit** (``--threads``) — the whole-program
+  thread/lock-discipline pass (``esr_tpu.analysis.concurrency``, CX rule
+  catalog) over ``paths`` (default ``esr_tpu/`` when none are given),
+  against ``--threads-baseline``. Pure AST, jax-free, seconds-fast.
 
-``--rules`` subsets either gate by catalog: ESR names restrict the AST
-lint, JX names restrict the jaxpr audit; a gate whose subset is empty is
-skipped (with a note), and an unknown name is a usage error.
+``--rules`` subsets any gate by catalog: ESR names restrict the AST
+lint, JX names the jaxpr audit, CX names the concurrency audit; a gate
+whose subset is empty is skipped (with a note), and an unknown name is a
+usage error.
 
 Exit codes: 0 clean (no findings beyond the baselines), 1 new findings
 (or a baseline generated under a different rule set — regenerate it),
@@ -76,8 +81,8 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="LIST",
         default=None,
         help="comma-separated rule names to run (default: all) — ESR names "
-        "subset the AST lint, JX names the jaxpr audit, e.g. "
-        "ESR002,ESR006 or JX001",
+        "subset the AST lint, JX names the jaxpr audit, CX names the "
+        "concurrency audit, e.g. ESR002,ESR006 or JX001 or CX001,CX003",
     )
     p.add_argument(
         "--relative-to",
@@ -106,6 +111,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="module exposing PROGRAMS (a list of ProgramSpec) — the "
         "production registry by default; point it at a fixture module to "
         "audit seeded hazards",
+    )
+    p.add_argument(
+        "--threads",
+        action="store_true",
+        help="run the host-concurrency audit (thread/lock-discipline CX "
+        "rule catalog in docs/ANALYSIS.md) over the given paths (default "
+        "esr_tpu/ when no paths are given)",
+    )
+    p.add_argument(
+        "--threads-baseline",
+        metavar="FILE",
+        default="concurrency_baseline.json",
+        help="baseline for the concurrency audit "
+        "(default: concurrency_baseline.json)",
     )
     return p
 
@@ -259,39 +278,98 @@ def _run_jaxpr(args, rule_subset, json_out: dict) -> int:
     return code
 
 
+def _run_threads(args, rule_subset, json_out: dict) -> int:
+    """The host-concurrency half; returns an exit code."""
+    import os
+
+    from esr_tpu.analysis.concurrency import (
+        audit_concurrency,
+        rules_signature as cx_signature,
+    )
+
+    paths = args.paths or ["esr_tpu"]
+    if not args.paths and not os.path.isdir("esr_tpu"):
+        print(
+            "--threads with no paths expects to run from the repo root "
+            "(no esr_tpu/ here) — pass the tree to audit explicitly",
+            file=sys.stderr,
+        )
+        return 2
+    from esr_tpu.analysis.core import iter_python_files
+
+    if not iter_python_files(paths):
+        print(
+            f"no python files found under {paths} — refusing to report a "
+            "clean concurrency audit over nothing",
+            file=sys.stderr,
+        )
+        return 2
+    audit = audit_concurrency(
+        paths,
+        rules=sorted(rule_subset) if rule_subset is not None else None,
+        relative_to=args.relative_to,
+    )
+    model = audit.model
+    return _ratchet_report(
+        audit.findings,
+        baseline_path=args.threads_baseline,
+        signature=cx_signature(),
+        full_run=rule_subset is None,
+        args=args,
+        json_out=json_out,
+        json_key="threads",
+        label=(
+            f"concurrency audit: {model['threads_modeled']} spawn site(s), "
+            f"{model['locks']} lock(s), {model['shared_attrs']} shared "
+            "attr(s), "
+        ),
+        json_extra={"model": model, "rules_version": cx_signature()},
+    )
+
+
 def _partition_rules(args):
-    """``--rules`` names split by catalog: (ast_subset, jx_subset), either
-    None meaning "full set". Unknown names raise SystemExit-style code 2
-    via a (None, None, error) triple."""
+    """``--rules`` names split by catalog: (ast_subset, jx_subset,
+    cx_subset), each None meaning "full set". Unknown names report a
+    usage error via the trailing error slot."""
     if not args.rules:
-        return None, None, None
-    from esr_tpu.analysis.jaxpr_audit import JAXPR_RULES
+        return None, None, None, None
+    from esr_tpu.analysis.concurrency import CONCURRENCY_RULES
 
     wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
     known_ast = {r.name for r in all_rules()}
-    known_jx = set(JAXPR_RULES)
-    unknown = wanted - known_ast - known_jx
+    known_cx = set(CONCURRENCY_RULES)
+    # the jaxpr catalog needs jax to import; only pay that when a name
+    # could plausibly belong to it
+    if wanted - known_ast - known_cx:
+        from esr_tpu.analysis.jaxpr_audit import JAXPR_RULES
+
+        known_jx = set(JAXPR_RULES)
+    else:
+        known_jx = set()
+    unknown = wanted - known_ast - known_jx - known_cx
     if unknown:
-        return None, None, (
+        return None, None, None, (
             f"unknown rule(s): {sorted(unknown)}; known: "
-            f"{sorted(known_ast | known_jx)}"
+            f"{sorted(known_ast | known_jx | known_cx)}"
         )
-    return wanted & known_ast, wanted & known_jx, None
+    return (wanted & known_ast, wanted & known_jx, wanted & known_cx,
+            None)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
 
-    if not args.paths and not args.jaxpr:
+    if not args.paths and not args.jaxpr and not args.threads:
         print(
-            "nothing to do: give paths to lint and/or --jaxpr to audit "
-            "the production programs",
+            "nothing to do: give paths to lint, --jaxpr to audit the "
+            "production programs, and/or --threads for the concurrency "
+            "audit",
             file=sys.stderr,
         )
         return 2
 
-    ast_subset, jx_subset, err = _partition_rules(args)
+    ast_subset, jx_subset, cx_subset, err = _partition_rules(args)
     if err:
         print(err, file=sys.stderr)
         return 2
@@ -306,7 +384,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         else:
             codes.append(_run_ast(args, ast_subset, json_out))
-    if args.jaxpr and (not codes or codes[0] != 2):
+    if args.threads and (not codes or codes[0] != 2):
+        if cx_subset is not None and not cx_subset:
+            print(
+                "--rules names no concurrency (CX*) rule — skipping the "
+                "threads gate",
+                file=sys.stderr,
+            )
+        else:
+            codes.append(_run_threads(args, cx_subset, json_out))
+    if args.jaxpr and 2 not in codes:
         if jx_subset is not None and not jx_subset:
             print(
                 "--rules names no jaxpr (JX*) rule — skipping the jaxpr "

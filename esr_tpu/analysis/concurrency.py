@@ -1,0 +1,1371 @@
+"""Host-concurrency auditor: static thread/lock-discipline proofs (CX rules).
+
+The AST lint (``core``/``rules``) is file-local and single-threaded in its
+world view; the jaxpr auditor proves device-program contracts. Neither says
+anything about the repo's HOST thread model — and the repo now runs a real
+concurrent program: the ``DevicePrefetcher`` producer + stall watchdog, the
+``AsyncCheckpointer`` writer slot, the serving engine's dispatch/readback
+overlap, the live HTTP plane with its health-source callbacks, the
+``LiveAggregator`` observer tap on every emitting thread, and the
+``DeviceWatermark`` poller. The latent cross-thread bugs that surfaced at
+runtime (PR 12's fresh-lane reset leak, PR 13's donated ``_init_state``
+aliasing) all lived exactly in this plane. This module is the device-free
+gate that sees it *statically*, the way JX001 became the gate the precision
+ladder lands behind (docs/ANALYSIS.md "The thread model").
+
+It is a **whole-program** pass (all files analyzed together — spawn sites
+in one class, joins in another method, callbacks registered across the
+module), built in two layers:
+
+1. **model extraction** — per class (plus a per-module pseudo-class for
+   module-level functions and locks):
+
+   - *thread-spawn sites*: ``threading.Thread(target=...)`` constructions
+     (daemon flag, the name the handle is stored to) and
+     ``ThreadPoolExecutor`` constructions + ``.submit(fn, ...)`` hand-offs;
+   - *entry points*: spawn targets resolved to the actual function bodies
+     (``self._produce`` → the class method, bare names → module or nested
+     defs), and *callback entries* — methods handed to the live plane's
+     registration surfaces (``sink.add_observer(self.observe)``,
+     ``register_health_source(name, self.health)``) that run on a FOREIGN
+     thread (the emitting thread / the HTTP thread);
+   - *thread domains*: every method is assigned the set of execution
+     domains it can run under (``main``, one per spawn entry, one per
+     callback entry) by propagating entry labels through the same-class
+     call graph; a method reachable from both sides carries both labels;
+   - *shared-state sets*: every ``self.X`` read/write per method, each
+     stamped with the set of locks lexically held (``with self._lock:``
+     regions; container stores ``self._d[k] = v`` count as writes of
+     ``_d``). Private helpers called ONLY from inside lock regions inherit
+     those locks (the lock-held-through-helper-call case, computed to a
+     fixpoint over the call graph);
+   - *lock domains*: attributes (and module globals) assigned from
+     ``threading.Lock/RLock/Condition/...`` constructors, and the
+     **acquisition graph** — an edge L1→L2 whenever L2 is taken while L1
+     is held (lexically or inherited).
+
+2. **the CX rule family** over that model (catalog mirrored in
+   docs/ANALYSIS.md):
+
+   - CX001 unsynchronized cross-thread shared mutable attribute;
+   - CX002 lock-order inversion (a cycle in the acquisition graph);
+   - CX003 unbounded blocking call while holding a lock;
+   - CX004 thread/executor leak (no join/shutdown/daemon/hand-off path);
+   - CX005 spawned-thread entry emitting telemetry without
+     ``trace.capture()``/``adopt()`` (the PR 8 house rule, until now
+     enforced only by review);
+   - CX006 re-entrant observer/health-source callback (a registered
+     callback that emits back into the telemetry plane it observes).
+
+Findings reuse the existing :class:`~esr_tpu.analysis.core.Finding` /
+fingerprint / ``# esr: noqa(CX00x)`` / baseline-ratchet machinery; the
+committed ratchet is ``concurrency_baseline.json`` (empty — the repo ships
+CLEAN), stamped with :func:`rules_signature`. Stale pure-CX noqa lines are
+reported as ESR011 by THIS gate (the AST gate exempts foreign-catalog
+noqas — each catalog polices its own suppressions).
+
+Deliberate scope limits (under-approximation is the design bias — a rule
+must be quiet enough to gate CI):
+
+- the pass never imports the code it audits (pure AST, stdlib-only,
+  jax-free — seconds on the whole repo);
+- cross-CLASS data flow is out of scope: an object shared between two
+  classes is audited where its methods live, not across the hand-off;
+- bare ``lock.acquire()``/``release()`` pairs are not modeled as regions
+  (only ``with`` blocks are) — the prefetcher's bounded-acquire source
+  lock is documented at the site instead;
+- threads spawned by the stdlib internally (``ThreadingHTTPServer``
+  handler threads) are invisible; the surfaces they reach (the aggregator,
+  the health registry) are lock-protected and audited via their callback
+  entries;
+- a nested-def thread target inside a class method is walked as its own
+  pseudo-method carrying the thread domain (so an inline-closure spawn —
+  including one in ``__init__`` — still races against the rest of the
+  class); ``target=lambda: ...`` spawns stay unresolved.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from esr_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    _call_name,
+    _dotted,
+    iter_python_files,
+    pure_cx_noqa,
+)
+
+__all__ = [
+    "CONCURRENCY_RULES",
+    "rules_signature",
+    "extract_module_model",
+    "audit_concurrency",
+    "ConcurrencyAudit",
+]
+
+# rule name -> (severity, one-line summary); docs/ANALYSIS.md mirrors this
+# catalog. Version-stamped into concurrency_baseline.json so a rule upgrade
+# reports "regenerate the baseline" instead of mass-firing (core semantics).
+CONCURRENCY_RULES: Dict[str, Tuple[str, str]] = {
+    "CX001": ("warning",
+              "unsynchronized cross-thread shared mutable attribute"),
+    "CX002": ("error", "lock-order inversion (acquisition-graph cycle)"),
+    "CX003": ("warning", "unbounded blocking call while holding a lock"),
+    "CX004": ("warning", "thread/executor leak (no join/daemon/hand-off)"),
+    "CX005": ("warning", "thread entry emits telemetry without trace adopt"),
+    "CX006": ("error", "re-entrant observer/health-source callback"),
+}
+
+_HINTS: Dict[str, str] = {
+    "CX001": (
+        "an attribute written in one thread domain and touched in another "
+        "with no common lock is a data race the moment the GIL stops "
+        "saving you (and a stale-read bug even while it does). Guard both "
+        "sides with one lock, hand the value off through a Queue/Event, "
+        "make it write-once in __init__, or state the invariant that makes "
+        "the race benign and justify with `# esr: noqa(CX001)`"
+    ),
+    "CX002": (
+        "two locks taken in opposite orders on two code paths deadlock the "
+        "first time the paths interleave. Impose one global acquisition "
+        "order (document it at the lock definitions) or collapse to one "
+        "lock; `# esr: noqa(CX002)` only with the ordering proof"
+    ),
+    "CX003": (
+        "an unbounded wait (join/get/put/wait with no timeout, sleep, "
+        "file/socket IO, device sync) while holding a lock parks every "
+        "other thread that needs the lock behind an event that may never "
+        "come — the wedge the DevicePrefetcher watchdog exists to escape. "
+        "Move the blocking call outside the region, bound it with a "
+        "timeout, or state why the wait is bounded and justify with "
+        "`# esr: noqa(CX003)`"
+    ),
+    "CX004": (
+        "a started non-daemon thread nobody joins outlives the work that "
+        "spawned it and blocks interpreter exit; an executor nobody shuts "
+        "down leaks its workers. Join it on the teardown path (the "
+        "DevicePrefetcher close() pattern), make it daemonic ON PURPOSE "
+        "(it may be killed mid-write), use `with ThreadPoolExecutor(...)`, "
+        "or justify with `# esr: noqa(CX004)`"
+    ),
+    "CX005": (
+        "contextvars do not flow into threads: telemetry emitted from a "
+        "spawned thread without trace.adopt(captured_ctx) parks outside "
+        "the causal tree — the exporter draws it with no parent and trace "
+        "completeness breaks. Capture the submitter's context at spawn "
+        "and `with trace.adopt(ctx):` at the top of the target (the "
+        "DevicePrefetcher._produce / AsyncCheckpointer._commit house "
+        "pattern), or justify with `# esr: noqa(CX005)`"
+    ),
+    "CX006": (
+        "a sink observer / health source runs INSIDE the telemetry plane "
+        "it observes: emitting a record from it re-enters the observer "
+        "dispatch (unbounded recursion on the emitting thread), and "
+        "re-polling the registry from a source re-enters the poll. "
+        "Callbacks must be read-only over their own plane; stage the data "
+        "and emit from the owning loop, or justify with "
+        "`# esr: noqa(CX006)`"
+    ),
+}
+
+
+def rules_signature() -> str:
+    """Stable identity of the CX rule set, stamped into the baseline."""
+    return "cx:" + ",".join(sorted(CONCURRENCY_RULES))
+
+
+# ---------------------------------------------------------------------------
+# model extraction
+
+# constructors whose VALUE is itself a synchronization primitive — sharing
+# the attribute across threads is the point, so CX001 never fires on them
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "Barrier"}
+_HANDOFF_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                  "JoinableQueue", "Event"}
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+# registration surfaces whose callable argument runs on a FOREIGN thread
+# (the sink's emitting threads / the live plane's HTTP thread)
+_CALLBACK_REGISTRARS = {"add_observer", "register_health_source"}
+# telemetry-emitting attribute calls (the sink record kinds) + the
+# resilience emitter — the CX005/CX006 "emits telemetry" predicate
+_EMIT_METHODS = {"event", "counter", "gauge", "span", "metric",
+                 "numerics", "attribution"}
+_EMIT_CALLS = {"emit_recovery"}
+# calls that re-enter the observation plane itself (CX006)
+_REENTRANT_CALLS = {"health_snapshot"}
+_MAIN = "main"
+
+
+@dataclasses.dataclass
+class SpawnSite:
+    """One thread/executor construction (or submit hand-off)."""
+
+    kind: str                      # "thread" | "executor" | "submit"
+    node: ast.AST                  # the construction/submit call
+    owner: Optional[str]           # class name (None = module level)
+    method: Optional[str]          # enclosing method/function name
+    target: str                    # dotted target text ("" if dynamic)
+    resolved: Optional[ast.AST]    # the target's def node, when resolvable
+    daemon: Optional[bool]         # True/False literal, None = absent/dynamic
+    store: str                     # dotted name the handle is stored to
+
+
+@dataclasses.dataclass
+class Access:
+    """One ``self.X`` touch inside a method."""
+
+    attr: str
+    write: bool
+    node: ast.AST
+    method: str
+    locks: frozenset  # lock ids held (lexical + inherited)
+
+
+class ClassModel:
+    """The extracted thread model of one class (or module pseudo-class)."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self.methods: Dict[str, ast.AST] = {}
+        self.lock_attrs: Set[str] = set()
+        self.handoff_attrs: Set[str] = set()   # queues/events: CX001-exempt
+        self.file_attrs: Set[str] = set()      # open()-valued: CX003 IO
+        # Condition(lock) wrapping: cond attr -> wrapped lock attr, so a
+        # wait() on the condition also exempts the lock it releases
+        self.cond_wraps: Dict[str, str] = {}
+        self.init_written: Set[str] = set()
+        self.outside_written: Set[str] = set()
+        self.spawns: List[SpawnSite] = []
+        # entry method name -> domain label ("thread:<m>" / "callback:<m>")
+        self.entries: Dict[str, str] = {}
+        self.entry_nodes: Dict[str, ast.AST] = {}
+        # nested-def spawn targets (def node -> pseudo-method domain):
+        # their bodies are walked as pseudo-methods so a closure spawned
+        # from inside a method (or __init__) still creates a thread
+        # domain for CX001 instead of hiding in the enclosing method
+        self.nested_targets: Dict[ast.AST, str] = {}
+        self.pseudo_domains: Dict[str, Set[str]] = {}
+        # non-spawn nested defs ("deferred" closures — stored callbacks):
+        # their execution domain is statically unknowable, so their
+        # accesses get a pseudo-method assigned EVERY domain the class
+        # has (a stored closure's write must neither hide inside
+        # __init__'s write-once exemption nor dodge the race check)
+        self.deferred_methods: Set[str] = set()
+        self.calls: Dict[str, Set[str]] = {}
+        # per-method call sites: callee -> [frozenset(locks held at site)]
+        self.call_locks: Dict[str, Dict[str, List[frozenset]]] = {}
+        self.accesses: List[Access] = []
+        self.inherited: Dict[str, frozenset] = {}
+        self.domains: Dict[str, Set[str]] = {}
+        # acquisition edges (lock_id -> lock_id) with one witness node each
+        self.lock_edges: Dict[Tuple[str, str], ast.AST] = {}
+        # every `with <lock>` acquisition per method (for edge folding
+        # through inherited-lock helpers)
+        self.method_acquires: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        # every blocking-class call: (node, method, what, lexical locks,
+        # exempt lock) — judged AFTER lock inheritance so a helper called
+        # only under a lock still fires CX003 on its unbounded waits.
+        # exempt locks (a Condition receiver's lock id + any lock the
+        # Condition wraps) clear the call when among the EFFECTIVE held
+        # set: Condition.wait releases them, wherever the `with` is
+        self.blocking_calls: List[
+            Tuple[ast.AST, str, str, frozenset, Optional[frozenset]]
+        ] = []
+
+    # lock ids are qualified by FILE and owner so the global acquisition
+    # graph never aliases same-named locks across unrelated modules (two
+    # files both defining `self._lock` — or a conventional module `_LOCK`
+    # — must not merge into one node and report phantom inversions)
+    def lock_id(self, attr: str) -> str:
+        return f"{self.path}::{self.name}.{attr}"
+
+    def shared_attrs(self) -> Set[str]:
+        """Attributes touched from more than one domain (lock-protected or
+        not) — the modeled shared-state set."""
+        doms: Dict[str, Set[str]] = {}
+        for a in self.accesses:
+            if a.method == "__init__":
+                continue
+            doms.setdefault(a.attr, set()).update(
+                self.domains.get(a.method, {_MAIN})
+            )
+        return {k for k, v in doms.items() if len(v) > 1}
+
+
+def _literal_bool(node: Optional[ast.AST]) -> Optional[bool]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _self_attr(node: ast.AST, selfname: str = "self") -> Optional[str]:
+    """``self.X`` → ``"X"`` (first attribute level only)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == selfname):
+        return node.attr
+    return None
+
+
+class _MethodWalker:
+    """One pass over a method body: attribute accesses with the lock stack,
+    same-class call sites, acquisition edges, blocking-under-lock calls.
+
+    Nested function bodies are walked with a FRESH lock stack (their code
+    runs when called, not where defined — the producer's ``put`` closure
+    takes its own ``_put_lock``), but their accesses still attribute to
+    the enclosing method.
+    """
+
+    def __init__(self, model: ClassModel, method: str, is_module: bool,
+                 module_locks: Set[str], import_aliases: Dict[str, str]):
+        self.m = model
+        self.method = method
+        self.is_module = is_module
+        self.module_locks = module_locks
+        self.aliases = import_aliases
+
+    # -- lock resolution ---------------------------------------------------
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.m.lock_attrs:
+            return self.m.lock_id(attr)
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"{self.m.path}::<module>.{expr.id}"
+        return None
+
+    # -- the walk ----------------------------------------------------------
+
+    def walk(self, body: Sequence[ast.AST], locks: Tuple[str, ...] = ()):
+        for stmt in body:
+            self._visit(stmt, locks)
+
+    def _visit(self, node: ast.AST, locks: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            label = self.m.nested_targets.get(node)
+            if label is not None and not isinstance(node, ast.Lambda):
+                # a registered spawn target: walk it as a PSEUDO-METHOD
+                # carrying the thread domain, so its self.X accesses race
+                # against the rest of the class (and writes inside an
+                # __init__-spawned closure never count as init-only).
+                # Line-qualified: two same-named closures spawned from
+                # different methods are distinct thread domains
+                pseudo = f"<closure:{node.name}@{node.lineno}>"
+                self.m.pseudo_domains[pseudo] = {label}
+                sub = _MethodWalker(self.m, pseudo, self.is_module,
+                                    self.module_locks, self.aliases)
+                sub.walk(node.body, ())
+                return
+            # other nested defs: deferred closures. Fresh lock stack AND
+            # a pseudo-method of their own — execution is deferred to
+            # whoever calls the stored closure, so the accesses must not
+            # masquerade as the enclosing method's (an __init__ closure
+            # is NOT construction-time state)
+            if isinstance(node, ast.Lambda):
+                self.walk([node.body], ())
+                return
+            pseudo = f"<deferred:{self.method}>"
+            self.m.deferred_methods.add(pseudo)
+            sub = _MethodWalker(self.m, pseudo, self.is_module,
+                                self.module_locks, self.aliases)
+            sub.walk(node.body, ())
+            return
+        if isinstance(node, ast.ClassDef):
+            return  # nested classes (the HTTP Handler) are out of scope
+        if isinstance(node, ast.Match):
+            # match cases are suites like any other compound statement —
+            # falling through to the expression walk would strip `with
+            # self._lock:` regions inside a case from the lock model
+            self._visit_expr(node.subject, locks)
+            for case in node.cases:
+                if case.guard is not None:
+                    self._visit_expr(case.guard, locks)
+                self.walk(case.body, locks)
+            return
+        if isinstance(node, ast.With):
+            taken = []
+            for item in node.items:
+                ctx = item.context_expr
+                lock = self._lock_of(ctx)
+                if lock is not None:
+                    # earlier items of the SAME statement are already
+                    # held: `with self._a, self._b:` is an _a -> _b edge
+                    for held in locks + tuple(taken):
+                        if held != lock:
+                            self.m.lock_edges.setdefault(
+                                (held, lock), node
+                            )
+                    self.m.method_acquires.setdefault(
+                        self.method, []
+                    ).append((lock, node))
+                    taken.append(lock)
+                else:
+                    # later items evaluate with the earlier items' locks
+                    # already held: `with self._lock, open(p) as f:` IS
+                    # file IO under the lock
+                    self._visit_expr(ctx, locks + tuple(taken))
+            self.walk(node.body, locks + tuple(taken))
+            return
+        # compound STATEMENTS keep the current stack for their bodies; the
+        # statement's own expressions (a loop's iter/test, an If's test)
+        # are visited under the same stack. The isinstance guard matters:
+        # expressions also carry `body` fields (IfExp, comprehensions)
+        # whose values are single nodes, not suites — iterating those
+        # would crash the gate on any `a if c else b` lambda body
+        if isinstance(node, ast.stmt) and any(
+                isinstance(getattr(node, f, None), list)
+                and getattr(node, f) for f in
+                ("body", "orelse", "finalbody", "handlers")):
+            self._visit_own_exprs(node, locks)
+            for f in ("body", "orelse", "finalbody"):
+                sub = getattr(node, f, None)
+                if sub:
+                    self.walk(sub, locks)
+            for h in getattr(node, "handlers", None) or ():
+                self.walk(h.body, locks)
+            return
+        self._visit_expr(node, locks)
+
+    def _visit_own_exprs(self, node: ast.AST, locks: Tuple[str, ...]):
+        """The non-body expressions of a compound statement visited under
+        the same stack."""
+        for field, value in ast.iter_fields(node):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.expr):
+                self._visit_expr(value, locks)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._visit_expr(v, locks)
+
+    def _visit_expr(self, node: ast.AST, locks: Tuple[str, ...]):
+        held = frozenset(locks)
+        # manual traversal (not ast.walk): nested def/lambda subtrees are
+        # PRUNED after their fresh-stack walk — ast.walk would descend
+        # into them a second time under the held stack, falsely stamping
+        # a deferred lambda's body with locks it never runs under (and
+        # double-counting its accesses)
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # already handled at statement level
+            if isinstance(sub, ast.Lambda):
+                self.walk([sub.body], ())
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if isinstance(sub, ast.Attribute):
+                attr = _self_attr(sub)
+                if attr is not None and not self.is_module:
+                    write = isinstance(sub.ctx, (ast.Store, ast.Del))
+                    self._record(attr, write, sub, held)
+            elif isinstance(sub, ast.Subscript):
+                # container mutation through the attr: self._d[k] = v
+                if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    attr = _self_attr(sub.value)
+                    if attr is not None and not self.is_module:
+                        self._record(attr, True, sub, held)
+            elif isinstance(sub, ast.Call):
+                self._visit_call(sub, held)
+
+    def _record(self, attr: str, write: bool, node: ast.AST,
+                held: frozenset):
+        self.m.accesses.append(Access(attr, write, node, self.method, held))
+        if write:
+            if self.method == "__init__":
+                self.m.init_written.add(attr)
+            else:
+                self.m.outside_written.add(attr)
+
+    # -- calls -------------------------------------------------------------
+
+    def _resolved_dotted(self, func: ast.AST) -> str:
+        dotted = _dotted(func)
+        if not dotted:
+            return ""
+        head, _, rest = dotted.partition(".")
+        if head in self.aliases:
+            return self.aliases[head] + (f".{rest}" if rest else "")
+        return dotted
+
+    def _visit_call(self, node: ast.Call, held: frozenset):
+        func = node.func
+        # same-class method call: the call-graph edge + the locks held at
+        # this site (the lock-held-through-helper-call inheritance input)
+        callee = None
+        if isinstance(func, ast.Attribute):
+            callee = _self_attr(func)
+        elif self.is_module and isinstance(func, ast.Name):
+            callee = func.id
+        if callee is not None and callee in self.m.methods:
+            self.m.calls.setdefault(self.method, set()).add(callee)
+            self.m.call_locks.setdefault(self.method, {}).setdefault(
+                callee, []
+            ).append(held)
+        kind = self._blocking_kind(node)
+        if kind is not None:
+            what, exempt = kind
+            self.m.blocking_calls.append(
+                (node, self.method, what, held, exempt)
+            )
+
+    def _blocking_kind(
+        self, node: ast.Call
+    ) -> Optional[Tuple[str, Optional[frozenset]]]:
+        """``(description, exempt_locks)`` for an unbounded-blocking
+        call, or None (CX003). ``exempt_locks`` is set for zero-arg
+        ``.wait()`` on a lock-valued receiver (a Condition, plus any
+        lock it wraps): holding THOSE does not park others — wait
+        releases them."""
+        func = node.func
+        kw = {k.arg for k in node.keywords}
+        dotted = self._resolved_dotted(func)
+        if dotted == "time.sleep":
+            return "`time.sleep(...)`", None
+        if dotted.split(".")[0] in ("socket", "urllib", "requests"):
+            return f"network call `{dotted}(...)`", None
+        if dotted in ("subprocess.run", "subprocess.check_call",
+                      "subprocess.check_output", "subprocess.call"):
+            return f"`{dotted}(...)`", None
+        if dotted in ("jax.device_get", "device_get"):
+            return "`jax.device_get(...)` (device sync)", None
+        if isinstance(func, ast.Name) and func.id == "open":
+            return "`open(...)` (file IO)", None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        if attr == "block_until_ready":
+            return "`.block_until_ready()` (device sync)", None
+        # zero-arg join/wait: an infinite wait by definition (a string
+        # `",".join(parts)` always has an argument, so it never matches).
+        # A wait on a lock-valued receiver (a Condition) is exempt when
+        # that lock is among the EFFECTIVE held set at check time —
+        # Condition.wait releases it, so nobody else is parked; the
+        # exemption must survive lock inheritance (a helper whose `with
+        # self._cond:` lives in its caller), hence decided in the checker
+        if attr in ("join", "wait") and not node.args and "timeout" not in kw:
+            exempt = (self._lock_of(func.value) if attr == "wait"
+                      else None)
+            if exempt is not None:
+                recv = _self_attr(func.value)
+                wrapped = (self.m.cond_wraps.get(recv)
+                           if recv is not None else None)
+                if wrapped is not None and wrapped in self.m.lock_attrs:
+                    # Condition(lock): wait releases the wrapped lock
+                    exempt = frozenset(
+                        {exempt, self.m.lock_id(wrapped)}
+                    )
+                else:
+                    exempt = frozenset({exempt})
+            return f"timeout-less `.{attr}()`", exempt
+        # queue get/put on a known hand-off attr without a bound
+        recv = _self_attr(func.value)
+        if (recv is not None and recv in self.m.handoff_attrs
+                and attr in ("get", "put")):
+            pos = node.args[1:] if attr == "put" else list(node.args)
+            if "timeout" in kw or len(pos) >= 2:
+                return None
+            block = next(
+                (k.value for k in node.keywords if k.arg == "block"),
+                pos[0] if pos else None,
+            )
+            if isinstance(block, ast.Constant) and block.value is False:
+                return None
+            return f"unbounded `self.{recv}.{attr}(...)`", None
+        # file IO on an open()-valued attr
+        if (recv is not None and recv in self.m.file_attrs
+                and attr in ("write", "read", "readline", "readlines",
+                             "flush")):
+            return f"file IO `self.{recv}.{attr}(...)`", None
+        return None
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """Classify an assigned value: "lock" | "handoff" | "file" | None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _call_name(value.func)
+    if name in _LOCK_CTORS:
+        return "lock"
+    if name in _HANDOFF_CTORS:
+        return "handoff"
+    if name == "open":
+        return "file"
+    return None
+
+
+def _collect_attr_kinds(model: ClassModel, tree: ast.AST) -> None:
+    """``self.X = threading.Lock()`` / ``queue.Queue()`` / ``open(...)``
+    anywhere in the class body, plus capture()-style immutable hand-offs
+    stay out of CX001 via the init-only write rule instead."""
+    for node in ast.walk(tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        kind = _ctor_kind(node.value)
+        if kind is None:
+            continue
+        # the documented Condition(lock) constructor form: wait() on the
+        # condition releases the WRAPPED lock too
+        wrapped = None
+        if (isinstance(node.value, ast.Call)
+                and _call_name(node.value.func) == "Condition"
+                and node.value.args):
+            wrapped = _self_attr(node.value.args[0])
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            {"lock": model.lock_attrs, "handoff": model.handoff_attrs,
+             "file": model.file_attrs}[kind].add(attr)
+            if wrapped is not None:
+                model.cond_wraps[attr] = wrapped
+
+
+def _module_locks(tree: ast.AST) -> Set[str]:
+    """Module-level names assigned from a lock constructor."""
+    out: Set[str] = set()
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if _ctor_kind(node.value) == "lock":
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _nested_defs(fn: ast.AST) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for sub in ast.walk(fn):
+        if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(sub.name, sub)
+    return out
+
+
+def _walk_excluding_classes(root: ast.AST):
+    """``ast.walk`` that never descends into (nested) class bodies —
+    those are modeled by their own ClassModel."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            stack.append(child)
+
+
+def _collect_spawns(model: ClassModel, tree: ast.AST, ctx: ModuleContext,
+                    module_defs: Dict[str, ast.AST]) -> None:
+    """Thread/executor constructions, submit hand-offs, and callback
+    registrations inside ``tree`` (one class body or the module level)."""
+    for node in _walk_excluding_classes(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        enclosing = ctx.enclosing_function(node)
+        method = getattr(enclosing, "name", None)
+        if name == "Thread":
+            target = next(
+                (k.value for k in node.keywords if k.arg == "target"), None
+            )
+            daemon = next(
+                (k.value for k in node.keywords if k.arg == "daemon"), None
+            )
+            tdotted = _dotted(target) if target is not None else ""
+            resolved = _resolve_target(
+                target, model, module_defs, enclosing
+            )
+            model.spawns.append(SpawnSite(
+                kind="thread", node=node, owner=_owner(model), method=method,
+                target=tdotted, resolved=resolved,
+                daemon=_literal_bool(daemon), store=_store_of(ctx, node),
+            ))
+            ent = _entry_method(target, model)
+            if ent is not None:
+                model.entries.setdefault(ent, f"thread:{ent}")
+            if resolved is not None and ent is None:
+                # nested/module def target: keep the node for CX005, and
+                # register nested defs for the pseudo-method walk
+                # (CX001). Keys carry the def's line so two same-named
+                # closures in different methods stay distinct domains
+                # (and both get their CX005 check)
+                name = (f"{tdotted}@{resolved.lineno}" if tdotted
+                        else f"<target@{node.lineno}>")
+                model.entry_nodes.setdefault(name, resolved)
+                if isinstance(
+                        resolved, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and resolved.name not in model.methods:
+                    model.nested_targets.setdefault(
+                        resolved, f"thread:{name}"
+                    )
+        elif name in _EXECUTOR_CTORS:
+            model.spawns.append(SpawnSite(
+                kind="executor", node=node, owner=_owner(model),
+                method=method, target="", resolved=None, daemon=None,
+                store=_store_of(ctx, node),
+            ))
+        elif name == "submit" and node.args:
+            fn_arg = node.args[0]
+            ent = _entry_method(fn_arg, model)
+            if ent is not None:
+                model.entries.setdefault(ent, f"thread:{ent}")
+            else:
+                resolved = _resolve_target(
+                    fn_arg, model, module_defs, enclosing
+                )
+                if resolved is not None:
+                    model.entry_nodes.setdefault(
+                        _dotted(fn_arg) or f"<submit@{node.lineno}>",
+                        resolved,
+                    )
+        elif name in _CALLBACK_REGISTRARS:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                ent = _entry_method(arg, model)
+                if ent is not None:
+                    model.entries.setdefault(ent, f"callback:{ent}")
+
+
+def _owner(model: ClassModel) -> Optional[str]:
+    return None if model.name == "<module>" else model.name
+
+
+def _entry_method(target: Optional[ast.AST],
+                  model: ClassModel) -> Optional[str]:
+    """``self.m`` (class) / bare module function name → the method name
+    when it is one of this model's methods."""
+    if target is None:
+        return None
+    attr = _self_attr(target)
+    if attr is not None and attr in model.methods:
+        return attr
+    if (model.name == "<module>" and isinstance(target, ast.Name)
+            and target.id in model.methods):
+        return target.id
+    return None
+
+
+def _resolve_target(target: Optional[ast.AST], model: ClassModel,
+                    module_defs: Dict[str, ast.AST],
+                    enclosing: Optional[ast.AST]) -> Optional[ast.AST]:
+    ent = _entry_method(target, model)
+    if ent is not None:
+        return model.methods[ent]
+    if isinstance(target, ast.Name):
+        if enclosing is not None:
+            nested = _nested_defs(enclosing)
+            if target.id in nested:
+                return nested[target.id]
+        return module_defs.get(target.id)
+    return None
+
+
+def _store_of(ctx: ModuleContext, node: ast.AST) -> str:
+    """The dotted name a constructed handle is stored to (via the parent
+    Assign), or "" for fire-and-forget constructions."""
+    parent = ctx.parents.get(node)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        return _dotted(parent.targets[0])
+    if isinstance(parent, ast.AnnAssign):
+        return _dotted(parent.target)
+    return ""
+
+
+def _propagate_domains(model: ClassModel) -> None:
+    """Entry labels flow through the same-class call graph; methods not
+    reachable from any entry (or with no in-class callers and no entry
+    role) seed the main domain. A method reachable both ways carries both
+    labels — its accesses race with themselves across domains."""
+    callers: Dict[str, Set[str]] = {}
+    for src, dsts in model.calls.items():
+        for d in dsts:
+            callers.setdefault(d, set()).add(src)
+    domains: Dict[str, Set[str]] = {m: set() for m in model.methods}
+    # pseudo-methods participate in the fixpoint as CALLERS: a helper
+    # called only from a spawned closure must inherit the closure's
+    # thread label, not default to main (filled by the walkers, which
+    # run before this)
+    for pseudo, labels in model.pseudo_domains.items():
+        domains[pseudo] = set(labels)
+    all_doms = {_MAIN} | set(model.entries.values()) | {
+        lab for labs in model.pseudo_domains.values() for lab in labs
+    }
+    for pseudo in model.deferred_methods:
+        # a stored closure could run under ANY of the class's domains
+        domains[pseudo] = set(all_doms)
+    for m in model.methods:
+        if m in model.entries:
+            domains[m].add(model.entries[m])
+        elif not callers.get(m):
+            domains[m].add(_MAIN)
+    changed = True
+    while changed:
+        changed = False
+        for src, dsts in model.calls.items():
+            for d in dsts:
+                # entries accumulate caller domains too: a spawn target
+                # ALSO invoked synchronously from main-thread code runs
+                # under both and must carry both labels (spawn-site
+                # REFERENCES like Thread(target=self._produce) are not
+                # calls, so pure entries never gain main this way)
+                before = len(domains[d])
+                domains[d] |= domains.get(src, set())
+                changed = changed or len(domains[d]) != before
+    for m, doms in domains.items():
+        if not doms:
+            doms.add(_MAIN)
+    model.domains = domains
+
+
+def _inherit_locks(model: ClassModel) -> None:
+    """Private helpers called ONLY under a lock inherit it (fixpoint):
+    ``inherited[m] = ∩ over in-class call sites (locks at site ∪
+    inherited[caller])`` for underscore-private methods with at least one
+    in-class call site. Public methods never inherit (they are callable
+    from anywhere without the lock)."""
+    inherited: Dict[str, frozenset] = {m: frozenset() for m in model.methods}
+    for _ in range(len(model.methods) + 1):
+        changed = False
+        for m in model.methods:
+            if not m.startswith("_") or m.startswith("__"):
+                continue
+            if m in model.entries:
+                # an entry's body ALSO runs on the spawned/callback
+                # thread, where no caller holds anything — inheriting
+                # from its synchronous call sites would stamp the
+                # lock-free thread path as protected and mask real races
+                continue
+            sites: List[frozenset] = []
+            for caller, callees in model.call_locks.items():
+                for held in callees.get(m, []):
+                    # pseudo-method callers (deferred closures) inherit
+                    # nothing themselves
+                    sites.append(held | inherited.get(caller, frozenset()))
+            if not sites:
+                continue
+            new = frozenset.intersection(*sites)
+            if new != inherited[m]:
+                inherited[m] = new
+                changed = True
+        if not changed:
+            break
+    model.inherited = inherited
+    # fold inherited locks into the recorded accesses; a helper's own
+    # `with` acquisitions gain the inherited locks as graph predecessors
+    # (the caller held them when the helper took its own)
+    for a in model.accesses:
+        inh = inherited.get(a.method, frozenset())
+        if inh:
+            a.locks = a.locks | inh
+    for m, inh in inherited.items():
+        if not inh:
+            continue
+        for lock, node in model.method_acquires.get(m, ()):
+            for held in inh:
+                if held != lock:
+                    model.lock_edges.setdefault((held, lock), node)
+
+
+def extract_module_model(ctx: ModuleContext) -> List[ClassModel]:
+    """All class models (plus the module pseudo-class) of one file."""
+    models: List[ClassModel] = []
+    module_defs: Dict[str, ast.AST] = {}
+    module_lock_names = _module_locks(ctx.tree)
+    aliases = _import_aliases(ctx.tree)
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_defs[node.name] = node
+
+    # the module pseudo-class: module functions + module locks
+    mod_model = ClassModel("<module>", ctx.path)
+    mod_model.methods = dict(module_defs)
+    _collect_spawns(mod_model, ctx.tree, ctx, module_defs)
+    for fname, fn in module_defs.items():
+        walker = _MethodWalker(mod_model, fname, True, module_lock_names,
+                               aliases)
+        walker.walk(fn.body)
+    _propagate_domains(mod_model)
+    _inherit_locks(mod_model)
+    models.append(mod_model)
+
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        model = ClassModel(node.name, ctx.path)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.methods[item.name] = item
+        _collect_attr_kinds(model, node)
+        _collect_spawns(model, node, ctx, module_defs)
+        for mname, fn in model.methods.items():
+            walker = _MethodWalker(model, mname, False, module_lock_names,
+                                   aliases)
+            walker.walk(fn.body)
+        _propagate_domains(model)
+        _inherit_locks(model)
+        models.append(model)
+    return models
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+# ---------------------------------------------------------------------------
+# the CX rules
+
+
+def _mk_finding(rule: str, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+    severity, _ = CONCURRENCY_RULES[rule]
+    line = getattr(node, "lineno", 1)
+    return Finding(
+        rule=rule, path=ctx.path, line=line,
+        col=getattr(node, "col_offset", 0) + 1,
+        severity=severity, message=message, hint=_HINTS[rule],
+        code=ctx.source_line(line),
+    )
+
+
+def _check_cx001(model: ClassModel, ctx: ModuleContext) -> Iterable[Finding]:
+    """Unsynchronized cross-thread shared mutable attribute."""
+    if model.name == "<module>" or not (model.entries
+                                        or model.nested_targets):
+        return
+    by_attr: Dict[str, List[Access]] = {}
+    for a in model.accesses:
+        if a.method == "__init__":
+            continue
+        if a.attr in model.lock_attrs or a.attr in model.handoff_attrs:
+            continue
+        # write-once-in-__init__ hand-off: immutable after construction
+        if (a.attr in model.init_written
+                and a.attr not in model.outside_written):
+            continue
+        by_attr.setdefault(a.attr, []).append(a)
+    for attr in sorted(by_attr):
+        accesses = by_attr[attr]
+        writes = [a for a in accesses if a.write]
+        if not writes:
+            continue
+        # one finding per distinct ANCHOR LINE (not one per attribute):
+        # suppression is per line, so a noqa on one witness must not
+        # silence a different unsynchronized access to the same
+        # attribute elsewhere — every unprotected site gets its own
+        # suppressible finding
+        seen_lines: Set[int] = set()
+        for w in writes:
+            wd = model.domains.get(w.method, {_MAIN})
+            for t in accesses:
+                td = model.domains.get(t.method, {_MAIN})
+                # cross-domain: the write's and the touch's domain sets
+                # differ, OR one method runs under several domains (its
+                # unlocked access races with itself across them)
+                if wd == td and len(wd) < 2:
+                    continue
+                if w.locks & t.locks:
+                    continue
+                # anchor the unprotected side; prefer the write
+                anchor = w if not w.locks else (
+                    t if not t.locks else w
+                )
+                line = getattr(anchor.node, "lineno", 1)
+                if line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                wdoms = "/".join(sorted(wd))
+                tdoms = "/".join(sorted(td))
+                yield _mk_finding(
+                    "CX001", ctx, anchor.node,
+                    f"`self.{attr}` of `{model.name}` is written in "
+                    f"`{w.method}` [{wdoms}] and "
+                    f"{'written' if t.write else 'read'} in "
+                    f"`{t.method}` [{tdoms}] with no common lock — an "
+                    "unsynchronized cross-thread shared mutable "
+                    "attribute",
+                )
+
+
+def _check_cx002(models: Sequence[Tuple[ClassModel, ModuleContext]],
+                 ) -> Iterable[Finding]:
+    """Lock-order inversion: a cycle in the global acquisition graph."""
+    edges: Dict[str, Set[str]] = {}
+    witness: Dict[Tuple[str, str], Tuple[ast.AST, ModuleContext]] = {}
+    for model, ctx in models:
+        for (l1, l2), node in model.lock_edges.items():
+            edges.setdefault(l1, set()).add(l2)
+            witness.setdefault((l1, l2), (node, ctx))
+    # DFS cycle detection with path recovery
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    reported: Set[frozenset] = set()
+
+    def dfs(n: str, path: List[str]):
+        color[n] = GRAY
+        path.append(n)
+        for nxt in sorted(edges.get(n, ())):
+            if color.get(nxt, WHITE) == GRAY:
+                cyc = path[path.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in reported:
+                    reported.add(key)
+                    node, ctx = witness[(n, nxt)]
+                    yield _mk_finding(
+                        "CX002", ctx, node,
+                        "lock-order inversion: the acquisition graph has "
+                        f"the cycle {' -> '.join(cyc)} — two paths take "
+                        "these locks in opposite orders and deadlock when "
+                        "they interleave",
+                    )
+            elif color.get(nxt, WHITE) == WHITE:
+                yield from dfs(nxt, path)
+        path.pop()
+        color[n] = BLACK
+
+    for n in sorted(edges):
+        if color.get(n, WHITE) == WHITE:
+            yield from dfs(n, [])
+
+
+def _check_cx003(model: ClassModel, ctx: ModuleContext) -> Iterable[Finding]:
+    for node, method, what, lexical, exempt in model.blocking_calls:
+        held = lexical | model.inherited.get(method, frozenset())
+        if exempt is not None:
+            # Condition.wait() on a held lock (or the lock a
+            # Condition(lock) wraps): wait RELEASES it
+            held = held - exempt
+        if not held:
+            continue
+        # display the local lock name; the path-qualified id is graph
+        # identity, not reader information (the finding names the file)
+        lock = sorted(held)[0].split("::", 1)[-1]
+        yield _mk_finding(
+            "CX003", ctx, node,
+            f"{what} while holding `{lock}` — every thread contending "
+            "for the lock is parked behind an unbounded (or IO-bound) "
+            "wait",
+        )
+
+
+def _teardown_call(store: str, method: str, tree: ast.AST) -> bool:
+    """Does the module ever CALL ``<store>.<method>(...)``? AST-based
+    like every other predicate here — a docstring or comment mentioning
+    ``self._thread.join()`` must not count as teardown evidence."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == method
+                and _dotted(node.func.value) == store):
+            return True
+    return False
+
+
+def _check_cx004(model: ClassModel, ctx: ModuleContext) -> Iterable[Finding]:
+    for site in model.spawns:
+        if site.kind == "thread":
+            if site.daemon is True:
+                continue
+            store = site.store
+            if store and _teardown_call(store, "join", ctx.tree):
+                continue
+            # factory hand-off: the enclosing function returns the handle
+            enclosing = ctx.enclosing_function(site.node)
+            if store and enclosing is not None and any(
+                isinstance(r, ast.Return) and _dotted(r.value or
+                                                      ast.Name(id="")) ==
+                store
+                for r in ast.walk(enclosing)
+            ):
+                continue
+            yield _mk_finding(
+                "CX004", ctx, site.node,
+                f"`threading.Thread(target={site.target or '...'})` is "
+                "neither daemonic nor joined anywhere in this module — a "
+                "leaked thread blocks interpreter exit (or outlives its "
+                "work silently)",
+            )
+        elif site.kind == "executor":
+            parent = ctx.parents.get(site.node)
+            # `with ThreadPoolExecutor(...) as pool:` — withitem parent
+            if isinstance(parent, ast.withitem):
+                continue
+            store = site.store
+            if store and _teardown_call(store, "shutdown", ctx.tree):
+                continue
+            yield _mk_finding(
+                "CX004", ctx, site.node,
+                "executor constructed outside a `with` block and never "
+                "`.shutdown(...)` in this module — its worker threads leak",
+            )
+
+
+def _closure_defs(model: ClassModel, entry: str) -> List[ast.AST]:
+    """The entry method plus every same-class method transitively
+    reachable from it (the code that runs on the spawned thread)."""
+    seen = {entry}
+    frontier = [entry]
+    while frontier:
+        m = frontier.pop()
+        for callee in model.calls.get(m, ()):
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return [model.methods[m] for m in sorted(seen) if m in model.methods]
+
+
+def _emitting_call(node: ast.AST) -> Optional[ast.Call]:
+    """The first telemetry-emitting call in a subtree, or None."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute) and func.attr in _EMIT_METHODS:
+            return sub
+        if _call_name(func) in _EMIT_CALLS:
+            return sub
+    return None
+
+
+def _adopts_trace(fn: ast.AST) -> bool:
+    """Does the entry function wrap its body in ``trace.adopt(...)`` (or
+    call ``adopt`` at all — the house pattern puts it first)?"""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and _call_name(sub.func) == "adopt":
+            return True
+    return False
+
+
+def _check_cx005(model: ClassModel, ctx: ModuleContext) -> Iterable[Finding]:
+    checked: List[Tuple[str, ast.AST, List[ast.AST]]] = []
+    for m, label in model.entries.items():
+        if label.startswith("thread:"):
+            checked.append((m, model.methods[m], _closure_defs(model, m)))
+    for name, fn in model.entry_nodes.items():
+        checked.append((name, fn, [fn]))
+    for name, entry_fn, closure in checked:
+        if _adopts_trace(entry_fn):
+            continue
+        for fn in closure:
+            call = _emitting_call(fn)
+            if call is not None:
+                yield _mk_finding(
+                    "CX005", ctx, call,
+                    f"thread entry `{name}` (reached via "
+                    f"`{getattr(fn, 'name', name)}`) emits telemetry "
+                    "without adopting the submitter's trace context — the "
+                    "records park outside the causal tree "
+                    "(capture()/adopt(), the PR 8 house rule)",
+                )
+                break
+
+
+def _check_cx006(model: ClassModel, ctx: ModuleContext) -> Iterable[Finding]:
+    for m, label in model.entries.items():
+        if not label.startswith("callback:"):
+            continue
+        for fn in _closure_defs(model, m):
+            call = _emitting_call(fn)
+            kind = "emits a telemetry record"
+            if call is None:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call) and _call_name(
+                            sub.func) in _REENTRANT_CALLS:
+                        call = sub
+                        kind = "re-polls the health registry"
+                        break
+            if call is not None:
+                yield _mk_finding(
+                    "CX006", ctx, call,
+                    f"registered callback `{model.name}.{m}` {kind} from "
+                    "inside the plane observing it — observer dispatch "
+                    "re-enters itself (unbounded recursion on the "
+                    "emitting thread)",
+                )
+                break
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+@dataclasses.dataclass
+class ConcurrencyAudit:
+    """One whole-program audit: findings + the model summary the bench
+    stage records (threads/locks/shared-attr counts, per-rule totals)."""
+
+    findings: List[Finding]
+    model: Dict
+
+
+def audit_concurrency(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    relative_to: Optional[str] = None,
+) -> ConcurrencyAudit:
+    """Extract the thread model of every file under ``paths`` and check
+    the CX rules (all of them, or the ``rules`` subset). ``# esr:
+    noqa(CX00x)`` suppression and path normalization follow the AST
+    lint's conventions exactly; on full-rule-set runs, pure-CX noqa lines
+    that suppressed nothing are reported as ESR011 (this gate polices its
+    own suppressions — the AST gate exempts foreign catalogs)."""
+    run_rules = set(CONCURRENCY_RULES if rules is None else rules)
+    unknown = run_rules - set(CONCURRENCY_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown concurrency rule(s): {sorted(unknown)}; known: "
+            f"{sorted(CONCURRENCY_RULES)}"
+        )
+    base = os.path.abspath(relative_to or os.getcwd())
+    findings: List[Finding] = []
+    all_models: List[Tuple[ClassModel, ModuleContext]] = []
+    contexts: List[ModuleContext] = []
+    n_files = 0
+    for f in iter_python_files(paths):
+        # normalize FIRST so every finding — including the unreadable-
+        # file ESR000 — fingerprints identically no matter how the gate
+        # was invoked (relative tree vs bench.py's absolute paths)
+        rel = os.path.relpath(os.path.abspath(f), base).replace(os.sep, "/")
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="ESR000", path=rel, line=1, col=1, severity="error",
+                message=f"unreadable file: {e}",
+            ))
+            continue
+        try:
+            ctx = ModuleContext(f, source, rel_path=rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="ESR000", path=rel, line=e.lineno or 1,
+                col=(e.offset or 0) + 1, severity="error",
+                message=f"syntax error: {e.msg}",
+            ))
+            continue
+        n_files += 1
+        contexts.append(ctx)
+        for model in extract_module_model(ctx):
+            all_models.append((model, ctx))
+
+    raw: List[Finding] = []
+    for model, ctx in all_models:
+        if "CX001" in run_rules:
+            raw.extend(_check_cx001(model, ctx))
+        if "CX003" in run_rules:
+            raw.extend(_check_cx003(model, ctx))
+        if "CX004" in run_rules:
+            raw.extend(_check_cx004(model, ctx))
+        if "CX005" in run_rules:
+            raw.extend(_check_cx005(model, ctx))
+        if "CX006" in run_rules:
+            raw.extend(_check_cx006(model, ctx))
+    if "CX002" in run_rules:
+        raw.extend(_check_cx002(all_models))
+
+    # suppression + per-gate staleness (full-rule-set runs only)
+    by_path = {c.path: c for c in contexts}
+    used_noqa: Dict[str, Set[int]] = {}
+    for f in raw:
+        ctx = by_path[f.path]
+        if ctx.suppressed(f):
+            used_noqa.setdefault(f.path, set()).add(f.line)
+        else:
+            findings.append(f)
+    if rules is None:
+        for ctx in contexts:
+            for line, names in sorted(ctx._noqa.items()):
+                # core.pure_cx_noqa is THE ownership predicate: this gate
+                # polices exactly the lines the AST gate's ESR011 sweep
+                # skips — a malformed name (`CX0O1`) stays the AST
+                # gate's, reported once
+                if not pure_cx_noqa(names):
+                    continue
+                if line in used_noqa.get(ctx.path, set()):
+                    continue
+                findings.append(Finding(
+                    rule="ESR011", path=ctx.path, line=line, col=1,
+                    severity="warning",
+                    message=(
+                        "stale suppression: `# esr: "
+                        f"noqa({', '.join(sorted(names))})` suppresses no "
+                        "concurrency finding on this line — delete it (or "
+                        "fix the rule name)"
+                    ),
+                    hint=(
+                        "a suppression that no longer suppresses anything "
+                        "rots the ratchet (docs/ANALYSIS.md)"
+                    ),
+                    code=ctx.source_line(line),
+                ))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # the bench-facing model summary
+    spawn_sites = sum(
+        1 for m, _ in all_models for s in m.spawns if s.kind != "submit"
+    )
+    callback_entries = sum(
+        1 for m, _ in all_models
+        for lab in m.entries.values() if lab.startswith("callback:")
+    )
+    thread_entries = sum(
+        1 for m, _ in all_models
+        for lab in m.entries.values() if lab.startswith("thread:")
+    ) + sum(len(m.entry_nodes) for m, _ in all_models)
+    locks = sum(len(m.lock_attrs) for m, _ in all_models
+                if m.name != "<module>")
+    for ctx in contexts:
+        locks += len(_module_locks(ctx.tree))
+    shared = sum(
+        len(m.shared_attrs())
+        for m, _ in all_models if m.entries or m.nested_targets
+    )
+    by_rule = {r: 0 for r in sorted(CONCURRENCY_RULES)}
+    for f in findings:
+        if f.rule in by_rule:
+            by_rule[f.rule] += 1
+    model_summary = {
+        "files": n_files,
+        "classes_modeled": sum(
+            1 for m, _ in all_models
+            if m.name != "<module>" and (m.entries or m.nested_targets)
+        ),
+        "threads_modeled": spawn_sites,
+        "thread_entries": thread_entries,
+        "callback_entries": callback_entries,
+        "locks": locks,
+        "lock_edges": sum(len(m.lock_edges) for m, _ in all_models),
+        "shared_attrs": shared,
+        "findings_by_rule": by_rule,
+        "rules_version": rules_signature(),
+    }
+    return ConcurrencyAudit(findings=findings, model=model_summary)

@@ -392,6 +392,16 @@ class ModuleContext:
         return not rules or finding.rule in rules
 
 
+def pure_cx_noqa(names: "Set[str]") -> bool:
+    """Is a noqa line owned by the concurrency gate? THE ownership
+    predicate — shared by this module's ESR011 exemption and the threads
+    gate's staleness sweep so the two can never disagree on who polices
+    a line (a malformed name like ``CX0O1`` belongs to the AST gate)."""
+    return bool(names) and all(
+        n.startswith("CX") and n[2:].isdigit() for n in names
+    )
+
+
 _NOQA_RULE_RE = None  # compiled lazily (keeps `re` out of the hot import)
 
 
@@ -506,6 +516,16 @@ def analyze_source(
             # reporting; a blanket noqa must NOT self-suppress its own
             # staleness finding (it suppressed nothing — that is the bug)
             if "ESR011" in names:
+                continue
+            # PURE concurrency-catalog suppressions are policed by the
+            # threads gate's own staleness sweep (this per-file lint
+            # never runs CX rules, so they would all look stale here by
+            # construction). Everything else stays in scope: a source
+            # noqa naming a JX rule can never suppress anything (the
+            # jaxpr gate suppresses via ProgramSpec.allow, not source
+            # comments) and a mixed ESR+CX line is judged by its ESR
+            # half — fail-closed beats a directive nobody polices.
+            if pure_cx_noqa(names):
                 continue
             what = (
                 "blanket `# esr: noqa`" if not names

@@ -790,7 +790,10 @@ class DevicePrefetcher:
             self._produce_inner(gen)
 
     def _abandoned(self, gen) -> bool:
-        return self._stop.is_set() or gen != self._gen
+        # racy _gen read BY DESIGN: the watchdog bumps under _put_lock
+        # only, and a stale read here is re-checked under _put_lock at
+        # enqueue (put()), so an abandoned producer can never land an item
+        return self._stop.is_set() or gen != self._gen  # esr: noqa(CX001)
 
     def _acquire_source(self) -> bool:
         """Bounded acquire of the iterator lock. A producer hung INSIDE
@@ -841,7 +844,9 @@ class DevicePrefetcher:
                 host_batch = next(self._it)
             except StopIteration:
                 return "end", None
-            self._item_idx = idx + 1
+            # guarded by _it_lock via the bounded _acquire_source() above
+            # (bare acquire/release regions are outside the CX lock model)
+            self._item_idx = idx + 1  # esr: noqa(CX001)
         finally:
             self._it_lock.release()
         for spec in specs:
@@ -889,7 +894,10 @@ class DevicePrefetcher:
         import warnings
 
         if self.restarts == 0:
-            self.restarts += 1
+            # watchdog ledger: written on the consumer thread only; the
+            # health() callback's cross-thread reads are GIL-atomic
+            # monitoring snapshots (stale by at most one poll)
+            self.restarts += 1  # esr: noqa(CX001)
             # bump under _put_lock ONLY (never _it_lock: a producer hung
             # inside next(self._it) holds that lock forever, and the
             # watchdog must stay hang-proof — the whole point)
@@ -906,7 +914,9 @@ class DevicePrefetcher:
             )
             self._thread = self._spawn_producer()
         elif not self.degraded:
-            self.degraded = True
+            # same ledger invariant as restarts: consumer-thread writes,
+            # GIL-atomic bool read from the health callback
+            self.degraded = True  # esr: noqa(CX001)
             with self._put_lock:
                 self._gen += 1  # abandon every producer for good
             emit_recovery(
@@ -945,8 +955,10 @@ class DevicePrefetcher:
                             kind, payload = self._next_sync()
                         break
         waited = time.monotonic() - t0
-        self.stalls += 1
-        self.stall_s += waited
+        # stall ledger: consumer-thread writes; health() reads cross-thread
+        # are GIL-atomic monitoring snapshots (stale by at most one poll)
+        self.stalls += 1  # esr: noqa(CX001)
+        self.stall_s += waited  # esr: noqa(CX001)
         sink = active_sink()
         if sink is not None:
             sink.counter("prefetch_stall", waited_s=round(waited, 6))
@@ -988,7 +1000,8 @@ class DevicePrefetcher:
                 # first-item warmup wait and the end-of-source wait for
                 # the "end" marker: both are genuine host-feed waits.
                 kind, payload = self._get_blocking()
-        self.gets += 1
+        # consumer-thread monotonic counter; health() reads are GIL-atomic
+        self.gets += 1  # esr: noqa(CX001)
         if self.gets % self._gauge_every == 0:
             sink = sink if sink is not None else active_sink()
             if sink is not None:

@@ -113,16 +113,39 @@ class DeviceWatermark:
         return {"bytes_in_use": in_use, "peak_bytes": self.peak_bytes,
                 "bytes_limit": stats.get("bytes_limit")}
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            if self.poll_once() is None:
-                return  # no stats on this backend: stop, loudly (event)
-            self._stop.wait(self.interval_s)
+    def _run(self, trace_ctx=None) -> None:
+        # adopt the starter's trace context (obs/trace.py): contextvars do
+        # not flow into threads, and without this every watermark gauge
+        # parked outside the run's causal tree (CX005 — the concurrency
+        # auditor's first real catch)
+        from esr_tpu.obs import trace
+
+        with trace.adopt(trace_ctx):
+            while not self._stop.is_set():
+                if self.poll_once() is None:
+                    return  # no stats on this backend: stop, loudly (event)
+                self._stop.wait(self.interval_s)
 
     def start(self) -> "DeviceWatermark":
+        if self._thread is not None and not self._thread.is_alive():
+            # a handle retained by a timed-out stop() whose zombie has
+            # SINCE exited: drop it, or start() would be a no-op forever
+            # (the dead-poller bug class all over again)
+            self._thread = None
         if self._thread is None:
+            # a watermark restarted after stop() must poll again: the stop
+            # event persists across start/stop cycles, and a set flag made
+            # the fresh thread exit on its first lap — a silently dead
+            # poller (caught by the CX sweep's DeviceWatermark audit,
+            # pinned by tests/test_concurrency_audit.py). Safe to clear
+            # here ONLY because stop() keeps the handle while a wedged
+            # poller is still alive, so this branch is unreachable then.
+            self._stop.clear()
+            from esr_tpu.obs import trace
+
             self._thread = threading.Thread(
-                target=self._run, daemon=True, name="device-watermark"
+                target=self._run, args=(trace.capture(),),
+                daemon=True, name="device-watermark",
             )
             self._thread.start()
         return self
@@ -131,6 +154,12 @@ class DeviceWatermark:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=max(2.0, 2 * self.interval_s))
+            if self._thread.is_alive():
+                # a poller wedged inside memory_stats outlived the join:
+                # KEEP the handle so a later start() cannot clear the
+                # stop flag and resurrect it as a duplicate — start()
+                # stays a no-op until the zombie actually dies
+                return
             self._thread = None
 
 

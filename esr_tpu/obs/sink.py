@@ -195,8 +195,12 @@ class TelemetrySink:
                 self.dropped += 1
             else:
                 try:
-                    self._f.write(line + "\n")
-                    self._f.flush()
+                    # the file IS the resource the lock serializes, and
+                    # flush-per-record is the crash-safety contract — a
+                    # local append+flush is a bounded syscall, not an
+                    # unbounded wait (docs/OBSERVABILITY.md)
+                    self._f.write(line + "\n")  # esr: noqa(CX003)
+                    self._f.flush()  # esr: noqa(CX003)
                     written = True
                 except (OSError, ValueError):
                     self.dropped += 1
@@ -323,7 +327,9 @@ class TelemetrySink:
         with self._lock:
             if self._f is not None and not self._f.closed:
                 try:
-                    self._f.flush()
+                    # bounded local flush; the lock exists to exclude
+                    # concurrent writers during teardown (see _write)
+                    self._f.flush()  # esr: noqa(CX003)
                 except (OSError, ValueError):
                     pass
                 self._f.close()

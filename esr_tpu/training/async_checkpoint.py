@@ -154,7 +154,10 @@ class AsyncCheckpointer:
             if t.is_alive():  # timed out; keep the handle for a later wait
                 return
             self._thread = None
-        err, self._error = self._error, None
+        # _error is written by the writer thread strictly BEFORE it exits
+        # and read here strictly AFTER join() observed it dead — the join
+        # is the happens-before edge (single-slot pipeline invariant)
+        err, self._error = self._error, None  # esr: noqa(CX001)
         if err is None:
             return
         if raise_error:
@@ -202,7 +205,10 @@ class AsyncCheckpointer:
                 iteration=iteration,
             )
         except BaseException as e:  # noqa: BLE001 - surfaced at the barrier
-            self._error = e
+            # single-slot invariant: written strictly before this thread
+            # exits, read by wait() strictly after join() — the join is
+            # the happens-before edge (same invariant as the reader side)
+            self._error = e  # esr: noqa(CX001)
             return
         seconds = time.monotonic() - t0
         self.last_commit_s = seconds

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # JAX-hazard static analysis over the package (AST lint + jaxpr program
-# audit), against the committed baselines — the same gates
-# tests/test_analysis_selfcheck.py and tests/test_analysis_cli_gate.py
-# enforce in tier-1. Rule catalogs + baseline workflow: docs/ANALYSIS.md.
+# audit + host-concurrency audit), against the committed baselines — the
+# same three gates tests/test_analysis_selfcheck.py,
+# tests/test_analysis_cli_gate.py, and tests/test_concurrency_audit.py
+# enforce in tier-1, combined into ONE exit code. Rule catalogs + baseline
+# workflow: docs/ANALYSIS.md.
 #
 # Usage: scripts/lint.sh [paths...]   (default: esr_tpu/)
 set -euo pipefail
@@ -11,4 +13,4 @@ if [ "$#" -eq 0 ]; then
   set -- esr_tpu/
 fi
 exec python -m esr_tpu.analysis \
-  --baseline analysis_baseline.json --relative-to . --jaxpr "$@"
+  --baseline analysis_baseline.json --relative-to . --jaxpr --threads "$@"

@@ -1,0 +1,112 @@
+"""Seeded host-concurrency hazards: one minimal firing program per CX rule.
+
+The concurrency auditor's acceptance fixture (ISSUE 14, the JX-fixture
+pattern of ``jaxpr_hazard_programs.py``): ``python -m esr_tpu.analysis
+--threads tests/fixtures/concurrency_hazards.py`` must exit 1 and name
+every rule below — pinned by ``tests/test_concurrency_audit.py``. The file
+is analyzed, never imported/executed, and is deliberately CLEAN under the
+AST (ESR*) catalog so the combined gate's exit code isolates the CX rules.
+"""
+
+import queue
+import threading
+import time
+
+
+class UnsyncedCounter:
+    """CX001: `self.count` written by the worker, read by the main-thread
+    report() — no lock, no queue hand-off, mutated after __init__."""
+
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        for _ in range(100):
+            self.count += 1
+
+    def report(self):
+        return self.count
+
+
+class InvertedLocks:
+    """CX002: _a is taken under _b on one path and _b under _a on the
+    other — the acquisition graph has the cycle _a -> _b -> _a."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.x += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.x -= 1
+
+
+class BlockingUnderLock:
+    """CX003: a timeout-less queue get (an unbounded wait) while holding
+    the lock every producer needs to make progress."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(maxsize=2)
+        self.last = None
+
+    def drain_one(self):
+        with self._lock:
+            self.last = self._q.get()
+        return self.last
+
+    def sleepy_update(self, value):
+        with self._lock:
+            time.sleep(0.5)
+            self.last = value
+
+
+class LeakedThread:
+    """CX004: a started non-daemon thread that is never joined anywhere in
+    this module — it outlives the work and blocks interpreter exit."""
+
+    def __init__(self):
+        self.done = False
+
+    def kick(self):
+        worker = threading.Thread(target=self._work)
+        worker.start()
+
+    def _work(self):
+        self.done = True  # thread-only write: CX004 is this class's seed
+
+
+class UntracedTelemetryThread:
+    """CX005: the spawned entry emits through the sink with no
+    trace.capture()/adopt() hand-off — its records park outside the
+    causal tree (the PR 8 house rule)."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        self._thread = threading.Thread(target=self._emit, daemon=True)
+        self._thread.start()
+
+    def _emit(self):
+        self._sink.event("fixture_tick", n=1)
+
+
+class ReentrantObserver:
+    """CX006: a sink observer that emits a record back into the sink it
+    observes — observer dispatch re-enters itself on the emitting
+    thread."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        sink.add_observer(self.observe)
+
+    def observe(self, rec):
+        self._sink.counter("records_seen")

@@ -25,13 +25,14 @@ def _run(*argv):
     )
 
 
-def test_repo_gate_ast_and_jaxpr_exit_zero():
-    """Both gates in one invocation (the lint.sh AST command + --jaxpr):
-    the package must lint clean AND every registered production program
-    must audit clean against the committed baselines."""
+def test_repo_gate_ast_jaxpr_and_threads_exit_zero():
+    """All three gates in one invocation (the exact scripts/lint.sh
+    command): the package must lint clean, every registered production
+    program must audit clean, AND the host thread model must audit clean
+    against the committed baselines — one combined exit code."""
     proc = _run(
         "--baseline", "analysis_baseline.json", "--relative-to", ".",
-        "esr_tpu/", "--jaxpr",
+        "esr_tpu/", "--jaxpr", "--threads",
     )
     assert proc.returncode == 0, (
         f"analysis gate failed\nstdout:\n{proc.stdout}\n"
@@ -39,6 +40,7 @@ def test_repo_gate_ast_and_jaxpr_exit_zero():
     )
     assert "0 new finding(s)" in proc.stderr
     assert "jaxpr audit:" in proc.stderr
+    assert "concurrency audit:" in proc.stderr
 
 
 def test_seeded_hazard_registry_exits_one():
@@ -62,14 +64,14 @@ def test_no_paths_and_no_jaxpr_is_a_usage_error():
 
 
 def test_combined_json_output_is_one_document():
-    """Both gates under --format json must print ONE parseable JSON
-    document (the AST findings plus a `jaxpr` section with per-program
-    profiles), not two concatenated objects."""
+    """All gates under --format json must print ONE parseable JSON
+    document (the AST findings plus `jaxpr` and `threads` sections), not
+    concatenated objects."""
     import json
 
     proc = _run(
         "--format", "json", "--baseline", "analysis_baseline.json",
-        "--relative-to", ".", "esr_tpu/", "--jaxpr",
+        "--relative-to", ".", "esr_tpu/", "--jaxpr", "--threads",
     )
     assert proc.returncode == 0, proc.stderr
     doc = json.loads(proc.stdout)  # raises on concatenated documents
@@ -77,6 +79,9 @@ def test_combined_json_output_is_one_document():
     assert doc["jaxpr"]["findings"] == []
     assert len(doc["jaxpr"]["profiles"]) >= 5
     assert doc["jaxpr"]["rules_version"].startswith("jx:")
+    assert doc["threads"]["findings"] == []
+    assert doc["threads"]["model"]["threads_modeled"] >= 5
+    assert doc["threads"]["rules_version"].startswith("cx:")
 
 
 def test_rules_subset_skips_baseline_version_gate(tmp_path):

@@ -35,8 +35,8 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
-        "dcn_sparse_ab", "mfu_ceiling", "program_audit", "obs_live",
-        "numerics_overhead",
+        "dcn_sparse_ab", "mfu_ceiling", "program_audit",
+        "concurrency_audit", "obs_live", "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
         "infer_throughput", "ckpt_overlap", "serve_loadgen",
         "chaos_recovery",
@@ -354,6 +354,39 @@ def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
         assert "float32->float32" in by_dtype, pname
     assert rec["clean"] is True and rec["total_findings"] == 0
     assert rec["rules_version"].startswith("jx:")
+
+
+def test_concurrency_audit_stage_registered_schema_pinned_and_clean():
+    """The host-concurrency series (ISSUE 14): the thread/lock-discipline
+    audit runs device-free (pure AST, jax-free) in smoke with a pinned
+    schema — the concurrent host surface (spawn sites, callback entries,
+    locks, shared attrs) and per-CX-rule finding counts are tracked
+    across rounds, and the audit must stay CLEAN."""
+    entry = [e for e in bench.STAGE_REGISTRY
+             if e[0] == "concurrency_audit"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert timeout >= 120
+    assert in_smoke is True
+    assert bench.CONCURRENCY_AUDIT_KEYS == (
+        "threads_modeled", "callback_entries", "locks", "lock_edges",
+        "shared_attrs", "findings_by_rule", "clean", "rules_version",
+    )
+    rec = bench.stage_concurrency_audit()
+    assert tuple(rec.keys()) == bench.CONCURRENCY_AUDIT_KEYS
+    # the modeled surface: prefetcher producer + watchdog, async-ckpt
+    # writer, watermark poller, live HTTP thread, backend-probe watchdog,
+    # loader worker pool; observe/health/lane-health callbacks
+    assert rec["threads_modeled"] >= 5
+    assert rec["callback_entries"] >= 3
+    assert rec["locks"] >= 5
+    assert rec["shared_attrs"] >= 10
+    assert sorted(rec["findings_by_rule"]) == [
+        "CX001", "CX002", "CX003", "CX004", "CX005", "CX006",
+    ]
+    assert all(v == 0 for v in rec["findings_by_rule"].values())
+    assert rec["clean"] is True
+    assert rec["rules_version"].startswith("cx:")
 
 
 def test_numerics_overhead_stage_registered_and_schema_pinned():

@@ -1,0 +1,1305 @@
+"""The host-concurrency auditor (ISSUE 14): thread-model extraction pins,
+positive + negative per CX rule, suppression/staleness/ratchet semantics,
+the subprocess CLI gates, and regression tests for the real fixes the
+first repo sweep surfaced (the DeviceWatermark dead-restart + untraced
+telemetry). Everything here is pure AST (jax-free) except the two
+watermark regressions and the subprocess gates.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from esr_tpu.analysis.concurrency import (
+    CONCURRENCY_RULES,
+    audit_concurrency,
+    extract_module_model,
+    rules_signature,
+)
+from esr_tpu.analysis.core import (
+    ModuleContext,
+    analyze_source,
+    check_baseline_version,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join("tests", "fixtures", "concurrency_hazards.py")
+
+
+def _audit_src(tmp_path, source, rules=None):
+    p = tmp_path / "mod.py"
+    p.write_text(source)
+    audit = audit_concurrency([str(p)], rules=rules,
+                              relative_to=str(tmp_path))
+    return audit
+
+
+def _rules_of(audit):
+    return sorted({f.rule for f in audit.findings})
+
+
+# ---------------------------------------------------------------------------
+# thread-model extraction
+
+
+def test_model_extracts_spawn_entries_domains_and_locks(tmp_path):
+    src = """
+import threading, queue
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(maxsize=2)
+        self.jobs = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._step()
+
+    def _step(self):
+        with self._lock:
+            self.jobs += 1
+
+    def report(self):
+        with self._lock:
+            return self.jobs
+"""
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    ctx = ModuleContext(str(p), src, rel_path="mod.py")
+    models = {m.name: m for m in extract_module_model(ctx)}
+    w = models["Worker"]
+    # spawn site + resolved entry
+    assert len(w.spawns) == 1 and w.spawns[0].daemon is True
+    assert w.entries == {"_run": "thread:_run"}
+    # domain propagation: _step reached only from the entry; report main
+    assert w.domains["_run"] == {"thread:_run"}
+    assert w.domains["_step"] == {"thread:_run"}
+    assert w.domains["report"] == {"main"}
+    # lock + hand-off attribute classification
+    assert w.lock_attrs == {"_lock"}
+    assert w.handoff_attrs == {"_q"}
+    # the shared-state set sees `jobs` from both domains
+    assert "jobs" in w.shared_attrs()
+
+
+def test_model_summary_counts_on_the_repo():
+    audit = audit_concurrency(
+        [os.path.join(REPO_ROOT, "esr_tpu")], relative_to=REPO_ROOT
+    )
+    m = audit.model
+    # the modeled concurrent surface: prefetcher, async ckpt, watermark,
+    # live HTTP, backend-probe watchdog (+ the loader's worker pool)
+    assert m["threads_modeled"] >= 5
+    assert m["callback_entries"] >= 3   # observe, health, lane health doc
+    assert m["locks"] >= 5
+    assert m["shared_attrs"] >= 10
+    assert m["rules_version"] == rules_signature()
+    assert m["files"] > 50
+
+
+def test_repo_audit_is_clean():
+    """The acceptance bar: the auditor ships CLEAN on the repo — every
+    true positive from the first sweep is fixed or carries a stated
+    invariant (docs/ANALYSIS.md)."""
+    audit = audit_concurrency(
+        [os.path.join(REPO_ROOT, "esr_tpu")], relative_to=REPO_ROOT
+    )
+    assert audit.findings == [], [f.format() for f in audit.findings]
+
+
+# ---------------------------------------------------------------------------
+# CX001 — unsynchronized cross-thread shared mutable attribute
+
+
+CX001_POSITIVE = """
+import threading
+
+class C:
+    def __init__(self):
+        self.n = 0
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        self.n += 1
+
+    def read(self):
+        return self.n
+"""
+
+
+def test_cx001_fires_on_unlocked_cross_thread_attr(tmp_path):
+    audit = _audit_src(tmp_path, CX001_POSITIVE)
+    assert _rules_of(audit) == ["CX001"]
+    assert "`self.n`" in audit.findings[0].message
+
+
+def test_cx001_silent_when_both_sides_hold_the_lock(tmp_path):
+    src = CX001_POSITIVE.replace(
+        "        self.n += 1",
+        "        with self._lk:\n            self.n += 1",
+    ).replace(
+        "        return self.n",
+        "        with self._lk:\n            return self.n",
+    ).replace(
+        "        self.n = 0",
+        "        self.n = 0\n        self._lk = threading.Lock()",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx001_lock_held_through_private_helper(tmp_path):
+    """A private helper called ONLY from inside lock regions inherits the
+    lock — the LiveAggregator `_epoch_state` pattern must audit clean."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        with self._lk:
+            self._bump()
+
+    def _bump(self):
+        self.n += 1
+
+    def read(self):
+        with self._lk:
+            return self.n
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx001_queue_handoff_and_event_allowlisted(tmp_path):
+    src = """
+import queue, threading
+
+class C:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        while not self._stop.is_set():
+            self._q.put_nowait(1)
+
+    def read(self):
+        self._stop.set()
+        return self._q.get_nowait()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx001_write_once_in_init_is_immutable_handoff(tmp_path):
+    src = """
+import threading
+
+class C:
+    def __init__(self, fn):
+        self.fn = fn
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        self.out = self.fn()
+
+    def ping(self):
+        return self.fn
+"""
+    audit = _audit_src(tmp_path, src)
+    # fn: init-only write -> exempt; out: thread-only -> no cross pair
+    assert _rules_of(audit) == []
+
+
+def test_cx001_callback_entry_counts_as_foreign_thread(tmp_path):
+    """The health-source/observer registration surfaces run on a foreign
+    thread — the DevicePrefetcher.health pattern fires without a lock."""
+    src = """
+def register_health_source(name, fn):
+    pass
+
+class C:
+    def __init__(self, registrar):
+        self.n = 0
+        registrar.register_health_source("c", self.health)
+
+    def bump(self):
+        self.n += 1
+
+    def health(self):
+        return {"healthy": True, "n": self.n}
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX001"]
+
+
+def test_cx001_sees_nested_def_spawn_targets(tmp_path):
+    """PRE-FIX: a thread spawned on an inline closure created no thread
+    domain at all — the textbook `def work(): self.x += 1;
+    Thread(target=work)` race was invisible (and an __init__-spawned
+    closure's writes even counted as init-only hand-offs)."""
+    src = """
+import threading
+
+class D:
+    def __init__(self):
+        self.x = 0
+
+    def kick(self):
+        def work():
+            self.x += 1
+        threading.Thread(target=work, daemon=True).start()
+
+    def read(self):
+        return self.x
+
+class E:
+    def __init__(self):
+        self.y = 0
+        def work():
+            self.y += 1
+        threading.Thread(target=work, daemon=True).start()
+
+    def read(self):
+        return self.y
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX001", "CX001"]
+    blob = " ".join(f.message for f in audit.findings)
+    assert "`self.x`" in blob and "`self.y`" in blob
+
+
+def test_closure_spawned_helper_chain_stays_single_domain(tmp_path):
+    """PRE-FIX: a helper called only from a spawned closure defaulted to
+    the main domain (the pseudo-method caller was absent from the
+    propagation fixpoint), so exclusively-thread-side state was reported
+    as a cross-thread race — a false positive."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.count = 0
+
+    def start(self):
+        def run():
+            self.count = 0
+            self._tick()
+        threading.Thread(target=run, daemon=True).start()
+
+    def _tick(self):
+        self.count += 1
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx003_condition_wait_exemption_survives_lock_inheritance(
+        tmp_path):
+    """PRE-FIX: the Condition.wait exemption only saw lexically-held
+    locks, so factoring the wait into a private helper (whose `with
+    self._cond:` lives in the caller) fired a false CX003."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def get(self):
+        with self._cond:
+            return self._drain()
+
+    def _drain(self):
+        while not self.ready:
+            self._cond.wait()
+        return 1
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_same_named_closure_targets_are_distinct_domains(tmp_path):
+    """PRE-FIX: two same-named nested-def spawn targets collapsed into
+    one pseudo-method/domain, so their mutual race was invisible."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.x = 0
+
+    def start_a(self):
+        def run():
+            self.x += 1
+        threading.Thread(target=run, daemon=True).start()
+
+    def start_b(self):
+        def run():
+            self.x -= 1
+        threading.Thread(target=run, daemon=True).start()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX001"]
+
+
+def test_cx003_condition_wrapping_a_lock_exempts_the_wrapped_lock(
+        tmp_path):
+    """`Condition(self._lock)` + `with self._lock: self._cond.wait()` is
+    the documented constructor form — wait releases the WRAPPED lock, so
+    the gate must stay silent (pre-fix it flagged the held `_lock`)."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.ready = False
+
+    def consume(self):
+        with self._lock:
+            while not self.ready:
+                self._cond.wait()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx002_same_named_locks_in_different_files_never_alias(tmp_path):
+    """PRE-FIX: lock ids were not file-qualified, so two unrelated
+    modules using the conventional names in opposite orders merged into
+    one graph node pair and reported a phantom deadlock."""
+    a = tmp_path / "a.py"
+    a.write_text(
+        "import threading\n"
+        "_REG = threading.Lock()\n"
+        "_CACHE = threading.Lock()\n"
+        "def fwd():\n"
+        "    with _REG:\n"
+        "        with _CACHE:\n"
+        "            pass\n"
+    )
+    b = tmp_path / "b.py"
+    b.write_text(
+        "import threading\n"
+        "_REG = threading.Lock()\n"
+        "_CACHE = threading.Lock()\n"
+        "def bwd():\n"
+        "    with _CACHE:\n"
+        "        with _REG:\n"
+        "            pass\n"
+    )
+    audit = audit_concurrency([str(a), str(b)],
+                              relative_to=str(tmp_path))
+    assert _rules_of(audit) == []
+    # the same two orders in ONE file still invert
+    both = tmp_path / "c.py"
+    both.write_text(a.read_text() + b.read_text().replace(
+        "import threading\n_REG = threading.Lock()\n"
+        "_CACHE = threading.Lock()\n", ""
+    ))
+    audit = audit_concurrency([str(both)], relative_to=str(tmp_path))
+    assert "CX002" in _rules_of(audit)
+
+
+def test_cx001_spawn_entry_never_inherits_its_call_site_locks(tmp_path):
+    """PRE-FIX: a private method that is BOTH a spawn target and called
+    synchronously under a lock inherited that lock, stamping the
+    lock-free thread path as protected and masking the race."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.x = 0
+        threading.Thread(target=self._helper, daemon=True).start()
+
+    def _helper(self):
+        self.x += 1
+
+    def kick(self):
+        with self._lock:
+            self._helper()
+
+    def read(self):
+        with self._lock:
+            return self.x
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX001"]
+
+
+def test_lock_regions_inside_match_cases_are_modeled(tmp_path):
+    """PRE-FIX: ast.Match fell through to the expression walk, so a
+    `with self._lock:` inside a case was stripped from the lock model
+    and correctly locked code fired a spurious CX001."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.x = 0
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        with self._lock:
+            self.x += 1
+
+    def read(self, mode):
+        match mode:
+            case "a":
+                with self._lock:
+                    return self.x
+            case _:
+                return None
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx001_deferred_init_closure_is_not_construction_state(tmp_path):
+    """PRE-FIX: a non-spawn closure defined in __init__ had its writes
+    counted as construction-time, exempting an attribute actually
+    mutated post-construction by whoever invokes the stored callback."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.x = 0
+        def run():
+            self.x = 5
+        self._cb = run
+        threading.Thread(target=self._go, daemon=True).start()
+
+    def _go(self):
+        self._cb()
+
+    def read(self):
+        return self.x
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX001"]
+    assert "`self.x`" in audit.findings[0].message
+
+
+def test_cx001_silent_for_class_without_entries(tmp_path):
+    src = """
+class C:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+# ---------------------------------------------------------------------------
+# CX002 — lock-order inversion
+
+
+CX002_POSITIVE = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def bwd(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def test_cx002_fires_on_inverted_order(tmp_path):
+    audit = _audit_src(tmp_path, CX002_POSITIVE)
+    assert "CX002" in _rules_of(audit)
+    assert "cycle" in audit.findings[0].message
+
+
+def test_cx002_silent_on_consistent_order(tmp_path):
+    src = CX002_POSITIVE.replace(
+        "        with self._b:\n            with self._a:",
+        "        with self._a:\n            with self._b:",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx002_multi_item_with_records_the_edge(tmp_path):
+    """`with self._a, self._b:` is an _a -> _b acquisition — inverted by
+    a nested `with self._b: with self._a:` elsewhere (pre-fix, items of
+    one statement never saw each other and the cycle was missed)."""
+    src = CX002_POSITIVE.replace(
+        """        with self._a:
+            with self._b:
+                pass
+""",
+        """        with self._a, self._b:
+            pass
+""",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert "CX002" in _rules_of(audit)
+
+
+def test_cx001_entry_also_called_from_main_carries_both_domains(tmp_path):
+    """A spawn target ALSO invoked synchronously runs under both domains
+    (pre-fix, entries never accumulated caller domains and the shared
+    body's race was invisible)."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.x = 0
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        self.x += 1
+
+    def run_inline(self):
+        self._work()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX001"]
+
+
+def test_cx003_condition_wait_on_the_held_lock_is_exempt(tmp_path):
+    """Condition.wait() releases the lock it is called under — the
+    idiomatic producer/consumer must not fail the gate; a wait on
+    something OTHER than the held lock still fires."""
+    src = """
+import threading
+
+class CondWait:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._other = threading.Event()
+        self.ready = False
+
+    def consume(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait()
+
+    def bad(self):
+        with self._cond:
+            self._other.wait()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX003"]
+    assert "_other" in audit.findings[0].code
+
+
+def test_cx002_sees_inversion_through_a_private_helper(tmp_path):
+    """fwd takes _a then _b lexically; bwd takes _b then calls a private
+    helper that takes _a — the inherited-lock edge closes the cycle."""
+    src = CX002_POSITIVE.replace(
+        """    def bwd(self):
+        with self._b:
+            with self._a:
+                pass
+""",
+        """    def bwd(self):
+        with self._b:
+            self._locked_a()
+
+    def _locked_a(self):
+        with self._a:
+            pass
+""",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert "CX002" in _rules_of(audit)
+
+
+# ---------------------------------------------------------------------------
+# CX003 — blocking call while holding a lock
+
+
+def test_cx003_fires_per_blocking_kind(tmp_path):
+    src = """
+import queue, threading, time
+
+class C:
+    def __init__(self, th):
+        self._lk = threading.Lock()
+        self._q = queue.Queue()
+        self._th = th
+
+    def bad_get(self):
+        with self._lk:
+            return self._q.get()
+
+    def bad_sleep(self):
+        with self._lk:
+            time.sleep(1.0)
+
+    def bad_join(self):
+        with self._lk:
+            self._th.join()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX003"] * 3
+    blob = " ".join(f.message for f in audit.findings)
+    assert "get" in blob and "sleep" in blob and ".join()" in blob
+
+
+def test_cx003_bounded_and_unlocked_calls_are_silent(tmp_path):
+    src = """
+import queue, threading, time
+
+class C:
+    def __init__(self, th):
+        self._lk = threading.Lock()
+        self._q = queue.Queue()
+        self._th = th
+
+    def ok_bounded(self):
+        with self._lk:
+            return self._q.get(timeout=0.2)
+
+    def ok_nowait(self):
+        with self._lk:
+            return self._q.get_nowait()
+
+    def ok_string_join(self, parts):
+        with self._lk:
+            return ",".join(parts)
+
+    def ok_unlocked(self):
+        time.sleep(0.1)
+        self._th.join()
+        return self._q.get()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx003_file_io_under_lock_through_helper(tmp_path):
+    """The TelemetrySink shape: an open()-valued attr written under the
+    lock — including when the write happens in a lock-inheriting private
+    helper."""
+    src = """
+import threading
+
+class C:
+    def __init__(self, path):
+        self._lk = threading.Lock()
+        self._f = open(path, "a")
+
+    def emit(self, line):
+        with self._lk:
+            self._write(line)
+
+    def _write(self, line):
+        self._f.write(line)
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX003"]
+    assert "file IO" in audit.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# CX004 — thread/executor leak
+
+
+def test_cx004_fires_on_unjoined_nondaemon_thread(tmp_path):
+    src = """
+import threading
+
+def kick(fn):
+    threading.Thread(target=fn).start()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX004"]
+
+
+def test_cx004_daemon_watchdog_exempt(tmp_path):
+    """The backend-probe/stall-watchdog pattern: an explicitly daemonic
+    thread is a deliberate abandon-on-exit hand-off."""
+    src = """
+import threading
+
+def kick(fn):
+    threading.Thread(target=fn, daemon=True).start()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx004_joined_and_factory_returned_threads_exempt(tmp_path):
+    src = """
+import threading
+
+class C:
+    def __init__(self, fn):
+        self._thread = threading.Thread(target=fn)
+        self._thread.start()
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+def spawn(fn):
+    th = threading.Thread(target=fn)
+    th.start()
+    return th
+"""
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx004_executor_with_block_and_shutdown_exempt_leak_fires(tmp_path):
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+class C:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=2)
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+
+def ok(jobs, fn):
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        return [pool.submit(fn, j) for j in jobs]
+
+def leak(fn):
+    pool = ThreadPoolExecutor(max_workers=2)
+    pool.submit(fn)
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX004"]
+    assert audit.findings[0].line > 10  # the leak() site, not the others
+
+
+# ---------------------------------------------------------------------------
+# CX005 — thread entry emitting telemetry without trace adoption
+
+
+CX005_POSITIVE = """
+import threading
+
+class C:
+    def __init__(self, sink):
+        self._sink = sink
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        self._emit()
+
+    def _emit(self):
+        self._sink.counter("ticks")
+"""
+
+
+def test_cx005_fires_through_the_call_closure(tmp_path):
+    audit = _audit_src(tmp_path, CX005_POSITIVE)
+    assert _rules_of(audit) == ["CX005"]
+    assert "_work" in audit.findings[0].message
+
+
+def test_cx005_adopting_entry_is_silent(tmp_path):
+    src = CX005_POSITIVE.replace(
+        """    def _work(self):
+        self._emit()
+""",
+        """    def _work(self):
+        from esr_tpu.obs import trace
+        with trace.adopt(self._ctx):
+            self._emit()
+""",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_cx005_non_emitting_thread_is_silent(tmp_path):
+    src = CX005_POSITIVE.replace(
+        '        self._sink.counter("ticks")', "        return 1"
+    )
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+# ---------------------------------------------------------------------------
+# CX006 — re-entrant observer/health-source callback
+
+
+def test_cx006_fires_on_emitting_observer_and_reentrant_health(tmp_path):
+    src = """
+def health_snapshot():
+    return True, {}
+
+class Obs:
+    def __init__(self, sink):
+        self._sink = sink
+        sink.add_observer(self.observe)
+
+    def observe(self, rec):
+        self._sink.event("seen")
+
+class Health:
+    def __init__(self, reg):
+        reg.register_health_source("h", self.health)
+
+    def health(self):
+        ok, detail = health_snapshot()
+        return {"healthy": ok}
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX006", "CX006"]
+    blob = " ".join(f.message for f in audit.findings)
+    assert "emits a telemetry record" in blob
+    assert "re-polls the health registry" in blob
+
+
+def test_cx006_read_only_callback_is_silent(tmp_path):
+    src = """
+class Obs:
+    def __init__(self, sink):
+        self.records = 0
+        sink.add_observer(self.observe)
+
+    def observe(self, rec):
+        self.records += 1
+"""
+    audit = _audit_src(tmp_path, src)
+    # the observer mutates state the main thread could read — but here
+    # nothing reads it cross-domain, and it emits nothing: silent
+    assert _rules_of(audit) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression, staleness, ratchet, rules_version
+
+
+def test_cx001_one_finding_per_unprotected_site_not_per_attr(tmp_path):
+    """PRE-FIX: one witness pair per attribute meant a noqa on that
+    witness silenced every OTHER unsynchronized access to the same
+    attribute — each unprotected site must carry its own suppressible
+    finding."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.n = 0
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        self.n += 1  # esr: noqa(CX001)
+
+    def reset(self):
+        self.n = 0
+
+    def read(self):
+        return self.n
+"""
+    audit = _audit_src(tmp_path, src)
+    # the un-noqa'd main-domain write is still reported
+    assert _rules_of(audit) == ["CX001"]
+    assert "reset" in audit.findings[0].message
+
+
+def test_cx003_later_with_items_run_under_earlier_locks(tmp_path):
+    """`with self._lk, open(p) as f:` IS file IO under the lock — the
+    pre-fix walker visited later items with the earlier items' locks not
+    yet on the stack."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def bad(self, p):
+        with self._lk, open(p) as f:
+            return f
+"""
+    audit = _audit_src(tmp_path, src)
+    assert [f.rule for f in audit.findings] == ["CX003"]
+    assert "open" in audit.findings[0].message
+
+
+def test_cx004_docstring_mention_of_join_is_not_teardown_evidence(
+        tmp_path):
+    """PRE-FIX: the join/shutdown evidence was a regex over raw source,
+    so a docstring saying 'callers must invoke worker.join()' satisfied
+    the leak check for a thread nobody joins."""
+    src = '''
+import threading
+
+def kick(fn):
+    """Spawn the worker. Callers must invoke worker.join() on shutdown.
+    """
+    worker = threading.Thread(target=fn)
+    worker.start()
+'''
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX004"]
+
+
+def test_noqa_escapes_a_cx_finding(tmp_path):
+    # the finding anchors at the unprotected WRITE — one noqa there is
+    # exactly enough (a second one on the read line would itself be
+    # stale, which the staleness test below pins)
+    src = CX001_POSITIVE.replace(
+        "        self.n += 1",
+        "        self.n += 1  # esr: noqa(CX001)",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == []
+
+
+def test_stale_pure_cx_noqa_reported_as_esr011_by_threads_gate(tmp_path):
+    src = CX001_POSITIVE.replace(
+        "    def read(self):",
+        "    def unrelated(self):\n"
+        "        return 0  # esr: noqa(CX003)\n\n"
+        "    def read(self):",
+    )
+    audit = _audit_src(tmp_path, src)
+    assert _rules_of(audit) == ["CX001", "ESR011"]
+    stale = [f for f in audit.findings if f.rule == "ESR011"]
+    assert "CX003" in stale[0].message
+    # subset runs never judge staleness (an unrun rule's noqa would
+    # always look stale)
+    subset = _audit_src(tmp_path, src, rules=["CX001"])
+    assert _rules_of(subset) == ["CX001"]
+
+
+def test_ast_gate_exempts_only_pure_cx_noqas():
+    """core.analyze_source must NOT flag pure `# esr: noqa(CX...)` lines
+    as ESR011-stale (the threads gate polices those — the sweep's
+    invariant comments in loader.py/sink.py live under the AST gate too)
+    — but everything ELSE stays in scope: a JX source noqa can never
+    suppress anything (jaxpr suppression is ProgramSpec.allow), and a
+    mixed ESR+CX directive is judged by its ESR half (fail-closed)."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n += 1  # esr: noqa(CX001)\n"
+        "    def typo(self):\n"
+        "        return 2  # esr: noqa(ESR999)\n"
+        "    def jx(self):\n"
+        "        return 3  # esr: noqa(JX001)\n"
+        "    def mixed(self):\n"
+        "        return 4  # esr: noqa(ESR002, CX001)\n"
+    )
+    findings = analyze_source(src, path="mod.py")
+    # the pure-CX line (6) is exempt; the ESR typo (8), the meaningless
+    # JX source noqa (10), and the mixed line with an unused ESR half
+    # (12) are all stale
+    assert [(f.rule, f.line) for f in findings] == [
+        ("ESR011", 8), ("ESR011", 10), ("ESR011", 12),
+    ]
+
+
+def test_baseline_ratchet_and_rules_version_drift(tmp_path):
+    audit = _audit_src(tmp_path, CX001_POSITIVE)
+    assert len(audit.findings) == 1
+    baseline_path = tmp_path / "cx_baseline.json"
+    write_baseline(str(baseline_path), audit.findings,
+                   rules_version=rules_signature())
+    baseline = load_baseline(str(baseline_path))
+    # grandfathered: the same finding is not "new"
+    assert new_findings(audit.findings, baseline) == []
+    # same rule set -> no drift message
+    assert check_baseline_version(str(baseline_path),
+                                  rules_signature()) is None
+    # a CX catalog upgrade over a NON-EMPTY baseline must fail with the
+    # one regenerate message, not per-finding noise
+    msg = check_baseline_version(
+        str(baseline_path), rules_signature() + ",CX007"
+    )
+    assert msg is not None and "Regenerate" in msg
+
+
+def test_conditional_lambda_bodies_do_not_crash_the_walker(tmp_path):
+    """PRE-FIX: ast.IfExp (and comprehensions) carry a `body` field that
+    is a single expression, not a suite — the compound-statement branch
+    iterated it and the gate hard-crashed on any `a if c else b` lambda
+    anywhere under the audited tree."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        f = lambda x: 1 if x else 2
+        vals = [y for y in range(3) if y]
+        with self._lk:
+            self.n += f(len(vals))
+"""
+    audit = _audit_src(tmp_path, src)  # must not raise
+    assert "CX002" not in _rules_of(audit)
+
+
+def test_deferred_lambda_body_not_stamped_with_the_lock(tmp_path):
+    """PRE-FIX: the expression walk descended into lambda subtrees a
+    second time under the held stack, so a deferred callback BUILT under
+    a lock was falsely flagged CX003 as if it RAN under it."""
+    src = """
+import queue, threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._cb = None
+
+    def arm(self):
+        with self._lock:
+            self._cb = lambda: self._q.get()
+"""
+    audit = _audit_src(tmp_path, src)
+    assert "CX003" not in _rules_of(audit)
+
+
+def test_malformed_cx_noqa_owned_by_exactly_one_gate(tmp_path):
+    """A typo'd CX name (`CX0O1`, letter O) must be reported stale ONCE:
+    the AST gate keeps it (not a well-formed CX name) and the threads
+    gate's ownership predicate — identical to core's exemption — skips
+    it."""
+    src = CX001_POSITIVE.replace(
+        "        self.n += 1",
+        "        self.n += 1  # esr: noqa(CX0O1)",
+    )
+    audit = _audit_src(tmp_path, src)
+    # the threads gate reports the (unsuppressed) CX001 but NOT the
+    # malformed line's staleness...
+    assert _rules_of(audit) == ["CX001"]
+    # ...which belongs to the AST gate
+    findings = analyze_source((tmp_path / "mod.py").read_text(),
+                              path="mod.py")
+    assert [f.rule for f in findings] == ["ESR011"]
+    assert "CX0O1" in findings[0].message
+
+
+def test_unknown_rule_name_raises():
+    with pytest.raises(ValueError, match="CX999"):
+        audit_concurrency([FIXTURE], rules=["CX999"])
+
+
+def test_rules_signature_covers_the_catalog():
+    assert rules_signature() == "cx:" + ",".join(sorted(CONCURRENCY_RULES))
+    assert set(CONCURRENCY_RULES) == {
+        "CX001", "CX002", "CX003", "CX004", "CX005", "CX006"
+    }
+
+
+# ---------------------------------------------------------------------------
+# the CLI gates (subprocess: the exact commands CI and humans run)
+
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "esr_tpu.analysis", *argv],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+
+
+def test_cli_threads_gate_exits_zero_on_the_repo():
+    """ISSUE 14 acceptance: `python -m esr_tpu.analysis --threads` from
+    the repo root, against the committed baseline, exits 0 — and fast
+    (device-free, jax-free; the ~10 s bound covers interpreter start)."""
+    t0 = time.monotonic()
+    proc = _run_cli("--threads")
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, (
+        f"threads gate failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    assert "concurrency audit:" in proc.stderr
+    assert "0 new finding(s)" in proc.stderr
+    assert elapsed < 10.0, f"threads gate took {elapsed:.1f}s"
+
+
+def test_cli_fixture_exits_one_naming_every_rule():
+    proc = _run_cli("--threads", FIXTURE)
+    assert proc.returncode == 1, (
+        f"expected exit 1\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    for rule in sorted(CONCURRENCY_RULES):
+        assert rule in proc.stdout, f"{rule} missing from fixture findings"
+
+
+def test_cli_unknown_rules_name_exits_two():
+    proc = _run_cli("--threads", "--rules", "CX999")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_threads_json_section(tmp_path):
+    proc = _run_cli("--format", "json", "--threads")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["threads"]["findings"] == []
+    assert doc["threads"]["model"]["threads_modeled"] >= 5
+    assert doc["threads"]["rules_version"].startswith("cx:")
+
+
+# ---------------------------------------------------------------------------
+# regressions for the real fixes the first sweep surfaced
+
+
+class _Rec:
+    """Minimal record tap (the real sink attaches trace fields; this one
+    just counts — used where only call counts matter)."""
+
+    def __init__(self):
+        self.events = []
+        self.gauges = []
+
+    def event(self, name, **fields):
+        self.events.append((name, fields))
+
+    def gauge(self, name, value, **fields):
+        self.gauges.append((name, value, fields))
+
+
+def _wait_until(pred, timeout=3.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_device_watermark_restart_polls_again():
+    """PRE-FIX: the stop event persisted across start/stop cycles, so a
+    restarted watermark's fresh thread saw the set flag and exited
+    without a single poll — a silently dead poller."""
+    from esr_tpu.obs.device import DeviceWatermark
+
+    w = DeviceWatermark(sink=_Rec(), interval_s=0.02)
+    w.start()
+    assert _wait_until(lambda: w.polls >= 1)
+    w.stop()
+    p1 = w.polls
+    w.start()
+    assert not w._stop.is_set()
+    assert _wait_until(lambda: w.polls > p1), (
+        "restarted watermark never polled again"
+    )
+    w.stop()
+
+
+def test_device_watermark_wedged_stop_cannot_resurrect_a_zombie():
+    """A stop() whose join times out (poller wedged inside memory_stats)
+    must KEEP the thread handle, so a later start() cannot clear the
+    stop flag and spawn a duplicate poller beside the zombie."""
+    import threading
+
+    from esr_tpu.obs import device as device_mod
+    from esr_tpu.obs.device import DeviceWatermark
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def _wedged_stats(device_index=0):
+        entered.set()
+        release.wait(10.0)
+        return None
+
+    real = device_mod.device_memory_stats
+    device_mod.device_memory_stats = _wedged_stats
+    zombie = None
+    try:
+        w = DeviceWatermark(sink=_Rec(), interval_s=0.01)
+        w.start()
+        assert entered.wait(3.0)
+        zombie = w._thread
+        w.stop()  # the join times out (~2 s floor): the poller is wedged
+        assert zombie.is_alive()
+        assert w._thread is zombie, "stop() dropped a live thread handle"
+        w.start()  # must NOT clear the stop flag / spawn a duplicate
+        assert w._thread is zombie
+        assert w._stop.is_set(), "start() resurrected a wedged poller"
+        # once the zombie actually dies, start() must work again (a
+        # retained DEAD handle must not make start() a no-op forever)
+        release.set()
+        zombie.join(timeout=3.0)
+        assert not zombie.is_alive()
+        p = w.polls
+        w.start()
+        assert w._thread is not None and w._thread is not zombie
+        assert _wait_until(lambda: w.polls > p), (
+            "start() after the zombie died never polled again"
+        )
+        w.stop()
+    finally:
+        release.set()
+        device_mod.device_memory_stats = real
+        if zombie is not None:
+            zombie.join(timeout=2.0)
+
+
+def test_device_watermark_thread_adopts_starter_trace_context(tmp_path):
+    """PRE-FIX (CX005): watermark records emitted from the poller thread
+    carried no trace linkage — they parked outside the causal tree."""
+    from esr_tpu.obs import trace
+    from esr_tpu.obs.device import DeviceWatermark
+    from esr_tpu.obs.sink import TelemetrySink
+
+    sink = TelemetrySink(str(tmp_path / "t.jsonl"), manifest={})
+    seen = []
+    sink.add_observer(seen.append)
+    handle = trace.begin("wm_root", sink=sink)
+    try:
+        w = DeviceWatermark(sink=sink, interval_s=0.02)
+        w.start()
+        # CPU has no memory stats: the thread polls once, emits the
+        # one-shot unavailable event, and stops — that event must link
+        assert _wait_until(lambda: any(
+            r.get("name") == "device_watermark_unavailable" for r in seen
+        ))
+        w.stop()
+    finally:
+        handle.end()
+        sink.close()
+    rec = next(r for r in seen
+               if r.get("name") == "device_watermark_unavailable")
+    assert rec.get("trace_id") == handle.trace_id
+    assert rec.get("parent_id") == handle.span_id
