@@ -2029,6 +2029,72 @@ def stage_chaos_recovery(ctx):
     return res
 
 
+# The fleet_loadgen stage record schema, pinned by test_bench_registry —
+# the FLEET headline (ISSUE 15): fleet-sustained windows/s at a pinned
+# p99 window latency THROUGH a mid-run replica kill + partition +
+# forced handoff, with zero lost requests and twin metric parity as
+# tracked booleans. `fleet_vs_single` is informational on CPU (all
+# replicas share the cores and the fleet run pays arrival pacing + the
+# chaos detection windows the single-engine replay does not).
+FLEET_LOADGEN_KEYS = (
+    "fleet_windows_per_sec", "single_windows_per_sec", "fleet_vs_single",
+    "p99_window_ms", "requests", "completed_ok", "migrations",
+    "failovers", "replicas", "zero_lost", "faults_injected",
+    "faults_unrecovered", "parity_max_rel_diff", "ok", "seed",
+)
+
+
+def stage_fleet_loadgen(ctx):
+    """The fleet tier end to end (``esr_tpu.serving.fleet``, ISSUE 15):
+    the scripted fleet chaos scenario — seeded Poisson traffic through a
+    3-replica consistent-hash router while a ``fleet_router`` FaultPlan
+    fires ``router_handoff`` (bit-exact wire-format migration),
+    ``replica_kill`` (missed heartbeats -> fail-over), and
+    ``replica_partition`` (fence -> fail-over) mid-run. Headline:
+    fleet-sustained windows/s with the merged per-class p99 window
+    latency, zero-lost accounting, and per-request metric parity against
+    the unfaulted single-engine twin. Host/CPU-bound by design (the
+    point is the routing/recovery control flow), so it runs in smoke."""
+    import json as _json
+
+    from esr_tpu.resilience.chaos_fleet import (
+        N_REPLICAS,
+        run_fleet_scenario,
+    )
+
+    seed = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        summary = run_fleet_scenario(tmp, seed=seed)
+        with open(summary["merged_report"]) as f:
+            merged = _json.load(f)["report"]
+    class_p99 = [
+        c.get("window_latency_p99_ms")
+        for c in merged["serving"]["classes"].values()
+        if c.get("window_latency_p99_ms") is not None
+    ]
+    fleet_wps = summary["summary"]["windows_per_sec"]
+    single_wps = summary["twin_summary"]["windows_per_sec"]
+    res = dict(zip(FLEET_LOADGEN_KEYS, (
+        fleet_wps,
+        single_wps,
+        round(fleet_wps / single_wps, 3) if single_wps else None,
+        max(class_p99) if class_p99 else None,
+        summary["summary"]["requests"],
+        summary["summary"]["statuses"].get("ok", 0),
+        summary["summary"]["migrations"],
+        summary["summary"]["failovers"],
+        N_REPLICAS,
+        summary["summary"]["zero_lost"],
+        summary["faults"]["injected"],
+        summary["faults"]["unrecovered"],
+        summary["parity"]["max_rel_diff"],
+        summary["ok"],
+        seed,
+    ), strict=True))
+    EXTRA["fleet_loadgen"] = dict(res)
+    return res
+
+
 # The obs_live stage record schema, pinned by test_bench_registry — the
 # live-telemetry-plane cost trio (ISSUE 11) stays machine-comparable
 # across rounds: what attaching the LiveAggregator costs on the record
@@ -2376,6 +2442,10 @@ STAGE_REGISTRY = [
     # restarts under seeded Poisson churn (tiny + dispatch-bound like
     # infer_throughput, so it runs in smoke too)
     ("serve_loadgen", stage_serve_loadgen, 900, True),
+    # the fleet headline: N replicas behind the consistent-hash router
+    # surviving a scripted kill + partition + forced handoff with zero
+    # lost requests and twin parity (host-bound, runs in smoke)
+    ("fleet_loadgen", stage_fleet_loadgen, 900, True),
     # the chaos gate: seeded fault schedule over a short train+serve
     # session; faults_injected / recovered / recovery_overhead_frac
     # become a tracked series (host-bound by design, runs in smoke)

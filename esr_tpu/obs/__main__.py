@@ -1,11 +1,17 @@
 """CLI: ``python -m esr_tpu.obs <export|report|drift> ...``.
 
-- ``export telemetry.jsonl [-o trace.json]`` — Chrome trace-event /
-  Perfetto JSON (open in ``ui.perfetto.dev``; obs/export.py).
-- ``report telemetry.jsonl [--slo configs/slo.yml] [-o report.json]`` —
-  offline rollup (goodput, per-span p50/p99, per-class window latency,
-  trace completeness, numerics) printed as JSON; with ``--slo`` the run
-  is gated against declarative thresholds (obs/report.py).
+- ``export telemetry.jsonl [more.jsonl ...] [-o trace.json]`` — Chrome
+  trace-event / Perfetto JSON (open in ``ui.perfetto.dev``;
+  obs/export.py). Several paths (a fleet's router + replica files,
+  optionally ``label=path``) merge into one trace with per-replica
+  process groups.
+- ``report telemetry.jsonl [more.jsonl ...] [--slo configs/slo.yml]
+  [-o report.json]`` — offline rollup (goodput, per-span p50/p99,
+  per-class window latency, trace completeness, numerics) printed as
+  JSON; with ``--slo`` the run is gated against declarative thresholds
+  (obs/report.py). Several paths merge into one FLEET-level rollup
+  (exact percentiles — merge==concat) with a per-replica ``replicas``
+  section; the SLO gates the fleet view (docs/SERVING.md "The fleet").
 - ``drift [--dtype bf16] [--break-tag TAG] [--fail-on-drift]`` — the
   precision-drift attribution harness (obs v4, obs/numerics.py): one
   seeded batch through an f32-reference and a candidate-dtype twin of
@@ -43,7 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
     ex = sub.add_parser(
         "export", help="convert telemetry.jsonl to Perfetto/Chrome JSON"
     )
-    ex.add_argument("telemetry", help="path to a telemetry.jsonl")
+    ex.add_argument(
+        "telemetry", nargs="+",
+        help="telemetry.jsonl path(s); several (optionally `label=path` "
+             "— a fleet's router + replica files) merge into ONE trace "
+             "with per-replica process groups",
+    )
     ex.add_argument(
         "-o", "--out", default=None,
         help="output path (default: <telemetry>.trace.json)",
@@ -57,7 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
     rp = sub.add_parser(
         "report", help="roll up a run and (optionally) gate it on an SLO"
     )
-    rp.add_argument("telemetry", help="path to a telemetry.jsonl")
+    rp.add_argument(
+        "telemetry", nargs="+",
+        help="telemetry.jsonl path(s); several (optionally `label=path` "
+             "— a fleet's router + replica files) merge into one "
+             "fleet-level rollup with a per-replica `replicas` section",
+    )
     rp.add_argument(
         "--slo", default=None, metavar="YAML",
         help="SLO thresholds (e.g. configs/slo.yml); exit 1 on violation",
@@ -114,12 +130,18 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "export":
-        from esr_tpu.obs.export import export_file
+        from esr_tpu.obs.export import export_file, export_files
+        from esr_tpu.obs.report import split_label
 
-        out = args.out or (args.telemetry + ".trace.json")
+        out = args.out or (split_label(args.telemetry[0])[1]
+                           + ".trace.json")
         try:
-            stats = export_file(args.telemetry, out,
-                                run_index=args.run_index)
+            if len(args.telemetry) == 1 and "=" not in args.telemetry[0]:
+                stats = export_file(args.telemetry[0], out,
+                                    run_index=args.run_index)
+            else:
+                stats = export_files(args.telemetry, out,
+                                     run_index=args.run_index)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
@@ -146,11 +168,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         return 0
 
-    from esr_tpu.obs.report import report_file
+    from esr_tpu.obs.report import report_files
 
     try:
-        doc, code = report_file(args.telemetry, args.slo, args.out,
-                                run_index=args.run_index)
+        doc, code = report_files(args.telemetry, args.slo, args.out,
+                                 run_index=args.run_index)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -318,3 +318,53 @@ def export_file(in_path: str, out_path: str, run_index: int = -1) -> Dict:
         "torn_lines": torn,
         "out": out_path,
     }
+
+
+# pid stride between replica process groups in a merged fleet export:
+# each file keeps its host/lanes/requests/counters track split, shifted
+# into its own block and labeled "<replica>: <track>"
+_FLEET_PID_STRIDE = 10
+
+
+def export_files(
+    in_args, out_path: str, run_index: int = -1
+) -> Dict:
+    """Merge N telemetry files (a fleet: router + one per replica) into
+    ONE Perfetto trace: each file's tracks land in their own pid block,
+    process names prefixed with the replica label
+    (``obs.report.split_label`` — ``r0=path`` or filename-derived), so
+    lanes/requests/counters of different replicas never overlay. Each
+    file keeps its own zero-based time axis — replicas start together in
+    a fleet run, so tracks align to within startup skew (the same reason
+    appended RUNS of one file are still selected, never merged)."""
+    from esr_tpu.obs.report import split_label
+
+    events = []
+    total_records = 0
+    total_torn = 0
+    for i, arg in enumerate(in_args):
+        label, path = split_label(arg)
+        manifest, records, torn = read_telemetry(path, run_index=run_index)
+        total_records += len(records)
+        total_torn += torn
+        doc = to_chrome_trace(records, manifest)
+        offset = i * _FLEET_PID_STRIDE
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            if "pid" in ev:
+                ev["pid"] = int(ev["pid"]) + offset
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                ev["args"] = {
+                    "name": f"{label}: {ev['args'].get('name', '')}"
+                }
+            events.append(ev)
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    return {
+        "events": len(events),
+        "records": total_records,
+        "torn_lines": total_torn,
+        "files": len(list(in_args)),
+        "out": out_path,
+    }

@@ -16,7 +16,11 @@ run is in flight (docs/OBSERVABILITY.md "The live plane"):
   health source (:func:`register_health_source` — the ``DevicePrefetcher``
   stall watchdog, the serving tier's lane-quarantine ledger) is consulted;
   HTTP 200 when all healthy, 503 when any is not. The body is JSON with
-  the per-source detail either way.
+  the per-source detail either way. Source names may carry an ``@<ns>``
+  suffix (the fleet tier runs N replicas in one process): a server built
+  with ``ns=...`` sees only its own namespaced sources plus the
+  un-suffixed process-wide ones, so replica A's quarantine can never 503
+  replica B (docs/SERVING.md "The fleet").
 - ``/slo`` — LIVE multi-window burn-rate evaluation of the same
   ``configs/slo.yml`` the offline reporter gates on: the rules are
   evaluated against the aggregator's fast-window snapshot AND its
@@ -73,10 +77,25 @@ def unregister_health_source(name: str) -> None:
         _HEALTH_SOURCES.pop(name, None)
 
 
-def health_snapshot() -> Tuple[bool, Dict[str, Dict]]:
-    """``(all_healthy, {source: detail})`` over every registered source."""
+def health_snapshot(ns: Optional[str] = None) -> Tuple[bool, Dict[str, Dict]]:
+    """``(all_healthy, {source: detail})`` over every registered source.
+
+    ``ns`` scopes the view for MULTI-REPLICA processes (the fleet tier,
+    docs/SERVING.md "The fleet"): source names may carry an ``@<ns>``
+    suffix (``serving_lanes@r0``), and a namespaced snapshot sees only
+    its own ``@<ns>`` sources plus the un-suffixed process-wide ones —
+    replica A's lane quarantine must never flip replica B's ``/healthz``
+    to 503 (the router would drain a healthy replica). ``ns=None`` (the
+    default, every single-replica process) keeps today's behavior: every
+    source, namespaced or not."""
     with _HEALTH_LOCK:
         sources = dict(_HEALTH_SOURCES)
+    if ns is not None:
+        suffix = "@" + str(ns)
+        sources = {
+            name: fn for name, fn in sources.items()
+            if "@" not in name or name.endswith(suffix)
+        }
     out: Dict[str, Dict] = {}
     healthy = True
     for name in sorted(sources):
@@ -233,8 +252,12 @@ class LiveTelemetryServer:
         host: str = "127.0.0.1",
         slo_path: Optional[str] = None,
         windows: Tuple[float, float] = (60.0, 300.0),
+        ns: Optional[str] = None,
     ):
         self.aggregator = aggregator
+        # health-source namespace (fleet tier): /healthz consults only
+        # this server's @<ns> sources + the un-suffixed global ones
+        self.ns = ns
         self._host = host
         self._want_port = int(port)
         self.slo_path = slo_path
@@ -258,7 +281,7 @@ class LiveTelemetryServer:
         return render_prometheus(self.aggregator.snapshot())
 
     def healthz_doc(self) -> Tuple[int, Dict]:
-        healthy, sources = health_snapshot()
+        healthy, sources = health_snapshot(ns=self.ns)
         snap = self.aggregator.snapshot()
         doc = {
             "healthy": healthy,
@@ -407,7 +430,9 @@ class LivePlane:
     def close(self) -> None:
         self.server.close()
         if self.sink is not None:
-            unregister_health_source("numerics")
+            name = ("numerics" if self.server.ns is None
+                    else f"numerics@{self.server.ns}")
+            unregister_health_source(name)
             self.aggregator.detach(self.sink)
             self.sink = None
 
@@ -419,6 +444,7 @@ def start_live_plane(
     slo_path: Optional[str] = None,
     windows: Tuple[float, float] = (60.0, 300.0),
     rel_err: float = 0.01,
+    ns: Optional[str] = None,
 ) -> LivePlane:
     """The one-call wiring every entry point uses: build a
     :class:`~esr_tpu.obs.aggregate.LiveAggregator`, attach it to ``sink``,
@@ -440,9 +466,12 @@ def start_live_plane(
     # tier alike); healthy while no probes report.
     from esr_tpu.obs.numerics import numerics_health_source
 
-    register_health_source("numerics", numerics_health_source(aggregator))
+    register_health_source(
+        "numerics" if ns is None else f"numerics@{ns}",
+        numerics_health_source(aggregator),
+    )
     server = LiveTelemetryServer(
         aggregator, port=port, host=host, slo_path=slo_path,
-        windows=windows,
+        windows=windows, ns=ns,
     ).start()
     return LivePlane(sink, aggregator, server)

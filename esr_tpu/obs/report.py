@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from esr_tpu.obs.export import _span_edges, read_telemetry
@@ -71,6 +72,9 @@ __all__ = [
     "load_slo",
     "evaluate_slo",
     "report_file",
+    "split_label",
+    "merge_fleet_reports",
+    "report_files",
 ]
 
 
@@ -124,6 +128,21 @@ def _round(v: Optional[float], scale: float = 1.0) -> Optional[float]:
 # the terminal event a complete request trace must hang off of
 _REQUEST_TERMINAL = "serve_request_done"
 
+# terminal statuses that legitimately have NO journey root in the file
+# that carries them: `shed` never had a journey; `replica_lost` and
+# `failover_retry_exhausted` are ROUTER-emitted (the journey spans live
+# in the replica files, the router classifies the outcome —
+# docs/RESILIENCE.md status taxonomy). `migrated` is NOT here: the
+# source replica emits it WITH its root span, so it stays walkable.
+_ROOTLESS_STATUSES = frozenset(
+    {"shed", "replica_lost", "failover_retry_exhausted"}
+)
+
+# attempt-terminal statuses excluded from request/window totals: the
+# stream CONTINUED on another replica, whose final terminal carries the
+# full-stream accounting — folding these in would double-count.
+_CONTINUED_STATUSES = frozenset({"shed", "migrated", "replica_lost"})
+
 
 def _trace_completeness(records: List[Dict]) -> Dict:
     """Walk every ``serve_request_done`` event's parent chain: complete
@@ -140,9 +159,9 @@ def _trace_completeness(records: List[Dict]) -> Dict:
     for rec in records:
         if rec.get("type") != "event" or rec.get("name") != _REQUEST_TERMINAL:
             continue
-        if rec.get("status") == "shed":
-            # a shed submit never had a journey (no root span exists);
-            # it is classified, not incomplete
+        if rec.get("status") in _ROOTLESS_STATUSES:
+            # classified, not incomplete: these statuses never had a
+            # journey root in THIS file (module constant above)
             continue
         requests += 1
         rid = rec.get("request", "?")
@@ -190,6 +209,7 @@ def _fault_completeness(records: List[Dict]) -> Dict:
         "ckpt_commit": ("ckpt_commit",),
         "ckpt_restore": ("ckpt_restore",),
         "serve_chunk": ("serve_chunk",),
+        "fleet_router": ("fleet_router",),
     }
     faults = [
         r for r in records
@@ -304,8 +324,11 @@ def build_report(
                     "ok" if rec.get("completed", False) else "bad_stream"
                 )
                 statuses[status] = statuses.get(status, 0) + 1
-                if status == "shed":
-                    continue  # shed submits are classified, not served
+                if status in _CONTINUED_STATUSES:
+                    # classified but not SERVED here: shed never ran;
+                    # migrated / replica_lost continued elsewhere and the
+                    # final terminal carries the full-stream totals
+                    continue
                 requests_done += 1
                 windows_total += int(rec.get("windows", 0) or 0)
                 if not rec.get("completed", False):
@@ -502,6 +525,133 @@ def report_file(
         telemetry_path, run_index=run_index
     )
     report = build_report(records, manifest, torn_lines=torn)
+    doc: Dict = {"report": report}
+    code = 0
+    if slo_path is not None:
+        slo = load_slo(slo_path)
+        ok, verdicts = evaluate_slo(report, slo)
+        doc["slo"] = {"ok": ok, "path": slo_path, "verdicts": verdicts}
+        code = 0 if ok else 1
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    return doc, code
+
+
+# -- fleet rollup: one report over many telemetry files ----------------------
+
+
+def split_label(arg: str) -> Tuple[str, str]:
+    """``label=path`` -> ``(label, path)``; a bare path derives its label
+    from the filename (``telemetry_r0.jsonl`` -> ``telemetry_r0``), or —
+    for the conventional per-run ``telemetry.jsonl`` name — from the
+    parent directory, so replica rows stay tellable apart by default."""
+    if "=" in arg and not os.path.exists(arg):
+        label, _, path = arg.partition("=")
+        if label and path:
+            return label, path
+    base = os.path.basename(arg)
+    stem = base[: -len(".jsonl")] if base.endswith(".jsonl") else base
+    if stem == "telemetry":
+        parent = os.path.basename(os.path.dirname(os.path.abspath(arg)))
+        stem = parent or stem
+    return stem, arg
+
+
+def merge_fleet_reports(
+    labeled: List[Tuple[str, Optional[Dict], List[Dict], int]],
+) -> Dict:
+    """Fleet-level rollup over per-replica telemetry (docs/SERVING.md
+    "The fleet"): ``labeled`` is ``(replica label, manifest, records,
+    torn)`` per file.
+
+    The fleet sections are built from the CONCATENATED record stream, so
+    everything distribution-shaped is EXACT — percentiles over durations
+    are order-free (the same merge==concat property the live plane's
+    ``QuantileSketch`` pins), fault->recovery matching and trace
+    completeness walk ids that are unique across processes. Two sections
+    need per-file composition instead: ``counters`` carry running totals
+    (last-wins under concat; the fleet sums each file's final total) and
+    ``goodput`` walls live on per-file clock bases (the fleet reports a
+    wall-weighted mean plus the per-replica values). A ``replicas``
+    section labels each file's own rollup row, so per-replica and fleet
+    views come from the same files."""
+    if not labeled:
+        raise ValueError("merge_fleet_reports needs at least one file")
+    per: List[Tuple[str, Dict]] = [
+        (label, build_report(records, manifest, torn_lines=torn))
+        for label, manifest, records, torn in labeled
+    ]
+    all_records = [rec for _, _, records, _ in labeled for rec in records]
+    fleet = build_report(
+        all_records, labeled[0][1],
+        torn_lines=sum(torn for _, _, _, torn in labeled),
+    )
+    counters: Dict[str, float] = {}
+    for _, rep in per:
+        for name, total in rep["counters"].items():
+            counters[name] = counters.get(name, 0.0) + total
+    fleet["counters"] = {k: counters[k] for k in sorted(counters)}
+    valued = [(label, rep["goodput"]) for label, rep in per
+              if rep["goodput"]["value"] is not None]
+    if valued:
+        weights = [float(g.get("wall_s") or 0.0) or 1.0 for _, g in valued]
+        fleet["goodput"] = {
+            "value": round(
+                sum(g["value"] * w for (_, g), w in zip(valued, weights))
+                / sum(weights), 6,
+            ),
+            "source": "fleet",
+            "wall_s": round(max(
+                float(g.get("wall_s") or 0.0) for _, g in valued
+            ), 6),
+            "busy_s": round(sum(
+                float(g.get("busy_s") or 0.0) for _, g in valued
+            ), 6),
+            "replicas": {label: g["value"] for label, g in valued},
+        }
+    else:
+        fleet["goodput"] = {"value": None, "source": "fleet"}
+    fleet["replicas"] = {
+        label: {
+            "records": rep["records"],
+            "torn_lines": rep["torn_lines"],
+            "goodput": rep["goodput"]["value"],
+            "requests": rep["serving"]["requests"],
+            "completed": rep["serving"]["completed"],
+            "errors": rep["serving"]["errors"],
+            "windows": rep["serving"]["windows"],
+            "statuses": rep["serving"]["statuses"],
+            "preemptions": rep["serving"]["preemptions"],
+            "faults_injected": rep["faults"]["injected"],
+            "faults_unrecovered": rep["faults"]["unrecovered"],
+            "traces_incomplete": rep["traces"]["incomplete"],
+        }
+        for label, rep in per
+    }
+    return fleet
+
+
+def report_files(
+    telemetry_args: Sequence[str],
+    slo_path: Optional[str] = None,
+    out_path: Optional[str] = None,
+    run_index: int = -1,
+) -> Tuple[Dict, int]:
+    """Multi-file CLI body (``python -m esr_tpu.obs report a.jsonl
+    b.jsonl ...``): one file behaves exactly like :func:`report_file`;
+    several are merged into the fleet rollup (labels via
+    :func:`split_label` — ``r0=path`` or filename-derived) and the SLO
+    gate evaluates the FLEET-level report."""
+    if len(telemetry_args) == 1 and "=" not in telemetry_args[0]:
+        return report_file(telemetry_args[0], slo_path, out_path,
+                           run_index=run_index)
+    labeled = []
+    for arg in telemetry_args:
+        label, path = split_label(arg)
+        manifest, records, torn = read_telemetry(path, run_index=run_index)
+        labeled.append((label, manifest, records, torn))
+    report = merge_fleet_reports(labeled)
     doc: Dict = {"report": report}
     code = 0
     if slo_path is not None:

@@ -1,0 +1,322 @@
+"""The scripted FLEET chaos scenario: N replicas under replica-level faults.
+
+One level up from :mod:`esr_tpu.resilience.chaos` (train/serve-site
+faults inside one process), this scenario proves the FLEET contract
+(docs/SERVING.md "The fleet", ISSUE 15) end to end on CPU, shared by the
+tier-1 fleet smoke (``tests/test_fleet_smoke.py``,
+``scripts/fleet_smoke.sh``) and the bench ``fleet_loadgen`` stage's
+chaos half:
+
+1. **twin serve** — every stream through ONE fault-free ``ServingEngine``
+   (same classes, same request ids): the per-request ground truth.
+2. **fleet serve** — the SAME streams as seeded Poisson traffic through a
+   3-replica :class:`~esr_tpu.serving.fleet.FleetRouter` under a
+   ``fleet_router`` :class:`~esr_tpu.resilience.faults.FaultPlan`:
+   ``router_handoff`` (forced voluntary drain — streams migrate
+   bit-exactly over the lane-state wire format), ``replica_kill``
+   (abrupt death mid-run — missed heartbeats, involuntary fail-over),
+   and ``replica_partition`` (unreachable — fenced, then failed over).
+3. **checks** — zero lost requests (every ledger row classified
+   terminal), all three faults injected AND recovered
+   (``faults.unrecovered == 0`` over the merged router + replica
+   telemetry), migrated/failed-over streams matching the twin's
+   per-request metric means within ``1e-5`` rel (a handoff resumes
+   bit-exactly; a fail-over replays from window 0 — either way the
+   full-stream means are the twin's), and the merged
+   ``obs report --slo configs/slo_fleet.yml`` exiting 0.
+
+CLI: ``python -m esr_tpu.resilience.chaos_fleet --out DIR [--seed N]``
+prints the summary JSON and exits 0 iff every acceptance property held.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
+
+# scenario scale (tiny: the whole thing must run inside the CPU tier-1
+# budget; the chunk programs are shared with the twin via the process
+# program cache, so tracing is paid once)
+N_REPLICAS = 3
+LANES = 2
+N_STREAMS = 6
+RATE_HZ = 2.5          # arrivals span ~2.5 s: rounds keep ticking while
+                       # the late faults (kill detection, fence) land
+EVENTS_SCHEDULE = (1600, 4200)   # alternating short/long streams
+
+
+def dataset_config() -> Dict:
+    return {
+        "scale": 2,
+        "ori_scale": "down8",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 1024,
+        "sliding_window": 512,
+        "need_gt_events": True,
+        "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {
+            "sequence_length": 4,
+            "seqn": 3,
+            "step_size": None,
+            "pause": {"enabled": False},
+        },
+    }
+
+
+def serving_classes() -> Dict:
+    from esr_tpu.serving import RequestClass
+
+    return {
+        "interactive": RequestClass("interactive", chunk_windows=2),
+        "standard": RequestClass("standard", chunk_windows=4),
+    }
+
+
+def build_fleet_plan(seed: int) -> FaultPlan:
+    """Three replica-level faults at EARLY router rounds (streams must
+    still be in flight when each lands). Placement is structural —
+    handoff first (state exists to migrate), kill next, partition last
+    (its fence needs the detection window) — with seed jitter so the
+    gate does not ossify around one fixed trace. Targets walk to an
+    alive replica at enactment, so the three faults always hit three
+    DIFFERENT fates."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    handoff_round = 1 + int(rng.integers(0, 2))           # 1-2
+    kill_round = handoff_round + 1                         # 2-3
+    partition_round = kill_round + 2 + int(rng.integers(0, 2))  # 4-6
+    return FaultPlan([
+        FaultSpec("fleet_router", handoff_round, "router_handoff",
+                  arg=0.0),
+        FaultSpec("fleet_router", kill_round, "replica_kill", arg=1.0),
+        FaultSpec("fleet_router", partition_round, "replica_partition",
+                  arg=2.0),
+    ])
+
+
+def _build_model(seed: int = 0):
+    import jax
+    import numpy as np
+
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    # basech=4 (not the serve-smoke suite's 2) ON PURPOSE: the chunk
+    # program cache is process-global and keyed by (model, lanes, W,
+    # grid) — sharing keys with tests/test_serve_smoke.py would warm its
+    # programs (this module sorts first in tier-1) and its churn-timing
+    # assertions (preemptions under load) only hold from a cold start
+    model = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    x = np.zeros((1, 3, 16, 16, 2), np.float32)
+    params = model.init(
+        jax.random.PRNGKey(seed), x, model.init_states(1, 16, 16)
+    )
+    return model, params
+
+
+def _run_twin(out_dir: str, model, params, schedule) -> Tuple[Dict, Dict]:
+    """Every stream through one fault-free engine with the SAME request
+    ids and classes the fleet will see; returns ``(per-request reports,
+    session summary)`` — the ground truth AND the single-engine
+    baseline row the bench stage compares against."""
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.serving import ServingEngine
+
+    sink = TelemetrySink(os.path.join(out_dir, "telemetry_twin.jsonl"))
+    prev = set_active_sink(sink)
+    try:
+        engine = ServingEngine(
+            model, params, dataset_config(), lanes=LANES,
+            classes=serving_classes(), default_class="standard",
+            preempt_quantum=0,
+        )
+        for a in schedule:
+            engine.submit(a.path, a.request_class, request_id=a.request_id)
+        summary = engine.run(max_wall_s=300.0)
+        return engine.reports(), summary
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+
+def _metric_parity(twin_reports: Dict, fleet_reports: Dict) -> Dict:
+    """Worst per-request relative difference of the engine-schema metric
+    means between the unfaulted twin and the fleet's terminal reports —
+    the migrated/failed-over parity evidence."""
+    from esr_tpu.inference.engine import METRIC_KEYS
+
+    worst = 0.0
+    worst_at: Optional[Tuple[str, str]] = None
+    compared = 0
+    windows_match = True
+    for rid, fleet_rep in fleet_reports.items():
+        if fleet_rep.get("status") != "ok":
+            continue
+        twin_rep = twin_reports[rid]
+        if fleet_rep["n_windows"] != twin_rep["n_windows"]:
+            # a migrated/failed-over stream must still serve the FULL
+            # window count — a short count is a lost-tail bug, reported
+            # (not crashed) so the summary names it
+            windows_match = False
+        compared += 1
+        for key in METRIC_KEYS:
+            a, b = float(twin_rep[key]), float(fleet_rep[key])
+            rel = abs(a - b) / max(abs(a), 1e-12)
+            if rel > worst:
+                worst, worst_at = rel, (rid, key)
+    return {"max_rel_diff": worst, "at": worst_at, "compared": compared,
+            "windows_match": windows_match}
+
+
+def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
+    """The whole scripted fleet scenario; returns the machine-checkable
+    summary (every acceptance property precomputed as a boolean)."""
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.obs.report import report_files
+    from esr_tpu.serving import (
+        FleetRouter,
+        Replica,
+        poisson_schedule,
+        make_stream_corpus,
+    )
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = make_stream_corpus(
+        os.path.join(out_dir, "streams"), n=N_STREAMS, seed=seed,
+        events_schedule=EVENTS_SCHEDULE,
+    )
+    schedule = poisson_schedule(
+        paths, rate_hz=RATE_HZ, seed=seed,
+        classes=("standard", "interactive"),
+    )
+    model, params = _build_model(seed)
+    twin_reports, twin_summary = _run_twin(out_dir, model, params, schedule)
+
+    plan = build_fleet_plan(seed)
+    replica_files = {
+        f"r{i}": os.path.join(out_dir, f"telemetry_r{i}.jsonl")
+        for i in range(N_REPLICAS)
+    }
+    replicas = [
+        Replica(
+            rid, model, params, dataset_config(),
+            telemetry_path=path, classes=serving_classes(),
+            default_class="standard", lanes=LANES,
+            live_slo=os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))), "configs", "slo.yml",
+            ),
+            preempt_quantum=0,
+        ).start()
+        for rid, path in sorted(replica_files.items())
+    ]
+    router_file = os.path.join(out_dir, "telemetry_router.jsonl")
+    router_sink = TelemetrySink(router_file)
+    prev = set_active_sink(router_sink)
+    router = FleetRouter(
+        replicas, default_class="standard",
+        failover_budget=2, miss_budget=2,
+    )
+    t0 = time.monotonic()
+    try:
+        with installed(plan):
+            summary = router.run(arrivals=schedule, max_wall_s=300.0)
+    finally:
+        router.close()
+        set_active_sink(prev)
+        router_sink.close()
+    wall = time.monotonic() - t0
+
+    fleet_reports = router.reports()
+    parity = _metric_parity(twin_reports, fleet_reports)
+    merged_args = [f"router={router_file}"] + [
+        f"{rid}={path}" for rid, path in sorted(replica_files.items())
+    ]
+    slo_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "configs", "slo_fleet.yml",
+    )
+    merged_doc, merged_code = report_files(
+        merged_args, slo_path,
+        out_path=os.path.join(out_dir, "FLEET_REPORT.json"),
+    )
+    faults = merged_doc["report"]["faults"]
+
+    statuses = {r["status"] for r in fleet_reports.values()}
+    result = {
+        "seed": seed,
+        "wall_s": round(wall, 3),
+        "summary": summary,
+        "twin_summary": twin_summary,
+        "parity": parity,
+        "faults": faults,
+        "merged_report": os.path.join(out_dir, "FLEET_REPORT.json"),
+        "telemetry": {
+            "router": router_file, **replica_files,
+            "twin": os.path.join(out_dir, "telemetry_twin.jsonl"),
+        },
+        "checks": {
+            # zero lost requests: every submitted request classified
+            "zero_lost": bool(summary["zero_lost"]),
+            "all_statuses_classified": None not in statuses,
+            # all three fleet faults fired (a drained-too-early run
+            # proves nothing) and every one was answered
+            "all_faults_fired": plan.pending_count() == 0,
+            "enough_faults": faults["injected"] >= 3,
+            "all_faults_recovered": faults["unrecovered"] == 0,
+            # migration AND fail-over genuinely happened
+            "migrated": summary["migrations"] >= 1,
+            "failed_over": summary["failovers"] >= 1,
+            # a replica really died and one was really fenced
+            "replica_died": "dead" in summary["replicas"].values(),
+            # per-request metric parity with the unfaulted twin
+            "twin_parity": (parity["max_rel_diff"] <= 1e-5
+                            and parity["windows_match"]
+                            and parity["compared"] >= 1),
+            "all_requests_ok": all(
+                r["status"] == "ok" for r in fleet_reports.values()
+            ),
+            # the merged fleet SLO gate (configs/slo_fleet.yml) is green
+            "merged_slo_ok": merged_code == 0,
+        },
+    }
+    result["ok"] = all(result["checks"].values())
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="scripted fleet chaos scenario (docs/SERVING.md "
+                    "'The fleet')"
+    )
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    summary = run_fleet_scenario(args.out, seed=args.seed)
+    with open(os.path.join(args.out, "FLEET_CHAOS_SUMMARY.json"),
+              "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    print(json.dumps({
+        "ok": summary["ok"],
+        "checks": summary["checks"],
+        "statuses": summary["summary"]["statuses"],
+        "migrations": summary["summary"]["migrations"],
+        "failovers": summary["summary"]["failovers"],
+        "parity_max_rel_diff": summary["parity"]["max_rel_diff"],
+        "faults": {k: summary["faults"][k]
+                   for k in ("injected", "recovered", "unrecovered")},
+    }))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,6 +34,13 @@ site                  kinds
                       mid-iteration), ``preempt_signal`` (simulated host
                       preemption — every bound lane is drained/saved and
                       requeued)
+``fleet_router``      ``replica_kill`` (a replica dies abruptly — its
+                      streams fail over elsewhere), ``replica_partition``
+                      (a replica becomes unreachable — fenced, then failed
+                      over), ``router_handoff`` (forced voluntary drain —
+                      every stream migrates bit-exactly over the
+                      lane-state wire format). ``arg`` selects the target
+                      replica index; keyed by the router's round ordinal.
 ====================  =====================================================
 
 Everything here is stdlib+numpy only: the data layer imports this module
@@ -49,7 +56,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 SITES = ("prefetch", "train_step", "ckpt_commit", "ckpt_restore",
-         "serve_chunk")
+         "serve_chunk", "fleet_router")
 
 _KINDS: Dict[str, Tuple[str, ...]] = {
     "prefetch": ("corrupt", "stall"),
@@ -57,6 +64,8 @@ _KINDS: Dict[str, Tuple[str, ...]] = {
     "ckpt_commit": ("fail", "torn"),
     "ckpt_restore": ("truncate",),
     "serve_chunk": ("lane_fault", "stream_error", "preempt_signal"),
+    "fleet_router": ("replica_kill", "replica_partition",
+                     "router_handoff"),
 }
 
 

@@ -5,7 +5,10 @@ over the same fused chunk program the offline engine runs
 (docs/SERVING.md). ``scheduler`` is the host-side policy (admission queue,
 virtual-lane binding, quantum preemption), ``server`` the device loop
 (state save/evict/restore, per-class chunk sizing, AOT programs),
-``loadgen`` the seeded synthetic-traffic driver.
+``loadgen`` the seeded synthetic-traffic driver, ``replica``/``fleet``
+the horizontally-scaled tier (N replicas behind a consistent-hash router
+with supervision, bit-exact stream migration, and fail-over — "The
+fleet" in docs/SERVING.md).
 """
 
 from esr_tpu.serving.scheduler import (  # noqa: F401
@@ -19,6 +22,19 @@ from esr_tpu.serving.server import RecordingStream, ServingEngine  # noqa: F401
 from esr_tpu.serving.loadgen import (  # noqa: F401
     Arrival,
     cohorts,
+    fleet_traffic,
     make_stream_corpus,
     poisson_schedule,
+)
+from esr_tpu.serving.replica import (  # noqa: F401
+    AotRegistry,
+    HandoffPacket,
+    Replica,
+    pack_lane_state,
+    unpack_lane_state,
+)
+from esr_tpu.serving.fleet import (  # noqa: F401
+    FleetRouter,
+    HashRing,
+    ReplicaSupervisor,
 )

@@ -26,7 +26,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["Arrival", "make_stream_corpus", "poisson_schedule", "cohorts"]
+__all__ = ["Arrival", "make_stream_corpus", "poisson_schedule", "cohorts",
+           "fleet_traffic"]
 
 
 @dataclass(frozen=True)
@@ -139,6 +140,36 @@ def poisson_schedule(
         ))
         t += float(rng.exponential(1.0 / rate_hz))
     return out
+
+
+def fleet_traffic(
+    out_dir: str,
+    n_replicas: int,
+    streams_per_replica: int = 4,
+    rate_hz_per_replica: float = 2.0,
+    seed: int = 0,
+    classes: Sequence[Optional[str]] = (None,),
+    **corpus_kw,
+) -> Tuple[List[str], List[Arrival]]:
+    """Multi-replica loadgen mode (docs/SERVING.md "The fleet"): corpus
+    size and AGGREGATE Poisson rate scale with the replica count, so the
+    same knobs describe per-replica pressure at any fleet size — a
+    3-replica fleet at ``rate_hz_per_replica=2`` sees 6 streams/s, each
+    replica ~2. Returns ``(paths, schedule)`` ready for
+    ``FleetRouter.run(arrivals=schedule)``; ``corpus_kw`` passes through
+    to :func:`make_stream_corpus` (``events_schedule``,
+    ``burst_schedule``, ...)."""
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    paths = make_stream_corpus(
+        out_dir, n=int(n_replicas) * int(streams_per_replica), seed=seed,
+        **corpus_kw,
+    )
+    schedule = poisson_schedule(
+        paths, rate_hz=float(rate_hz_per_replica) * int(n_replicas),
+        seed=seed, classes=classes,
+    )
+    return paths, schedule
 
 
 def cohorts(

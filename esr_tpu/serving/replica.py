@@ -1,0 +1,503 @@
+"""One fleet replica: a ``ServingEngine`` plus the things a ROUTER needs.
+
+The fleet tier (docs/SERVING.md "The fleet") is pure host policy over N
+replicas, each running today's :class:`esr_tpu.serving.server.ServingEngine`
+unchanged. This module is the per-replica half:
+
+- **the lane-state wire format** — :func:`pack_lane_state` /
+  :func:`unpack_lane_state` serialize one stream's recurrent state
+  (``inference/engine.extract_lane_state``'s host pytree) to
+  self-describing bytes and back, bit-exactly: ``ESRLANE1`` magic, a JSON
+  header naming every leaf (tree key path, shape, dtype) plus a sha256
+  digest over the raw leaf bytes, then an uncompressed ``.npz`` body. A
+  corrupted or reordered packet fails the digest/keys check LOUDLY at
+  inject time, never silently poisons a resumed stream. The header/body
+  split is parseable with numpy + stdlib alone (:func:`read_wire`), so a
+  receiving process can validate a packet without jax — pinned by the
+  cross-process round-trip test in ``tests/test_fleet.py``.
+- **the AOT artifact registry** — :class:`AotRegistry` scans a directory
+  of ``inference/export.py`` chunk-program artifacts (``*.stablehlo`` +
+  ``.json`` geometry sidecars), validates every sidecar against the
+  serving geometry at REGISTRY load (lanes, seqn, grid — before any
+  request exists, not mid-loop), and hands each replica the
+  ``{chunk_windows: path}`` map ``ServingEngine(aot_programs=...)``
+  expects: replicas cold-start from artifacts and never trace.
+- **the replica lifecycle** — :class:`Replica` owns one engine, its OWN
+  telemetry sink (one ``telemetry.jsonl`` per replica — the fleet rollup
+  merges them, ``python -m esr_tpu.obs report tel_r0.jsonl tel_r1.jsonl``),
+  and its live plane (``/metrics`` + ``/healthz`` + ``/slo`` on an
+  ephemeral port, health sources namespaced ``@<replica_id>`` so
+  co-resident replicas cannot 503 each other). The router drives it
+  cooperatively: ``pump()`` runs one engine round under this replica's
+  sink, ``drain()`` evacuates every stream as wire-format handoff
+  packets, ``admit_handoff()`` re-admits one, ``kill()`` simulates an
+  abrupt process death (the chaos plane's ``replica_kill``: live plane
+  torn down mid-flight, no terminals emitted, engine abandoned), and
+  ``partition()`` simulates a network partition (endpoints unreachable,
+  engine still alive until the router fences it).
+
+Module-level imports are stdlib + numpy only (the wire format must be
+parseable in processes that never touch an accelerator); jax and the
+engine are imported lazily.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import json
+import logging
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "WIRE_MAGIC",
+    "pack_lane_state",
+    "read_wire",
+    "unpack_lane_state",
+    "HandoffPacket",
+    "AotRegistry",
+    "Replica",
+]
+
+
+# ---------------------------------------------------------------------------
+# the lane-state wire format (extract -> BYTES -> inject)
+
+WIRE_MAGIC = b"ESRLANE1"
+_LEN = struct.Struct("<Q")
+
+
+def _wire_digest(keys, arrays) -> str:
+    """sha256 over every leaf's key path, shape, dtype, and raw bytes in
+    packet order — the same recipe as the checkpoint integrity digest
+    (``resilience.recovery.state_digest``), so bit-exactness is checked
+    end to end, not assumed."""
+    h = hashlib.sha256()
+    for key, arr in zip(keys, arrays):
+        arr = np.ascontiguousarray(arr)
+        h.update(str(key).encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def pack_lane_state(state) -> bytes:
+    """One lane's host state pytree (``extract_lane_state``) -> bytes:
+    magic, length-prefixed JSON header (schema, leaf key paths, digest),
+    uncompressed npz body. Deterministic for a given pytree — equal
+    states pack to equal bytes (the cross-process bit-exactness pin)."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    keys = [jax.tree_util.keystr(path) for path, _ in leaves]
+    arrays = [np.asarray(leaf) for _, leaf in leaves]
+    buf = io.BytesIO()
+    np.savez(buf, **{f"a{i}": a for i, a in enumerate(arrays)})
+    header = json.dumps({
+        "schema": 1,
+        "keys": keys,
+        "digest": _wire_digest(keys, arrays),
+    }, sort_keys=True).encode()
+    return WIRE_MAGIC + _LEN.pack(len(header)) + header + buf.getvalue()
+
+
+def read_wire(data: bytes) -> Tuple[Dict, List[np.ndarray]]:
+    """Parse + integrity-check a wire packet with numpy/stdlib ONLY:
+    returns ``(header, arrays in key order)``. Raises ``ValueError`` on a
+    bad magic, torn packet, or digest mismatch — a handoff must fail
+    loudly, never inject corrupted state."""
+    if data[: len(WIRE_MAGIC)] != WIRE_MAGIC:
+        raise ValueError(
+            f"not a lane-state packet (magic {data[:8]!r}, "
+            f"want {WIRE_MAGIC!r})"
+        )
+    off = len(WIRE_MAGIC)
+    try:
+        (hlen,) = _LEN.unpack_from(data, off)
+        off += _LEN.size
+        header = json.loads(data[off: off + hlen].decode())
+        body = data[off + hlen:]
+        with np.load(io.BytesIO(body), allow_pickle=False) as z:
+            arrays = [z[f"a{i}"] for i in range(len(header["keys"]))]
+    except ValueError:
+        raise
+    except Exception as e:  # noqa: BLE001 - re-raised as ValueError
+        # normalize torn/garbled packets (zip/json/struct errors) to the
+        # documented contract: a bad packet raises ValueError, loudly
+        raise ValueError(f"torn lane-state packet: {e!r}")
+    got = _wire_digest(header["keys"], arrays)
+    if got != header["digest"]:
+        raise ValueError(
+            f"lane-state digest mismatch (packet {header['digest'][:12]}…, "
+            f"recomputed {got[:12]}…) — refusing to inject corrupted state"
+        )
+    return header, arrays
+
+
+def unpack_lane_state(data: bytes, template):
+    """Bytes -> host pytree with ``template``'s structure (any pytree of
+    the model's state shape, e.g. ``model.init_states(1, 1, 1)`` — only
+    the STRUCTURE is read). Key paths must match the template's exactly:
+    a packet from a different model topology is rejected, not coerced."""
+    import jax
+
+    header, arrays = read_wire(data)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    want = [jax.tree_util.keystr(path) for path, _ in leaves]
+    if want != header["keys"]:
+        raise ValueError(
+            f"lane-state packet keys {header['keys']} do not match the "
+            f"model state structure {want}"
+        )
+    return jax.tree_util.tree_unflatten(treedef, arrays)
+
+
+# ---------------------------------------------------------------------------
+# handoff packets (the router-visible unit of migration)
+
+
+class HandoffPacket:
+    """One migrating stream: the engine's handoff entry with the lane
+    state flattened through the wire format (``state_bytes``; None for a
+    stream that never dispatched — it rebinds fresh on the target)."""
+
+    __slots__ = ("entry", "state_bytes")
+
+    def __init__(self, entry: Dict, state_bytes: Optional[bytes]):
+        self.entry = entry
+        self.state_bytes = state_bytes
+
+    @property
+    def request_id(self) -> str:
+        return self.entry["request_id"]
+
+    def __repr__(self) -> str:
+        return (f"HandoffPacket({self.request_id!r}, "
+                f"windows_done={self.entry.get('windows_done')}, "
+                f"state={'yes' if self.state_bytes else 'no'})")
+
+
+# ---------------------------------------------------------------------------
+# AOT artifact registry (replicas cold-start without tracing)
+
+
+class AotRegistry:
+    """Directory of exported chunk-program artifacts, validated UP FRONT.
+
+    ``inference/export.export_checkpoint(..., program="engine_chunk")``
+    writes ``<name>.stablehlo`` + ``<name>.stablehlo.json`` (geometry
+    sidecar). The registry scans the directory once, parses every
+    sidecar, and :meth:`programs_for` returns the ``{chunk_windows:
+    path}`` map for a requested serving geometry — raising at REGISTRY
+    time (cold start) when a depth is missing or a sidecar disagrees on
+    lanes/grid/seqn, instead of mid-serving-loop. The engine re-validates
+    at deserialization (``ServingEngine._program``); the registry makes
+    the failure mode a startup error with a complete inventory in it."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.artifacts: List[Dict] = []
+        for name in sorted(os.listdir(root)):
+            if not name.endswith(".json"):
+                continue
+            artifact = os.path.join(root, name[: -len(".json")])
+            if not os.path.exists(artifact):
+                continue
+            try:
+                with open(os.path.join(root, name)) as f:
+                    sidecar = json.load(f)
+            except (OSError, ValueError) as e:
+                raise ValueError(
+                    f"unreadable artifact sidecar {name!r} in registry "
+                    f"{root!r}: {e!r}"
+                )
+            self.artifacts.append({"path": artifact, "sidecar": sidecar})
+        if not self.artifacts:
+            raise ValueError(
+                f"AOT registry {root!r} holds no artifact/sidecar pairs "
+                "(export chunk programs first, docs/SERVING.md)"
+            )
+
+    def programs_for(
+        self,
+        lanes: int,
+        chunk_windows: Tuple[int, ...],
+        gt_hw: Optional[Tuple[int, int]] = None,
+        lr_hw: Optional[Tuple[int, int]] = None,
+        seqn: Optional[int] = None,
+    ) -> Dict[int, str]:
+        """The ``{W: artifact path}`` map for one serving geometry; every
+        requested depth must resolve to a sidecar-matching artifact."""
+        want_geo = {"gt_hw": gt_hw, "lr_hw": lr_hw, "seqn": seqn}
+
+        def _geo_ok(side: Dict) -> bool:
+            # a sidecar field that is absent (older exports) passes; a
+            # PRESENT field must agree with the requested geometry
+            for key, want in (("gt_hw", gt_hw), ("lr_hw", lr_hw)):
+                if (want is not None and side.get(key) is not None
+                        and list(side[key]) != list(want)):
+                    return False
+            if (seqn is not None and side.get("seqn") is not None
+                    and int(side["seqn"]) != int(seqn)):
+                return False
+            return True
+
+        out: Dict[int, str] = {}
+        for rec in self.artifacts:
+            side = rec["sidecar"]
+            if side.get("lanes") != int(lanes) or not _geo_ok(side):
+                continue
+            w = side.get("chunk_windows")
+            if w is not None:
+                out.setdefault(int(w), rec["path"])
+        missing = sorted(set(int(w) for w in chunk_windows) - set(out))
+        if missing:
+            raise ValueError(
+                f"AOT registry {self.root!r} has no artifact for "
+                f"chunk_windows={missing} at lanes={lanes}, "
+                f"geometry={want_geo} (inventory: "
+                f"{[r['sidecar'].get('chunk_windows') for r in self.artifacts]})"
+            )
+        return {int(w): out[int(w)] for w in chunk_windows}
+
+
+# ---------------------------------------------------------------------------
+# the replica
+
+
+class Replica:
+    """One fleet replica: engine + per-replica sink + live plane.
+
+    Every engine interaction runs under THIS replica's sink
+    (:meth:`activated` swaps the process-active sink around the call —
+    the fleet loop is single-threaded by design, docs/SERVING.md), so
+    each replica writes its own ``telemetry.jsonl`` and the fleet rollup
+    is an exact merge. The live plane binds an ephemeral loopback port;
+    the router's supervisor polls ``/healthz`` + ``/slo`` over real HTTP.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        model,
+        params,
+        dataset_config: Dict,
+        telemetry_path: str,
+        classes: Optional[Dict] = None,
+        default_class: str = "standard",
+        lanes: int = 2,
+        live_slo: Optional[str] = None,
+        aot_registry: Optional[AotRegistry] = None,
+        aot_programs: Optional[Dict[int, str]] = None,
+        **engine_kw,
+    ):
+        self.replica_id = str(replica_id)
+        self.telemetry_path = telemetry_path
+        self._model = model
+        self._params = params
+        self._dataset_config = dict(dataset_config)
+        self._classes = classes
+        self._default_class = default_class
+        self._lanes = int(lanes)
+        self._live_slo = live_slo
+        self._aot_registry = aot_registry
+        self._aot_programs = dict(aot_programs) if aot_programs else None
+        self._engine_kw = dict(engine_kw)
+        self.engine = None
+        self.sink = None
+        self.alive = False
+        self.partitioned = False
+        self._reported: set = set()
+
+    # -- sink scoping --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def activated(self):
+        """Run a block with this replica's sink process-active (and the
+        previous sink restored after) — every engine call the router
+        makes goes through here, so telemetry lands in the right file."""
+        from esr_tpu.obs import set_active_sink
+
+        prev = set_active_sink(self.sink)
+        try:
+            yield
+        finally:
+            set_active_sink(prev)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Replica":
+        """Cold-start the engine: open the sink, resolve AOT programs
+        from the registry (when one is configured — the replica then
+        never traces), construct the engine with its live plane on an
+        ephemeral port, namespaced to this replica."""
+        from esr_tpu.obs import TelemetrySink
+        from esr_tpu.serving.server import ServingEngine
+
+        self.sink = TelemetrySink(self.telemetry_path)
+        aot_programs = self._aot_programs
+        if aot_programs is None and self._aot_registry is not None:
+            from esr_tpu.serving.scheduler import DEFAULT_CLASSES
+
+            classes = self._classes or DEFAULT_CLASSES
+            depths = tuple(sorted(
+                {c.chunk_windows for c in classes.values()}
+            ))
+            aot_programs = self._aot_registry.programs_for(
+                self._lanes, depths,
+            )
+        with self.activated():
+            self.engine = ServingEngine(
+                self._model, self._params, self._dataset_config,
+                lanes=self._lanes,
+                classes=self._classes,
+                default_class=self._default_class,
+                aot_programs=aot_programs,
+                live_port=0,
+                live_slo=self._live_slo,
+                health_ns=self.replica_id,
+                **self._engine_kw,
+            )
+        self.alive = True
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        if self.engine is None or self.engine.live is None:
+            return None
+        return self.engine.live.port
+
+    def url(self, endpoint: str) -> Optional[str]:
+        port = self.port
+        if port is None:
+            return None
+        return f"http://127.0.0.1:{port}/{endpoint.lstrip('/')}"
+
+    # -- serving (router-driven, cooperative) --------------------------------
+
+    def submit(self, path: str, request_class=None,
+               request_id: Optional[str] = None) -> str:
+        with self.activated():
+            return self.engine.submit(
+                path, request_class=request_class, request_id=request_id,
+            )
+
+    def pump(self) -> str:
+        """One engine round under this replica's sink; returns the
+        engine's pump status (``dispatched`` / ``idle`` / ``drained``)."""
+        with self.activated():
+            return self.engine.pump()
+
+    def flush(self) -> None:
+        with self.activated():
+            self.engine.flush()
+
+    def poll_terminals(self) -> List[Tuple[str, Dict]]:
+        """Newly terminal requests since the last poll, as ``(request_id,
+        report)`` — the router folds them into its ledger. ``migrated``
+        terminals are EXCLUDED: the router initiated those and owns their
+        continuation."""
+        if self.engine is None:
+            return []
+        out = []
+        for rid in self.engine.terminal_request_ids():
+            if rid in self._reported:
+                continue
+            report = self.engine.report(rid)
+            # migrated records also land in the reported set (their
+            # report would otherwise be rebuilt every poll forever);
+            # admit_handoff clears the slot when the stream returns
+            self._reported.add(rid)
+            if report["status"] == "migrated":
+                continue
+            out.append((rid, report))
+        return out
+
+    # -- migration (voluntary drain / handoff) -------------------------------
+
+    def drain(self) -> List[HandoffPacket]:
+        """Evacuate every live stream as wire-format handoff packets
+        (``ServingEngine.evacuate`` + :func:`pack_lane_state`): the
+        voluntary half of migration. The replica stays alive and empty —
+        it may rejoin placement."""
+        with self.activated():
+            entries = self.engine.evacuate()
+        packets = []
+        for entry in entries:
+            state = entry.pop("state")
+            packets.append(HandoffPacket(
+                entry, None if state is None else pack_lane_state(state),
+            ))
+        return packets
+
+    def admit_handoff(self, packet: HandoffPacket) -> str:
+        """Target half of migration: unpack the wire bytes against this
+        replica's model state structure (digest + key checks happen
+        here) and re-admit cap-exempt."""
+        state = None
+        if packet.state_bytes is not None:
+            template = self._model.init_states(1, 1, 1)
+            state = unpack_lane_state(packet.state_bytes, template)
+        # a returning stream replaces its migrated-out record — its NEW
+        # terminal must be reported to the router when it lands
+        self._reported.discard(packet.request_id)
+        with self.activated():
+            return self.engine.admit_handoff(packet.entry, state=state)
+
+    # -- failure simulation (the chaos plane's replica-level kinds) ----------
+
+    def kill(self) -> None:
+        """Abrupt death (``replica_kill``): the live plane vanishes
+        (supervisor heartbeats start failing), the engine is abandoned
+        WITHOUT drain or terminal events — exactly what a crashed
+        process leaves behind. The sink is closed so the telemetry file
+        holds every record up to the crash."""
+        self.alive = False
+        if self.engine is not None:
+            with self.activated():
+                self.engine.close_live()
+        if self.sink is not None:
+            self.sink.close()
+            self.sink = None
+        self.engine = None
+
+    def partition(self) -> None:
+        """Network partition (``replica_partition``): the endpoints
+        become unreachable (live plane torn down — polls fail) but the
+        engine object survives; the router must FENCE it (stop pumping)
+        before failing its streams over, so a partitioned replica can
+        never double-serve a migrated stream."""
+        self.partitioned = True
+        if self.engine is not None:
+            with self.activated():
+                self.engine.close_live()
+
+    def fence(self) -> None:
+        """Fence a partitioned replica: stop serving it permanently
+        (the router stops pumping; the engine and sink are closed with
+        NO terminal events — its unfinished journeys are failed over by
+        the router, which owns their continuation)."""
+        self.alive = False
+        if self.sink is not None:
+            self.sink.close()
+            self.sink = None
+        self.engine = None
+
+    def close(self) -> None:
+        """Graceful shutdown (idempotent): live plane down, sink closed."""
+        self.alive = False
+        if self.engine is not None:
+            with self.activated():
+                self.engine.close_live()
+            self.engine = None
+        if self.sink is not None:
+            self.sink.close()
+            self.sink = None

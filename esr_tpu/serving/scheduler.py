@@ -154,6 +154,11 @@ class StreamRequest:
     status: Optional[str] = None
     error_kind: Optional[str] = None
     retries: int = 0
+    # fleet (docs/SERVING.md "The fleet"): completed voluntary migrations
+    # this stream has ridden (extract -> bytes -> inject handoffs) — the
+    # target engine's ``admit_handoff`` carries the count forward, so the
+    # final report records how many replicas served the stream.
+    handoffs: int = 0
 
     @property
     def resumable(self) -> bool:
@@ -254,6 +259,16 @@ class LaneScheduler:
         req = self.lanes[lane]
         self.lanes[lane] = None
         return req
+
+    def drain_queue(self) -> List[StreamRequest]:
+        """Pop EVERY queued request (the voluntary-drain half of the
+        fleet handoff, docs/SERVING.md "The fleet"): the server has
+        already stripped the bound lanes; the queue's requests leave
+        with whatever saved state they carry. Returns them in FIFO
+        order; the queue is empty afterwards."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
 
     def quarantine(self, lane: int) -> None:
         """Circuit-break a lane: it must be empty (drained first) and is

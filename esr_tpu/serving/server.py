@@ -187,6 +187,7 @@ class ServingEngine:
         live_slo: Optional[str] = None,
         profile_steps: int = 0,
         profile_dir: Optional[str] = None,
+        health_ns: Optional[str] = None,
     ):
         self.model = model
         self.params = params
@@ -261,6 +262,15 @@ class ServingEngine:
         # stream, so it requires one: serve.py installs the sink before
         # constructing the engine.
         self.live = None
+        # health-source namespace (the fleet tier, docs/SERVING.md "The
+        # fleet"): N in-process replicas each get their own /healthz view
+        # — replica A's quarantine must not 503 replica B. None (every
+        # single-replica process) keeps the un-suffixed global names.
+        self.health_ns = health_ns
+        self._health_source_name = (
+            "serving_lanes" if health_ns is None
+            else f"serving_lanes@{health_ns}"
+        )
         if live_port is not None:
             from esr_tpu.obs.http import (
                 register_health_source,
@@ -269,11 +279,14 @@ class ServingEngine:
 
             self.live = start_live_plane(
                 active_sink(), port=int(live_port), slo_path=live_slo,
+                ns=health_ns,
             )
             # lane-quarantine health: the circuit-breaker ledger is the
             # serving tier's liveness signal — any quarantined lane flips
             # /healthz to 503 (a drained replica needs operator action)
-            register_health_source("serving_lanes", self._lane_health_doc)
+            register_health_source(
+                self._health_source_name, self._lane_health_doc
+            )
         # bounded on-chip capture (obs/device.py): trace the first
         # profile_steps dispatched chunks, stamp a profiler_capture event
         self._profiler = None
@@ -1039,6 +1052,166 @@ class ServingEngine:
             self._profiler.stop()
         return self.summary()
 
+    def flush(self) -> None:
+        """Resolve every in-flight chunk readback (blocks on the device).
+        ``run`` does this at drain; the fleet tier calls it before a
+        handoff so accumulators and ``windows_done`` are settled."""
+        while self._pending:
+            self._resolve(self._pending.popleft())
+
+    # -- fleet drain / handoff (docs/SERVING.md "The fleet") -----------------
+
+    def _handoff_entry(self, req: StreamRequest, state,
+                       lane: Optional[int] = None) -> Dict:
+        """Build one handoff entry for ``req`` and finish it on THIS
+        engine with terminal status ``migrated`` (this replica's half of
+        the journey ends classified; the router re-admits the entry
+        elsewhere). ``state`` is the extracted host lane-state pytree
+        (None for a stream that never dispatched — it rebinds fresh)."""
+        acc = self._acc[req.request_id]
+        entry = {
+            "request_id": req.request_id,
+            "path": req.path,
+            "class": req.cls.name,
+            "state": state,
+            "acc_sums": dict(acc["sums"]),
+            "acc_count": int(acc["count"]),
+            "windows_done": int(req.windows_done),
+            "windows_skipped": int(req.windows_skipped),
+            "preemptions": int(req.preemptions),
+            "retries": int(req.retries),
+            "handoffs": int(req.handoffs) + 1,
+            "window_latencies": list(req.window_latencies),
+        }
+        sink = active_sink()
+        if sink is not None:
+            sink.event(
+                "serve_handoff_out", request=req.request_id,
+                trace_id=req.trace_id, parent_id=req.root_span_id,
+                cls=req.cls.name, lane=lane,
+                windows_done=req.windows_done,
+                with_state=state is not None,
+            )
+        req.status = "migrated"
+        req.ended = True
+        req.completed_t = self._now()
+        self.scheduler.completed.append(req)
+        self._finish(req)
+        return entry
+
+    def evacuate(self) -> List[Dict]:
+        """Voluntary drain — the fleet handoff's source half: flush every
+        in-flight readback, then strip EVERY live request off the
+        scheduler. Bound lanes leave with their recurrent state extracted
+        (``extract_lane_state`` — bit-exact host numpy); queued requests
+        leave with whatever saved state an earlier preemption left them;
+        a lane that only ever skipped gated windows has no state and
+        rebinds fresh. Each request terminates HERE with status
+        ``migrated``. Returns the handoff entries; the caller owns the
+        bytes half (``serving/replica.py`` wire format) and the
+        re-admission (``admit_handoff`` on the target engine)."""
+        self.flush()
+        sched = self.scheduler
+        out: List[Dict] = []
+        for lane in range(self.lanes):
+            req = sched.lanes[lane]
+            if req is None:
+                continue
+            state = (
+                None if lane in self._lane_needs_reset
+                else extract_lane_state(self._states, lane)
+            )
+            self._lane_needs_reset.discard(lane)
+            sched.unbind(lane)
+            out.append(self._handoff_entry(req, state, lane=lane))
+        for req in sched.drain_queue():
+            state, req.saved_state = req.saved_state, None
+            out.append(self._handoff_entry(req, state))
+        return out
+
+    def admit_handoff(self, entry: Dict, state=None) -> str:
+        """Re-admit a migrated (or failed-over) stream — the handoff's
+        target half. Exempt from the ``max_pending`` backpressure cap,
+        exactly like ``LaneScheduler.requeue``: the stream was already
+        admitted SOMEWHERE, and a migration must never be able to shed
+        it. ``state`` (the host pytree the wire format round-tripped)
+        resumes the recurrent state bit-exactly at the next bind; None
+        restarts the state fresh (involuntary fail-over lost the device
+        state by definition). The window source is rebuilt and
+        fast-forwarded past the ``windows_done + windows_skipped``
+        windows the source replica already served, so the target
+        continues at exactly the next unserved window (the rasterizer is
+        deterministic per recording — the fast-forward replays the same
+        prefix the source consumed)."""
+        rid = entry["request_id"]
+        existing = self._requests.get(rid)
+        if existing is not None and existing.status != "migrated":
+            # a LIVE (or finally-terminal) incarnation must never be
+            # shadowed; a migrated-out one may return (ring rebalance
+            # round trip) — the new incarnation replaces its record
+            raise ValueError(f"duplicate request_id {rid!r}")
+        cls_name = entry["class"]
+        if cls_name not in self.classes:
+            raise ValueError(
+                f"handoff request class {cls_name!r} not among this "
+                f"engine's classes {sorted(self.classes)} (fleet replicas "
+                "must share one class table, docs/SERVING.md)"
+            )
+        req = StreamRequest(
+            rid, entry["path"], self.classes[cls_name],
+            submitted_t=self._now(),
+        )
+        req.trace_id = trace.new_id()
+        req.root_span_id = trace.new_id()
+        req.submitted_mono = time.monotonic()
+        req.windows_done = int(entry.get("windows_done", 0))
+        req.windows_skipped = int(entry.get("windows_skipped", 0))
+        req.preemptions = int(entry.get("preemptions", 0))
+        req.retries = int(entry.get("retries", 0))
+        req.handoffs = int(entry.get("handoffs", 0))
+        req.window_latencies = list(entry.get("window_latencies", []))
+        sums = entry.get("acc_sums", {})
+        self._acc[rid] = {
+            "sums": {k: float(sums.get(k, 0.0)) for k in METRIC_KEYS},
+            "count": int(entry.get("acc_count", 0)),
+        }
+        src = RecordingStream(
+            req.path, self.dataset_config, activity_tile=self.activity_tile,
+        )
+        self._ensure_device(src)
+        if (src.inp_resolution, src.gt_resolution) != self._resolutions:
+            raise ValueError(
+                f"handoff stream {req.path} resolution "
+                f"{src.inp_resolution}->{src.gt_resolution} does not "
+                f"match the serving pack's {self._resolutions}"
+            )
+        for _ in range(req.windows_done + req.windows_skipped):
+            try:
+                next(src)
+            except StopIteration:
+                break  # shorter than claimed: the first pull ends it
+        req.source = src
+        req.saved_state = state
+        self._requests[rid] = req
+        self.scheduler.requeue(req)
+        sink = active_sink()
+        if sink is not None:
+            sink.event(
+                "serve_handoff_in", request=rid,
+                trace_id=req.trace_id, parent_id=req.root_span_id,
+                cls=cls_name, windows_done=req.windows_done,
+                resumed=state is not None, handoffs=req.handoffs,
+            )
+        return rid
+
+    def terminal_request_ids(self) -> List[str]:
+        """Request ids whose terminal status is classified (submission
+        order) — the fleet replica's completion poll."""
+        return [
+            rid for rid, req in self._requests.items()
+            if req.status is not None
+        ]
+
     def close_live(self) -> None:
         """Tear down the opt-in live plane (idempotent): unregister the
         lane-health source, detach the aggregator, stop the HTTP thread,
@@ -1048,7 +1221,7 @@ class ServingEngine:
         if self.live is not None:
             from esr_tpu.obs.http import unregister_health_source
 
-            unregister_health_source("serving_lanes")
+            unregister_health_source(self._health_source_name)
             live, self.live = self.live, None
             live.close()
 
@@ -1072,7 +1245,10 @@ class ServingEngine:
         req = self._requests[request_id]
         acc = self._acc[request_id]
         n = acc["count"]
-        completed = req.error is None and req.ended and req.inflight == 0
+        # a migrated request is NOT completed here — its journey
+        # continued on another replica (the router owns the final word)
+        completed = (req.error is None and req.ended and req.inflight == 0
+                     and req.status != "migrated")
         out = {
             "request_id": request_id,
             "path": req.path,
@@ -1086,6 +1262,7 @@ class ServingEngine:
             "status": req.status or ("ok" if completed else None),
             "error_kind": req.error_kind,
             "retries": req.retries,
+            "handoffs": req.handoffs,
             "preemptions": req.preemptions,
             "admit_latency_s": (
                 round(req.first_bind_t - req.submitted_t, 6)
@@ -1120,7 +1297,8 @@ class ServingEngine:
             )
             preemptions += req.preemptions
             skipped += req.windows_skipped
-            if req.error is None and req.ended and req.inflight == 0:
+            if (req.error is None and req.ended and req.inflight == 0
+                    and req.status != "migrated"):
                 completed += 1
             status = req.status or "live"
             statuses[status] = statuses.get(status, 0) + 1

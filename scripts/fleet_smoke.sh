@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Fleet-tier smoke: the scripted fleet chaos scenario END TO END on CPU
+# (esr_tpu.resilience.chaos_fleet) — seeded Poisson traffic through a
+# 3-replica consistent-hash router (each replica its own ServingEngine,
+# telemetry file, and live /healthz + /slo plane) while the fleet_router
+# FaultPlan fires a forced handoff (bit-exact wire-format migration), a
+# replica kill (missed heartbeats -> fail-over), and a replica partition
+# (fence -> fail-over) mid-run. Zero lost requests, every fault answered
+# by a recovery_* event, per-request metric parity with the unfaulted
+# single-engine twin, and a green merged obs report over all files
+# (configs/slo_fleet.yml).
+#
+# Runs the exact assertions tier-1 enforces (tests/test_fleet_smoke.py)
+# as a standalone gate; architecture + knobs: docs/SERVING.md "The fleet".
+#
+# Usage: scripts/fleet_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_smoke.py -q "$@"

@@ -77,6 +77,24 @@ def get_flags():
                    help="times a fault-hit request is re-admitted before "
                         "failing with a classified status")
 
+    # the fleet tier (docs/SERVING.md "The fleet"): N replicas behind a
+    # consistent-hash router with supervision + fail-over
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serving replicas; >1 runs the fleet router "
+                        "(per-replica telemetry files, /healthz + /slo "
+                        "supervision, drain/handoff, fail-over)")
+    p.add_argument("--failover_retries", type=int, default=1,
+                   help="times a request lost to a dead replica is "
+                        "re-admitted elsewhere before "
+                        "failover_retry_exhausted (fleet mode)")
+    p.add_argument("--heartbeat_misses", type=int, default=3,
+                   help="consecutive failed health polls before the "
+                        "router declares a replica dead (fleet mode)")
+    p.add_argument("--supervise_interval", type=float, default=None,
+                   metavar="S",
+                   help="poll replicas from a supervisor thread every S "
+                        "seconds (default: poll inline each router round)")
+
     # the live telemetry plane (obs v3, docs/OBSERVABILITY.md): opt-in
     p.add_argument("--live-port", type=int, default=None, metavar="PORT",
                    help="serve live telemetry (/metrics, /healthz, /slo) "
@@ -127,6 +145,13 @@ def main():
     from esr_tpu.parallel.mesh import honor_platform_env
 
     honor_platform_env()
+    # bounded backend bring-up (docs/RESILIENCE.md "Entry-point
+    # bring-up"): the observed wedged-tunnel failure mode must exit 2
+    # with the attempt log instead of hanging the serving job for the
+    # full watchdog window — same gate as train.py / infer.py
+    from esr_tpu.utils.artifacts import probe_backend_or_exit
+
+    probe_backend_or_exit()
     assert (flags.data_list is None) != (flags.loadgen is None), (
         "pass exactly one of --data_list / --loadgen"
     )
@@ -201,6 +226,11 @@ def main():
         classes=tuple(sorted(classes)),
     )
 
+    if flags.replicas > 1:
+        run_fleet(flags, model, params, dataset_config, classes,
+                  schedule, aot_programs)
+        return
+
     sink = TelemetrySink(os.path.join(flags.output_path, "telemetry.jsonl"))
     prev = set_active_sink(sink)
     server = None
@@ -248,6 +278,86 @@ def main():
         f"# traces + SLO verdict (docs/OBSERVABILITY.md):\n"
         f"#   python -m esr_tpu.obs export {tel}\n"
         f"#   python -m esr_tpu.obs report {tel} --slo configs/slo.yml",
+        file=sys.stderr,
+    )
+
+
+def run_fleet(flags, model, params, dataset_config, classes, schedule,
+              aot_programs):
+    """The fleet path (``--replicas N``, docs/SERVING.md "The fleet"):
+    N replicas — each its own ``ServingEngine``, telemetry file, and
+    live ``/healthz`` + ``/slo`` plane — behind a consistent-hash router
+    with supervision, drain/handoff, and fail-over. Outputs:
+    ``telemetry_r<i>.jsonl`` per replica, ``telemetry_router.jsonl``
+    (placement/fail-over events), ``fleet_requests.jsonl``,
+    ``fleet_summary.json``; percentile detail comes from the merged
+    report over all files."""
+    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.serving import FleetRouter, Replica
+
+    replicas = []
+    for i in range(flags.replicas):
+        rid = f"r{i}"
+        replicas.append(Replica(
+            rid, model, params, dataset_config,
+            telemetry_path=os.path.join(
+                flags.output_path, f"telemetry_{rid}.jsonl"
+            ),
+            classes=classes,
+            default_class=flags.default_class,
+            lanes=flags.lanes,
+            live_slo=flags.live_slo,
+            aot_programs=aot_programs,
+            seqn=flags.seqn,
+            max_pending=flags.max_pending,
+            preempt_quantum=flags.preempt_quantum,
+            lane_quarantine_k=flags.lane_quarantine_k,
+            request_retries=flags.request_retries,
+        ).start())
+    for rep in replicas:
+        print(
+            f"# replica {rep.replica_id}: "
+            f"http://127.0.0.1:{rep.port}/{{metrics,healthz,slo}}",
+            file=sys.stderr,
+        )
+    router_sink = TelemetrySink(
+        os.path.join(flags.output_path, "telemetry_router.jsonl")
+    )
+    prev = set_active_sink(router_sink)
+    router = FleetRouter(
+        replicas,
+        default_class=flags.default_class,
+        failover_budget=flags.failover_retries,
+        miss_budget=flags.heartbeat_misses,
+        supervise_interval_s=flags.supervise_interval,
+    )
+    try:
+        summary = router.run(arrivals=schedule, max_wall_s=flags.max_wall)
+    finally:
+        router.close()
+        set_active_sink(prev)
+        router_sink.close()
+
+    with open(os.path.join(flags.output_path, "fleet_requests.jsonl"),
+              "w") as f:
+        for rid, rep in sorted(router.reports().items()):
+            f.write(json.dumps(rep) + "\n")
+    with open(os.path.join(flags.output_path, "fleet_summary.json"),
+              "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    tel_files = " ".join(
+        [os.path.join(flags.output_path, "telemetry_router.jsonl")]
+        + [os.path.join(flags.output_path, f"telemetry_r{i}.jsonl")
+           for i in range(flags.replicas)]
+    )
+    print(
+        f"# fleet rollup + SLO verdict (docs/SERVING.md 'The fleet';\n"
+        f"# configs/slo_fleet.yml is the CHAOS gate — it requires\n"
+        f"# injected faults, so a clean run gates on configs/slo.yml):\n"
+        f"#   python -m esr_tpu.obs report {tel_files} "
+        f"--slo configs/slo.yml\n"
+        f"#   python -m esr_tpu.obs export {tel_files} -o fleet.trace.json",
         file=sys.stderr,
     )
 

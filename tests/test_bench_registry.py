@@ -39,7 +39,7 @@ def test_stage_registry_names_order_and_timeouts():
         "concurrency_audit", "obs_live", "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
         "infer_throughput", "ckpt_overlap", "serve_loadgen",
-        "chaos_recovery",
+        "fleet_loadgen", "chaos_recovery",
     ]
     for name, runner, timeout, in_smoke in bench.STAGE_REGISTRY:
         assert callable(runner), name
@@ -203,6 +203,27 @@ def test_serve_loadgen_stage_registered_and_schema_pinned():
         "dense_windows_per_sec", "gated_windows_per_sec", "gate_speedup",
         "windows", "windows_skipped", "active_window_frac",
         "min_activity", "streams",
+    )
+
+
+def test_fleet_loadgen_stage_registered_and_schema_pinned():
+    """The FLEET headline (ISSUE 15): fleet-sustained windows/s at the
+    merged per-class p99 through a scripted mid-run replica kill +
+    partition + forced handoff, with zero-lost accounting and twin
+    metric parity as tracked booleans. Host-bound by design (routing and
+    recovery control flow), so it runs in smoke (CPU) too."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "fleet_loadgen"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_fleet_loadgen
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.FLEET_LOADGEN_KEYS == (
+        "fleet_windows_per_sec", "single_windows_per_sec",
+        "fleet_vs_single", "p99_window_ms", "requests", "completed_ok",
+        "migrations", "failovers", "replicas", "zero_lost",
+        "faults_injected", "faults_unrecovered", "parity_max_rel_diff",
+        "ok", "seed",
     )
 
 

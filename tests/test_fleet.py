@@ -1,0 +1,373 @@
+"""Unit coverage for the fleet tier's host-side pieces (ISSUE 15,
+docs/SERVING.md "The fleet"): the lane-state wire format (bit-exact,
+digest-checked, parseable without jax — pinned across processes),
+consistent-hash placement (deterministic; join/leave remaps ~1/N), the
+replica supervisor's heartbeat/verdict ledger, and the router-level
+status taxonomy. Everything here is device-free and fast; the end-to-end
+fleet contract lives in tests/test_fleet_smoke.py."""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from esr_tpu.serving.fleet import (
+    ROUTER_TERMINAL_STATUSES,
+    HashRing,
+    ReplicaSupervisor,
+)
+from esr_tpu.serving.replica import (
+    WIRE_MAGIC,
+    pack_lane_state,
+    read_wire,
+    unpack_lane_state,
+)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+
+
+def _state(seed=0):
+    """A ConvGRU-shaped state pytree (tuple of dicts of float32 arrays —
+    the shape class ``extract_lane_state`` produces)."""
+    rng = np.random.default_rng(seed)
+    z = rng.standard_normal((4, 6, 8)).astype(np.float32)
+    h = rng.standard_normal((4, 6, 8)).astype(np.float32)
+    return ({"gru": z}, {"gru": h})
+
+
+def test_wire_roundtrip_is_bit_exact_and_deterministic():
+    state = _state()
+    packet = pack_lane_state(state)
+    assert packet[: len(WIRE_MAGIC)] == WIRE_MAGIC
+    out = unpack_lane_state(packet, _state(seed=99))  # template: structure only
+    import jax
+
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(out)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype
+        assert a.tobytes() == b.tobytes()  # BIT-exact, not just close
+    # equal states pack to equal bytes (the cross-process contract)
+    assert pack_lane_state(out) == packet
+
+
+def test_wire_rejects_corruption_and_bad_magic():
+    good = pack_lane_state(_state())
+    with pytest.raises(ValueError, match="not a lane-state packet"):
+        read_wire(b"NOTMAGIC" + good[8:])
+    # flip one byte inside an array's data region: the digest catches it
+    poisoned = bytearray(good)
+    poisoned[len(poisoned) // 2] ^= 0xFF
+    with pytest.raises(ValueError):
+        read_wire(bytes(poisoned))
+    # tear the tail off (zip central directory gone): still a ValueError
+    with pytest.raises(ValueError):
+        read_wire(good[:-16])
+
+
+def test_wire_rejects_mismatched_template_structure():
+    packet = pack_lane_state(_state())
+    with pytest.raises(ValueError, match="do not match"):
+        unpack_lane_state(packet, ({"other": np.zeros(2)},))
+
+
+def test_wire_cross_process_bit_exact(tmp_path):
+    """The handoff contract across PROCESS boundaries: a receiver with
+    numpy + stdlib alone (no jax, no esr_tpu — the script re-implements
+    the documented format, pinning it) validates the digest and rebuilds
+    a byte-identical packet."""
+    state = _state(seed=3)
+    packet = pack_lane_state(state)
+    src = tmp_path / "packet.bin"
+    dst = tmp_path / "echo.bin"
+    src.write_bytes(packet)
+    script = r"""
+import io, json, hashlib, struct, sys
+import numpy as np
+
+data = open(sys.argv[1], "rb").read()
+assert data[:8] == b"ESRLANE1", data[:8]
+(hlen,) = struct.unpack_from("<Q", data, 8)
+header = json.loads(data[16:16 + hlen].decode())
+with np.load(io.BytesIO(data[16 + hlen:]), allow_pickle=False) as z:
+    arrays = [z[f"a{i}"] for i in range(len(header["keys"]))]
+h = hashlib.sha256()
+for key, arr in zip(header["keys"], arrays):
+    arr = np.ascontiguousarray(arr)
+    h.update(str(key).encode())
+    h.update(str(arr.shape).encode())
+    h.update(str(arr.dtype).encode())
+    h.update(arr.tobytes())
+assert h.hexdigest() == header["digest"]
+buf = io.BytesIO()
+np.savez(buf, **{f"a{i}": a for i, a in enumerate(arrays)})
+open(sys.argv[2], "wb").write(data[:16 + hlen] + buf.getvalue())
+"""
+    subprocess.run(
+        [sys.executable, "-c", script, str(src), str(dst)],
+        check=True, timeout=120,
+    )
+    assert dst.read_bytes() == packet
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash placement
+
+
+def test_hash_ring_deterministic_and_covers_all_nodes():
+    keys = [f"req-{i:04d}" for i in range(300)]
+    a = HashRing(["r0", "r1", "r2"], vnodes=64)
+    b = HashRing(["r2", "r0", "r1"], vnodes=64)  # order-independent
+    placed = {k: a.place(k) for k in keys}
+    assert {b.place(k) for k in keys} == set(placed.values())
+    assert all(placed[k] == b.place(k) for k in keys)
+    assert set(placed.values()) == {"r0", "r1", "r2"}
+
+
+def test_hash_ring_join_remaps_bounded_fraction():
+    """The consistent-hashing property the fleet's placement stability
+    rests on: a replica JOINING an N-node ring remaps ~1/(N+1) of the
+    keys — never a wholesale reshuffle (pinned deterministic: sha256)."""
+    keys = [f"req-{i:04d}" for i in range(400)]
+    ring = HashRing(["r0", "r1", "r2", "r3"], vnodes=64)
+    before = {k: ring.place(k) for k in keys}
+    ring.add("r4")
+    moved = [k for k in keys if ring.place(k) != before[k]]
+    frac = len(moved) / len(keys)
+    # ideal 1/5 = 0.2; generous slack for vnode variance, but far from
+    # the ~0.8 a naive mod-N rehash would produce
+    assert 0.05 <= frac <= 0.4, frac
+    # every moved key moved TO the joiner — nothing shuffles laterally
+    assert all(ring.place(k) == "r4" for k in moved)
+
+
+def test_hash_ring_leave_remaps_only_departed_keys():
+    keys = [f"req-{i:04d}" for i in range(400)]
+    ring = HashRing(["r0", "r1", "r2"], vnodes=64)
+    before = {k: ring.place(k) for k in keys}
+    ring.remove("r1")
+    for k in keys:
+        if before[k] == "r1":
+            assert ring.place(k) in ("r0", "r2")
+        else:
+            assert ring.place(k) == before[k]  # survivors keep their keys
+
+
+def test_hash_ring_place_honors_exclusions():
+    ring = HashRing(["r0", "r1"], vnodes=16)
+    assert ring.place("k", exclude=["r0"]) == "r1"
+    assert ring.place("k", exclude=["r0", "r1"]) is None
+
+
+# ---------------------------------------------------------------------------
+# supervision
+
+
+def _fake_fetch(responses):
+    """A scripted fetch: ``responses[url]`` is an int status or an
+    exception instance to raise (transport failure = heartbeat miss)."""
+    def fetch(url, timeout_s):
+        r = responses[url]
+        if isinstance(r, BaseException):
+            raise r
+        return int(r)
+    return fetch
+
+
+def test_supervisor_healthy_and_slo_verdicts():
+    responses = {"hz": 200, "slo": 429}
+    sup = ReplicaSupervisor(miss_budget=2, fetch=_fake_fetch(responses))
+    sup.watch("r0", "hz", "slo")
+    sup.poll_once()
+    v = sup.verdict("r0")
+    assert v["alive"] and v["healthy"] and v["slo_verdict"] == "warn"
+    responses["hz"] = 503
+    responses["slo"] = 503
+    sup.poll_once()
+    v = sup.verdict("r0")
+    assert v["alive"]            # answering 503 is NOT a missed heartbeat
+    assert v["healthy"] is False  # ... but it is unhealthy (drain signal)
+    assert v["slo_verdict"] == "page"
+
+
+def test_supervisor_miss_budget_declares_dead_and_recovers():
+    responses = {"hz": OSError("connection refused"), "slo": 200}
+    sup = ReplicaSupervisor(miss_budget=2, fetch=_fake_fetch(responses))
+    sup.watch("r0", "hz", "slo")
+    assert sup.verdict("r0")["alive"]   # grace before the first poll
+    sup.poll_once()
+    assert sup.verdict("r0")["alive"]   # one miss < budget
+    sup.poll_once()
+    v = sup.verdict("r0")
+    assert not v["alive"] and v["misses"] == 2
+    responses["hz"] = 200               # a successful contact resets
+    sup.poll_once()
+    assert sup.verdict("r0")["alive"] and sup.verdict("r0")["misses"] == 0
+
+
+def test_supervisor_poller_thread_polls_and_stops():
+    polls = []
+    responses = {"hz": 200}
+
+    def fetch(url, timeout_s):
+        polls.append(url)
+        return 200
+
+    sup = ReplicaSupervisor(miss_budget=2, fetch=fetch)
+    sup.watch("r0", "hz", None)
+    sup.start(interval_s=0.02)
+    deadline = time.monotonic() + 5.0
+    while not polls and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sup.stop()
+    assert polls, "poller thread never polled"
+    n = len(polls)
+    time.sleep(0.08)
+    assert len(polls) == n, "poller kept polling after stop()"
+
+
+# ---------------------------------------------------------------------------
+# router admission / hold / fail-over policy (stub replicas — no engines)
+
+
+class _StubScheduler:
+    def __init__(self, depth=0, max_pending=4):
+        self._depth = depth
+        self.max_pending = max_pending
+
+    def queue_depth(self):
+        return self._depth
+
+
+class _StubReplica:
+    """The Replica surface FleetRouter touches, without an engine: enough
+    to unit-test admission, hold, and fail-over policy deterministically."""
+
+    def __init__(self, rid, queue_depth=0, max_pending=4):
+        self.replica_id = rid
+        self.alive = True
+        self.partitioned = False
+        self.engine = type("E", (), {})()
+        self.engine.scheduler = _StubScheduler(queue_depth, max_pending)
+        self.submitted = []
+        self.handoffs = []
+
+    def url(self, endpoint):
+        return None
+
+    def submit(self, path, request_class=None, request_id=None):
+        self.submitted.append(request_id)
+
+    def admit_handoff(self, packet):
+        self.handoffs.append(packet.request_id)
+
+    def pump(self):
+        return "drained"
+
+    def flush(self):
+        pass
+
+    def poll_terminals(self):
+        return []
+
+    def drain(self):
+        return []
+
+    def kill(self):
+        self.alive = False
+        self.engine = None
+
+    def close(self):
+        self.alive = False
+
+
+def _router(replicas, **kw):
+    from esr_tpu.serving.fleet import FleetRouter
+
+    kw.setdefault("supervisor", ReplicaSupervisor(
+        miss_budget=2, fetch=lambda url, t: 200,
+    ))
+    return FleetRouter(replicas, **kw)
+
+
+def test_router_per_class_cap_sheds_with_classified_terminal():
+    rep = _StubReplica("r0")
+    router = _router([rep], class_pending_cap={"standard": 1})
+    a = router.submit("s0.h5", "standard")
+    b = router.submit("s1.h5", "standard")   # over the fleet-wide cap
+    assert router._ledger[a]["status"] is None and rep.submitted == [a]
+    assert router._ledger[b]["status"] == "shed"
+    assert router.summary()["statuses"]["shed"] == 1
+    assert router.sheds == 1
+
+
+def test_router_holds_when_full_and_terminalizes_when_fleet_dies():
+    rep = _StubReplica("r0", queue_depth=4, max_pending=4)  # full queue
+    router = _router([rep])
+    rid = router.submit("s0.h5", "standard")
+    assert rep.submitted == []                 # full: held, not shed
+    assert router._ledger[rid]["status"] is None
+    router._retry_held()
+    assert router._ledger[rid]["status"] is None   # still delayed
+    rep.kill()                                 # the whole fleet is gone
+    router._retry_held()
+    # zero-lost: a permanently unplaceable request terminates LOUDLY
+    assert router._ledger[rid]["status"] == "failover_retry_exhausted"
+    assert router.summary()["zero_lost"]
+
+
+def test_router_failover_placement_is_cap_exempt():
+    dead = _StubReplica("r0")
+    full = _StubReplica("r1", queue_depth=4, max_pending=4)
+    router = _router([dead, full], failover_budget=1)
+    rid = router.submit("s0.h5", "standard")
+    placed_on = router._ledger[rid]["replica"]
+    if placed_on == "r1":                      # hash landed on the full one
+        router._ledger[rid]["replica"] = "r0"
+        router._ledger[rid]["served_on"] = {"r0"}
+    dead.kill()
+    router._state["r0"] = "dead"
+    router._failover("r0")
+    # the full-but-healthy replica must still take the stream
+    # (admit_handoff is cap-exempt — a full queue never loses a stream)
+    assert router._ledger[rid]["replica"] == "r1"
+    assert full.handoffs == [rid]
+    assert router._ledger[rid]["status"] is None
+
+
+# ---------------------------------------------------------------------------
+# taxonomy pins
+
+
+def test_router_terminal_statuses_pinned():
+    assert ROUTER_TERMINAL_STATUSES == {
+        "migrated", "replica_lost", "failover_retry_exhausted",
+    }
+
+
+def test_report_rootless_statuses_pinned():
+    """obs/report.py must keep skipping exactly these statuses in the
+    completeness walker (router-emitted terminals have no journey root
+    in the router's file) — and `migrated` must NOT be among them (the
+    source replica emits it WITH its root)."""
+    from esr_tpu.obs.report import _CONTINUED_STATUSES, _ROOTLESS_STATUSES
+
+    assert _ROOTLESS_STATUSES == {
+        "shed", "replica_lost", "failover_retry_exhausted",
+    }
+    assert _CONTINUED_STATUSES == {"shed", "migrated", "replica_lost"}
+
+
+def test_fleet_fault_site_registered():
+    from esr_tpu.resilience.faults import _KINDS, SITES, FaultSpec
+
+    assert "fleet_router" in SITES
+    assert _KINDS["fleet_router"] == (
+        "replica_kill", "replica_partition", "router_handoff",
+    )
+    with pytest.raises(ValueError, match="unknown kind"):
+        FaultSpec("fleet_router", 0, "stall")

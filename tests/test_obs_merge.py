@@ -1,0 +1,193 @@
+"""Multi-file telemetry merge (ISSUE 15 satellite): ``python -m
+esr_tpu.obs report/export`` over SEVERAL telemetry files rolls them into
+one fleet-level view — exact percentiles (merge == concat), per-file
+counter totals summed (a running total must not last-write-win), rows
+labeled by replica id, cross-file fault -> recovery matching, and the
+Perfetto export splitting each file into its own process group."""
+
+import json
+
+import pytest
+
+from esr_tpu.obs import TelemetrySink, set_active_sink
+from esr_tpu.obs.__main__ import main as obs_main
+from esr_tpu.obs.report import (
+    build_report,
+    merge_fleet_reports,
+    percentile,
+    report_files,
+    split_label,
+)
+from esr_tpu.obs.export import read_telemetry
+
+
+def _write_replica(path, cls_latencies, counter=0, fault=None,
+                   recovery=None, done_status="ok", windows=3):
+    """One small per-replica telemetry file: chunk-participation spans
+    (the per-class latency evidence), an optional counter, an optional
+    fault/recovery event, and a terminal."""
+    sink = TelemetrySink(str(path))
+    prev = set_active_sink(sink)
+    try:
+        root = "root-" + str(path.name)
+        sink.span("serve_request", 1.0, trace_id="t" + str(path.name),
+                  span_id=root, parent_id=None, request="req-" + path.name)
+        for i, lat in enumerate(cls_latencies):
+            sink.span("serve_chunk_part", lat, cls="standard",
+                      windows=1, trace_id="t" + str(path.name),
+                      span_id=f"part{i}-{path.name}", parent_id=root,
+                      request="req-" + path.name)
+        for _ in range(counter):
+            sink.counter("serve_backpressure")
+        if fault is not None:
+            sink.event("fault_injected", site=fault, kind="replica_kill",
+                       index=0, fault_id=f"{fault}:0:replica_kill:0")
+        if recovery is not None:
+            sink.event(recovery, site="fleet_router",
+                       fault_id="fleet_router:0:replica_kill:0")
+        sink.event("serve_request_done", request="req-" + path.name,
+                   trace_id="t" + str(path.name), parent_id=root,
+                   status=done_status, completed=done_status == "ok",
+                   windows=windows, cls="standard")
+    finally:
+        set_active_sink(prev)
+        sink.close()
+
+
+def test_split_label_forms(tmp_path):
+    p = tmp_path / "telemetry_r0.jsonl"
+    p.write_text("")
+    assert split_label(str(p)) == ("telemetry_r0", str(p))
+    assert split_label(f"r7={p}") == ("r7", str(p))
+    nested = tmp_path / "run42"
+    nested.mkdir()
+    q = nested / "telemetry.jsonl"
+    q.write_text("")
+    # the conventional per-run filename falls back to the parent dir
+    assert split_label(str(q)) == ("run42", str(q))
+
+
+@pytest.fixture()
+def fleet_files(tmp_path):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_replica(a, [0.010, 0.020, 0.030], counter=2,
+                   fault="fleet_router")
+    _write_replica(b, [0.040, 0.050], counter=3,
+                   recovery="recovery_replica_failover")
+    return a, b
+
+
+def test_merged_percentiles_are_exact_concat(fleet_files):
+    a, b = fleet_files
+    doc, code = report_files([str(a), str(b)])
+    assert code == 0
+    merged = doc["report"]
+    # per-class latency percentiles == percentiles of the concatenation
+    lat = [0.010, 0.020, 0.030, 0.040, 0.050]
+    cls = merged["serving"]["classes"]["standard"]
+    assert cls["window_latency_p50_ms"] == round(
+        percentile(lat, 50) * 1e3, 4)
+    assert cls["window_latency_p99_ms"] == round(
+        percentile(lat, 99) * 1e3, 4)
+    assert cls["windows"] == 5
+
+
+def test_merged_counters_sum_per_file_totals(fleet_files):
+    a, b = fleet_files
+    doc, _ = report_files([str(a), str(b)])
+    # each sink keeps a RUNNING total (2 and 3): the merge must sum the
+    # per-file finals, not let the last file's total win
+    assert doc["report"]["counters"]["serve_backpressure"] == 5.0
+
+
+def test_merged_faults_match_across_files(fleet_files):
+    a, b = fleet_files
+    doc, _ = report_files([str(a), str(b)])
+    faults = doc["report"]["faults"]
+    # the fault fired in file a; its recovery event lives in file b
+    # (router vs replica files) — the merged view pairs them by fault_id
+    assert faults["injected"] == 1
+    assert faults["unrecovered"] == 0
+
+
+def test_merged_replica_rows_labeled(fleet_files):
+    a, b = fleet_files
+    doc, _ = report_files([f"left={a}", f"right={b}"])
+    rows = doc["report"]["replicas"]
+    assert set(rows) == {"left", "right"}
+    assert rows["left"]["requests"] == 1
+    assert rows["left"]["faults_injected"] == 1
+    assert rows["right"]["faults_injected"] == 0
+
+
+def test_single_path_keeps_exact_single_file_shape(fleet_files):
+    a, _ = fleet_files
+    doc, code = report_files([str(a)])
+    manifest, records, torn = read_telemetry(str(a))
+    assert doc["report"] == build_report(records, manifest,
+                                         torn_lines=torn)
+    assert "replicas" not in doc["report"]
+
+
+def test_continued_statuses_excluded_from_totals(tmp_path):
+    a = tmp_path / "src.jsonl"
+    b = tmp_path / "dst.jsonl"
+    # the source replica's half ends `migrated` (windows served so far);
+    # the target's final terminal carries the FULL stream count
+    _write_replica(a, [0.01], done_status="migrated", windows=2)
+    _write_replica(b, [0.02], done_status="ok", windows=5)
+    doc, _ = report_files([str(a), str(b)])
+    serving = doc["report"]["serving"]
+    assert serving["requests"] == 1          # the migrated half not double-counted
+    assert serving["windows"] == 5
+    assert serving["statuses"] == {"migrated": 1, "ok": 1}
+    # the migrated terminal has a root in its own file: still a complete trace
+    assert doc["report"]["traces"]["incomplete"] == 0
+
+
+def test_rootless_router_terminal_not_incomplete(tmp_path):
+    path = tmp_path / "router.jsonl"
+    sink = TelemetrySink(str(path))
+    prev = set_active_sink(sink)
+    try:
+        # router-level terminals have no journey root in the router file
+        sink.event("serve_request_done", request="req-x",
+                   status="replica_lost", completed=False, windows=0)
+        sink.event("serve_request_done", request="req-y",
+                   status="failover_retry_exhausted", completed=False,
+                   windows=0)
+    finally:
+        set_active_sink(prev)
+        sink.close()
+    manifest, records, torn = read_telemetry(str(path))
+    report = build_report(records, manifest, torn_lines=torn)
+    assert report["traces"]["incomplete"] == 0
+    # replica_lost continued elsewhere; exhausted is FINAL and counts
+    assert report["serving"]["requests"] == 1
+    assert report["serving"]["errors"] == 1
+
+
+def test_cli_report_and_export_accept_multiple_paths(fleet_files, tmp_path,
+                                                     capsys):
+    a, b = fleet_files
+    assert obs_main(["report", str(a), str(b)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["report"]["replicas"]) == {"a", "b"}
+
+    out = tmp_path / "fleet.trace.json"
+    assert obs_main(["export", f"ra={a}", f"rb={b}", "-o", str(out)]) == 0
+    trace = json.loads(out.read_text())
+    names = {ev["args"]["name"] for ev in trace["traceEvents"]
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    # each file's tracks live in their own labeled process group
+    assert any(n.startswith("ra:") for n in names)
+    assert any(n.startswith("rb:") for n in names)
+    pids_a = {ev["pid"] for ev in trace["traceEvents"]
+              if ev.get("ph") == "X"}
+    assert len(pids_a) >= 2  # spans from two distinct pid blocks
+
+
+def test_merge_requires_at_least_one_file():
+    with pytest.raises(ValueError):
+        merge_fleet_reports([])
