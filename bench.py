@@ -1273,6 +1273,66 @@ def stage_concurrency_audit():
     return res
 
 
+# the tier-1 wall-clock ceiling (ISSUE 16): the re-tiering brought the
+# suite from ~840s of an 870s timeout back under this line; the bench
+# series pins it so budget creep surfaces as data, not as a timeout 15
+# PRs later. docs/TESTING.md states the eviction policy that defends it.
+TIER1_WALL_CEILING_S = 600.0
+
+TIER1_BUDGET_KEYS = (
+    "wall_s", "ceiling_s", "within_budget", "test_files",
+    "test_functions", "slow_test_functions", "session_fixtures",
+    "auditor_clean", "findings_by_rule", "rules_version",
+)
+
+
+def stage_tier1_budget():
+    """Tier-1 budget contracts (ISSUE 16): the whole-suite test-plane
+    audit (``esr_tpu.analysis.testplane``, TX rule catalog) against the
+    committed ``testplane_baseline.json`` — test/slow/fixture counts and
+    the clean flag become a tracked bench series next to program_audit
+    and concurrency_audit. Pure AST, pytest-free, seconds-fast: runs
+    (and must stay CLEAN) in smoke. Wall time is observational, not
+    measured here (a bench stage cannot re-run the suite that is running
+    it): scripts/tier1_budget.sh exports ESR_TIER1_WALL_S from a real
+    timed run; absent that, wall_s records null and within_budget judges
+    only what is known."""
+    from esr_tpu.analysis.core import load_baseline, new_findings
+    from esr_tpu.analysis.testplane import audit_testplane, rules_signature
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    audit = audit_testplane(
+        [os.path.join(root, "tests")], relative_to=root
+    )
+    fresh = new_findings(
+        audit.findings,
+        load_baseline(os.path.join(root, "testplane_baseline.json")),
+    )
+    m = audit.model
+    wall_env = os.environ.get("ESR_TIER1_WALL_S")
+    wall_s = float(wall_env) if wall_env else None
+    res = dict(zip(TIER1_BUDGET_KEYS, (
+        wall_s,
+        TIER1_WALL_CEILING_S,
+        wall_s is None or wall_s <= TIER1_WALL_CEILING_S,
+        m["test_files"],
+        m["test_functions"],
+        m["slow_test_functions"],
+        m["session_fixtures"],
+        len(fresh) == 0,
+        m["findings_by_rule"],
+        m["rules_version"],
+    ), strict=True))
+    EXTRA["tier1_budget"] = {
+        "wall_s": res["wall_s"],
+        "within_budget": res["within_budget"],
+        "auditor_clean": res["auditor_clean"],
+        "tests": res["test_functions"],
+        "slow": res["slow_test_functions"],
+    }
+    return res
+
+
 def stage_scaling(ctx, batches=None):
     """Per-chip batch scaling curve (VERDICT r2: is the small MFU
     small-batch arithmetic intensity or a pipeline problem?).
@@ -2416,6 +2476,11 @@ STAGE_REGISTRY = [
     # the package (pure AST, jax-free — runs and must stay clean in
     # smoke); the concurrent host surface becomes a tracked series
     ("concurrency_audit", lambda ctx: stage_concurrency_audit(), 300, True),
+    # tier-1 budget contracts: the test-plane audit over tests/ (pure
+    # AST, pytest-free — runs and must stay clean in smoke) + the pinned
+    # wall-clock ceiling; the suite's cost tiering becomes a tracked
+    # series so budget creep is bench data, not a timeout
+    ("tier1_budget", lambda ctx: stage_tier1_budget(), 300, True),
     # the live telemetry plane's cost trio: aggregator tap overhead,
     # sketch-vs-exact max relative error, endpoint poll p50 — host-bound
     # by design, runs in smoke (and BEFORE the loader-heavy stages so no

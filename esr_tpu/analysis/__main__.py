@@ -1,6 +1,6 @@
 """CLI: ``python -m esr_tpu.analysis [options] [paths]`` (= ``esr-analyze``).
 
-Three gates behind one exit code:
+Four gates behind one exit code:
 
 - the **AST lint** over ``paths`` (files/directories), against
   ``--baseline``;
@@ -12,12 +12,17 @@ Three gates behind one exit code:
 - the **host-concurrency audit** (``--threads``) — the whole-program
   thread/lock-discipline pass (``esr_tpu.analysis.concurrency``, CX rule
   catalog) over ``paths`` (default ``esr_tpu/`` when none are given),
-  against ``--threads-baseline``. Pure AST, jax-free, seconds-fast.
+  against ``--threads-baseline``. Pure AST, jax-free, seconds-fast;
+- the **test-plane audit** (``--testplane``) — the whole-suite cost-
+  tiering pass (``esr_tpu.analysis.testplane``, TX rule catalog) over
+  ``--testplane-root`` (default ``tests``, deliberately independent of
+  ``paths`` so hazard-fixture invocations never drag the AST gate in),
+  against ``--testplane-baseline``. Pure AST, jax-free, pytest-free.
 
 ``--rules`` subsets any gate by catalog: ESR names restrict the AST
-lint, JX names the jaxpr audit, CX names the concurrency audit; a gate
-whose subset is empty is skipped (with a note), and an unknown name is a
-usage error.
+lint, JX names the jaxpr audit, CX names the concurrency audit, TX
+names the test-plane audit; a gate whose subset is empty is skipped
+(with a note), and an unknown name is a usage error.
 
 Exit codes: 0 clean (no findings beyond the baselines), 1 new findings
 (or a baseline generated under a different rule set — regenerate it),
@@ -82,7 +87,8 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="comma-separated rule names to run (default: all) — ESR names "
         "subset the AST lint, JX names the jaxpr audit, CX names the "
-        "concurrency audit, e.g. ESR002,ESR006 or JX001 or CX001,CX003",
+        "concurrency audit, TX names the test-plane audit, e.g. "
+        "ESR002,ESR006 or JX001 or CX001,CX003 or TX001,TX005",
     )
     p.add_argument(
         "--relative-to",
@@ -125,6 +131,27 @@ def build_parser() -> argparse.ArgumentParser:
         default="concurrency_baseline.json",
         help="baseline for the concurrency audit "
         "(default: concurrency_baseline.json)",
+    )
+    p.add_argument(
+        "--testplane",
+        action="store_true",
+        help="run the test-plane audit (suite cost-tiering TX rule "
+        "catalog in docs/ANALYSIS.md) over --testplane-root",
+    )
+    p.add_argument(
+        "--testplane-baseline",
+        metavar="FILE",
+        default="testplane_baseline.json",
+        help="baseline for the test-plane audit "
+        "(default: testplane_baseline.json)",
+    )
+    p.add_argument(
+        "--testplane-root",
+        metavar="DIR",
+        default="tests",
+        help="tree whose test files and conftests the test-plane audit "
+        "sweeps (default: tests) — point it at a hazard-fixture tree to "
+        "audit seeded hazards",
     )
     return p
 
@@ -327,49 +354,100 @@ def _run_threads(args, rule_subset, json_out: dict) -> int:
     )
 
 
+def _run_testplane(args, rule_subset, json_out: dict) -> int:
+    """The test-plane half; returns an exit code."""
+    import os
+
+    from esr_tpu.analysis.testplane import (
+        audit_testplane,
+        iter_test_files,
+        rules_signature as tx_signature,
+    )
+
+    root = args.testplane_root
+    if not os.path.isdir(root):
+        print(
+            f"--testplane-root {root!r} is not a directory — expects to "
+            "run from the repo root (or pass the suite tree explicitly)",
+            file=sys.stderr,
+        )
+        return 2
+    if not iter_test_files([root]):
+        print(
+            f"no test files found under {root!r} — refusing to report a "
+            "clean test-plane audit over nothing",
+            file=sys.stderr,
+        )
+        return 2
+    audit = audit_testplane(
+        [root],
+        rules=sorted(rule_subset) if rule_subset is not None else None,
+        relative_to=args.relative_to,
+    )
+    model = audit.model
+    return _ratchet_report(
+        audit.findings,
+        baseline_path=args.testplane_baseline,
+        signature=tx_signature(),
+        full_run=rule_subset is None,
+        args=args,
+        json_out=json_out,
+        json_key="testplane",
+        label=(
+            f"testplane audit: {model['test_functions']} test(s) in "
+            f"{model['test_files']} file(s), {model['fixtures']} "
+            "fixture(s), "
+        ),
+        json_extra={"model": model, "rules_version": tx_signature()},
+    )
+
+
 def _partition_rules(args):
     """``--rules`` names split by catalog: (ast_subset, jx_subset,
-    cx_subset), each None meaning "full set". Unknown names report a
-    usage error via the trailing error slot."""
+    cx_subset, tx_subset), each None meaning "full set". Unknown names
+    report a usage error via the trailing error slot."""
     if not args.rules:
-        return None, None, None, None
+        return None, None, None, None, None
     from esr_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from esr_tpu.analysis.testplane import TESTPLANE_RULES
 
     wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
     known_ast = {r.name for r in all_rules()}
     known_cx = set(CONCURRENCY_RULES)
+    known_tx = set(TESTPLANE_RULES)
     # the jaxpr catalog needs jax to import; only pay that when a name
     # could plausibly belong to it
-    if wanted - known_ast - known_cx:
+    if wanted - known_ast - known_cx - known_tx:
         from esr_tpu.analysis.jaxpr_audit import JAXPR_RULES
 
         known_jx = set(JAXPR_RULES)
     else:
         known_jx = set()
-    unknown = wanted - known_ast - known_jx - known_cx
+    unknown = wanted - known_ast - known_jx - known_cx - known_tx
     if unknown:
-        return None, None, None, (
+        return None, None, None, None, (
             f"unknown rule(s): {sorted(unknown)}; known: "
-            f"{sorted(known_ast | known_jx | known_cx)}"
+            f"{sorted(known_ast | known_jx | known_cx | known_tx)}"
         )
     return (wanted & known_ast, wanted & known_jx, wanted & known_cx,
-            None)
+            wanted & known_tx, None)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
 
-    if not args.paths and not args.jaxpr and not args.threads:
+    if (not args.paths and not args.jaxpr and not args.threads
+            and not args.testplane):
         print(
             "nothing to do: give paths to lint, --jaxpr to audit the "
-            "production programs, and/or --threads for the concurrency "
-            "audit",
+            "production programs, --threads for the concurrency audit, "
+            "and/or --testplane for the test-plane audit",
             file=sys.stderr,
         )
         return 2
 
-    ast_subset, jx_subset, cx_subset, err = _partition_rules(args)
+    ast_subset, jx_subset, cx_subset, tx_subset, err = _partition_rules(args)
     if err:
         print(err, file=sys.stderr)
         return 2
@@ -393,6 +471,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         else:
             codes.append(_run_threads(args, cx_subset, json_out))
+    if args.testplane and 2 not in codes:
+        if tx_subset is not None and not tx_subset:
+            print(
+                "--rules names no testplane (TX*) rule — skipping the "
+                "testplane gate",
+                file=sys.stderr,
+            )
+        else:
+            codes.append(_run_testplane(args, tx_subset, json_out))
     if args.jaxpr and 2 not in codes:
         if jx_subset is not None and not jx_subset:
             print(

@@ -402,6 +402,17 @@ def pure_cx_noqa(names: "Set[str]") -> bool:
     )
 
 
+def pure_tx_noqa(names: "Set[str]") -> bool:
+    """Is a noqa line owned by the testplane gate? Same contract as
+    :func:`pure_cx_noqa`, for the TX catalog: the testplane gate's own
+    staleness sweep polices these lines, so the per-file AST lint must
+    not double-report them (a malformed name like ``TX0O1`` stays with
+    the AST gate — fail-closed)."""
+    return bool(names) and all(
+        n.startswith("TX") and n[2:].isdigit() for n in names
+    )
+
+
 _NOQA_RULE_RE = None  # compiled lazily (keeps `re` out of the hot import)
 
 
@@ -525,7 +536,9 @@ def analyze_source(
             # jaxpr gate suppresses via ProgramSpec.allow, not source
             # comments) and a mixed ESR+CX line is judged by its ESR
             # half — fail-closed beats a directive nobody polices.
-            if pure_cx_noqa(names):
+            # Pure testplane (TX) suppressions are likewise owned by the
+            # testplane gate's own sweep.
+            if pure_cx_noqa(names) or pure_tx_noqa(names):
                 continue
             what = (
                 "blanket `# esr: noqa`" if not names

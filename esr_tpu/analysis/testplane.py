@@ -1,0 +1,878 @@
+"""Test-plane auditor: static cost-tiering proofs over the suite (TX rules).
+
+The AST lint polices the package, the jaxpr audit polices the programs, the
+concurrency audit polices the host thread model — and the ~21k-LoC test
+suite, the one plane that decides whether tier-1 fits its wall-clock
+budget, had no gate at all. Every PR re-negotiated the budget by hand:
+PR 15 had to shape its fleet model (basech=4) around program-cache timing
+interference with ``test_serve_smoke``, and the suite crept to ~840s of an
+870s ceiling one per-test corpus rebuild at a time. This module makes cost
+tiering a *checked, machine-enforced property*: tests name the scenario
+they pin, while fixture scope, fast-path-vs-full splits, and ``slow``
+markers are proven statically (docs/TESTING.md states the policy this gate
+enforces; docs/ANALYSIS.md carries the rule catalog).
+
+It is a **whole-suite** pass over ``tests/`` + ``conftest.py`` (test files
+and conftests only — seeded hazard registries under ``fixtures/`` are
+excluded from the sweep and audited explicitly), built in two layers:
+
+1. **model extraction** — per test module:
+
+   - the *fixture graph*: every ``@pytest.fixture`` def with its scope
+     (default ``function``), its parameters, and its consumers (tests and
+     fixtures naming it — conftest fixtures count consumers suite-wide);
+   - *expensive-factory call sites* resolved through the module call
+     graph, the way the concurrency auditor resolves spawn targets: a
+     test whose helper's helper calls ``write_synthetic_h5`` is charged
+     at ITS call site, with the chain named. The known-expensive set:
+     corpus synthesis (``write_synthetic_h5``/``make_stream_corpus``/
+     ``make_synthetic_recording``/``simulate_ladder_recording``/
+     ``fleet_traffic``), scenario runners (``run_scenario``/
+     ``run_fleet_scenario``), trainer/engine construction (``Trainer``/
+     ``ServingEngine``/``StreamingEngine``/``FleetRouter``), traced-
+     program factories (``checked_jit``/``make_train_step``/
+     ``make_multi_step``/``make_chunk_fn``/``jit_eval_step``/
+     ``make_fused_eval_accum``), and model init (an ``.init(...)`` call
+     fed a ``PRNGKey``);
+   - *slow markers*: ``@pytest.mark.slow`` per test, per class, or via a
+     module-level ``pytestmark`` — slow tests are outside the tier-1
+     budget, so the budget rules skip them;
+   - *module constants* (literal module-level assignments), so corpus
+     signatures resolve ``n=N_STREAMS`` to its value instead of ``?``.
+
+2. **the TX rule family** over that model (catalog mirrored in
+   docs/ANALYSIS.md):
+
+   - TX001 heavyweight setup in the test body (the same expensive factory
+     hit from ≥2 test bodies of one module — per-test rebuilds of what a
+     fixture should own);
+   - TX002 under-scoped expensive fixture (function-scoped fixture whose
+     body hits an expensive factory, with ≥2 consumers);
+   - TX003 subprocess spawn in tier-1 without a ``slow`` marker or a
+     bounded-timeout fast-path guard (the PR 9/14 CLI-gate pattern —
+     ``timeout=`` ≤ 600 at the spawn site — stays allowed);
+   - TX004 unbounded wait (bare ``time.sleep`` ≥ 0.5s, timeout-less
+     zero-arg ``join()``/``wait()``/``get()``/``result()`` — the
+     test-side twin of ESR009);
+   - TX005 program-cache churn (the same traced-program factory traced
+     from ≥3 test bodies suite-wide instead of a warmed-program fixture —
+     the exact interference PR 15 hit);
+   - TX006 duplicate corpus rebuild (≥2 sites synthesizing corpora with
+     the same resolved signature that one shared fixture should provide;
+     session-scoped conftest fixtures ARE the canonical providers and are
+     exempt).
+
+Findings reuse the :class:`~esr_tpu.analysis.core.Finding` / fingerprint /
+``# esr: noqa(TX00x)`` / baseline-ratchet machinery; the committed ratchet
+is ``testplane_baseline.json`` (the grandfathered pre-re-tiering debt —
+the suite can only get cheaper), stamped with :func:`rules_signature`.
+Stale pure-TX noqa lines are reported as ESR011 by THIS gate (the AST gate
+exempts foreign catalogs — each gate polices its own suppressions).
+
+Deliberate scope limits (quiet enough to gate CI, like the CX pass):
+
+- never imports or collects the suite (pure AST, pytest-free, jax-free —
+  the whole plane audits in well under a second);
+- cross-FILE helpers (a test importing a builder from a sibling test
+  module) resolve one hop through the import, not transitively;
+- "fresh shapes/dtypes" in TX005 is approximated by call-site counting —
+  distinct test-body trace sites are what churns the program cache,
+  whatever their shapes;
+- dynamically-built fixtures (``request.getfixturevalue``) and
+  ``usefixtures`` marks are invisible; the suite does not use them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from esr_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    _call_name,
+    _dotted,
+    pure_tx_noqa,
+)
+
+__all__ = [
+    "TESTPLANE_RULES",
+    "rules_signature",
+    "extract_test_module",
+    "audit_testplane",
+    "TestplaneAudit",
+]
+
+# rule name -> (severity, one-line summary); docs/ANALYSIS.md mirrors this
+# catalog. Version-stamped into testplane_baseline.json so a rule upgrade
+# reports "regenerate the baseline" instead of mass-firing (core semantics).
+TESTPLANE_RULES: Dict[str, Tuple[str, str]] = {
+    "TX001": ("warning", "heavyweight setup rebuilt per test body"),
+    "TX002": ("warning", "under-scoped expensive fixture"),
+    "TX003": ("warning", "subprocess spawn in tier-1 without slow marker"),
+    "TX004": ("warning", "unbounded wait in test code"),
+    "TX005": ("warning", "program-cache churn across test bodies"),
+    "TX006": ("warning", "duplicate corpus rebuild"),
+}
+
+_HINTS: Dict[str, str] = {
+    "TX001": (
+        "an expensive factory (corpus synthesis, model init, trainer/"
+        "engine construction, production-program tracing) called inside "
+        "each test body pays its cost once PER TEST — hoist it into one "
+        "module- or session-scoped fixture (tests/conftest.py owns the "
+        "shared ones) and let the tests consume the result, or justify "
+        "with `# esr: noqa(TX001)`"
+    ),
+    "TX002": (
+        "a function-scoped fixture re-runs its expensive body for every "
+        "consumer; with >=2 consumers that is the same per-test rebuild "
+        "TX001 flags, one indirection away. Widen to scope='module' (or "
+        "'session' in conftest.py) if the value is read-only, or justify "
+        "mutation-isolation with `# esr: noqa(TX002)`"
+    ),
+    "TX003": (
+        "a subprocess in tier-1 pays interpreter + jax import (~5-15s) "
+        "per spawn and hides its wall time from the fixture graph. Keep "
+        "it only for true entry-point gates with a bounded literal "
+        "`timeout=` (the CLI-gate pattern), mark the test `slow` so the "
+        "standalone scripts/*_smoke.sh gate owns it, or justify with "
+        "`# esr: noqa(TX003)`"
+    ),
+    "TX004": (
+        "a bare `time.sleep(...)` burns budget on every run and still "
+        "races the condition it waits for; a timeout-less `join()`/"
+        "`wait()`/`get()`/`result()` can hang the whole suite past the "
+        "tier-1 ceiling (the test-side twin of ESR009). Poll with a "
+        "deadline or pass a timeout, or justify with `# esr: noqa(TX004)`"
+    ),
+    "TX005": (
+        "each test-body call of a production jit factory traces (and "
+        "compiles) a fresh program; at N sites the program cache churns "
+        "N times per run and cross-test timing interference appears — "
+        "the test_serve_smoke effect PR 15 had to design around. Trace "
+        "once in a warmed-program fixture (tests/conftest.py) and share "
+        "it, or justify with `# esr: noqa(TX005)`"
+    ),
+    "TX006": (
+        "several sites synthesize an equivalent corpus the shared "
+        "session fixture already provides (or should) — each rebuild is "
+        "seconds of h5 writing repeated per module. Consume the "
+        "conftest.py corpus fixture, or give this site genuinely "
+        "different parameters, or justify with `# esr: noqa(TX006)`"
+    ),
+}
+
+
+def rules_signature() -> str:
+    """Stable identity of the TX rule set, stamped into the baseline."""
+    return "tx:" + ",".join(sorted(TESTPLANE_RULES))
+
+
+# ---------------------------------------------------------------------------
+# the known-expensive surface (names, not imports — the auditor never runs
+# the suite). Kept in one place so docs/TESTING.md and the hazard fixtures
+# can mirror it.
+
+CORPUS_FACTORIES = {
+    "write_synthetic_h5", "make_stream_corpus", "make_synthetic_recording",
+    "simulate_ladder_recording", "fleet_traffic",
+}
+SCENARIO_RUNNERS = {"run_scenario", "run_fleet_scenario"}
+ENGINE_CTORS = {"Trainer", "ServingEngine", "StreamingEngine", "FleetRouter"}
+TRACED_FACTORIES = {
+    "checked_jit", "make_train_step", "make_multi_step", "make_chunk_fn",
+    "jit_eval_step", "make_fused_eval_accum",
+}
+_SUBPROCESS_NAMES = {
+    "run", "call", "check_call", "check_output", "Popen", "system", "popen",
+}
+_WAIT_METHODS = {"join", "wait", "get", "result"}
+SLEEP_THRESHOLD_S = 0.5
+TX003_TIMEOUT_CEILING_S = 600.0
+TX005_MIN_SITES = 3
+
+_KIND_OF = {}
+for _n in CORPUS_FACTORIES:
+    _KIND_OF[_n] = "corpus"
+for _n in SCENARIO_RUNNERS:
+    _KIND_OF[_n] = "scenario"
+for _n in ENGINE_CTORS:
+    _KIND_OF[_n] = "engine"
+for _n in TRACED_FACTORIES:
+    _KIND_OF[_n] = "traced"
+
+
+@dataclasses.dataclass
+class ExpensiveCall:
+    """One expensive-factory hit, anchored where the charged def pays it.
+
+    ``anchor`` is the node inside the charged def (the factory call
+    itself, or the local helper call that transitively reaches it);
+    ``via`` names the helper chain for the message ("" for direct)."""
+
+    factory: str
+    kind: str            # corpus | scenario | engine | traced | model_init
+    node: ast.AST        # the factory call (signature source)
+    anchor: ast.AST      # node inside the charged def
+    via: str
+    sig: str = ""        # resolved arg signature (corpus grouping)
+
+
+@dataclasses.dataclass
+class FixtureDef:
+    name: str
+    scope: str
+    node: ast.AST
+    path: str
+    params: Tuple[str, ...]
+    conftest: bool
+    expensive: List[ExpensiveCall]
+    consumers: int = 0
+
+
+@dataclasses.dataclass
+class TestDef:
+    name: str
+    node: ast.AST
+    path: str
+    params: Tuple[str, ...]
+    slow: bool
+    expensive: List[ExpensiveCall]
+
+
+@dataclasses.dataclass
+class SubprocessSite:
+    node: ast.AST
+    what: str
+    bounded: bool        # literal timeout= within the ceiling
+    anchor: ast.AST
+    via: str
+
+
+class TestModule:
+    """The extracted cost model of one test file (or conftest)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.path = ctx.path
+        self.is_conftest = os.path.basename(ctx.path) == "conftest.py"
+        self.consts = _module_constants(ctx.tree)
+        self.module_slow = _module_slow(ctx.tree)
+        self.fixtures: Dict[str, FixtureDef] = {}
+        self.tests: List[TestDef] = []
+        self.helpers: Dict[str, ast.AST] = {}
+        self.waits: List[Tuple[ast.AST, str]] = []  # TX004 sites
+        self.subprocesses: Dict[ast.AST, List[SubprocessSite]] = {}
+        self._direct: Dict[ast.AST, List[ExpensiveCall]] = {}
+        self._direct_sub: Dict[ast.AST, List[SubprocessSite]] = {}
+        self._local_calls: Dict[ast.AST, List[Tuple[ast.AST, str]]] = {}
+
+
+def _module_constants(tree: ast.AST) -> Dict[str, object]:
+    """Literal module-level assignments (``N_STREAMS = 8``), so corpus
+    signatures resolve symbolic args to their values."""
+    out: Dict[str, object] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)):
+            try:
+                out[node.targets[0].id] = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                pass
+    return out
+
+
+def _is_slow_mark(dec: ast.AST) -> bool:
+    """``pytest.mark.slow`` (possibly called: ``pytest.mark.slow()``)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    dotted = _dotted(dec)
+    return dotted.endswith("mark.slow")
+
+
+def _module_slow(tree: ast.AST) -> bool:
+    """``pytestmark = pytest.mark.slow`` (or a list containing it)."""
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "pytestmark"):
+            continue
+        value = node.value
+        items = value.elts if isinstance(value, (ast.List, ast.Tuple)) else [
+            value
+        ]
+        if any(_is_slow_mark(i) for i in items):
+            return True
+    return False
+
+
+def _fixture_scope(dec: ast.AST) -> Optional[str]:
+    """The fixture scope when ``dec`` is a pytest.fixture decorator
+    (default ``function``), else None."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if _call_name(target) != "fixture":
+        return None
+    if isinstance(dec, ast.Call):
+        for kw in dec.keywords:
+            if kw.arg == "scope":
+                try:
+                    return str(ast.literal_eval(kw.value))
+                except (ValueError, SyntaxError):
+                    return "function"
+    return "function"
+
+
+def _contains_prngkey(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Call) and _call_name(sub.func) == "PRNGKey"
+        for sub in ast.walk(node)
+    )
+
+
+_PATHISH_KWARGS = {"path", "out_dir", "out", "dir", "directory"}
+
+
+def _arg_signature(call: ast.Call, factory: str,
+                   consts: Dict[str, object]) -> str:
+    """Canonical resolved-argument signature for TX006 grouping. Path-like
+    arguments (the first positional of a corpus factory, path-named
+    kwargs) are excluded — two rebuilds of the same corpus always differ
+    in tmp path. Unresolvable values render as ``?``; a signature with NO
+    resolved value is returned empty (too uncertain to group)."""
+
+    def resolve(node: ast.AST) -> Tuple[bool, str]:
+        try:
+            return True, repr(ast.literal_eval(node))
+        except (ValueError, SyntaxError):
+            pass
+        if isinstance(node, ast.Name) and node.id in consts:
+            return True, repr(consts[node.id])
+        if isinstance(node, ast.Tuple):
+            parts = [resolve(e) for e in node.elts]
+            if all(ok for ok, _ in parts):
+                return True, "(" + ", ".join(s for _, s in parts) + ")"
+        return False, "?"
+
+    parts: List[str] = []
+    any_resolved = False
+    positions = call.args[1:] if factory in CORPUS_FACTORIES else call.args
+    for a in positions:
+        ok, s = resolve(a)
+        any_resolved = any_resolved or ok
+        parts.append(s)
+    for kw in sorted(
+            (k for k in call.keywords if k.arg), key=lambda k: k.arg):
+        if kw.arg in _PATHISH_KWARGS:
+            continue
+        ok, s = resolve(kw.value)
+        any_resolved = any_resolved or ok
+        parts.append(f"{kw.arg}={s}")
+    if not any_resolved:
+        return ""
+    return f"{factory}({', '.join(parts)})"
+
+
+def _literal_timeout(call: ast.Call,
+                     consts: Dict[str, object]) -> Optional[float]:
+    for kw in call.keywords:
+        if kw.arg != "timeout":
+            continue
+        try:
+            return float(ast.literal_eval(kw.value))
+        except (ValueError, SyntaxError, TypeError):
+            if isinstance(kw.value, ast.Name) and kw.value.id in consts:
+                try:
+                    return float(consts[kw.value.id])  # type: ignore[arg-type]
+                except (TypeError, ValueError):
+                    return None
+            return None
+    return None
+
+
+def _classify_expensive(call: ast.Call,
+                        consts: Dict[str, object]) -> Optional[ExpensiveCall]:
+    name = _call_name(call.func)
+    kind = _KIND_OF.get(name)
+    if kind is not None:
+        sig = (_arg_signature(call, name, consts)
+               if kind == "corpus" else "")
+        return ExpensiveCall(name, kind, call, call, "", sig)
+    # model init: `.init(...)` fed a PRNGKey — flax Module.init, the
+    # compile-on-host cost, without false-firing on dict-ish `.init`s
+    if (isinstance(call.func, ast.Attribute) and call.func.attr == "init"
+            and any(_contains_prngkey(a) for a in call.args)):
+        recv = _dotted(call.func.value) or "<expr>"
+        return ExpensiveCall(f"{recv}.init", "model_init", call, call, "")
+    return None
+
+
+def _classify_subprocess(call: ast.Call,
+                         consts: Dict[str, object]) -> Optional[str]:
+    """Dotted text of a process-spawning call, or None."""
+    func = call.func
+    dotted = _dotted(func)
+    head = dotted.split(".")[0]
+    name = _call_name(func)
+    if head in ("subprocess", "os") and name in _SUBPROCESS_NAMES:
+        return dotted
+    if name == "Popen":
+        return dotted or name
+    return None
+
+
+def _classify_wait(call: ast.Call,
+                   consts: Dict[str, object]) -> Optional[str]:
+    """TX004 witness text for an unbounded-wait call, or None."""
+    func = call.func
+    if _dotted(func) == "time.sleep" and call.args:
+        try:
+            secs = float(ast.literal_eval(call.args[0]))
+        except (ValueError, SyntaxError, TypeError):
+            a = call.args[0]
+            if isinstance(a, ast.Name) and a.id in consts:
+                try:
+                    secs = float(consts[a.id])  # type: ignore[arg-type]
+                except (TypeError, ValueError):
+                    return None
+            else:
+                return None
+        if secs >= SLEEP_THRESHOLD_S:
+            return f"`time.sleep({secs:g})`"
+        return None
+    if (isinstance(func, ast.Attribute) and func.attr in _WAIT_METHODS
+            and not call.args
+            and not any(k.arg == "timeout" for k in call.keywords)):
+        return f"timeout-less `.{func.attr}()`"
+    return None
+
+
+def _iter_defs(tree: ast.Module):
+    """(def, class_slow) for module-level defs and methods of top-level
+    classes (pytest's collectible surface)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, False
+        elif isinstance(node, ast.ClassDef):
+            cls_slow = any(_is_slow_mark(d) for d in node.decorator_list)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield item, cls_slow
+
+
+def extract_test_module(ctx: ModuleContext) -> TestModule:
+    """The cost model of one test file: fixture defs (scope + params),
+    tests (slow flags), helper call graph, expensive/subprocess/wait
+    sites — with expensive and subprocess sites resolved transitively
+    through the module's local call graph."""
+    m = TestModule(ctx)
+    defs: Dict[str, ast.AST] = {}
+    for fn, cls_slow in _iter_defs(ctx.tree):
+        defs.setdefault(fn.name, fn)
+        scope = None
+        for dec in fn.decorator_list:
+            scope = scope or _fixture_scope(dec)
+        params = tuple(
+            a.arg for a in fn.args.args + fn.args.posonlyargs
+            if a.arg not in ("self", "cls")
+        )
+        if scope is not None:
+            m.fixtures[fn.name] = FixtureDef(
+                name=fn.name, scope=scope, node=fn, path=m.path,
+                params=params, conftest=m.is_conftest, expensive=[],
+            )
+        elif fn.name.startswith("test_"):
+            slow = (m.module_slow or cls_slow
+                    or any(_is_slow_mark(d) for d in fn.decorator_list))
+            m.tests.append(TestDef(
+                name=fn.name, node=fn, path=m.path, params=params,
+                slow=slow, expensive=[],
+            ))
+        else:
+            m.helpers[fn.name] = fn
+
+    # direct sites per def (nested defs walked as part of the def that
+    # owns them — a corpus built inside a closure still runs per test)
+    for fn in defs.values():
+        direct: List[ExpensiveCall] = []
+        direct_sub: List[SubprocessSite] = []
+        calls: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            exp = _classify_expensive(node, m.consts)
+            if exp is not None:
+                direct.append(exp)
+            sub = _classify_subprocess(node, m.consts)
+            if sub is not None:
+                timeout = _literal_timeout(node, m.consts)
+                direct_sub.append(SubprocessSite(
+                    node=node, what=sub,
+                    bounded=(timeout is not None
+                             and timeout <= TX003_TIMEOUT_CEILING_S),
+                    anchor=node, via="",
+                ))
+            wait = _classify_wait(node, m.consts)
+            if wait is not None:
+                m.waits.append((node, wait))
+            callee = _call_name(node.func)
+            if (isinstance(node.func, ast.Name) and callee in defs
+                    and defs[callee] is not fn):
+                calls.append((node, callee))
+        m._direct[fn] = direct
+        m._direct_sub[fn] = direct_sub
+        m._local_calls[fn] = calls
+
+    # transitive closure: re-anchor a helper's sites at the caller's
+    # call site, naming the chain (the CX resolve-through-the-call-graph
+    # move, applied to cost)
+    def closure(fn: ast.AST, seen: Set[ast.AST]):
+        exp = list(m._direct.get(fn, ()))
+        subs = list(m._direct_sub.get(fn, ()))
+        for site, callee_name in m._local_calls.get(fn, ()):
+            callee = defs.get(callee_name)
+            if callee is None or callee in seen:
+                continue
+            sub_exp, sub_subs = closure(callee, seen | {callee})
+            for e in sub_exp:
+                via = f"{callee_name}()" + (f" -> {e.via}" if e.via else "")
+                exp.append(dataclasses.replace(e, anchor=site, via=via))
+            for s in sub_subs:
+                via = f"{callee_name}()" + (f" -> {s.via}" if s.via else "")
+                subs.append(dataclasses.replace(s, anchor=site, via=via))
+        return exp, subs
+
+    for t in m.tests:
+        t.expensive, subs = closure(t.node, {t.node})
+        if subs:
+            m.subprocesses[t.node] = subs
+    for f in m.fixtures.values():
+        f.expensive, _ = closure(f.node, {f.node})
+    return m
+
+
+# ---------------------------------------------------------------------------
+# the TX rules
+
+
+def _mk_finding(rule: str, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+    severity, _ = TESTPLANE_RULES[rule]
+    line = getattr(node, "lineno", 1)
+    return Finding(
+        rule=rule, path=ctx.path, line=line,
+        col=getattr(node, "col_offset", 0) + 1,
+        severity=severity, message=message, hint=_HINTS[rule],
+        code=ctx.source_line(line),
+    )
+
+
+def _via(e) -> str:
+    return f" (via {e.via})" if e.via else ""
+
+
+def _check_tx001(m: TestModule) -> Iterable[Finding]:
+    """The same expensive factory hit from >=2 non-slow test BODIES of
+    one module: per-test rebuilds of what one fixture should own. A
+    single test paying once gains nothing from a fixture, so it stays
+    quiet."""
+    by_factory: Dict[Tuple[str, str], List[Tuple[TestDef, ExpensiveCall]]]
+    by_factory = {}
+    for t in m.tests:
+        if t.slow:
+            continue
+        seen_here: Set[Tuple[str, str]] = set()
+        for e in t.expensive:
+            key = (e.kind, e.factory)
+            if key in seen_here:
+                continue  # one charge per test, not per call
+            seen_here.add(key)
+            by_factory.setdefault(key, []).append((t, e))
+    for (kind, factory), sites in sorted(by_factory.items()):
+        if len(sites) < 2:
+            continue
+        for t, e in sites:
+            yield _mk_finding(
+                "TX001", m.ctx, e.anchor,
+                f"expensive {kind} `{factory}(...)` runs in the body of "
+                f"`{t.name}`{_via(e)} — {len(sites)} tests in this module "
+                "each rebuild it per test instead of sharing a "
+                "module/session fixture",
+            )
+
+
+def _check_tx002(m: TestModule) -> Iterable[Finding]:
+    for name in sorted(m.fixtures):
+        f = m.fixtures[name]
+        if f.scope != "function" or not f.expensive or f.consumers < 2:
+            continue
+        e = f.expensive[0]
+        yield _mk_finding(
+            "TX002", m.ctx, f.node,
+            f"function-scoped fixture `{name}` runs expensive {e.kind} "
+            f"`{e.factory}(...)`{_via(e)} for each of its {f.consumers} "
+            "consumers — widen to scope='module' (or 'session' in "
+            "conftest.py)",
+        )
+
+
+def _check_tx003(m: TestModule) -> Iterable[Finding]:
+    for t in m.tests:
+        if t.slow:
+            continue
+        for s in m.subprocesses.get(t.node, ()):
+            if s.bounded:
+                continue
+            yield _mk_finding(
+                "TX003", m.ctx, s.anchor,
+                f"`{s.what}(...)` spawns a subprocess in tier-1 test "
+                f"`{t.name}`{_via(s)} with no slow marker and no bounded "
+                f"literal `timeout=` (<= {TX003_TIMEOUT_CEILING_S:g}s)",
+            )
+
+
+def _check_tx004(m: TestModule) -> Iterable[Finding]:
+    for node, what in sorted(
+            m.waits, key=lambda w: getattr(w[0], "lineno", 1)):
+        yield _mk_finding(
+            "TX004", m.ctx, node,
+            f"{what} in test code — an unbounded (or fixed-cost) wait "
+            "the tier-1 wall-clock budget pays on every run",
+        )
+
+
+def _check_tx005(modules: Sequence[TestModule]) -> Iterable[Finding]:
+    """Suite-wide: the same traced-program factory traced from >=3
+    non-slow test bodies churns the program cache once per site."""
+    sites: Dict[str, List[Tuple[TestModule, TestDef, ExpensiveCall]]] = {}
+    for m in modules:
+        for t in m.tests:
+            if t.slow:
+                continue
+            seen_here: Set[str] = set()
+            for e in t.expensive:
+                if e.kind != "traced" or e.factory in seen_here:
+                    continue
+                seen_here.add(e.factory)
+                sites.setdefault(e.factory, []).append((m, t, e))
+    for factory in sorted(sites):
+        group = sites[factory]
+        if len(group) < TX005_MIN_SITES:
+            continue
+        files = sorted({m.path for m, _, _ in group})
+        for m, t, e in group:
+            yield _mk_finding(
+                "TX005", m.ctx, e.anchor,
+                f"`{factory}(...)` is traced in the body of `{t.name}`"
+                f"{_via(e)} — {len(group)} test-body trace sites across "
+                f"{len(files)} file(s) churn the program cache instead of "
+                "reusing a warmed-program fixture",
+            )
+
+
+def _check_tx006(modules: Sequence[TestModule]) -> Iterable[Finding]:
+    """Suite-wide: corpus-synthesis sites grouped by resolved signature;
+    >=2 sites rebuilding an equivalent corpus flag each other. Session-
+    scoped conftest fixtures are the canonical providers — exempt."""
+    groups: Dict[str, List[Tuple[TestModule, str, ExpensiveCall]]] = {}
+    for m in modules:
+        charged: List[Tuple[str, ExpensiveCall]] = []
+        for t in m.tests:
+            if not t.slow:
+                charged.extend(
+                    (f"test `{t.name}`", e) for e in t.expensive
+                )
+        for f in m.fixtures.values():
+            if f.conftest and f.scope == "session":
+                continue
+            charged.extend(
+                (f"{f.scope}-scoped fixture `{f.name}`", e)
+                for e in f.expensive
+            )
+        seen_nodes: Set[ast.AST] = set()
+        for owner, e in charged:
+            if e.kind != "corpus" or not e.sig or e.node in seen_nodes:
+                continue
+            seen_nodes.add(e.node)  # one site, however many owners reach it
+            groups.setdefault(e.sig, []).append((m, owner, e))
+    for sig in sorted(groups):
+        group = groups[sig]
+        if len(group) < 2:
+            continue
+        files = sorted({m.path for m, _, _ in group})
+        for m, owner, e in group:
+            others = [p for p in files if p != m.path] or ["this file"]
+            yield _mk_finding(
+                "TX006", m.ctx, e.node,
+                f"{owner} rebuilds corpus `{sig}` — {len(group)} "
+                f"equivalent synthesis sites (also in: "
+                f"{', '.join(others[:3])}) that one shared fixture "
+                "should provide",
+            )
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+@dataclasses.dataclass
+class TestplaneAudit:
+    """One whole-suite audit: findings + the model summary the bench
+    stage records (test/fixture/slow counts, per-rule totals)."""
+
+    findings: List[Finding]
+    model: Dict
+
+
+def iter_test_files(paths: Sequence[str]) -> List[str]:
+    """Test files and conftests under ``paths``. Directories named
+    ``fixtures`` are skipped — seeded hazard registries live there and
+    are audited explicitly, never swept."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "fixtures")
+                )
+                for n in sorted(names):
+                    if n == "conftest.py" or (
+                            n.startswith("test_") and n.endswith(".py")):
+                        files.append(os.path.join(root, n))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def audit_testplane(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    relative_to: Optional[str] = None,
+) -> TestplaneAudit:
+    """Extract the cost model of every test file under ``paths`` and
+    check the TX rules (all, or the ``rules`` subset). ``# esr:
+    noqa(TX00x)`` suppression and path normalization follow the AST
+    lint's conventions; on full-rule-set runs, pure-TX noqa lines that
+    suppressed nothing are reported as ESR011 (this gate polices its own
+    suppressions — the AST gate exempts foreign catalogs)."""
+    run_rules = set(TESTPLANE_RULES if rules is None else rules)
+    unknown = run_rules - set(TESTPLANE_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown testplane rule(s): {sorted(unknown)}; known: "
+            f"{sorted(TESTPLANE_RULES)}"
+        )
+    base = os.path.abspath(relative_to or os.getcwd())
+    findings: List[Finding] = []
+    modules: List[TestModule] = []
+    for f in iter_test_files(paths):
+        rel = os.path.relpath(os.path.abspath(f), base).replace(os.sep, "/")
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="ESR000", path=rel, line=1, col=1, severity="error",
+                message=f"unreadable file: {e}",
+            ))
+            continue
+        try:
+            ctx = ModuleContext(f, source, rel_path=rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="ESR000", path=rel, line=e.lineno or 1,
+                col=(e.offset or 0) + 1, severity="error",
+                message=f"syntax error: {e.msg}",
+            ))
+            continue
+        modules.append(extract_test_module(ctx))
+
+    # fixture consumers: local names shadow conftest names; conftest
+    # fixtures count consumers suite-wide (tests AND dependent fixtures)
+    conftest_fixtures: Dict[str, FixtureDef] = {}
+    for m in modules:
+        if m.is_conftest:
+            conftest_fixtures.update(m.fixtures)
+    for m in modules:
+        consumers: List[Tuple[str, ...]] = [t.params for t in m.tests]
+        consumers.extend(f.params for f in m.fixtures.values())
+        for params in consumers:
+            for p in params:
+                if p in m.fixtures and not m.is_conftest:
+                    m.fixtures[p].consumers += 1
+                elif p in conftest_fixtures:
+                    conftest_fixtures[p].consumers += 1
+
+    raw: List[Finding] = []
+    for m in modules:
+        if "TX001" in run_rules:
+            raw.extend(_check_tx001(m))
+        if "TX002" in run_rules:
+            raw.extend(_check_tx002(m))
+        if "TX003" in run_rules:
+            raw.extend(_check_tx003(m))
+        if "TX004" in run_rules:
+            raw.extend(_check_tx004(m))
+    if "TX005" in run_rules:
+        raw.extend(_check_tx005(modules))
+    if "TX006" in run_rules:
+        raw.extend(_check_tx006(modules))
+
+    # suppression + per-gate staleness (full-rule-set runs only)
+    by_path = {m.path: m.ctx for m in modules}
+    used_noqa: Dict[str, Set[int]] = {}
+    for f in raw:
+        ctx = by_path[f.path]
+        if ctx.suppressed(f):
+            used_noqa.setdefault(f.path, set()).add(f.line)
+        else:
+            findings.append(f)
+    if rules is None:
+        for m in modules:
+            for line, names in sorted(m.ctx._noqa.items()):
+                if not pure_tx_noqa(names):
+                    continue
+                if line in used_noqa.get(m.path, set()):
+                    continue
+                findings.append(Finding(
+                    rule="ESR011", path=m.path, line=line, col=1,
+                    severity="warning",
+                    message=(
+                        "stale suppression: `# esr: "
+                        f"noqa({', '.join(sorted(names))})` suppresses no "
+                        "testplane finding on this line — delete it (or "
+                        "fix the rule name)"
+                    ),
+                    hint=(
+                        "a suppression that no longer suppresses anything "
+                        "rots the ratchet (docs/ANALYSIS.md)"
+                    ),
+                    code=m.ctx.source_line(line),
+                ))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # the bench-facing model summary
+    tests = [t for m in modules for t in m.tests]
+    fixtures = [f for m in modules for f in m.fixtures.values()]
+    by_rule = {r: 0 for r in sorted(TESTPLANE_RULES)}
+    for f in findings:
+        if f.rule in by_rule:
+            by_rule[f.rule] += 1
+    model = {
+        "files": len(modules),
+        "test_files": sum(1 for m in modules if not m.is_conftest),
+        "test_functions": len(tests),
+        "slow_test_functions": sum(1 for t in tests if t.slow),
+        "fixtures": len(fixtures),
+        "session_fixtures": sum(
+            1 for f in fixtures if f.scope == "session"
+        ),
+        "expensive_fixtures": sum(1 for f in fixtures if f.expensive),
+        "subprocess_tests": sum(len(m.subprocesses) for m in modules),
+        "findings_by_rule": by_rule,
+        "rules_version": rules_signature(),
+    }
+    return TestplaneAudit(findings=findings, model=model)

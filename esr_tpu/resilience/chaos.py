@@ -93,7 +93,7 @@ def dataset_config() -> Dict:
     }
 
 
-def train_config(out_root: str, datalist: str) -> Dict:
+def train_config(out_root: str, datalist: str, basech: int = 4) -> Dict:
     loader = {
         "path_to_datalist_txt": datalist,
         "batch_size": BATCH_SIZE,
@@ -106,7 +106,7 @@ def train_config(out_root: str, datalist: str) -> Dict:
         "experiment": "chaos",
         "model": {
             "name": "DeepRecurrNet",
-            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+            "args": {"inch": 2, "basech": basech, "num_frame": 3},
         },
         "optimizer": {
             "name": "Adam",
@@ -272,16 +272,23 @@ def _run_serve(ckpt_path: str, recordings: List[str], seed: int,
     return {"summary": summary, "reports": reports}
 
 
-def run_scenario(out_dir: str, seed: int = 0) -> Dict:
+def run_scenario(out_dir: str, seed: int = 0, fast: bool = False) -> Dict:
     """The whole scripted scenario; returns the machine-checkable summary
-    (every acceptance property precomputed as a boolean)."""
+    (every acceptance property precomputed as a boolean).
+
+    ``fast=True`` is the tier-1 profile (docs/TESTING.md): the SAME
+    corpus, iteration count, fault plans, and checks, on a half-width
+    model (``basech=2``) — fault placement is iteration-indexed and the
+    parity checks are twin-relative, so nothing observable changes except
+    wall-clock. The full profile (``basech=4``, the production smoke
+    shape) stays gated in ``scripts/chaos_smoke.sh`` via the CLI."""
     from esr_tpu.obs import TelemetrySink, set_active_sink
     from esr_tpu.obs.report import report_file
     from esr_tpu.resilience.recovery import restore_with_fallback
 
     os.makedirs(out_dir, exist_ok=True)
     datalist = build_corpus(os.path.join(out_dir, "corpus"))
-    config = train_config(out_dir, datalist)
+    config = train_config(out_dir, datalist, basech=2 if fast else 4)
 
     twin = _run_train(config, "twin", seed, None)
     train_plan = build_train_plan(seed)

@@ -13,6 +13,8 @@ cd "$(dirname "$0")/.."
 OUT="${1:-artifacts/chaos_smoke}"
 SEED="${2:-0}"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# the CLI always runs the FULL profile (basech=4); tier-1's
+# tests/test_chaos_smoke.py runs the fast profile (docs/TESTING.md)
 
 rm -rf "$OUT"
 python -m esr_tpu.resilience.chaos --out "$OUT" --seed "$SEED"

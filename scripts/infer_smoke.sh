@@ -11,4 +11,4 @@
 # Usage: scripts/infer_smoke.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_infer_smoke.py -q "$@"
+exec env JAX_PLATFORMS=cpu ESR_SMOKE_FULL=1 python -m pytest tests/test_infer_smoke.py -q "$@"

@@ -1,16 +1,41 @@
 #!/usr/bin/env bash
-# JAX-hazard static analysis over the package (AST lint + jaxpr program
-# audit + host-concurrency audit), against the committed baselines — the
-# same three gates tests/test_analysis_selfcheck.py,
-# tests/test_analysis_cli_gate.py, and tests/test_concurrency_audit.py
-# enforce in tier-1, combined into ONE exit code. Rule catalogs + baseline
-# workflow: docs/ANALYSIS.md.
+# JAX-hazard static analysis: all FOUR gates — AST lint, jaxpr program
+# audit, host-concurrency audit, test-plane audit — against the committed
+# baselines, combined into ONE exit code. The same gates tier-1 enforces
+# via tests/test_analysis_selfcheck.py, tests/test_analysis_cli_gate.py,
+# tests/test_concurrency_audit.py, and tests/test_testplane_cli_gate.py.
+# Rule catalogs + baseline workflow: docs/ANALYSIS.md; tiering policy the
+# testplane gate enforces: docs/TESTING.md.
+#
+# Gates run separately with per-gate wall time printed, so lint itself
+# stays budgetable: the three pure-AST gates are sub-second each, the
+# jaxpr gate pays one device-free jax import/trace (~10-20s). The exit
+# code is the max over the gates (0 clean, 1 new findings, 2 usage).
 #
 # Usage: scripts/lint.sh [paths...]   (default: esr_tpu/)
-set -euo pipefail
+set -uo pipefail
 cd "$(dirname "$0")/.."
 if [ "$#" -eq 0 ]; then
   set -- esr_tpu/
 fi
-exec python -m esr_tpu.analysis \
-  --baseline analysis_baseline.json --relative-to . --jaxpr --threads "$@"
+
+overall=0
+run_gate() {
+  local label="$1"; shift
+  local t0 t1 rc
+  t0=$(date +%s.%N)
+  python -m esr_tpu.analysis "$@"
+  rc=$?
+  t1=$(date +%s.%N)
+  printf '[lint] %-12s rc=%d  %6.1fs\n' "$label" "$rc" \
+    "$(echo "$t1 $t0" | awk '{print $1 - $2}')" >&2
+  if [ "$rc" -gt "$overall" ]; then overall=$rc; fi
+}
+
+run_gate ast       --baseline analysis_baseline.json --relative-to . "$@"
+run_gate threads   --threads --relative-to .
+run_gate testplane --testplane --relative-to .
+run_gate jaxpr     --jaxpr --relative-to .
+
+echo "[lint] combined exit: $overall" >&2
+exit "$overall"

@@ -17,4 +17,4 @@
 # Usage: scripts/numerics_smoke.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_numerics_smoke.py tests/test_obs_numerics.py -q "$@"
+exec env JAX_PLATFORMS=cpu ESR_SMOKE_FULL=1 python -m pytest tests/test_numerics_smoke.py tests/test_obs_numerics.py -q "$@"

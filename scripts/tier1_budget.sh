@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Time a real tier-1 run and judge it against the pinned wall-clock
+# ceiling (docs/TESTING.md): runs the ROADMAP verify selection, exports
+# the measured wall as ESR_TIER1_WALL_S, and replays the bench
+# `tier1_budget` stage so within_budget is judged on DATA — the same
+# record a full bench round tracks as a series. Exit: pytest's status,
+# or 3 when the suite passed but blew the ceiling.
+#
+# Usage: scripts/tier1_budget.sh [extra pytest args...]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+t0=$(date +%s)
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider "$@"
+rc=$?
+t1=$(date +%s)
+wall=$((t1 - t0))
+echo "[tier1_budget] suite rc=$rc wall=${wall}s" >&2
+
+ESR_TIER1_WALL_S="$wall" JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import bench
+
+rec = bench.stage_tier1_budget()
+print(json.dumps(rec, indent=2))
+raise SystemExit(0 if rec["within_budget"] and rec["auditor_clean"] else 3)
+EOF
+budget_rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+exit "$budget_rc"

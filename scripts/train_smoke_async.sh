@@ -12,4 +12,4 @@
 # Usage: scripts/train_smoke_async.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_train_smoke_async.py -q "$@"
+exec env JAX_PLATFORMS=cpu ESR_SMOKE_FULL=1 python -m pytest tests/test_train_smoke_async.py -q "$@"

@@ -18,8 +18,108 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- session-scoped shared planes (docs/TESTING.md; TX002/TX005/TX006) -------
+#
+# The canonical synthetic corpora and the warmed flagship programs live
+# here so they are synthesized/traced ONCE per tier-1 process instead of
+# once per consuming module. Everything below is READ-ONLY by contract: a
+# test that must mutate a recording copies it into its own tmp dir first.
+
+
+@pytest.fixture(scope="session")
+def shared_corpus_dir(tmp_path_factory):
+    """The canonical (64, 64) training corpus: ``rec{0..3}.h5`` written
+    with the suite-wide signature (``base_events=2048, num_frames=6,
+    seed=i``) plus ``datalist{1,2,3,4}.txt`` covering the first N
+    recordings — the exact files five modules used to rebuild per module
+    (TX006). Returns the directory as a ``pathlib.Path``."""
+    from esr_tpu.data.synthetic import write_synthetic_h5
+
+    root = tmp_path_factory.mktemp("shared_corpus")
+    paths = []
+    for i in range(4):
+        p = root / f"rec{i}.h5"
+        write_synthetic_h5(str(p), (64, 64), base_events=2048,
+                           num_frames=6, seed=i)
+        paths.append(str(p))
+    for n in (1, 2, 3, 4):
+        (root / f"datalist{n}.txt").write_text("\n".join(paths[:n]) + "\n")
+    return root
+
+
+@pytest.fixture(scope="session")
+def shared_stream_corpus(tmp_path_factory):
+    """The canonical serving-stream corpus (8 alternating short/long
+    streams, ``events_schedule=(1200, 4200)``, seed 0) shared by the
+    serving-tier smokes. Returns the list of stream paths."""
+    from esr_tpu.serving import make_stream_corpus
+
+    root = tmp_path_factory.mktemp("shared_streams")
+    return make_stream_corpus(
+        str(root / "streams"), n=8, seed=0, events_schedule=(1200, 4200)
+    )
+
+
+@pytest.fixture(scope="session")
+def warmed_programs(shared_stream_corpus):
+    """The flagship serving model (``DeepRecurrNet(inch=2, basech=2,
+    num_frame=3)``) with initialized params, plus its chunk programs
+    traced once by a one-stream warm-up session. The chunk-program cache
+    is process-global, so after this fixture EVERY consumer of the
+    flagship config sees warm programs regardless of module order — the
+    determinism that lets tests share the flagship shapes instead of
+    coding around cold-start timing (the PR 15 ``basech=4`` workaround).
+    """
+    import numpy as np
+
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.serving import RequestClass, ServingEngine
+
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    x = np.zeros((1, 3, 16, 16, 2), np.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), x, model.init_states(1, 16, 16)
+    )
+    # must stay in lockstep with tests/test_serve_smoke.py (same chunk
+    # cache keys: model config, lanes, chunk windows, dataset geometry)
+    dataset_cfg = {
+        "scale": 2,
+        "ori_scale": "down8",
+        "time_bins": 1,
+        "mode": "events",
+        "window": 1024,
+        "sliding_window": 512,
+        "need_gt_events": True,
+        "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {
+            "sequence_length": 4,
+            "seqn": 3,
+            "step_size": None,
+            "pause": {"enabled": False},
+        },
+    }
+    classes = {
+        "interactive": RequestClass("interactive", chunk_windows=2),
+        "standard": RequestClass("standard", chunk_windows=4),
+    }
+    engine = ServingEngine(
+        model, params, dataset_cfg, lanes=2, classes=classes,
+        default_class="standard",
+    )
+    # one stream per class: both chunk depths (2 and 4) get traced
+    engine.submit(shared_stream_corpus[0], "interactive",
+                  request_id="warmup-interactive")
+    engine.submit(shared_stream_corpus[1], "standard",
+                  request_id="warmup-standard")
+    engine.run(max_wall_s=120.0)
+    return {"model": model, "params": params}
 
 
 def ensure_module(name: str, defaults: dict | None = None):

@@ -1,0 +1,19 @@
+"""TX001 seed: the SAME expensive engine construction repeated in two
+tier-1 test bodies — per-test rebuilds of what one module fixture should
+own. Deliberately clean under the other TX rules: `Trainer` is an engine
+ctor (not a corpus factory, so no TX006; not a traced-program factory, so
+no TX005), there is no fixture (TX002), no subprocess (TX003), and no
+wait (TX004). Analyzed by the testplane gate, never collected by pytest
+(tests/fixtures/testplane_hazards/README.md)."""
+
+from esr_tpu.training.trainer import Trainer  # noqa: F401  (never imported)
+
+
+def test_first_rebuilds_trainer(tmp_path):
+    trainer = Trainer(model=None, config={}, out_dir=str(tmp_path))
+    assert trainer is not None
+
+
+def test_second_rebuilds_trainer(tmp_path):
+    trainer = Trainer(model=None, config={}, out_dir=str(tmp_path))
+    assert trainer is not None

@@ -1,0 +1,23 @@
+"""TX002 seed: a function-scoped fixture whose body constructs an
+expensive engine, consumed by two tests — the engine is rebuilt once PER
+CONSUMER where `scope="module"` would build it once. Clean under the
+other rules: the expensive call sits in the FIXTURE body (TX001 charges
+test bodies), one site (TX005/TX006 need groups), no subprocess (TX003),
+no wait (TX004). Analyzed, never collected (README.md)."""
+
+import pytest
+
+from esr_tpu.inference.engine import StreamingEngine  # noqa: F401
+
+
+@pytest.fixture
+def engine():
+    return StreamingEngine(model=None, params={}, dataset_config={})
+
+
+def test_engine_exists(engine):
+    assert engine is not None
+
+
+def test_engine_again(engine):
+    assert engine is not None

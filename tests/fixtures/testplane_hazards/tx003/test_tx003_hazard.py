@@ -1,0 +1,16 @@
+"""TX003 seed: a subprocess spawned from a tier-1 test with NO slow
+marker and NO bounded literal ``timeout=`` — the spawn pays interpreter
+startup per run and can hang the suite unbounded. Clean under the other
+rules: one test (TX001 needs two), no fixture (TX002), no expensive
+factory (TX005/TX006), and the spawn is not a wait call (TX004).
+Analyzed, never collected (README.md)."""
+
+import subprocess
+import sys
+
+
+def test_cli_entrypoint_spawns_unbounded():
+    proc = subprocess.run(
+        [sys.executable, "-c", "print('ok')"], capture_output=True,
+    )
+    assert proc.returncode == 0

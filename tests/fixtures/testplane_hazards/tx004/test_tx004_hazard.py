@@ -1,0 +1,17 @@
+"""TX004 seed: unbounded waits — a fixed ``time.sleep`` over the
+threshold and a timeout-less ``join()`` (the test-side twin of ESR009:
+the sleep burns budget every run and still races; the join can hang the
+whole suite past the tier-1 ceiling). Clean under the other rules: no
+expensive factory, no fixture, no subprocess; a single test. Analyzed,
+never collected (README.md)."""
+
+import threading
+import time
+
+
+def test_waits_for_worker_without_deadline():
+    worker = threading.Thread(target=lambda: None)
+    worker.start()
+    time.sleep(2.0)
+    worker.join()
+    assert not worker.is_alive()

@@ -1,0 +1,14 @@
+"""TX005 seed (1/3): one of three suite-wide test-body ``checked_jit``
+trace sites — together they churn the program cache three times per run
+instead of sharing a warmed-program fixture (the test_serve_smoke
+interference PR 15 designed around). One site per FILE so TX001 (which
+fires at two sites within one module) stays clean; no corpus (TX006),
+no fixture (TX002), no subprocess/wait (TX003/TX004). Analyzed, never
+collected (README.md)."""
+
+from esr_tpu.analysis import checked_jit  # noqa: F401
+
+
+def test_traces_fresh_program_a():
+    program = checked_jit(lambda x: x + 1)
+    assert program is not None

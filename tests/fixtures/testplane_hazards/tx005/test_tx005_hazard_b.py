@@ -1,0 +1,8 @@
+"""TX005 seed (2/3) — see test_tx005_hazard_a.py."""
+
+from esr_tpu.analysis import checked_jit  # noqa: F401
+
+
+def test_traces_fresh_program_b():
+    program = checked_jit(lambda x: x * 2)
+    assert program is not None

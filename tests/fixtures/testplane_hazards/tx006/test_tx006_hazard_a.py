@@ -1,0 +1,16 @@
+"""TX006 seed (1/2): synthesizes a corpus whose RESOLVED signature —
+``write_synthetic_h5((64, 64), base_events=2048, num_frames=6, seed=0)``,
+tmp path excluded — is identical to the one test_tx006_hazard_b.py
+builds: two rebuilds of what one shared fixture should provide. One site
+per FILE so TX001 stays clean; single sites per module keep TX002/TX005
+clean; no subprocess/wait. Analyzed, never collected (README.md)."""
+
+from esr_tpu.data.synthetic import write_synthetic_h5  # noqa: F401
+
+
+def test_builds_its_own_corpus_a(tmp_path):
+    path = write_synthetic_h5(
+        str(tmp_path / "rec.h5"), (64, 64),
+        base_events=2048, num_frames=6, seed=0,
+    )
+    assert path

@@ -1,0 +1,11 @@
+"""TX006 seed (2/2) — see test_tx006_hazard_a.py."""
+
+from esr_tpu.data.synthetic import write_synthetic_h5  # noqa: F401
+
+
+def test_builds_its_own_corpus_b(tmp_path):
+    path = write_synthetic_h5(
+        str(tmp_path / "rec.h5"), (64, 64),
+        base_events=2048, num_frames=6, seed=0,
+    )
+    assert path

@@ -36,7 +36,8 @@ def test_stage_registry_names_order_and_timeouts():
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
         "dcn_sparse_ab", "mfu_ceiling", "program_audit",
-        "concurrency_audit", "obs_live", "numerics_overhead",
+        "concurrency_audit", "tier1_budget", "obs_live",
+        "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
         "infer_throughput", "ckpt_overlap", "serve_loadgen",
         "fleet_loadgen", "chaos_recovery",
@@ -408,6 +409,47 @@ def test_concurrency_audit_stage_registered_schema_pinned_and_clean():
     assert all(v == 0 for v in rec["findings_by_rule"].values())
     assert rec["clean"] is True
     assert rec["rules_version"].startswith("cx:")
+
+
+def test_tier1_budget_stage_registered_schema_pinned_and_clean(monkeypatch):
+    """The tier-1 budget series (ISSUE 16): the test-plane audit runs
+    device-free (pure AST, pytest-free) in smoke with a pinned schema —
+    suite size, slow-marker count, per-TX-rule finding counts, and the
+    wall-clock ceiling are tracked across rounds, the audit must stay
+    CLEAN against the committed baseline, and the ceiling itself is
+    pinned (loosening it is a reviewed diff, not a drift)."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "tier1_budget"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert timeout >= 120
+    assert in_smoke is True
+    assert bench.TIER1_WALL_CEILING_S == 600.0
+    assert bench.TIER1_BUDGET_KEYS == (
+        "wall_s", "ceiling_s", "within_budget", "test_files",
+        "test_functions", "slow_test_functions", "session_fixtures",
+        "auditor_clean", "findings_by_rule", "rules_version",
+    )
+    # no measured wall: observational null, within_budget judges true
+    monkeypatch.delenv("ESR_TIER1_WALL_S", raising=False)
+    rec = bench.stage_tier1_budget()
+    assert tuple(rec.keys()) == bench.TIER1_BUDGET_KEYS
+    assert rec["wall_s"] is None
+    assert rec["ceiling_s"] == 600.0
+    assert rec["within_budget"] is True
+    assert rec["test_files"] >= 70
+    assert rec["test_functions"] >= 500
+    assert rec["slow_test_functions"] >= 100
+    assert rec["session_fixtures"] >= 1  # the shared-corpus conftest plane
+    assert rec["auditor_clean"] is True
+    assert sorted(rec["findings_by_rule"]) == [
+        "TX001", "TX002", "TX003", "TX004", "TX005", "TX006",
+    ]
+    assert rec["rules_version"].startswith("tx:")
+    # a measured wall over the ceiling flips the budget flag
+    monkeypatch.setenv("ESR_TIER1_WALL_S", "845.0")
+    rec = bench.stage_tier1_budget()
+    assert rec["wall_s"] == 845.0
+    assert rec["within_budget"] is False
 
 
 def test_numerics_overhead_stage_registered_and_schema_pinned():

@@ -20,6 +20,8 @@ behind (ROADMAP): a recovery path that stops emitting its paired event,
 or stops recovering, fails tier-1 off-TPU.
 """
 
+import os
+
 import pytest
 
 from esr_tpu.resilience.chaos import ITERATIONS, run_scenario
@@ -27,8 +29,12 @@ from esr_tpu.resilience.chaos import ITERATIONS, run_scenario
 
 @pytest.fixture(scope="module")
 def scenario(tmp_path_factory):
+    # tier-1 runs the fast profile (half-width model, identical fault
+    # plan and checks); scripts/chaos_smoke.sh keeps the full shape
     out = tmp_path_factory.mktemp("chaos")
-    return run_scenario(str(out), seed=0)
+    return run_scenario(
+        str(out), seed=0, fast=not os.environ.get("ESR_SMOKE_FULL")
+    )
 
 
 def test_faulted_run_completes_and_rejoins_twin(scenario):

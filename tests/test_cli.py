@@ -11,11 +11,8 @@ import os
 import subprocess
 import sys
 
-import numpy as np
 import pytest
 import yaml
-
-from esr_tpu.data.synthetic import write_synthetic_h5
 
 pytestmark = pytest.mark.slow
 
@@ -32,17 +29,10 @@ def _env():
 
 
 @pytest.fixture(scope="module")
-def corpus(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("cli_corpus")
-    paths = []
-    for i in range(2):
-        p = str(tmp / f"rec{i}.h5")
-        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6, seed=i)
-        paths.append(p)
-    datalist = str(tmp / "datalist.txt")
-    with open(datalist, "w") as f:
-        f.write("\n".join(paths) + "\n")
-    return str(tmp), datalist
+def corpus(shared_corpus_dir):
+    # the session corpus plane (conftest.py): the subprocess CLIs read
+    # the recordings by absolute path; outputs go to each test's tmp_path
+    return str(shared_corpus_dir), str(shared_corpus_dir / "datalist2.txt")
 
 
 def test_train_then_infer_cli(corpus, tmp_path):
@@ -61,7 +51,7 @@ def test_train_then_infer_cli(corpus, tmp_path):
         "valid_dataloader;dataset;sequence;sequence_length=4",
         "train_dataloader;batch_size=8",
         "valid_dataloader;batch_size=8",
-        "model;args;basech=4",
+        "model;args;basech=2",  # fast tier-1 shape; plumbing-identical
         f"trainer;output_path={out}",
         "trainer;iteration_based_train;iterations=8",
         "trainer;iteration_based_train;valid_step=4",

@@ -99,7 +99,13 @@ def _multi(traj, k, **kwargs):
     return traj["multi_cache"][key]
 
 
-@pytest.mark.parametrize("k", [1, 2, 4])
+@pytest.mark.parametrize(
+    "k",
+    # k=4 compiles a third fused program for ~15s of tier-1 wall; k∈{1,2}
+    # plus the k=4 validation below keep the contract covered, the full
+    # sweep runs in the slow tier (ISSUE 16 re-tier)
+    [1, 2, pytest.param(4, marks=pytest.mark.slow)],
+)
 def test_multi_step_matches_sequential(k, trajectory):
     n = 4  # covered by full groups for every k under test
     batches = trajectory["batches"][:n]
@@ -143,11 +149,16 @@ def test_multi_step_matches_sequential(k, trajectory):
     )
 
 
+@pytest.mark.slow
 def test_multi_step_carries_batch_stats():
     """BN models: running ``batch_stats`` must ride the scan carry across
     the k chained steps exactly as across k sequential steps (the
     cross-step recurrent state; the ConvGRU states reset per sequence
-    inside each step and are covered by the equivalence test above)."""
+    inside each step and are covered by the equivalence test above).
+
+    slow (ISSUE 16 re-tier): the BN variant compiles a fresh model +
+    fused program pair (~100s); BN-layer coverage stays in tier-1 via
+    tests/test_batchnorm.py."""
     step_fn, state0, batches = _setup(n_batches=2, norm="BN", seed=3)
     assert "batch_stats" in state0.params  # the model actually has BN
 
@@ -190,9 +201,13 @@ def test_remainder_tail_matches_sequential(trajectory):
     _assert_states_close(s_mix.opt_state, s_seq.opt_state)
 
 
+@pytest.mark.slow
 def test_reuse_batch_mode_matches_repeated_steps(trajectory):
     """Bench chaining mode: the same batch (no k axis) feeds every chained
-    step; equals calling the step k times on that batch."""
+    step; equals calling the step k times on that batch.
+
+    slow (ISSUE 16 re-tier): ``reuse_batch`` compiles its own k=3 fused
+    program (~19s) and only the bench chaining path consumes the mode."""
     batch = trajectory["batches"][0]
     step = trajectory["step"]
     s_seq = trajectory["state0"]

@@ -26,12 +26,15 @@ import urllib.request
 
 import pytest
 
-from esr_tpu.resilience.chaos import build_corpus, dataset_config
+from esr_tpu.resilience.chaos import dataset_config
 from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
 
 ITERATIONS = 4
 K_STEPS = 2
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# fast profile in tier-1 (docs/TESTING.md); scripts/numerics_smoke.sh
+# exports ESR_SMOKE_FULL=1 for the production smoke shape
+BASECH = 4 if os.environ.get("ESR_SMOKE_FULL") else 2
 
 
 def _smoke_config(out_root: str, datalist: str) -> dict:
@@ -47,7 +50,7 @@ def _smoke_config(out_root: str, datalist: str) -> dict:
         "experiment": "numerics_smoke",
         "model": {
             "name": "DeepRecurrNet",
-            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+            "args": {"inch": 2, "basech": BASECH, "num_frame": 3},
         },
         "optimizer": {
             "name": "Adam",
@@ -80,7 +83,7 @@ def _smoke_config(out_root: str, datalist: str) -> dict:
 
 
 @pytest.fixture(scope="module")
-def smoke_run(tmp_path_factory):
+def smoke_run(tmp_path_factory, shared_corpus_dir):
     import copy
 
     from esr_tpu.config.parser import RunConfig
@@ -88,7 +91,7 @@ def smoke_run(tmp_path_factory):
     from esr_tpu.training.trainer import Trainer
 
     out = str(tmp_path_factory.mktemp("numerics_smoke"))
-    datalist = build_corpus(os.path.join(out, "corpus"))
+    datalist = str(shared_corpus_dir / "datalist4.txt")
     config = _smoke_config(out, datalist)
     run = RunConfig(copy.deepcopy(config), runid="numerics", seed=0)
     trainer = Trainer(run)

@@ -212,18 +212,7 @@ def test_live_plane_is_default_off(tmp_path):
     engine.close_live()  # no-op, never raises
 
 
-def _tiny_train_config(tmp_path, live):
-    from esr_tpu.data.synthetic import write_synthetic_h5
-
-    paths = []
-    for i in range(2):
-        p = str(tmp_path / f"rec{i}.h5")
-        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6,
-                           seed=i)
-        paths.append(p)
-    datalist = str(tmp_path / "datalist.txt")
-    with open(datalist, "w") as f:
-        f.write("\n".join(paths) + "\n")
+def _tiny_train_config(tmp_path, live, datalist):
     dataset = {
         "scale": 2, "ori_scale": "down4", "time_bins": 1,
         "mode": "events", "window": 128, "sliding_window": 64,
@@ -258,7 +247,7 @@ def _tiny_train_config(tmp_path, live):
     }
 
 
-def test_trainer_live_telemetry_opt_in(tmp_path):
+def test_trainer_live_telemetry_opt_in(tmp_path, shared_corpus_dir):
     """trainer.live_telemetry: 0 serves the plane on an ephemeral port
     for the duration of train(), stamps the bound port as a
     live_telemetry event, runs the device watermark poller (CPU:
@@ -267,7 +256,9 @@ def test_trainer_live_telemetry_opt_in(tmp_path):
     from esr_tpu.config.parser import RunConfig
     from esr_tpu.training.trainer import Trainer
 
-    config = _tiny_train_config(tmp_path, live=0)
+    config = _tiny_train_config(
+        tmp_path, live=0, datalist=str(shared_corpus_dir / "datalist2.txt")
+    )
     trainer = Trainer(RunConfig(config, runid="live0", seed=0))
     assert trainer.live_cfg is not None
     trainer.train()
@@ -287,11 +278,14 @@ def test_trainer_live_telemetry_opt_in(tmp_path):
     assert events["train_end"]["completed"] is True
 
 
-def test_trainer_live_telemetry_default_off(tmp_path):
+def test_trainer_live_telemetry_default_off(tmp_path, shared_corpus_dir):
     from esr_tpu.config.parser import RunConfig
     from esr_tpu.training.trainer import Trainer
 
-    config = _tiny_train_config(tmp_path, live=False)
+    config = _tiny_train_config(
+        tmp_path, live=False,
+        datalist=str(shared_corpus_dir / "datalist2.txt"),
+    )
     trainer = Trainer(RunConfig(config, runid="live_off", seed=0))
     assert trainer.live_cfg is None
     assert trainer.live_plane is None

@@ -28,12 +28,14 @@ import numpy as np
 import pytest
 
 from esr_tpu.config.parser import RunConfig
-from esr_tpu.data.synthetic import write_synthetic_h5
 from esr_tpu.obs import SCHEMA_VERSION
 from esr_tpu.training.trainer import Trainer
 
 K_STEPS = 4
 SUPER_STEPS = 2
+# fast profile in tier-1 (docs/TESTING.md); scripts/obs_smoke.sh exports
+# ESR_SMOKE_FULL=1 for the production smoke shape
+BASECH = 4 if os.environ.get("ESR_SMOKE_FULL") else 2
 
 
 def _smoke_config(tmp_path, datalist):
@@ -58,7 +60,7 @@ def _smoke_config(tmp_path, datalist):
         "experiment": "obs_smoke",
         "model": {
             "name": "DeepRecurrNet",
-            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+            "args": {"inch": 2, "basech": BASECH, "num_frame": 3},
         },
         "optimizer": {
             "name": "Adam",
@@ -97,17 +99,9 @@ def _smoke_config(tmp_path, datalist):
 
 
 @pytest.fixture(scope="module")
-def telemetry_records(tmp_path_factory):
+def telemetry_records(tmp_path_factory, shared_corpus_dir):
     tmp = tmp_path_factory.mktemp("obs_smoke")
-    paths = []
-    for i in range(2):
-        p = str(tmp / f"rec{i}.h5")
-        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6,
-                           seed=i)
-        paths.append(p)
-    datalist = str(tmp / "datalist.txt")
-    with open(datalist, "w") as f:
-        f.write("\n".join(paths) + "\n")
+    datalist = str(shared_corpus_dir / "datalist2.txt")
 
     run = RunConfig(_smoke_config(tmp, datalist), runid="obs", seed=0)
     trainer = Trainer(run)

@@ -16,18 +16,15 @@ The acceptance contract (ISSUE 6 / docs/SERVING.md):
 """
 
 import json
-import os
 
 import numpy as np
 import pytest
 
 from esr_tpu.inference.engine import METRIC_KEYS
-from esr_tpu.models.esr import DeepRecurrNet
 from esr_tpu.obs import TelemetrySink, set_active_sink
 from esr_tpu.serving import (
     RequestClass,
     ServingEngine,
-    make_stream_corpus,
     poisson_schedule,
 )
 
@@ -58,23 +55,24 @@ DATASET_CFG = {
 
 
 @pytest.fixture(scope="module")
-def smoke_run(tmp_path_factory):
+def smoke_run(tmp_path_factory, shared_stream_corpus, warmed_programs):
     """One loadgen-driven serving session; returns (server, summary,
-    telemetry records, schedule)."""
-    import jax
+    telemetry records, schedule).
 
+    Streams and the flagship model/params come from the session fixtures
+    (conftest.py): the chunk programs are warm before this session
+    starts. The arrival rate is deliberately a BURST (200 Hz: all 8
+    streams inside ~40 ms) so the admission queue backs up faster than
+    even warm-cache service can drain it — quantum preemption then fires
+    deterministically from ANY program-cache state, where the old 20 Hz
+    schedule only churned from a cold start (the coupling that forced
+    PR 15's fleet ``basech=4`` workaround)."""
     tmp = tmp_path_factory.mktemp("serve_smoke")
-    paths = make_stream_corpus(
-        str(tmp / "streams"), n=N_STREAMS, seed=0,
-        events_schedule=(1200, 4200),  # alternating short/long churn
-    )
-    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
-    x = np.zeros((1, 3, 16, 16, 2), np.float32)
-    params = model.init(
-        jax.random.PRNGKey(0), x, model.init_states(1, 16, 16)
-    )
+    paths = shared_stream_corpus
+    model = warmed_programs["model"]
+    params = warmed_programs["params"]
     schedule = poisson_schedule(
-        paths, rate_hz=20.0, seed=0,
+        paths, rate_hz=200.0, seed=0,
         classes=("standard", "interactive"),
     )
     tel_path = str(tmp / "telemetry.jsonl")

@@ -1,0 +1,499 @@
+"""Unit contract of the test-plane auditor (``esr_tpu.analysis.testplane``,
+ISSUE 16): model extraction (fixture graph, slow markers, call-graph
+resolution of expensive factories), each TX rule positive AND negative,
+``# esr: noqa(TX00x)`` suppression + the gate's own staleness sweep, the
+ratchet against ``tx:``-stamped baselines, and the sweep filters (test
+files + conftests only, ``fixtures/`` directories excluded). All pure
+AST over sources written to tmp dirs — no jax, no pytest collection."""
+
+import os
+import textwrap
+
+import pytest
+
+from esr_tpu.analysis.core import (
+    check_baseline_version,
+    load_baseline,
+    new_findings,
+    pure_tx_noqa,
+    write_baseline,
+)
+from esr_tpu.analysis.testplane import (
+    TESTPLANE_RULES,
+    audit_testplane,
+    iter_test_files,
+    rules_signature,
+)
+
+
+def _suite(tmp_path, **files):
+    """Write ``name -> source`` under one tmp suite dir; returns the dir."""
+    root = tmp_path / "suite"
+    root.mkdir(exist_ok=True)
+    for name, src in files.items():
+        # the `conftest=` kwarg spelling (a dot is not kwarg-able)
+        path = root / ("conftest.py" if name == "conftest" else name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def _audit(tmp_path, rules=None, **files):
+    root = _suite(tmp_path, **files)
+    return audit_testplane([root], rules=rules, relative_to=root)
+
+
+def _rules_fired(audit):
+    return sorted({f.rule for f in audit.findings})
+
+
+# ---------------------------------------------------------------------------
+# model extraction
+
+
+def test_model_counts_fixtures_scopes_and_slow_markers(tmp_path):
+    audit = _audit(
+        tmp_path,
+        conftest="""
+        import pytest
+
+        @pytest.fixture(scope="session")
+        def corpus(tmp_path_factory):
+            return make_stream_corpus(str(tmp_path_factory.mktemp("c")), n=4)
+        """,
+        **{"test_a.py": """
+        import pytest
+
+        pytestmark = pytest.mark.slow
+
+        def test_module_marked_slow():
+            pass
+        """,
+           "test_b.py": """
+        import pytest
+
+        @pytest.fixture
+        def small():
+            return 1
+
+        @pytest.mark.slow
+        def test_decorated_slow(small):
+            pass
+
+        class TestGroup:
+            def test_in_class(self):
+                pass
+        """},
+    )
+    m = audit.model
+    assert m["files"] == 3
+    assert m["test_files"] == 2
+    assert m["test_functions"] == 3
+    assert m["slow_test_functions"] == 2  # pytestmark + decorator
+    assert m["fixtures"] == 2
+    assert m["session_fixtures"] == 1
+    assert m["expensive_fixtures"] == 1  # the conftest corpus
+    assert m["rules_version"] == rules_signature()
+
+
+def test_class_level_slow_pytestmark_exempts_methods(tmp_path):
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": """
+        import pytest
+        import subprocess
+
+        @pytest.mark.slow
+        class TestSlowGroup:
+            def test_spawn(self):
+                subprocess.run(["x"])
+        """},
+    )
+    assert audit.model["slow_test_functions"] == 1
+    assert _rules_fired(audit) == []  # TX003 skips slow tests
+
+
+def test_expensive_call_resolves_through_helper_chain(tmp_path):
+    """TX001's witness anchors at the TEST's call site and names the
+    helper chain — the CX-style call-graph resolution."""
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": """
+        from esr_tpu.serving import make_stream_corpus
+
+        def _inner(d):
+            return make_stream_corpus(d, n=2)
+
+        def _outer(d):
+            return _inner(d)
+
+        def test_one(tmp_path):
+            _outer(str(tmp_path))
+
+        def test_two(tmp_path):
+            _outer(str(tmp_path))
+        """},
+    )
+    tx1 = [f for f in audit.findings if f.rule == "TX001"]
+    assert len(tx1) == 2
+    for f in tx1:
+        assert "via _outer() -> _inner()" in f.message
+        assert "_outer(str(tmp_path))" in f.code  # anchored in the test
+
+
+# ---------------------------------------------------------------------------
+# the rules, positive and negative
+
+
+def test_tx001_requires_two_sites_and_skips_slow(tmp_path):
+    body = """
+    import pytest
+    from esr_tpu.training.trainer import Trainer
+
+    def test_single_site(tmp_path):
+        Trainer(model=None, config={}, out_dir=str(tmp_path))
+    """
+    assert _rules_fired(_audit(tmp_path, **{"test_a.py": body})) == []
+    two = body + """
+    @pytest.mark.slow
+    def test_slow_site(tmp_path):
+        Trainer(model=None, config={}, out_dir=str(tmp_path))
+    """
+    # second site is slow -> still quiet; a second FAST site fires both
+    assert _rules_fired(_audit(tmp_path, **{"test_a.py": two})) == []
+    three = two + """
+    def test_other_fast_site(tmp_path):
+        Trainer(model=None, config={}, out_dir=str(tmp_path))
+    """
+    audit = _audit(tmp_path, **{"test_a.py": three})
+    tx1 = [f for f in audit.findings if f.rule == "TX001"]
+    # the slow site stays exempt: exactly the two fast bodies are flagged
+    assert len(tx1) == 2
+    assert {"test_single_site", "test_other_fast_site"} == {
+        f.message.split("`")[3] for f in tx1
+    }
+
+
+def test_tx001_charges_model_init_with_prngkey(tmp_path):
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": """
+        import jax
+        import numpy as np
+
+        def test_first(model):
+            model.init(jax.random.PRNGKey(0), np.zeros((1, 4)))
+
+        def test_second(model):
+            model.init(jax.random.PRNGKey(1), np.zeros((1, 4)))
+
+        def test_dictionary_get_is_not_model_init(cfg):
+            cfg.init({"k": 1})
+        """},
+    )
+    tx1 = [f for f in audit.findings if f.rule == "TX001"]
+    assert len(tx1) == 2
+    assert all("model_init" in f.message for f in tx1)
+
+
+def test_tx002_fires_on_function_scope_with_two_consumers(tmp_path):
+    src = """
+    import pytest
+    from esr_tpu.inference.engine import StreamingEngine
+
+    @pytest.fixture{scope}
+    def engine():
+        return StreamingEngine(model=None, params={{}}, dataset_config={{}})
+
+    def test_one(engine):
+        pass
+
+    def test_two(engine):
+        pass
+    """
+    audit = _audit(tmp_path, **{"test_a.py": src.format(scope="")})
+    assert _rules_fired(audit) == ["TX002"]
+    assert "2 consumers" in audit.findings[0].message
+    # module scope: clean
+    audit = _audit(
+        tmp_path, **{"test_a.py": src.format(scope='(scope="module")')}
+    )
+    assert _rules_fired(audit) == []
+
+
+def test_tx002_single_consumer_and_cheap_fixture_are_quiet(tmp_path):
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": """
+        import pytest
+        from esr_tpu.inference.engine import StreamingEngine
+
+        @pytest.fixture
+        def engine():
+            return StreamingEngine(model=None, params={}, dataset_config={})
+
+        @pytest.fixture
+        def cheap():
+            return {"k": 1}
+
+        def test_only_consumer(engine):
+            pass
+
+        def test_cheap_a(cheap):
+            pass
+
+        def test_cheap_b(cheap):
+            pass
+        """},
+    )
+    assert _rules_fired(audit) == []
+
+
+def test_tx002_counts_conftest_consumers_suite_wide(tmp_path):
+    audit = _audit(
+        tmp_path,
+        conftest="""
+        import pytest
+        from esr_tpu.inference.engine import StreamingEngine
+
+        @pytest.fixture
+        def engine():
+            return StreamingEngine(model=None, params={}, dataset_config={})
+        """,
+        **{"test_a.py": "def test_one(engine):\n    pass\n",
+           "test_b.py": "def test_two(engine):\n    pass\n"},
+    )
+    assert _rules_fired(audit) == ["TX002"]
+    assert audit.findings[0].path == "conftest.py"
+
+
+def test_tx003_bounded_timeout_and_slow_are_allowed(tmp_path):
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": """
+        import pytest
+        import subprocess
+
+        def test_gate_with_bounded_timeout():
+            subprocess.run(["x"], timeout=300)
+
+        @pytest.mark.slow
+        def test_slow_spawn():
+            subprocess.Popen(["x"])
+
+        def test_unbounded_spawn():
+            subprocess.run(["x"])
+
+        def test_huge_timeout_is_not_a_guard():
+            subprocess.run(["x"], timeout=3600)
+        """},
+    )
+    tx3 = [f for f in audit.findings if f.rule == "TX003"]
+    assert len(tx3) == 2
+    assert {"test_unbounded_spawn", "test_huge_timeout_is_not_a_guard"} == {
+        f.message.split("`")[3] for f in tx3
+    }
+
+
+def test_tx004_thresholds_sleeps_and_timeoutless_waits(tmp_path):
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": """
+        import time
+
+        POLL_S = 0.05
+
+        def test_short_poll_ok(worker):
+            time.sleep(POLL_S)
+            time.sleep(0.1)
+            worker.join(timeout=5.0)
+            worker.result(timeout=2.0)
+
+        def test_long_sleep_fires():
+            time.sleep(2.0)
+
+        def test_timeoutless_join_fires(worker):
+            worker.join()
+
+        def test_str_join_is_not_a_wait(parts):
+            assert "".join(parts)
+        """},
+    )
+    tx4 = [f for f in audit.findings if f.rule == "TX004"]
+    assert len(tx4) == 2
+    assert any("time.sleep(2)" in f.message for f in tx4)
+    assert any(".join()" in f.message for f in tx4)
+
+
+def test_tx005_fires_at_three_suite_wide_trace_sites(tmp_path):
+    one_site = (
+        "from esr_tpu.analysis import checked_jit\n\n"
+        "def test_{n}():\n"
+        "    checked_jit(lambda x: x)\n"
+    )
+    files = {f"test_{n}.py": one_site.format(n=n) for n in "ab"}
+    assert _rules_fired(_audit(tmp_path, **files)) == []  # 2 sites: quiet
+    files[f"test_c.py"] = one_site.format(n="c")
+    audit = _audit(tmp_path, **files)
+    tx5 = [f for f in audit.findings if f.rule == "TX005"]
+    assert len(tx5) == 3
+    assert all("3 test-body trace sites" in f.message for f in tx5)
+
+
+def test_tx006_groups_by_resolved_signature(tmp_path):
+    site = (
+        "from esr_tpu.data.synthetic import write_synthetic_h5\n\n"
+        "N_FRAMES = 6\n\n"
+        "def test_build(tmp_path):\n"
+        "    write_synthetic_h5(str(tmp_path / 'r.h5'), (64, 64),\n"
+        "                       base_events={events}, num_frames=N_FRAMES)\n"
+    )
+    # same resolved signature across two files (module-const num_frames
+    # resolves; the tmp path argument is excluded) -> both sites fire
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": site.format(events=2048),
+           "test_b.py": site.format(events=2048)},
+    )
+    tx6 = [f for f in audit.findings if f.rule == "TX006"]
+    assert len(tx6) == 2
+    assert all("num_frames=6" in f.message for f in tx6)
+    # genuinely different parameters: quiet
+    audit = _audit(
+        tmp_path,
+        **{"test_a.py": site.format(events=2048),
+           "test_b.py": site.format(events=900)},
+    )
+    assert _rules_fired(audit) == []
+
+
+def test_tx006_exempts_session_conftest_provider(tmp_path):
+    """The canonical provider pattern: a session-scoped conftest fixture
+    plus ONE test-body rebuild of the same corpus is not a duplicate
+    group (the fix for the group is to consume the provider; the
+    provider itself must never be flagged)."""
+    audit = _audit(
+        tmp_path,
+        conftest="""
+        import pytest
+        from esr_tpu.data.synthetic import write_synthetic_h5
+
+        @pytest.fixture(scope="session")
+        def corpus(tmp_path_factory):
+            d = tmp_path_factory.mktemp("c")
+            return write_synthetic_h5(str(d / "r.h5"), (64, 64),
+                                      base_events=2048, num_frames=6)
+        """,
+        **{"test_a.py": """
+        from esr_tpu.data.synthetic import write_synthetic_h5
+
+        def test_rebuilds(tmp_path):
+            write_synthetic_h5(str(tmp_path / "r.h5"), (64, 64),
+                               base_events=2048, num_frames=6)
+        """},
+    )
+    assert _rules_fired(audit) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression, staleness, ratchet, sweep filters
+
+
+def test_noqa_suppresses_and_staleness_fires_on_full_runs_only(tmp_path):
+    files = {
+        "test_a.py": """
+        import time
+
+        def test_suppressed_wait():
+            time.sleep(2.0)  # esr: noqa(TX004)
+
+        def test_stale_marker():
+            x = 1  # esr: noqa(TX004)
+            assert x
+        """,
+    }
+    audit = _audit(tmp_path, **files)
+    assert _rules_fired(audit) == ["ESR011"]  # the wait suppressed, the
+    stale = audit.findings[0]                 # orphan marker reported
+    assert "noqa(TX004)" in stale.message
+    assert stale.line == 8
+    # subset runs never judge staleness (unrun rules would all look stale)
+    audit = _audit(tmp_path, rules=["TX004"], **files)
+    assert _rules_fired(audit) == []
+
+
+def test_ast_lint_leaves_pure_tx_noqa_to_this_gate(tmp_path):
+    """The ownership split: the per-file AST lint (which never runs TX
+    rules) must not report a pure-TX noqa as stale — this gate polices
+    it. Mixed or malformed names stay with the AST lint (fail-closed)."""
+    from esr_tpu.analysis import analyze_source
+
+    src = (
+        "import time\n\n\n"
+        "def helper():\n"
+        "    time.sleep(9.0)  # esr: noqa(TX004)\n"
+    )
+    assert analyze_source(src, rel_path="test_x.py") == []
+    assert pure_tx_noqa({"TX004", "TX001"})
+    assert not pure_tx_noqa({"TX004", "CX001"})
+    assert not pure_tx_noqa({"TX0O4"})  # typo'd: the AST gate keeps it
+    assert not pure_tx_noqa(set())
+
+
+def test_ratchet_and_tx_baseline_version_gate(tmp_path):
+    root = _suite(tmp_path, **{"test_a.py": """
+    import time
+
+    def test_wait():
+        time.sleep(2.0)
+    """})
+    audit = audit_testplane([root], relative_to=root)
+    assert len(audit.findings) == 1
+    baseline = tmp_path / "testplane_baseline.json"
+    write_baseline(
+        str(baseline), audit.findings, rules_version=rules_signature()
+    )
+    # grandfathered: nothing new
+    again = audit_testplane([root], relative_to=root)
+    assert new_findings(again.findings, load_baseline(str(baseline))) == []
+    # same signature: no drift complaint
+    assert check_baseline_version(str(baseline), rules_signature()) is None
+    # a TX catalog change over a NON-EMPTY baseline demands regeneration
+    drift = check_baseline_version(str(baseline), "tx:TX001,TX007")
+    assert drift is not None and "Regenerate" in drift
+    assert rules_signature() in drift
+
+
+def test_unknown_rule_is_an_error_and_sweep_filters(tmp_path):
+    root = _suite(
+        tmp_path,
+        **{"test_a.py": "def test_ok():\n    pass\n",
+           "helper.py": "import time\ntime.sleep(9.0)\n",
+           "fixtures/tx999/test_seeded.py": "import time\n\n"
+           "def test_hazard():\n    time.sleep(9.0)\n"},
+    )
+    with pytest.raises(ValueError, match="TX999"):
+        audit_testplane([root], rules=["TX999"])
+    # non-test helpers and fixtures/ trees are outside the sweep...
+    files = [os.path.relpath(f, root) for f in iter_test_files([root])]
+    assert files == ["test_a.py"]
+    assert audit_testplane([root], relative_to=root).findings == []
+    # ...but an explicit root reaches the seeded hazard
+    seeded = audit_testplane(
+        [os.path.join(root, "fixtures", "tx999")], relative_to=root
+    )
+    assert _rules_fired(seeded) == ["TX004"]
+
+
+def test_rules_catalog_is_stable():
+    """The committed baseline's signature pins this exact catalog; a new
+    rule must regenerate it (ISSUE 16 / docs/ANALYSIS.md)."""
+    assert sorted(TESTPLANE_RULES) == [
+        "TX001", "TX002", "TX003", "TX004", "TX005", "TX006",
+    ]
+    assert rules_signature() == (
+        "tx:TX001,TX002,TX003,TX004,TX005,TX006"
+    )
+    for severity, summary in TESTPLANE_RULES.values():
+        assert severity in ("error", "warning")
+        assert summary

@@ -1,0 +1,109 @@
+"""Tier-1 CLI gate (ISSUE 16 satellite): the EXACT test-plane commands CI
+and humans run — ``python -m esr_tpu.analysis --testplane`` over the repo
+suite against the committed ``testplane_baseline.json``, and over each
+seeded TX hazard directory (``tests/fixtures/testplane_hazards/``) where
+it must exit 1 naming the rule. The PR 9/14 pattern: subprocess on
+purpose, because the gate must prove the real entry point (argv parsing,
+exit codes, baseline resolution from the repo root), not the in-process
+API ``test_testplane.py`` already covers.
+
+The audit half is pure AST (no jax, no pytest collection), so every
+subprocess here is seconds-scale — each spawn carries a bounded timeout,
+which is exactly the TX003 fast-path contract this file must itself
+satisfy."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HAZARDS = "tests/fixtures/testplane_hazards"
+TX_RULES = ("TX001", "TX002", "TX003", "TX004", "TX005", "TX006")
+
+
+def _run(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "esr_tpu.analysis", *argv],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+
+
+def test_repo_testplane_sweep_exits_zero():
+    """ISSUE 16 acceptance: the whole-suite sweep is clean against the
+    committed baseline — any NEW cost-tiering hazard a future PR adds to
+    tests/ fails here, in tier-1."""
+    proc = _run("--testplane", "--relative-to", ".")
+    assert proc.returncode == 0, (
+        f"testplane gate failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    assert "testplane audit:" in proc.stderr
+    assert "0 new finding(s)" in proc.stderr
+
+
+@pytest.mark.parametrize("rule", TX_RULES)
+def test_each_seeded_hazard_exits_one_naming_its_rule(rule):
+    """ISSUE 16 acceptance: every seeded hazard directory exits 1 and the
+    report names EXACTLY its own rule — firing a neighbor rule means the
+    seed (or a rule) lost its precision contract."""
+    root = f"{HAZARDS}/{rule.lower()}"
+    proc = _run("--testplane", "--testplane-root", root, "--relative-to", ".")
+    assert proc.returncode == 1, (
+        f"expected exit 1 for {root}\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    # match the FINDING pattern ("TXnnn [severity]"), not bare substrings:
+    # a rule's hint prose may legitimately cross-reference another rule
+    assert f"{rule} [" in proc.stdout
+    for other in TX_RULES:
+        if other != rule:
+            assert f"{other} [" not in proc.stdout, (rule, other, proc.stdout)
+
+
+def test_no_args_is_a_usage_error():
+    proc = _run()
+    assert proc.returncode == 2
+    assert "nothing to do" in proc.stderr
+    assert "--testplane" in proc.stderr  # the usage text names the gate
+
+
+def test_repo_sweep_skips_hazard_fixtures():
+    """The seeded hazards live under tests/fixtures/ — the repo sweep
+    must never see them (they would instantly dirty the baseline), while
+    an explicit --testplane-root reaches them (previous test). JSON mode
+    proves it: one parseable document, zero new findings, and the model
+    counts exclude the hazard files."""
+    import json
+
+    proc = _run("--format", "json", "--testplane", "--relative-to", ".")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["testplane"]["findings"] == []
+    model = doc["testplane"]["model"]
+    assert model["rules_version"].startswith("tx:")
+    assert model["test_functions"] >= 500  # the real suite, ...
+    hazard_files = sum(
+        f.endswith(".py")
+        for _, _, names in os.walk(os.path.join(REPO_ROOT, HAZARDS))
+        for f in names
+    )
+    assert hazard_files >= 9  # ... and the seeds exist but are not swept
+    assert model["files"] <= 90  # 75ish suite files, not suite + seeds
+
+
+def test_rules_subset_runs_only_named_tx_rules():
+    """--rules TX004 restricts the testplane gate to one rule and (by the
+    subset contract) skips the baseline drift check; the TX004 seed still
+    fails, a TX001-only subset over it passes."""
+    root = f"{HAZARDS}/tx004"
+    proc = _run("--testplane", "--testplane-root", root,
+                "--relative-to", ".", "--rules", "TX004")
+    assert proc.returncode == 1
+    assert "TX004" in proc.stdout
+    proc = _run("--testplane", "--testplane-root", root,
+                "--relative-to", ".", "--rules", "TX001")
+    assert proc.returncode == 0, proc.stdout + proc.stderr

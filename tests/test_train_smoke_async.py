@@ -26,7 +26,6 @@ import numpy as np
 import pytest
 
 from esr_tpu.config.parser import RunConfig
-from esr_tpu.data.synthetic import write_synthetic_h5
 from esr_tpu.training.checkpoint import (
     _to_host,
     find_latest_checkpoint,
@@ -36,6 +35,10 @@ from esr_tpu.training.trainer import Trainer
 
 K_STEPS = 4
 SUPER_STEPS = 2
+# fast profile in tier-1 (docs/TESTING.md): half-width model, identical
+# iteration/checkpoint cadence; scripts/train_smoke_async.sh exports
+# ESR_SMOKE_FULL=1 for the production smoke shape
+BASECH = 4 if os.environ.get("ESR_SMOKE_FULL") else 2
 
 
 def _smoke_config(tmp_path, datalist):
@@ -68,7 +71,7 @@ def _smoke_config(tmp_path, datalist):
         "experiment": "async_smoke",
         "model": {
             "name": "DeepRecurrNet",
-            "args": {"inch": 2, "basech": 4, "num_frame": 3},
+            "args": {"inch": 2, "basech": BASECH, "num_frame": 3},
         },
         "optimizer": {
             "name": "Adam",
@@ -100,17 +103,9 @@ def _smoke_config(tmp_path, datalist):
 
 
 @pytest.fixture(scope="module")
-def smoke(tmp_path_factory):
+def smoke(tmp_path_factory, shared_corpus_dir):
     tmp = tmp_path_factory.mktemp("async_smoke")
-    paths = []
-    for i in range(2):
-        p = str(tmp / f"rec{i}.h5")
-        write_synthetic_h5(p, (64, 64), base_events=2048, num_frames=6,
-                           seed=i)
-        paths.append(p)
-    datalist = str(tmp / "datalist.txt")
-    with open(datalist, "w") as f:
-        f.write("\n".join(paths) + "\n")
+    datalist = str(shared_corpus_dir / "datalist2.txt")
 
     run = RunConfig(_smoke_config(tmp, datalist), runid="async", seed=0)
     trainer = Trainer(run)
