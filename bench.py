@@ -2305,6 +2305,115 @@ def stage_obs_live(ctx):
     return res
 
 
+# The fleet_obs stage record schema, pinned by test_bench_registry — the
+# fleet view's cost trio (ISSUE 18) stays machine-comparable across
+# rounds: what one scrape+merge pass over K replica /snapshot endpoints
+# costs, how many wire bytes a snapshot document carries, how far the
+# MERGED fleet percentiles drift from exact offline percentiles on the
+# identical data (must stay inside the sketch bound), and whether the
+# advisory scaling signal reproduces its own formula on known gauges.
+FLEET_OBS_KEYS = (
+    "n_replicas", "scrape_merge_p50_ms", "scrape_merge_p99_ms",
+    "merge_overhead_frac", "wire_bytes_per_snapshot",
+    "fleet_rel_err_bound", "fleet_max_rel_err", "parity_ok",
+    "desired_replicas", "desired_expected", "desired_ok",
+    "records", "seed",
+)
+
+
+def stage_fleet_obs(ctx):
+    """The fleet view's cost, measured (ISSUE 18): (1) scrape+merge
+    latency over K real replica live planes — HTTP ``/snapshot`` fetch
+    + wire parse + sketch merge + render, p50/p99 over repeated laps;
+    (2) the wire cost of one snapshot document; (3) live-fleet-vs-
+    offline parity — the MERGED ``bench_span`` percentiles against
+    exact percentiles of the concatenated per-replica values (the
+    fleet extension of obs_live's sketch parity, same declared bound);
+    (4) ``desired_replicas`` sanity — the advisory signal must equal
+    its own queue formula on known gauges. Host-bound by design, so it
+    runs in smoke."""
+    from esr_tpu.obs import TelemetrySink
+    from esr_tpu.obs.fleetview import FleetAggregator
+    from esr_tpu.obs.http import start_live_plane
+    from esr_tpu.obs.report import percentile
+
+    seed = 0
+    k_replicas = 3
+    n_records = 800 if ctx.smoke else 3000
+    queue_depths = (6, 5, 7)     # gauges the signal must read back
+    rng = np.random.default_rng(seed)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        planes, sinks, all_values = [], [], []
+        fleet = FleetAggregator(scrape_budget=3)
+        try:
+            for i in range(k_replicas):
+                sink = TelemetrySink(os.path.join(tmp, f"r{i}.jsonl"))
+                plane = start_live_plane(sink, port=0, ns=f"r{i}")
+                values = rng.lognormal(
+                    mean=-4.0, sigma=1.0, size=n_records).tolist()
+                for j, v in enumerate(values):
+                    sink.span("bench_span", v, index=j)
+                sink.gauge("serve_queue_depth", queue_depths[i])
+                sinks.append(sink)
+                planes.append(plane)
+                all_values.extend(values)
+                fleet.watch(f"r{i}",
+                            f"http://127.0.0.1:{plane.port}/snapshot")
+
+            scrape_walls, merge_walls, total_walls = [], [], []
+            for _ in range(6 if ctx.smoke else 12):
+                t0 = time.perf_counter()
+                fleet.scrape_once()
+                t1 = time.perf_counter()
+                snap = fleet.snapshot()
+                t2 = time.perf_counter()
+                scrape_walls.append(t1 - t0)
+                merge_walls.append(t2 - t1)
+                total_walls.append(t2 - t0)
+            table = fleet.replica_table()
+            signal = fleet.scaling_signal()
+        finally:
+            for plane in planes:
+                plane.close()
+            for sink in sinks:
+                sink.close()
+
+    fam = snap["spans"]["bench_span"]
+    max_rel = 0.0
+    for q, key in ((50, "p50_ms"), (99, "p99_ms")):
+        exact = percentile(all_values, q) * 1e3
+        max_rel = max(max_rel, abs(fam[key] - exact) / exact)
+    wire_bytes = max(row["wire_bytes"] or 0 for row in table.values())
+    merge_frac = sum(merge_walls) / max(sum(total_walls), 1e-12)
+    # the signal's own formula (ScalingPolicy defaults, no burn): one
+    # desired replica per target_queue_per_replica of merged depth
+    expected = max(
+        fleet.policy.min_replicas,
+        min(fleet.policy.max_replicas,
+            int(np.ceil(sum(queue_depths)
+                        / fleet.policy.target_queue_per_replica))),
+    )
+    totals_ms = sorted(w * 1e3 for w in total_walls)
+    res = dict(zip(FLEET_OBS_KEYS, (
+        k_replicas,
+        round(percentile(totals_ms, 50), 3),
+        round(percentile(totals_ms, 99), 3),
+        round(merge_frac, 4),
+        wire_bytes,
+        fleet.rel_err,
+        round(max_rel, 6),
+        bool(max_rel <= fleet.rel_err),
+        signal["desired_replicas"],
+        expected,
+        bool(signal["desired_replicas"] == expected),
+        n_records * k_replicas,
+        seed,
+    ), strict=True))
+    EXTRA["fleet_obs"] = dict(res)
+    return res
+
+
 # The numerics_overhead stage record schema, pinned by test_bench_registry
 # (ISSUE 13): the A/B cost of the numerics plane's in-graph probes on the
 # production train step, scan-slope method so the per-call floor cancels.
@@ -2486,6 +2595,12 @@ STAGE_REGISTRY = [
     # by design, runs in smoke (and BEFORE the loader-heavy stages so no
     # leftover component health source can color its /healthz check)
     ("obs_live", stage_obs_live, 600, True),
+    # the fleet view's cost trio (ISSUE 18): scrape+merge latency over
+    # K real replica /snapshot planes, wire bytes per document, merged-
+    # sketch-vs-exact parity, desired_replicas sanity — host-bound by
+    # design, runs in smoke (right after obs_live for the same
+    # health-source-hygiene reason)
+    ("fleet_obs", stage_fleet_obs, 600, True),
     # the numerics plane's cost cell (ISSUE 13): probe-on vs probe-off
     # step time via the scan-slope method + the probe-off bitwise-
     # identity pin — compute-bound, runs (and must hold <2%) in smoke

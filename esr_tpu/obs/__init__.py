@@ -40,6 +40,16 @@ One subsystem, now two halves:
   (None-tolerant on CPU) and the bounded ``ProfilerCapture``
   (``--profile-steps``) that stamps on-chip captures into the stream.
 
+**The fleet view** (obs v5 — docs/OBSERVABILITY.md "The fleet view"):
+
+- :mod:`esr_tpu.obs.fleetview` — :class:`FleetAggregator` merging N
+  replicas' ``/snapshot`` wire documents (versioned, sketch-exact —
+  ``aggregate.snapshot_wire``/``parse_snapshot_wire``) into one fleet
+  rollup in the same dotted namespace, with per-replica staleness
+  tracking, a quorum ``/healthz``, merged multi-window ``/slo``, the
+  ``/fleet`` topology endpoint, and the advisory ``desired_replicas``
+  scaling signal (:class:`ScalingPolicy`, ``configs/fleet_scale.yml``).
+
 **The numerics plane** (obs v4 — docs/OBSERVABILITY.md "The numerics
 plane"):
 
@@ -71,7 +81,16 @@ host-side only — no ``obs`` call may appear inside jitted/scanned code
 """
 
 from esr_tpu.obs import trace
-from esr_tpu.obs.aggregate import LiveAggregator, QuantileSketch
+from esr_tpu.obs.aggregate import (
+    LiveAggregator,
+    QuantileSketch,
+    parse_snapshot_wire,
+)
+from esr_tpu.obs.fleetview import (
+    FleetAggregator,
+    ScalingPolicy,
+    start_fleet_plane,
+)
 from esr_tpu.obs.sink import (
     SCHEMA_VERSION,
     TelemetrySink,
@@ -84,8 +103,12 @@ from esr_tpu.obs.spans import StepAttribution, StepSpans
 
 __all__ = [
     "SCHEMA_VERSION",
+    "FleetAggregator",
     "LiveAggregator",
     "QuantileSketch",
+    "ScalingPolicy",
+    "parse_snapshot_wire",
+    "start_fleet_plane",
     "TelemetrySink",
     "active_sink",
     "config_fingerprint",

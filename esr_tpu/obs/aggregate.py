@@ -41,6 +41,17 @@ interpolated percentiles within ``rel_err`` (both rank endpoints are
 estimated within ``rel_err``, and the interpolation is the same convex
 combination), and counters/counts match exactly.
 
+**The wire format (obs v5, docs/OBSERVABILITY.md "The fleet view").**
+A snapshot is also serializable: :meth:`LiveAggregator.snapshot_wire`
+emits a versioned, JSON-safe document carrying the MERGED accumulation
+state itself (sketch buckets, counters, gauges, numerics table) rather
+than the rendered rollup, so a remote consumer
+(:class:`esr_tpu.obs.fleetview.FleetAggregator`) can parse it with
+:func:`parse_snapshot_wire` and keep merging — serialize → parse →
+merge is bucket-for-bucket identical to an in-process merge, which is
+what preserves the ``rel_err`` guarantee across the wire. A version or
+``rel_err`` mismatch is rejected loudly (``ValueError``), never merged.
+
 Everything here is stdlib-only and host-side only, like the rest of
 ``esr_tpu.obs`` (docs/OBSERVABILITY.md).
 """
@@ -51,19 +62,38 @@ import math
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
-# the reporter's rounding convention, shared (not copied): live snapshot
-# values must match offline report values to formatting, not just to
-# sketch error
-from esr_tpu.obs.report import _round
+# the reporter's conventions, shared (not copied): live snapshot values
+# must match offline report values to formatting, and the router-level
+# status taxonomy (continued / rootless terminals) must classify
+# identically live and offline — docs/RESILIENCE.md
+from esr_tpu.obs.report import (
+    _CONTINUED_STATUSES,
+    _ROOTLESS_STATUSES,
+    _round,
+)
 
 # the numerics plane's per-tag accumulation + section rendering is ONE
 # implementation shared with the offline reporter (obs/numerics.py) —
 # the live/offline parity contract extended to value telemetry
 from esr_tpu.obs import numerics as _numerics
 
-__all__ = ["QuantileSketch", "LiveAggregator"]
+__all__ = [
+    "QuantileSketch",
+    "LiveAggregator",
+    "SNAPSHOT_WIRE_VERSION",
+    "state_to_wire",
+    "state_from_wire",
+    "render_state",
+    "parse_snapshot_wire",
+]
+
+# the snapshot wire schema (obs v5): bumped on any change to the state
+# document shape; a parser seeing an unknown version must refuse to
+# merge (a silently-misparsed remote snapshot would corrupt the fleet
+# rollup without any visible failure)
+SNAPSHOT_WIRE_VERSION = 1
 
 
 class QuantileSketch:
@@ -174,6 +204,37 @@ class QuantileSketch:
         v_hi = self._value_at(hi)
         frac = rank - lo
         return v_lo * (1.0 - frac) + v_hi * frac
+
+    # -- wire ---------------------------------------------------------------
+
+    def to_wire(self) -> Dict:
+        """JSON-safe serialization. Bucket keys become strings (JSON
+        objects cannot key on ints); counts and the running sum are
+        carried exactly (ints exactly, floats via repr), so
+        ``from_wire(to_wire(sk))`` merges bucket-for-bucket identically
+        to ``sk`` — the round-trip half of the rel_err guarantee."""
+        return {
+            "rel_err": self.rel_err,
+            "min_value": self._min_value,
+            "buckets": {str(k): n for k, n in self._buckets.items()},
+            "zeros": self.zeros,
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_wire(cls, doc: Dict) -> "QuantileSketch":
+        sk = cls(rel_err=float(doc["rel_err"]),
+                 min_value=float(doc["min_value"]))
+        sk._buckets = {int(k): int(n) for k, n in doc["buckets"].items()}
+        sk.zeros = int(doc["zeros"])
+        sk.count = int(doc["count"])
+        sk.sum = float(doc["sum"])
+        sk.min = None if doc["min"] is None else float(doc["min"])
+        sk.max = None if doc["max"] is None else float(doc["max"])
+        return sk
 
 
 class _State:
@@ -370,7 +431,23 @@ class LiveAggregator:
                 "ok" if rec.get("completed", False) else "bad_stream"
             )
             st.statuses[status] = st.statuses.get(status, 0) + 1
-            if status == "shed":
+            # the reporter's status taxonomy, shared (docs/RESILIENCE.md):
+            # rootless terminals (shed, replica_lost, retry-exhausted —
+            # the emitting replica never ran the request root) are skipped
+            # by trace completeness, and continued terminals (shed,
+            # migrated, replica_lost — the request lives on elsewhere)
+            # never count toward request/window totals. This is what lets
+            # router-level ledger records join a merge without a migrated
+            # stream reading as a failed request.
+            if status not in _ROOTLESS_STATUSES:
+                # live completeness: the root span (serve_request) is
+                # emitted immediately before the terminal event, so
+                # parent-of-done resolving to a seen root is the live
+                # analogue of the reporter's parent-chain walk
+                st.trace_requests += 1
+                if rec.get("parent_id") in self._roots:
+                    st.trace_complete += 1
+            if status in _CONTINUED_STATUSES:
                 return
             st.requests += 1
             st.windows_total += int(rec.get("windows", 0) or 0)
@@ -378,13 +455,6 @@ class LiveAggregator:
                 st.completed_requests += 1
             else:
                 st.failed_requests += 1
-            # live completeness: the root span (serve_request) is emitted
-            # immediately before the terminal event, so parent-of-done
-            # resolving to a seen root is the live analogue of the
-            # reporter's parent-chain walk
-            st.trace_requests += 1
-            if rec.get("parent_id") in self._roots:
-                st.trace_complete += 1
 
     # -- snapshots -----------------------------------------------------------
 
@@ -422,79 +492,123 @@ class LiveAggregator:
             )
             return self._render(st, window_s, now)
 
+    def merged_state(self, window_s: Optional[float] = None) -> "_State":
+        """The merged accumulation state itself (cumulative, or the
+        trailing window) — a fresh :class:`_State` the caller owns. This
+        is the in-process twin of parsing a ``/snapshot`` wire document:
+        fleet-level consumers merge these instead of re-rendering."""
+        now = time.monotonic()
+        with self._lock:
+            return self._merged_state(
+                None if window_s is None else float(window_s), now
+            )
+
+    def snapshot_wire(self, windows: Iterable[float] = ()) -> Dict:
+        """The versioned wire document (module docstring): the cumulative
+        accumulation state plus one state per requested trailing window,
+        serialized with :func:`state_to_wire`. One call, one lock pass —
+        this is the single fetch the fleet plane lives on."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "version": SNAPSHOT_WIRE_VERSION,
+                "rel_err": self.rel_err,
+                "uptime_s": round(now - self._t0, 3),
+                "state": state_to_wire(self._merged_state(None, now)),
+                "window_states": {
+                    str(float(w)): state_to_wire(
+                        self._merged_state(float(w), now)
+                    )
+                    for w in windows
+                },
+            }
+
     def _render(self, st: _State, window_s, now: float) -> Dict:
-        goodput: Dict = {"value": None, "source": None}
-        if st.attr_records and st.attr_wall > 0:
-            goodput = {
-                "value": round(st.attr_wall_x_goodput / st.attr_wall, 6),
-                "source": "attribution",
-                "records": st.attr_records,
-            }
-        elif st.chunk_begin is not None:
-            wall = max((st.chunk_end or 0.0) - st.chunk_begin, 1e-9)
-            goodput = {
-                "value": round(min(st.chunk_busy / wall, 1.0), 6),
-                "source": ("serving" if "serve_chunk" in st.chunk_kinds
-                           else "inference"),
-                "busy_s": round(st.chunk_busy, 6),
-                "wall_s": round(wall, 6),
-            }
-        spans_out = {
-            name: {
-                "count": sk.count,
-                "total_s": round(sk.sum, 6),
-                "p50_ms": _round(sk.quantile(50), 1e3),
-                "p99_ms": _round(sk.quantile(99), 1e3),
-                "max_ms": _round(sk.max, 1e3),
-            }
-            for name, sk in sorted(st.spans.items())
+        return render_state(st, window_s=window_s,
+                            uptime_s=round(now - self._t0, 3),
+                            rel_err=self.rel_err)
+
+
+def render_state(st: "_State", window_s: Optional[float] = None,
+                 uptime_s: Optional[float] = None,
+                 rel_err: float = 0.01) -> Dict:
+    """Render one accumulation state into the report-shaped dotted
+    namespace (:meth:`LiveAggregator.snapshot`'s body, shared so the
+    fleet plane renders MERGED states through the exact same code path —
+    ``configs/slo*.yml`` cannot tell a fleet snapshot from a replica
+    one)."""
+    goodput: Dict = {"value": None, "source": None}
+    if st.attr_records and st.attr_wall > 0:
+        goodput = {
+            "value": round(st.attr_wall_x_goodput / st.attr_wall, 6),
+            "source": "attribution",
+            "records": st.attr_records,
         }
-        serving = {
-            "requests": st.requests,
-            "completed": st.completed_requests,
-            "errors": st.failed_requests,
-            "statuses": {k: st.statuses[k] for k in sorted(st.statuses)},
-            "windows": st.windows_total,
-            "windows_skipped": st.windows_skipped,
-            "active_window_frac": (
-                round(st.chunk_windows_valid
-                      / (st.chunk_windows_valid + st.windows_skipped), 6)
-                if (st.chunk_windows_valid + st.windows_skipped) else None
-            ),
-            "preemptions": st.events.get("serve_preempt", 0),
-            "backpressure": st.counters.get("serve_backpressure", 0.0),
-            "classes": {
-                cls: {
-                    "windows": st.class_windows.get(cls, 0),
-                    "window_latency_p50_ms": _round(sk.quantile(50), 1e3),
-                    "window_latency_p99_ms": _round(sk.quantile(99), 1e3),
-                }
-                for cls, sk in sorted(st.class_lat.items())
-            },
+    elif st.chunk_begin is not None:
+        wall = max((st.chunk_end or 0.0) - st.chunk_begin, 1e-9)
+        goodput = {
+            "value": round(min(st.chunk_busy / wall, 1.0), 6),
+            "source": ("serving" if "serve_chunk" in st.chunk_kinds
+                       else "inference"),
+            "busy_s": round(st.chunk_busy, 6),
+            "wall_s": round(wall, 6),
         }
-        return {
-            "live": True,
-            "window_s": window_s,
-            "uptime_s": round(now - self._t0, 3),
-            "records": st.records,
-            "sketch_rel_err": self.rel_err,
-            "goodput": goodput,
-            "spans": spans_out,
-            "counters": {k: st.counters[k] for k in sorted(st.counters)},
-            "gauges": {k: st.gauges[k] for k in sorted(st.gauges)},
-            "events": {k: st.events[k] for k in sorted(st.events)},
-            "serving": serving,
-            "traces": {
-                "requests": st.trace_requests,
-                "complete": st.trace_complete,
-                "incomplete": st.trace_requests - st.trace_complete,
-            },
-            "faults": {
-                "injected": st.faults_injected,
-                "recovery_events": st.recovery_events,
-            },
-            "numerics": _numerics.rollup(st.numerics),
+    spans_out = {
+        name: {
+            "count": sk.count,
+            "total_s": round(sk.sum, 6),
+            "p50_ms": _round(sk.quantile(50), 1e3),
+            "p99_ms": _round(sk.quantile(99), 1e3),
+            "max_ms": _round(sk.max, 1e3),
         }
+        for name, sk in sorted(st.spans.items())
+    }
+    serving = {
+        "requests": st.requests,
+        "completed": st.completed_requests,
+        "errors": st.failed_requests,
+        "statuses": {k: st.statuses[k] for k in sorted(st.statuses)},
+        "windows": st.windows_total,
+        "windows_skipped": st.windows_skipped,
+        "active_window_frac": (
+            round(st.chunk_windows_valid
+                  / (st.chunk_windows_valid + st.windows_skipped), 6)
+            if (st.chunk_windows_valid + st.windows_skipped) else None
+        ),
+        "preemptions": st.events.get("serve_preempt", 0),
+        "backpressure": st.counters.get("serve_backpressure", 0.0),
+        "classes": {
+            cls: {
+                "windows": st.class_windows.get(cls, 0),
+                "window_latency_p50_ms": _round(sk.quantile(50), 1e3),
+                "window_latency_p99_ms": _round(sk.quantile(99), 1e3),
+            }
+            for cls, sk in sorted(st.class_lat.items())
+        },
+    }
+    return {
+        "live": True,
+        "window_s": window_s,
+        "uptime_s": uptime_s,
+        "records": st.records,
+        "sketch_rel_err": rel_err,
+        "goodput": goodput,
+        "spans": spans_out,
+        "counters": {k: st.counters[k] for k in sorted(st.counters)},
+        "gauges": {k: st.gauges[k] for k in sorted(st.gauges)},
+        "events": {k: st.events[k] for k in sorted(st.events)},
+        "serving": serving,
+        "traces": {
+            "requests": st.trace_requests,
+            "complete": st.trace_complete,
+            "incomplete": st.trace_requests - st.trace_complete,
+        },
+        "faults": {
+            "injected": st.faults_injected,
+            "recovery_events": st.recovery_events,
+        },
+        "numerics": _numerics.rollup(st.numerics),
+    }
 
 
 def _merge_state(dst: _State, src: _State) -> None:
@@ -537,3 +651,126 @@ def _merge_state(dst: _State, src: _State) -> None:
     dst.faults_injected += src.faults_injected
     dst.recovery_events += src.recovery_events
     _numerics.merge_states(dst.numerics, src.numerics)
+
+
+# ---------------------------------------------------------------------------
+# the snapshot wire format (obs v5): every _State slot, JSON-safe
+
+
+def state_to_wire(st: _State) -> Dict:
+    """Serialize one accumulation state — every ``_State`` slot, sketches
+    via :meth:`QuantileSketch.to_wire`, ``chunk_kinds`` as a sorted list,
+    the numerics table verbatim (it is already JSON-scalar rows)."""
+    return {
+        "records": st.records,
+        "counters": dict(st.counters),
+        "gauges": dict(st.gauges),
+        "events": dict(st.events),
+        "spans": {k: sk.to_wire() for k, sk in st.spans.items()},
+        "class_lat": {k: sk.to_wire() for k, sk in st.class_lat.items()},
+        "class_windows": dict(st.class_windows),
+        "chunk_busy": st.chunk_busy,
+        "chunk_begin": st.chunk_begin,
+        "chunk_end": st.chunk_end,
+        "chunk_kinds": sorted(st.chunk_kinds),
+        "attr_records": st.attr_records,
+        "attr_wall": st.attr_wall,
+        "attr_wall_x_goodput": st.attr_wall_x_goodput,
+        "requests": st.requests,
+        "completed_requests": st.completed_requests,
+        "failed_requests": st.failed_requests,
+        "statuses": dict(st.statuses),
+        "windows_total": st.windows_total,
+        "chunk_windows_valid": st.chunk_windows_valid,
+        "windows_skipped": st.windows_skipped,
+        "trace_requests": st.trace_requests,
+        "trace_complete": st.trace_complete,
+        "faults_injected": st.faults_injected,
+        "recovery_events": st.recovery_events,
+        "numerics": {tag: dict(row) for tag, row in st.numerics.items()},
+    }
+
+
+def state_from_wire(doc: Dict) -> _State:
+    """Rebuild a :class:`_State` from :func:`state_to_wire` output. The
+    round-trip is exact (ints exactly; floats survive JSON via repr), so
+    merging a parsed state is indistinguishable from merging the
+    original — pinned in ``tests/test_fleet_obs.py``."""
+    st = _State(0.01)  # per-sketch rel_err rides each sketch's own wire
+    st.records = int(doc["records"])
+    st.counters = {str(k): float(v) for k, v in doc["counters"].items()}
+    st.gauges = dict(doc["gauges"])
+    st.events = {str(k): int(v) for k, v in doc["events"].items()}
+    st.spans = {
+        str(k): QuantileSketch.from_wire(v) for k, v in doc["spans"].items()
+    }
+    st.class_lat = {
+        str(k): QuantileSketch.from_wire(v)
+        for k, v in doc["class_lat"].items()
+    }
+    st.class_windows = {
+        str(k): int(v) for k, v in doc["class_windows"].items()
+    }
+    st.chunk_busy = float(doc["chunk_busy"])
+    st.chunk_begin = (None if doc["chunk_begin"] is None
+                      else float(doc["chunk_begin"]))
+    st.chunk_end = (None if doc["chunk_end"] is None
+                    else float(doc["chunk_end"]))
+    st.chunk_kinds = set(doc["chunk_kinds"])
+    st.attr_records = int(doc["attr_records"])
+    st.attr_wall = float(doc["attr_wall"])
+    st.attr_wall_x_goodput = float(doc["attr_wall_x_goodput"])
+    st.requests = int(doc["requests"])
+    st.completed_requests = int(doc["completed_requests"])
+    st.failed_requests = int(doc["failed_requests"])
+    st.statuses = {str(k): int(v) for k, v in doc["statuses"].items()}
+    st.windows_total = int(doc["windows_total"])
+    st.chunk_windows_valid = int(doc["chunk_windows_valid"])
+    st.windows_skipped = int(doc["windows_skipped"])
+    st.trace_requests = int(doc["trace_requests"])
+    st.trace_complete = int(doc["trace_complete"])
+    st.faults_injected = int(doc["faults_injected"])
+    st.recovery_events = int(doc["recovery_events"])
+    st.numerics = {
+        str(tag): dict(row) for tag, row in doc["numerics"].items()
+    }
+    return st
+
+
+def parse_snapshot_wire(doc: Dict) -> Dict:
+    """Parse one ``/snapshot`` wire document back into accumulation
+    state: ``{"version", "rel_err", "uptime_s", "state": _State,
+    "windows": {window_s: _State}}`` plus the live-plane context keys
+    (``replica``, ``health``, ``slo_verdict``) passed through untouched.
+
+    Raises :class:`ValueError` LOUDLY on a version mismatch or a torn
+    document — an unparseable snapshot must never be merged into a fleet
+    rollup (the caller marks the replica unhealthy instead)."""
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"snapshot wire document must be a dict, got "
+            f"{type(doc).__name__}"
+        )
+    version = doc.get("version")
+    if version != SNAPSHOT_WIRE_VERSION:
+        raise ValueError(
+            f"snapshot wire version {version!r} is not the supported "
+            f"{SNAPSHOT_WIRE_VERSION} — refusing to merge"
+        )
+    try:
+        parsed: Dict = {
+            "version": int(version),
+            "rel_err": float(doc["rel_err"]),
+            "uptime_s": float(doc.get("uptime_s", 0.0)),
+            "state": state_from_wire(doc["state"]),
+            "windows": {
+                float(k): state_from_wire(v)
+                for k, v in (doc.get("window_states") or {}).items()
+            },
+        }
+    except (KeyError, TypeError, ValueError, AttributeError) as exc:
+        raise ValueError(f"torn snapshot wire document: {exc!r}") from exc
+    for key in ("replica", "health", "slo_verdict"):
+        if key in doc:
+            parsed[key] = doc[key]
+    return parsed

@@ -29,6 +29,13 @@ run is in flight (docs/OBSERVABILITY.md "The live plane"):
   sustained rate); exactly one violating → 429 (warn: transient spike or
   recovering); neither → 200. A polling router sheds on 503, eases on
   429 — the VirtualFlow-style fleet signal ROADMAP.md's autoscaler needs.
+- ``/snapshot?window_s=`` — the obs v5 WIRE format
+  (``aggregate.snapshot_wire``): one versioned JSON document carrying the
+  serialized accumulation state (sketch buckets, counters, gauges,
+  numerics) cumulative + per requested trailing window, plus this
+  replica's health body and its own ``/slo`` verdict. This is the single
+  fetch per replica per poll that the fleet plane
+  (``obs/fleetview.py``) and the ``ReplicaSupervisor`` both live on.
 
 Strictly opt-in: nothing constructs this server unless
 ``trainer.live_telemetry`` / ``ServingEngine(live_port=...)`` /
@@ -44,8 +51,10 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 __all__ = [
+    "parse_windows_query",
     "register_health_source",
     "unregister_health_source",
     "health_snapshot",
@@ -236,6 +245,27 @@ def render_prometheus(snapshot: Dict, prefix: str = "esr") -> str:
     return "\n".join(lines) + "\n"
 
 
+def parse_windows_query(query: str) -> Optional[Tuple[float, ...]]:
+    """``window_s=60`` / ``window_s=60,300`` → the explicit trailing
+    windows a ``/snapshot`` request asks for; absent/empty → ``None``
+    (the server substitutes its burn-rate pair). Raises ``ValueError``
+    on junk — the endpoint answers 400, never a torn document."""
+    raw = parse_qs(query).get("window_s")
+    if not raw:
+        return None
+    try:
+        windows = tuple(
+            float(tok) for part in raw for tok in part.split(",") if tok
+        )
+    except ValueError:
+        raise ValueError(
+            f"window_s must be comma-separated seconds, got {raw!r}"
+        ) from None
+    if any(w <= 0 for w in windows):
+        raise ValueError(f"window_s values must be > 0, got {raw!r}")
+    return windows or None
+
+
 # ---------------------------------------------------------------------------
 # the server
 
@@ -292,30 +322,17 @@ class LiveTelemetryServer:
         return (200 if healthy else 503), doc
 
     def _eval_window(self, window_s: float) -> Dict:
-        """One window's burn verdict. Absence of evidence is not a burn:
-        an EMPTY window (zero records — an idle replica) is "no data" as
-        a whole, and a rule whose metric is simply ABSENT from the window
-        (goodput between attribution records, serving classes before the
-        first resolve) is skipped-as-missing rather than violated. The
-        offline gate keeps its strict missing=violation semantics for
-        finished runs; a live WINDOW legitimately lacks subsystems that
-        did not emit during it, and scoring that as a sustained burn
-        would make the router contract (503 → drain) kill healthy
-        replicas on every traffic lull or cadence gap. A present-but-
-        non-finite metric (NaN) still violates."""
-        from esr_tpu.obs.report import evaluate_slo
+        """One window's burn verdict — delegated to the SHARED windowed
+        semantics (:func:`esr_tpu.obs.report.evaluate_slo_window`: empty
+        window = no data; metric absent from the window = skipped as
+        missing, not violated; present-but-non-finite still violates) so
+        this endpoint and the fleet plane's merged-window evaluation can
+        never diverge."""
+        from esr_tpu.obs.report import evaluate_slo_window
 
-        snap = self.aggregator.snapshot(window_s=window_s)
-        if snap.get("records", 0) == 0:
-            return {"ok": True, "no_data": True, "violations": [],
-                    "missing": []}
-        _ok, verdicts = evaluate_slo(snap, self._slo)
-        missing = [v["name"] for v in verdicts
-                   if not v["ok"] and v["value"] is None]
-        violations = [v for v in verdicts
-                      if not v["ok"] and v["value"] is not None]
-        return {"ok": not violations, "no_data": False,
-                "violations": violations, "missing": missing}
+        return evaluate_slo_window(
+            self.aggregator.snapshot(window_s=window_s), self._slo
+        )
 
     def slo_doc(self) -> Tuple[int, Dict]:
         if self._slo is None:
@@ -337,6 +354,25 @@ class LiveTelemetryServer:
             "fast": fast,
             "slow": slow,
         }
+
+    def snapshot_doc(self, windows: Optional[Tuple[float, ...]] = None
+                     ) -> Dict:
+        """The ``/snapshot`` body (obs v5): ONE document carrying
+        everything a fleet consumer needs per poll — the versioned wire
+        state (cumulative + the requested trailing windows, defaulting
+        to this server's burn-rate pair), this replica's health body,
+        and its own ``/slo`` verdict — so death detection and the fleet
+        merge ride a single HTTP fetch per replica per poll
+        (docs/SERVING.md "The fleet signal")."""
+        if windows is None:
+            windows = self.windows
+        doc = self.aggregator.snapshot_wire(windows=windows)
+        doc["replica"] = self.ns
+        healthy, sources = health_snapshot(ns=self.ns)
+        doc["health"] = {"healthy": healthy, "sources": sources}
+        doc["slo_verdict"] = (None if self._slo is None
+                              else self.slo_doc()[1]["verdict"])
+        return doc
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -365,7 +401,8 @@ class LiveTelemetryServer:
                 self.wfile.write(payload)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                parts = urlsplit(self.path)
+                path = parts.path.rstrip("/") or "/"
                 try:
                     if path == "/metrics":
                         self._send(
@@ -380,11 +417,22 @@ class LiveTelemetryServer:
                         status, doc = server.slo_doc()
                         self._send(status, json.dumps(doc, indent=2),
                                    "application/json")
+                    elif path == "/snapshot":
+                        try:
+                            windows = parse_windows_query(parts.query)
+                        except ValueError as e:
+                            self._send(400, json.dumps({"error": str(e)}),
+                                       "application/json")
+                            return
+                        self._send(200,
+                                   json.dumps(server.snapshot_doc(windows)),
+                                   "application/json")
                     else:
                         self._send(
                             404,
                             json.dumps({"endpoints": [
-                                "/metrics", "/healthz", "/slo"]}),
+                                "/metrics", "/healthz", "/slo",
+                                "/snapshot"]}),
                             "application/json",
                         )
                 except Exception as e:  # noqa: BLE001 - endpoint must answer

@@ -71,6 +71,7 @@ __all__ = [
     "build_report",
     "load_slo",
     "evaluate_slo",
+    "evaluate_slo_window",
     "report_file",
     "split_label",
     "merge_fleet_reports",
@@ -509,6 +510,37 @@ def evaluate_slo(report: Dict, slo: Dict) -> Tuple[bool, List[Dict]]:
         all_ok = all_ok and verdict["ok"]
         verdicts.append(verdict)
     return all_ok, verdicts
+
+
+def evaluate_slo_window(snapshot: Dict, slo: Dict) -> Dict:
+    """One LIVE window's burn verdict — the windowed relaxation of
+    :func:`evaluate_slo`, shared by the per-replica ``/slo`` endpoint
+    (obs/http.py) and the fleet plane's merged-window evaluation
+    (obs/fleetview.py) so the two can never diverge on semantics.
+
+    Absence of evidence is not a burn: an EMPTY window (zero records —
+    an idle replica) is "no data" as a whole, and a rule whose metric is
+    simply ABSENT from the window (goodput between attribution records,
+    serving classes before the first resolve) is skipped-as-missing
+    rather than violated. The offline gate keeps its strict
+    missing=violation semantics for finished runs; a live WINDOW
+    legitimately lacks subsystems that did not emit during it, and
+    scoring that as a sustained burn would make the router contract
+    (503 → drain) kill healthy replicas on every traffic lull or cadence
+    gap. A present-but-non-finite metric (NaN) still violates.
+
+    Returns ``{"ok", "no_data", "violations", "missing"}``.
+    """
+    if snapshot.get("records", 0) == 0:
+        return {"ok": True, "no_data": True, "violations": [],
+                "missing": []}
+    _ok, verdicts = evaluate_slo(snapshot, slo)
+    missing = [v["name"] for v in verdicts
+               if not v["ok"] and v["value"] is None]
+    violations = [v for v in verdicts
+                  if not v["ok"] and v["value"] is not None]
+    return {"ok": not violations, "no_data": False,
+            "violations": violations, "missing": missing}
 
 
 def report_file(

@@ -16,14 +16,24 @@ chaos half:
    bit-exactly over the lane-state wire format), ``replica_kill``
    (abrupt death mid-run — missed heartbeats, involuntary fail-over),
    and ``replica_partition`` (unreachable — fenced, then failed over).
-3. **checks** — zero lost requests (every ledger row classified
+3. **fleet view** (ISSUE 18) — the live fleet plane
+   (``obs.fleetview.start_fleet_plane``) runs THROUGH the faults: the
+   router's supervisor feeds every ``/snapshot`` poll into a
+   :class:`~esr_tpu.obs.fleetview.FleetAggregator` (one fetch per
+   replica per poll), the router's own ledger stream joins the merge as
+   a local, and the killed replica must flip STALE — excluded with an
+   annotation, never silently merged — while the merged ``/slo``
+   verdict stays in agreement with the offline reporter over the
+   router + survivor telemetry files.
+4. **checks** — zero lost requests (every ledger row classified
    terminal), all three faults injected AND recovered
    (``faults.unrecovered == 0`` over the merged router + replica
    telemetry), migrated/failed-over streams matching the twin's
    per-request metric means within ``1e-5`` rel (a handoff resumes
    bit-exactly; a fail-over replays from window 0 — either way the
-   full-stream means are the twin's), and the merged
-   ``obs report --slo configs/slo_fleet.yml`` exiting 0.
+   full-stream means are the twin's), the merged
+   ``obs report --slo configs/slo_fleet.yml`` exiting 0, and the
+   fleet-view properties above.
 
 CLI: ``python -m esr_tpu.resilience.chaos_fleet --out DIR [--seed N]``
 prints the summary JSON and exits 0 iff every acceptance property held.
@@ -44,8 +54,13 @@ from esr_tpu.resilience.faults import FaultPlan, FaultSpec, installed
 N_REPLICAS = 3
 LANES = 2
 N_STREAMS = 6
-RATE_HZ = 2.5          # arrivals span ~2.5 s: rounds keep ticking while
-                       # the late faults (kill detection, fence) land
+RATE_HZ = 200.0        # arrival BURST: every stream is submitted (and
+                       # ring-placed) before the early fault rounds land,
+                       # so the kill always finds live streams to fail
+                       # over — from ANY program-cache state (the PR 16
+                       # burst rule: a warm cache makes rounds far faster
+                       # than wall-clock arrivals, and a 2.5 Hz schedule
+                       # left the killed replica empty in full-suite runs)
 EVENTS_SCHEDULE = (1600, 4200)   # alternating short/long streams
 
 
@@ -180,7 +195,8 @@ def _metric_parity(twin_reports: Dict, fleet_reports: Dict) -> Dict:
 def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
     """The whole scripted fleet scenario; returns the machine-checkable
     summary (every acceptance property precomputed as a boolean)."""
-    from esr_tpu.obs import TelemetrySink, set_active_sink
+    from esr_tpu.obs import LiveAggregator, TelemetrySink, set_active_sink
+    from esr_tpu.obs.fleetview import FleetAggregator, start_fleet_plane
     from esr_tpu.obs.report import report_files
     from esr_tpu.serving import (
         FleetRouter,
@@ -188,6 +204,7 @@ def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
         poisson_schedule,
         make_stream_corpus,
     )
+    from esr_tpu.serving.fleet import ReplicaSupervisor
 
     os.makedirs(out_dir, exist_ok=True)
     paths = make_stream_corpus(
@@ -206,15 +223,16 @@ def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
         f"r{i}": os.path.join(out_dir, f"telemetry_r{i}.jsonl")
         for i in range(N_REPLICAS)
     }
+    live_slo = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "configs", "slo.yml",
+    )
     replicas = [
         Replica(
             rid, model, params, dataset_config(),
             telemetry_path=path, classes=serving_classes(),
             default_class="standard", lanes=LANES,
-            live_slo=os.path.join(
-                os.path.dirname(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__)))), "configs", "slo.yml",
-            ),
+            live_slo=live_slo,
             preempt_quantum=0,
         ).start()
         for rid, path in sorted(replica_files.items())
@@ -222,15 +240,38 @@ def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
     router_file = os.path.join(out_dir, "telemetry_router.jsonl")
     router_sink = TelemetrySink(router_file)
     prev = set_active_sink(router_sink)
+    # the live fleet view (ISSUE 18, docs/OBSERVABILITY.md "The fleet
+    # view") runs THROUGH the faults: the router's supervisor hands each
+    # /snapshot poll to the FleetAggregator (one fetch per replica per
+    # poll serves death detection AND the merge), and the router's own
+    # ledger stream joins as a local
+    router_agg = LiveAggregator().attach(router_sink)
+    fleet_agg = FleetAggregator(scrape_budget=2)
+    fleet_agg.attach_local("router", router_agg)
     router = FleetRouter(
         replicas, default_class="standard",
         failover_budget=2, miss_budget=2,
+        supervisor=ReplicaSupervisor(
+            miss_budget=2, observer=fleet_agg.ingest),
+    )
+    fleet_plane = start_fleet_plane(
+        replicas, port=0, slo_path=live_slo, fleet=fleet_agg,
+        topology=lambda: {"ring_ownership": router.ring.ownership()},
     )
     t0 = time.monotonic()
+    fleet_view: Optional[Dict] = None
+    fleet_slo: Optional[Dict] = None
     try:
         with installed(plan):
             summary = router.run(arrivals=schedule, max_wall_s=300.0)
+        # one final pull so the merged view covers every survivor's
+        # full run, then capture the fleet documents while the
+        # survivors' planes are still up
+        fleet_agg.scrape_once()
+        fleet_view = fleet_plane.server.fleet_doc()
+        _, fleet_slo = fleet_plane.server.slo_doc()
     finally:
+        fleet_plane.close()
         router.close()
         set_active_sink(prev)
         router_sink.close()
@@ -251,6 +292,21 @@ def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
     )
     faults = merged_doc["report"]["faults"]
 
+    # the offline side of the fleet-view agreement: the SAME SLO file
+    # the live fleet /slo evaluated, applied offline to the router +
+    # SURVIVOR telemetry (the dead replicas are stale-excluded from the
+    # live merge, so their files are excluded here too)
+    dead = sorted(rid for rid, state in summary["replicas"].items()
+                  if state == "dead")
+    survivor_args = [f"router={router_file}"] + [
+        f"{rid}={path}" for rid, path in sorted(replica_files.items())
+        if rid not in dead
+    ]
+    _survivor_doc, survivor_code = report_files(
+        survivor_args, live_slo,
+        out_path=os.path.join(out_dir, "FLEET_VIEW_REPORT.json"),
+    )
+
     statuses = {r["status"] for r in fleet_reports.values()}
     result = {
         "seed": seed,
@@ -260,6 +316,8 @@ def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
         "parity": parity,
         "faults": faults,
         "merged_report": os.path.join(out_dir, "FLEET_REPORT.json"),
+        "fleet_view": fleet_view,
+        "fleet_slo": fleet_slo,
         "telemetry": {
             "router": router_file, **replica_files,
             "twin": os.path.join(out_dir, "telemetry_twin.jsonl"),
@@ -287,6 +345,31 @@ def run_fleet_scenario(out_dir: str, seed: int = 0) -> Dict:
             ),
             # the merged fleet SLO gate (configs/slo_fleet.yml) is green
             "merged_slo_ok": merged_code == 0,
+            # ISSUE 18: the live fleet view ran THROUGH the faults —
+            # every dead replica flipped STALE and was excluded with an
+            # annotation (never silently merged) ...
+            "fleet_killed_stale": (
+                fleet_view is not None and bool(dead) and all(
+                    fleet_view["replicas"][rid]["stale"]
+                    and rid in fleet_view["excluded"]
+                    for rid in dead
+                )
+            ),
+            # ... every survivor (and the router's own ledger stream)
+            # made it INTO the final merge ...
+            "fleet_survivors_merged": (
+                fleet_view is not None
+                and "local:router" in fleet_view["merged"]
+                and all(rid in fleet_view["merged"]
+                        for rid in sorted(replica_files)
+                        if rid not in dead)
+            ),
+            # ... and the merged live /slo verdict agrees with the
+            # offline reporter over the router + survivor files
+            "fleet_slo_matches_offline": (
+                fleet_slo is not None
+                and (fleet_slo["verdict"] == "ok") == (survivor_code == 0)
+            ),
         },
     }
     result["ok"] = all(result["checks"].values())

@@ -12,12 +12,17 @@ when a replica dies).
 
 The robustness contract (the chaos gate one level up):
 
-- **supervision** rides the existing per-replica endpoints
-  (:class:`ReplicaSupervisor` polls ``/healthz`` + ``/slo`` over real
-  HTTP): an unhealthy ``/healthz`` or a sustained ``/slo`` burn (503 =
-  "page") triggers a voluntary DRAIN; ``miss_budget`` consecutive failed
-  heartbeats declare the replica DEAD (a partitioned replica is fenced
-  first — it must never keep serving streams the router re-placed).
+- **supervision** rides ONE per-replica fetch (:class:`ReplicaSupervisor`
+  polls ``/snapshot`` over real HTTP — obs v5): the snapshot document
+  carries the replica's health body, its own ``/slo`` verdict, AND the
+  serialized rollup state, so death detection and the fleet view
+  (``obs/fleetview.FleetAggregator``, fed through the supervisor's
+  ``observer`` hook) consume literally the same fetch stream and can
+  never disagree about a replica. An unhealthy body or a sustained burn
+  verdict ("page") triggers a voluntary DRAIN; ``miss_budget``
+  consecutive failed heartbeats declare the replica DEAD (a partitioned
+  replica is fenced first — it must never keep serving streams the
+  router re-placed).
 - **voluntary drain/handoff** serializes every lane state through
   ``extract_lane_state`` -> bytes (``serving/replica.py`` wire format,
   digest-checked) -> ``inject_lane_state`` on the target, so a stream
@@ -59,6 +64,7 @@ router narrates transitions from the main loop).
 from __future__ import annotations
 
 import hashlib
+import json
 import logging
 import threading
 import time
@@ -66,6 +72,8 @@ from bisect import bisect_right
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from esr_tpu.obs.aggregate import parse_snapshot_wire
+from esr_tpu.obs.fleetview import http_fetch as _http_fetch
 from esr_tpu.serving.replica import HandoffPacket, Replica
 
 logger = logging.getLogger(__name__)
@@ -152,65 +160,78 @@ class HashRing:
                 return node
         return None
 
+    def ownership(self) -> Dict[str, float]:
+        """Fraction of the 2^64 key space each node owns (clockwise arc
+        lengths, wraparound included; fractions sum to 1) — the
+        placement-topology view the fleet plane's ``/fleet`` endpoint
+        surfaces."""
+        if not self._points:
+            return {}
+        out = {n: 0.0 for n in self._nodes}
+        span = float(2 ** 64)
+        prev = self._points[-1][0] - 2 ** 64
+        for h, node in self._points:
+            out[node] += (h - prev) / span
+            prev = h
+        return {n: round(v, 6) for n, v in sorted(out.items())}
+
 
 # ---------------------------------------------------------------------------
-# supervision: /healthz + /slo polling + heartbeat ledger
-
-
-def _http_fetch(url: str, timeout_s: float) -> int:
-    """GET ``url``; returns the HTTP status (200/429/503 are all valid
-    verdicts — an HTTPError IS the answer). Raises on transport failure
-    (connect refused, timeout) — the heartbeat-miss signal."""
-    import urllib.error
-    import urllib.request
-
-    try:
-        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
-            return int(resp.status)
-    except urllib.error.HTTPError as e:
-        return int(e.code)
+# supervision: one /snapshot poll per replica, heartbeat ledger
+# (_http_fetch is the obs fleet-view fetch: (status, body), HTTPError IS
+# an answer, transport failure raises — the heartbeat-miss signal)
 
 
 class ReplicaSupervisor:
-    """Heartbeat + verdict ledger over every watched replica's endpoints.
+    """Heartbeat + verdict ledger over every watched replica's
+    ``/snapshot`` endpoint — ONE fetch per replica per poll (obs v5).
 
-    :meth:`poll_once` fetches each replica's ``/healthz`` and ``/slo``
-    (transport failures count as heartbeat MISSES; HTTP status codes are
-    verdicts) and updates a lock-guarded ledger; :meth:`verdict` hands
-    the router a snapshot. Deterministic drivers (tier-1, the chaos
+    :meth:`poll_once` fetches each replica's snapshot document, which
+    carries the health body (``/healthz``'s verdict), the replica's own
+    ``/slo`` verdict, AND the serialized rollup state — so supervision
+    needs no second or third fetch, and the fleet view
+    (``obs/fleetview.FleetAggregator``), fed every parsed document (or
+    miss) through the ``observer`` hook, sees exactly the fetch stream
+    death detection acted on. Transport failures count as heartbeat
+    MISSES; a replica that ANSWERS with an unusable document
+    (wire-version mismatch, torn JSON) is alive-but-unhealthy, never a
+    miss, and never merged. Deterministic drivers (tier-1, the chaos
     scenario) call ``poll_once`` from the router round; production wires
     the optional poller thread (:meth:`start`) for wall-clock cadence —
     either way the ledger semantics are identical.
 
     Thread discipline (CX gate): every access to ``_targets``/``_ledger``
-    holds ``_lock``; the HTTP fetches run OUTSIDE the lock; the poller is
-    a daemon thread stopped via Event + timed join."""
+    holds ``_lock``; the HTTP fetches and the observer callback run
+    OUTSIDE the lock; the poller is a daemon thread stopped via Event +
+    timed join."""
 
     def __init__(
         self,
         miss_budget: int = 3,
         timeout_s: float = 1.0,
         fetch=None,
+        observer=None,
     ):
         if miss_budget < 1:
             raise ValueError(f"miss_budget must be >= 1, got {miss_budget}")
         self.miss_budget = int(miss_budget)
         self.timeout_s = float(timeout_s)
         self._fetch = fetch if fetch is not None else _http_fetch
+        # observer signature == FleetAggregator.ingest: (replica_id,
+        # parsed_snapshot_or_None, wire_bytes=, error=, unusable=)
+        self._observer = observer
         self._lock = threading.Lock()
-        self._targets: Dict[str, Dict[str, Optional[str]]] = {}
+        self._targets: Dict[str, Optional[str]] = {}
         self._ledger: Dict[str, Dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # -- watch list ----------------------------------------------------------
 
-    def watch(self, replica_id: str, healthz_url: Optional[str],
-              slo_url: Optional[str] = None) -> None:
+    def watch(self, replica_id: str,
+              snapshot_url: Optional[str]) -> None:
         with self._lock:
-            self._targets[replica_id] = {
-                "healthz": healthz_url, "slo": slo_url,
-            }
+            self._targets[replica_id] = snapshot_url
             self._ledger.setdefault(replica_id, {
                 "polls": 0, "misses": 0, "healthy": None,
                 "slo_verdict": None, "last_error": None,
@@ -224,30 +245,42 @@ class ReplicaSupervisor:
 
     def poll_once(self) -> None:
         """One supervision pass over every watched replica. Fetches run
-        outside the lock; ledger updates inside."""
+        outside the lock; ledger updates inside; the observer is handed
+        each outcome after the ledger settles."""
         with self._lock:
-            targets = {
-                rid: dict(urls) for rid, urls in self._targets.items()
-            }
-        for rid, urls in targets.items():
+            targets = dict(self._targets)
+        for rid, url in targets.items():
+            parsed = None
+            nbytes = None
             healthy = None
             slo_verdict = None
             error = None
             miss = False
             try:
-                if urls["healthz"] is None:
+                if url is None:
                     raise OSError("no endpoint (replica down)")
-                status = self._fetch(urls["healthz"], self.timeout_s)
-                healthy = status == 200
-                if urls["slo"] is not None:
-                    code = self._fetch(urls["slo"], self.timeout_s)
-                    slo_verdict = {200: "ok", 429: "warn", 503: "page"}.get(
-                        code, "unknown"
+                status, body = self._fetch(url, self.timeout_s)
+                if status != 200:
+                    raise ValueError(
+                        f"snapshot endpoint answered {status}, not 200"
                     )
+                parsed = parse_snapshot_wire(json.loads(body))
+                nbytes = len(body)
+                health = parsed.get("health") or {}
+                healthy = bool(health.get("healthy", False))
+                slo_verdict = parsed.get("slo_verdict")
+            except ValueError as e:
+                # answered, unusable (wire-version mismatch, torn JSON):
+                # ALIVE but unhealthy — never a heartbeat miss, never
+                # merged (parse_snapshot_wire's loud-rejection rule)
+                parsed = None
+                healthy = False
+                error = f"unusable snapshot: {e}"
             except Exception as e:  # esr: noqa(ESR012)
-                # transport failure IS the signal: a missed heartbeat —
-                # recorded on the ledger, consumed by the router's
-                # declare-dead transition (never swallowed silently)
+                # invariant: transport failure IS the signal — a missed
+                # heartbeat, recorded on the ledger below and consumed
+                # by the router's declare-dead transition (never
+                # swallowed silently)
                 miss = True
                 error = repr(e)
             with self._lock:
@@ -263,7 +296,19 @@ class ReplicaSupervisor:
                     slot["misses"] = 0
                     slot["healthy"] = healthy
                     slot["slo_verdict"] = slo_verdict
-                    slot["last_error"] = None
+                    slot["last_error"] = error
+            if self._observer is not None:
+                try:
+                    self._observer(
+                        rid, parsed, wire_bytes=nbytes, error=error,
+                        unusable=(not miss and parsed is None),
+                    )
+                except Exception as e:
+                    # the fleet view must never break supervision; the
+                    # failure is logged, not swallowed silently
+                    logger.warning(
+                        "supervisor observer failed for %s: %r", rid, e
+                    )
 
     def verdict(self, replica_id: str) -> Dict:
         """Snapshot verdict: ``alive`` flips False after ``miss_budget``
@@ -352,9 +397,7 @@ class FleetRouter:
         if self._own_poller:
             self.supervisor.start(float(supervise_interval_s))
         for rep in replicas:
-            self.supervisor.watch(
-                rep.replica_id, rep.url("healthz"), rep.url("slo"),
-            )
+            self.supervisor.watch(rep.replica_id, rep.url("snapshot"))
         # replica lifecycle state: up | drained (alive, SLO-evacuated,
         # excluded from placement until its endpoints recover) | dead
         self._state: Dict[str, str] = {
@@ -698,13 +741,13 @@ class FleetRouter:
             # would skip both (the dead-replica streams would strand)
             self.replicas[target].kill()
             self._fault_attrib[target] = spec.fault_id
-            self.supervisor.watch(target, None, None)  # polls now miss
+            self.supervisor.watch(target, None)  # polls now miss
         elif spec.kind == "replica_partition":
             logger.warning("chaos: partitioning replica %s (%s)", target,
                            spec.fault_id)
             self.replicas[target].partition()
             self._fault_attrib[target] = spec.fault_id
-            self.supervisor.watch(target, None, None)
+            self.supervisor.watch(target, None)
 
     def _evacuable(self, replica_id: str) -> int:
         """Streams a drain of ``replica_id`` would actually move: bound

@@ -25,9 +25,10 @@ unchanged. This module is the per-replica half:
 - **the replica lifecycle** — :class:`Replica` owns one engine, its OWN
   telemetry sink (one ``telemetry.jsonl`` per replica — the fleet rollup
   merges them, ``python -m esr_tpu.obs report tel_r0.jsonl tel_r1.jsonl``),
-  and its live plane (``/metrics`` + ``/healthz`` + ``/slo`` on an
-  ephemeral port, health sources namespaced ``@<replica_id>`` so
-  co-resident replicas cannot 503 each other). The router drives it
+  and its live plane (``/metrics`` + ``/healthz`` + ``/slo`` +
+  ``/snapshot`` — the obs v5 wire document the supervisor and fleet view
+  poll — on an ephemeral port, health sources namespaced
+  ``@<replica_id>`` so co-resident replicas cannot 503 each other). The router drives it
   cooperatively: ``pump()`` runs one engine round under this replica's
   sink, ``drain()`` evacuates every stream as wire-format handoff
   packets, ``admit_handoff()`` re-admits one, ``kill()`` simulates an
@@ -281,7 +282,11 @@ class Replica:
     the fleet loop is single-threaded by design, docs/SERVING.md), so
     each replica writes its own ``telemetry.jsonl`` and the fleet rollup
     is an exact merge. The live plane binds an ephemeral loopback port;
-    the router's supervisor polls ``/healthz`` + ``/slo`` over real HTTP.
+    the router's supervisor polls ``/snapshot`` over real HTTP — one
+    fetch carrying the health body, the replica's own ``/slo`` verdict,
+    and the wire-serialized rollup the fleet view merges (obs v5). A
+    killed or partitioned replica closes this plane, so fleet scrapes
+    fail at transport: the staleness signal.
     """
 
     def __init__(

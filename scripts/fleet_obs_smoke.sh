@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Fleet-view smoke: the ISSUE 18 live fleet plane END TO END on CPU
+# (esr_tpu.obs.fleetview) — the versioned /snapshot wire format
+# round-trips sketch-exact, a FleetAggregator scrapes real per-replica
+# live planes over HTTP and merges them into one fleet snapshot in the
+# offline reporter's namespace, staleness budgets exclude dead replicas
+# loudly (never a silent merge), quorum /healthz flips, the bounded
+# `replica` label keeps fleet /metrics Prometheus-parseable, and the
+# advisory desired_replicas signal follows the queue formula with
+# hysteresis. The acceptance pin: the merged live /slo verdict over
+# real serving sessions matches `obs report --slo configs/slo.yml`
+# within the sketch's rel_err.
+#
+# Runs the exact assertions tier-1 enforces (tests/test_fleet_obs.py)
+# as a standalone gate; architecture + knobs: docs/OBSERVABILITY.md
+# "The fleet view" and docs/SERVING.md "The fleet signal".
+#
+# Usage: scripts/fleet_obs_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu ESR_SMOKE_FULL=1 python -m pytest tests/test_fleet_obs.py -q "$@"

@@ -103,6 +103,11 @@ def get_flags():
     p.add_argument("--live-slo", type=str, default="configs/slo.yml",
                    help="SLO YAML the live /slo endpoint burn-rate-"
                         "evaluates (with --live-port)")
+    p.add_argument("--fleet-port", type=int, default=None, metavar="PORT",
+                   help="serve the merged FLEET view (/metrics, /healthz "
+                        "quorum, /slo over merged windows, /fleet "
+                        "topology + desired_replicas) on this port "
+                        "(0 = ephemeral; fleet mode only; default off)")
     p.add_argument("--profile-steps", type=int, default=0, metavar="N",
                    help="capture a jax.profiler device trace over the "
                         "first N dispatched chunks and stamp a "
@@ -317,23 +322,61 @@ def run_fleet(flags, model, params, dataset_config, classes, schedule,
     for rep in replicas:
         print(
             f"# replica {rep.replica_id}: "
-            f"http://127.0.0.1:{rep.port}/{{metrics,healthz,slo}}",
+            f"http://127.0.0.1:{rep.port}/"
+            f"{{metrics,healthz,slo,snapshot}}",
             file=sys.stderr,
         )
     router_sink = TelemetrySink(
         os.path.join(flags.output_path, "telemetry_router.jsonl")
     )
     prev = set_active_sink(router_sink)
+    # the fleet view (obs v5, docs/OBSERVABILITY.md "The fleet view"):
+    # the supervisor's one-fetch-per-replica /snapshot polls feed the
+    # FleetAggregator, so the merged rollup, quorum /healthz, merged
+    # /slo, and the desired_replicas signal cost no extra fetches
+    fleet_plane = None
+    supervisor = None
+    if flags.fleet_port is not None:
+        from esr_tpu.obs.fleetview import FleetAggregator, start_fleet_plane
+        from esr_tpu.serving import ReplicaSupervisor
+
+        fleet_agg = FleetAggregator(scrape_budget=flags.heartbeat_misses)
+        # the router's own ledger records (handoffs, sheds, fail-over
+        # terminals) join the merge beside the scraped replicas
+        from esr_tpu.obs import LiveAggregator
+
+        fleet_agg.attach_local(
+            "router", LiveAggregator().attach(router_sink))
+        supervisor = ReplicaSupervisor(
+            miss_budget=flags.heartbeat_misses,
+            observer=fleet_agg.ingest,
+        )
     router = FleetRouter(
         replicas,
         default_class=flags.default_class,
         failover_budget=flags.failover_retries,
         miss_budget=flags.heartbeat_misses,
         supervise_interval_s=flags.supervise_interval,
+        supervisor=supervisor,
     )
+    if flags.fleet_port is not None:
+        fleet_plane = start_fleet_plane(
+            replicas, port=flags.fleet_port, slo_path=flags.live_slo,
+            fleet=fleet_agg,
+            topology=lambda: {"ring_ownership": router.ring.ownership()},
+        )
+        print(
+            f"# fleet view: http://127.0.0.1:{fleet_plane.port}/"
+            f"{{metrics,healthz,slo,fleet}}",
+            file=sys.stderr,
+        )
     try:
         summary = router.run(arrivals=schedule, max_wall_s=flags.max_wall)
+        if fleet_plane is not None:
+            summary["fleet_view"] = fleet_plane.server.fleet_doc()
     finally:
+        if fleet_plane is not None:
+            fleet_plane.close()
         router.close()
         set_active_sink(prev)
         router_sink.close()

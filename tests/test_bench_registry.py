@@ -36,7 +36,7 @@ def test_stage_registry_names_order_and_timeouts():
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
         "dcn_sparse_ab", "mfu_ceiling", "program_audit",
-        "concurrency_audit", "tier1_budget", "obs_live",
+        "concurrency_audit", "tier1_budget", "obs_live", "fleet_obs",
         "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
         "infer_throughput", "ckpt_overlap", "serve_loadgen",
@@ -144,6 +144,40 @@ def test_obs_live_stage_registered_and_schema_pinned():
         "endpoint_p50_poll_ms", "endpoints_ok", "records",
         "span_families", "seed",
     )
+
+
+def test_fleet_obs_stage_registered_schema_pinned_and_smoke_runs():
+    """ISSUE 18: the fleet view's cost stage — scrape+merge latency over
+    K real replica /snapshot planes, wire bytes per snapshot document,
+    merged-sketch-vs-exact parity, desired_replicas sanity — runs in
+    smoke (host-bound by design) with a pinned schema, and the smoke
+    execution itself must hold the parity bound and reproduce the
+    scaling formula."""
+
+    class _Ctx:
+        smoke = True
+
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "fleet_obs"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_fleet_obs
+    assert timeout >= 300
+    assert in_smoke is True
+    assert bench.FLEET_OBS_KEYS == (
+        "n_replicas", "scrape_merge_p50_ms", "scrape_merge_p99_ms",
+        "merge_overhead_frac", "wire_bytes_per_snapshot",
+        "fleet_rel_err_bound", "fleet_max_rel_err", "parity_ok",
+        "desired_replicas", "desired_expected", "desired_ok",
+        "records", "seed",
+    )
+    rec = bench.stage_fleet_obs(_Ctx())
+    assert tuple(rec.keys()) == bench.FLEET_OBS_KEYS
+    assert rec["n_replicas"] == 3
+    assert rec["scrape_merge_p50_ms"] > 0
+    assert rec["wire_bytes_per_snapshot"] > 0
+    assert 0.0 <= rec["merge_overhead_frac"] <= 1.0
+    assert rec["parity_ok"] is True
+    assert rec["desired_ok"] is True
 
 
 def test_infer_throughput_stage_registered_and_schema_pinned():

@@ -167,58 +167,118 @@ def test_hash_ring_place_honors_exclusions():
 # supervision
 
 
+def _snapshot_body(healthy=True, verdict="ok"):
+    """A minimal valid ``/snapshot`` wire document body (obs v5) — what
+    a healthy replica's live plane answers with."""
+    from esr_tpu.obs.aggregate import LiveAggregator
+
+    doc = LiveAggregator().snapshot_wire(windows=(60.0, 300.0))
+    doc["replica"] = "stub"
+    doc["health"] = {"healthy": healthy, "sources": {}}
+    doc["slo_verdict"] = verdict
+    return json.dumps(doc)
+
+
 def _fake_fetch(responses):
-    """A scripted fetch: ``responses[url]`` is an int status or an
-    exception instance to raise (transport failure = heartbeat miss)."""
+    """A scripted fetch: ``responses[url]`` is a ``(status, body)`` pair
+    or an exception instance to raise (transport failure = heartbeat
+    miss)."""
     def fetch(url, timeout_s):
         r = responses[url]
         if isinstance(r, BaseException):
             raise r
-        return int(r)
+        return r
     return fetch
 
 
 def test_supervisor_healthy_and_slo_verdicts():
-    responses = {"hz": 200, "slo": 429}
+    responses = {"snap": (200, _snapshot_body(True, "warn"))}
     sup = ReplicaSupervisor(miss_budget=2, fetch=_fake_fetch(responses))
-    sup.watch("r0", "hz", "slo")
+    sup.watch("r0", "snap")
     sup.poll_once()
     v = sup.verdict("r0")
     assert v["alive"] and v["healthy"] and v["slo_verdict"] == "warn"
-    responses["hz"] = 503
-    responses["slo"] = 503
+    responses["snap"] = (200, _snapshot_body(False, "page"))
     sup.poll_once()
     v = sup.verdict("r0")
-    assert v["alive"]            # answering 503 is NOT a missed heartbeat
+    assert v["alive"]            # an unhealthy ANSWER is NOT a miss
     assert v["healthy"] is False  # ... but it is unhealthy (drain signal)
     assert v["slo_verdict"] == "page"
 
 
-def test_supervisor_miss_budget_declares_dead_and_recovers():
-    responses = {"hz": OSError("connection refused"), "slo": 200}
+def test_supervisor_unusable_snapshot_alive_but_unhealthy():
+    """A replica that answers with a torn or mis-versioned document is
+    alive (no heartbeat miss) but unhealthy, and the error is loud on
+    the ledger — the never-silently-merged rule, supervisor side."""
+    responses = {"snap": (200, "{not json")}
     sup = ReplicaSupervisor(miss_budget=2, fetch=_fake_fetch(responses))
-    sup.watch("r0", "hz", "slo")
+    sup.watch("r0", "snap")
+    sup.poll_once()
+    v = sup.verdict("r0")
+    assert v["alive"] and v["healthy"] is False
+    assert "unusable snapshot" in v["last_error"]
+    body = json.loads(_snapshot_body())
+    body["version"] = 99
+    responses["snap"] = (200, json.dumps(body))
+    sup.poll_once()
+    v = sup.verdict("r0")
+    assert v["alive"] and v["healthy"] is False
+    assert "version" in v["last_error"]
+
+
+def test_supervisor_single_fetch_feeds_observer():
+    """The dedup contract: ONE fetch per replica per poll serves both
+    death detection and the fleet view (the observer receives every
+    parsed document / miss)."""
+    calls = []
+    body = _snapshot_body(True, "ok")
+
+    def fetch(url, timeout_s):
+        calls.append(url)
+        if url == "dead":
+            raise OSError("connection refused")
+        return 200, body
+
+    seen = []
+
+    def observer(rid, parsed, wire_bytes=None, error=None, unusable=False):
+        seen.append((rid, parsed is not None, unusable))
+
+    sup = ReplicaSupervisor(miss_budget=2, fetch=fetch, observer=observer)
+    sup.watch("r0", "snap0")
+    sup.watch("r1", "snap1")
+    sup.watch("r2", "dead")
+    sup.poll_once()
+    assert len(calls) == 3          # one fetch per replica per poll
+    assert sorted(seen) == [("r0", True, False), ("r1", True, False),
+                            ("r2", False, False)]
+
+
+def test_supervisor_miss_budget_declares_dead_and_recovers():
+    responses = {"snap": OSError("connection refused")}
+    sup = ReplicaSupervisor(miss_budget=2, fetch=_fake_fetch(responses))
+    sup.watch("r0", "snap")
     assert sup.verdict("r0")["alive"]   # grace before the first poll
     sup.poll_once()
     assert sup.verdict("r0")["alive"]   # one miss < budget
     sup.poll_once()
     v = sup.verdict("r0")
     assert not v["alive"] and v["misses"] == 2
-    responses["hz"] = 200               # a successful contact resets
+    responses["snap"] = (200, _snapshot_body())  # contact resets
     sup.poll_once()
     assert sup.verdict("r0")["alive"] and sup.verdict("r0")["misses"] == 0
 
 
 def test_supervisor_poller_thread_polls_and_stops():
     polls = []
-    responses = {"hz": 200}
+    body = _snapshot_body()
 
     def fetch(url, timeout_s):
         polls.append(url)
-        return 200
+        return 200, body
 
     sup = ReplicaSupervisor(miss_budget=2, fetch=fetch)
-    sup.watch("r0", "hz", None)
+    sup.watch("r0", "snap")
     sup.start(interval_s=0.02)
     deadline = time.monotonic() + 5.0
     while not polls and time.monotonic() < deadline:
@@ -289,7 +349,7 @@ def _router(replicas, **kw):
     from esr_tpu.serving.fleet import FleetRouter
 
     kw.setdefault("supervisor", ReplicaSupervisor(
-        miss_budget=2, fetch=lambda url, t: 200,
+        miss_budget=2, fetch=lambda url, t: (200, _snapshot_body()),
     ))
     return FleetRouter(replicas, **kw)
 

@@ -1,0 +1,573 @@
+"""The fleet view (obs v5, ISSUE 18 / docs/OBSERVABILITY.md "The fleet
+view"):
+
+- snapshot wire format: serialize -> parse -> merge equals the
+  in-process merge bucket-for-bucket, and a version-mismatched or torn
+  document is rejected loudly (never half-merged);
+- the per-replica ``/snapshot`` endpoint serves the wire document over
+  HTTP (windows pinned via ``?window_s=``; junk answers 400);
+- staleness: a replica missing its scrape budget is excluded from every
+  merge WITH an annotation — transport misses tolerate the budget on
+  the last good document, an answered-but-unparseable reply does not;
+- quorum ``/healthz`` flips 200 -> 503 when the fresh-and-healthy
+  fraction drops below the threshold;
+- fleet ``/metrics`` stays parseable Prometheus v0.0.4 with the
+  ``replica`` label bounded by the watched ledger (ESR013);
+- the advisory ``desired_replicas`` signal follows its queue formula
+  with hold-N hysteresis;
+- THE acceptance pin: the fleet snapshot over K replica sinks matches
+  the offline multi-path ``obs report`` on the same JSONL within the
+  sketch rel_err bound, and the fleet ``/slo`` verdict agrees with
+  ``obs report --slo`` — on synthetic sink-replay AND on a real
+  flagship serving session (session fixtures, seconds-scale).
+"""
+
+import json
+import os
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from esr_tpu.obs import (
+    LiveAggregator,
+    TelemetrySink,
+    parse_snapshot_wire,
+    set_active_sink,
+    trace,
+)
+from esr_tpu.obs.aggregate import SNAPSHOT_WIRE_VERSION
+from esr_tpu.obs.fleetview import (
+    FleetAggregator,
+    FleetTelemetryServer,
+    ScalingPolicy,
+    start_fleet_plane,
+)
+from esr_tpu.obs.http import start_live_plane
+from esr_tpu.obs.report import report_files
+
+REL_ERR = 0.01
+# tiny replay for tier-1 wall; scripts/fleet_obs_smoke.sh exports
+# ESR_SMOKE_FULL=1 for the production smoke shape
+N_CHUNKS = 160 if os.environ.get("ESR_SMOKE_FULL") else 40
+SLO_YML = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "configs", "slo.yml",
+)
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (NaN|[-+0-9.eE]+)$"
+)
+
+
+def _get(url, timeout=10):
+    import urllib.error
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _replay_session(sink, seed=7, prefix="req"):
+    """One deterministic mini serving session (the test_obs_live replay,
+    parameterized so K replicas produce disjoint requests): chunk spans
+    with begin/end edges, 3 classed requests, roots + terminals,
+    counters + gauges — every record kind the fleet merge rolls up."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    for chunk in range(N_CHUNKS):
+        seconds = float(rng.lognormal(mean=-3.5, sigma=0.8))
+        t += seconds
+        sink.span(
+            "serve_chunk", seconds, span_id=trace.new_id(),
+            begin=round(t - seconds, 6), end=round(t, 6), chunk=chunk,
+            windows=4, lanes=2, occupancy=2, queue_depth=1,
+        )
+    for i, cls in ((0, "interactive"), (1, "standard"), (2, "standard")):
+        rid = f"{prefix}-{i}"
+        root = trace.new_id()
+        for chunk in range(30):
+            lat = float(rng.lognormal(mean=-3.0, sigma=1.0))
+            sink.span(
+                "serve_chunk_part", lat, trace_id=f"tr-{rid}",
+                span_id=trace.new_id(), parent_id=root,
+                request=rid, cls=cls, chunk=chunk, lane=i % 2,
+                windows=int(rng.integers(1, 4)),
+            )
+        sink.span(
+            "serve_request", 1.0, trace_id=f"tr-{rid}", span_id=root,
+            parent_id=None, request=rid, cls=cls, windows=30,
+            preemptions=0, completed=True,
+        )
+        sink.event(
+            "serve_request_done", request=rid, trace_id=f"tr-{rid}",
+            parent_id=root, cls=cls, windows=30, preemptions=0,
+            completed=True, status="ok",
+        )
+    sink.counter("serve_backpressure", inc=0)
+    sink.gauge("serve_queue_depth", 2)
+
+
+def _wire_body(queue=None, healthy=True, verdict="ok", rel_err=REL_ERR,
+               seed=0, n=60, replica="rX"):
+    """A realistic serialized /snapshot body built through a real
+    aggregator (no hand-rolled documents drifting from the format)."""
+    agg = LiveAggregator(rel_err=rel_err)
+    rng = np.random.default_rng(seed)
+    for v in rng.lognormal(mean=-4.0, sigma=0.8, size=n):
+        agg.observe({"type": "span", "name": "bench_span",
+                     "seconds": float(v)})
+    if queue is not None:
+        agg.observe({"type": "gauge", "name": "serve_queue_depth",
+                     "value": queue})
+    doc = agg.snapshot_wire(windows=(60.0, 300.0))
+    doc["replica"] = replica
+    doc["health"] = {"healthy": healthy, "sources": {}}
+    doc["slo_verdict"] = verdict
+    return json.dumps(doc)
+
+
+# ---------------------------------------------------------------------------
+# the wire format
+
+
+def test_snapshot_wire_round_trip_merge_equals_in_process(tmp_path):
+    """serialize -> JSON -> parse -> merge must equal merging the same
+    aggregators in-process: identical span quantiles (bucket-exact, not
+    merely close), identical counters/serving totals/traces."""
+    aggs = []
+    for k in range(3):
+        sink = TelemetrySink(str(tmp_path / f"r{k}.jsonl"))
+        agg = LiveAggregator(rel_err=REL_ERR).attach(sink)
+        _replay_session(sink, seed=10 + k, prefix=f"r{k}")
+        sink.close()
+        aggs.append(agg)
+
+    over_wire = FleetAggregator(rel_err=REL_ERR)
+    in_process = FleetAggregator(rel_err=REL_ERR)
+    for k, agg in enumerate(aggs):
+        body = json.dumps(agg.snapshot_wire(windows=(60.0, 300.0)))
+        over_wire.watch(f"r{k}", f"fake://r{k}")
+        over_wire.ingest(f"r{k}", parse_snapshot_wire(json.loads(body)),
+                         wire_bytes=len(body))
+        in_process.attach_local(f"r{k}", agg)
+
+    wired = over_wire.snapshot()
+    direct = in_process.snapshot()
+    assert wired["fleet"]["excluded"] == {}
+    assert sorted(wired["fleet"]["merged"]) == ["r0", "r1", "r2"]
+    assert wired["counters"] == direct["counters"]
+    assert wired["events"] == direct["events"]
+    assert wired["serving"] == direct["serving"]
+    assert wired["traces"] == direct["traces"]
+    assert set(wired["spans"]) == set(direct["spans"])
+    for fam, dv in direct["spans"].items():
+        wv = wired["spans"][fam]
+        assert wv["count"] == dv["count"], fam
+        for key in ("p50_ms", "p99_ms", "max_ms", "total_s"):
+            assert wv[key] == dv[key], (fam, key)
+    assert wired["goodput"]["value"] == pytest.approx(
+        direct["goodput"]["value"], rel=1e-9
+    )
+
+
+def test_snapshot_wire_version_mismatch_and_torn_doc_rejected():
+    doc = json.loads(_wire_body())
+    assert doc["version"] == SNAPSHOT_WIRE_VERSION
+    bad = dict(doc)
+    bad["version"] = 99
+    with pytest.raises(ValueError, match="version"):
+        parse_snapshot_wire(bad)
+    torn = dict(doc)
+    del torn["state"]
+    with pytest.raises(ValueError, match="torn"):
+        parse_snapshot_wire(torn)
+    # a rejected document never half-lands in a fleet merge
+    fleet = FleetAggregator(rel_err=REL_ERR)
+    fleet.watch("r0", "fake://r0")
+    fleet.ingest("r0", None, error="snapshot wire version 99", unusable=True)
+    _st, merged, excluded = fleet.merged_state()
+    assert merged == []
+    assert excluded == {"r0": "no_parseable_snapshot"}
+
+
+def test_mismatched_rel_err_refused_loudly():
+    fleet = FleetAggregator(rel_err=REL_ERR)
+    fleet.watch("r0", "fake://r0")
+    parsed = parse_snapshot_wire(json.loads(_wire_body(rel_err=0.05)))
+    fleet.ingest("r0", parsed)
+    table = fleet.replica_table()
+    assert table["r0"]["stale"] is True
+    assert "rel_err" in table["r0"]["last_error"]
+    _st, merged, excluded = fleet.merged_state()
+    assert merged == [] and "r0" in excluded
+
+
+# ---------------------------------------------------------------------------
+# the /snapshot endpoint
+
+
+def test_snapshot_endpoint_serves_wire_doc(tmp_path):
+    sink = TelemetrySink(str(tmp_path / "t.jsonl"))
+    plane = start_live_plane(sink, port=0, slo_path=SLO_YML, ns="r7")
+    try:
+        _replay_session(sink, seed=3, prefix="r7")
+        base = f"http://127.0.0.1:{plane.port}"
+        status, body = _get(base + "/snapshot?window_s=60,300")
+        assert status == 200
+        parsed = parse_snapshot_wire(json.loads(body))
+        assert parsed["version"] == SNAPSHOT_WIRE_VERSION
+        assert parsed["rel_err"] == REL_ERR
+        assert sorted(parsed["windows"]) == [60.0, 300.0]
+        assert parsed["replica"] == "r7"
+        assert parsed["health"]["healthy"] is True
+        assert parsed["slo_verdict"] in ("ok", "warn", "page")
+        assert parsed["state"].requests == 3
+        # junk windows answer 400, not a stack trace
+        status, _ = _get(base + "/snapshot?window_s=sixty")
+        assert status == 400
+        # /snapshot is advertised on the 404 endpoint list
+        status, body = _get(base + "/nope")
+        assert status == 404 and "/snapshot" in body
+    finally:
+        plane.close()
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+# staleness + quorum
+
+
+def test_staleness_budget_tolerance_then_exclusion():
+    """Transport misses keep merging the LAST GOOD document until the
+    scrape budget runs out; at budget the replica is excluded with the
+    annotation (never silently merged)."""
+    answers = {"r0": (200, _wire_body(seed=1)),
+               "r1": (200, _wire_body(seed=2))}
+
+    def fetch(url, timeout_s):
+        rid = url.split("//")[1].split("/")[0]
+        if answers[rid] is None:
+            raise ConnectionError("down")
+        return answers[rid]
+
+    fleet = FleetAggregator(rel_err=REL_ERR, scrape_budget=2, fetch=fetch)
+    fleet.watch("r0", "fake://r0/snapshot")
+    fleet.watch("r1", "fake://r1/snapshot")
+    assert fleet.scrape_once() == {"r0": True, "r1": True}
+    _st, merged, excluded = fleet.merged_state()
+    assert sorted(merged) == ["r0", "r1"] and excluded == {}
+
+    answers["r1"] = None          # r1 drops off the network
+    fleet.scrape_once()           # miss 1 of 2: last good still merges
+    _st, merged, excluded = fleet.merged_state()
+    assert sorted(merged) == ["r0", "r1"] and excluded == {}
+    table = fleet.replica_table()
+    assert table["r1"]["misses"] == 1 and table["r1"]["stale"] is False
+
+    fleet.scrape_once()           # miss 2 of 2: budget exhausted
+    _st, merged, excluded = fleet.merged_state()
+    assert merged == ["r0"]
+    assert excluded == {"r1": "scrape_budget_exhausted"}
+    assert fleet.replica_table()["r1"]["stale"] is True
+    # a watched-but-never-scraped replica is annotated as such
+    fleet.watch("r2", None)
+    assert fleet.merged_state()[2]["r2"] == "never_scraped"
+
+
+def test_quorum_healthz_flips_on_staleness():
+    answers = {f"r{i}": (200, _wire_body(seed=i)) for i in range(3)}
+
+    def fetch(url, timeout_s):
+        rid = url.split("//")[1].split("/")[0]
+        if answers[rid] is None:
+            raise ConnectionError("down")
+        return answers[rid]
+
+    fleet = FleetAggregator(rel_err=REL_ERR, scrape_budget=2, fetch=fetch)
+    for i in range(3):
+        fleet.watch(f"r{i}", f"fake://r{i}/snapshot")
+    server = FleetTelemetryServer(fleet, quorum=0.5)  # bodies only
+    fleet.scrape_once()
+    status, doc = server.healthz_doc()
+    assert status == 200
+    assert doc["healthy"] is True and doc["fraction"] == 1.0
+
+    answers["r1"] = answers["r2"] = None
+    fleet.scrape_once()
+    fleet.scrape_once()           # budget out: 1/3 fresh-and-healthy
+    status, doc = server.healthz_doc()
+    assert status == 503 and doc["healthy"] is False
+    assert doc["replicas"]["r1"]["stale"] is True
+    # an empty watch list has no quorum to claim
+    empty = FleetTelemetryServer(FleetAggregator(), quorum=0.5)
+    assert empty.healthz_doc()[0] == 503
+
+
+# ---------------------------------------------------------------------------
+# fleet /metrics (ESR013: bounded replica label)
+
+
+def test_fleet_metrics_prometheus_parse_and_bounded_replica_label():
+    fleet = FleetAggregator(rel_err=REL_ERR)
+    watched = {"r0", "r1", "r2"}
+    for i, rid in enumerate(sorted(watched)):
+        fleet.watch(rid, f"fake://{rid}/snapshot")
+        fleet.ingest(rid, parse_snapshot_wire(
+            json.loads(_wire_body(seed=i, queue=i, replica=rid))))
+    page = FleetTelemetryServer(fleet).metrics_page()
+    label = re.compile(r'\{replica="([^"]+)"\}')
+    seen = set()
+    samples = 0
+    for line in page.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), line
+        samples += 1
+        m = label.search(line)
+        if m:
+            seen.add(m.group(1))
+    # the replica label vocabulary is exactly the watched ledger —
+    # bounded by fleet configuration, never per-request (ESR013)
+    assert seen == watched
+    assert samples > 10
+    assert "esr_fleet_desired_replicas" in page
+    assert "esr_fleet_replicas_watched 3.0" in page
+
+
+# ---------------------------------------------------------------------------
+# the scaling signal
+
+
+def test_desired_replicas_queue_formula_with_hysteresis():
+    policy = ScalingPolicy(target_queue_per_replica=4.0, min_replicas=1,
+                           max_replicas=8, hold_polls=2)
+    fleet = FleetAggregator(rel_err=REL_ERR, policy=policy)
+    fleet.watch("r0", "fake://r0")
+    fleet.watch("r1", "fake://r1")
+
+    def round_with(queue):
+        for i, rid in enumerate(("r0", "r1")):
+            fleet.ingest(rid, parse_snapshot_wire(json.loads(
+                _wire_body(seed=i, queue=queue, replica=rid))))
+
+    round_with(2)                 # total 4 -> raw 1; first tick seeds
+    sig = fleet.scaling_signal()
+    assert sig["desired_replicas"] == 1 and sig["queue_depth"] == 4.0
+    round_with(8)                 # total 16 -> raw 4; hold 1 of 2
+    sig = fleet.scaling_signal()
+    assert sig["desired_replicas"] == 1
+    assert sig["pending"] == 4 and sig["pending_polls"] == 1
+    round_with(8)                 # hold 2 of 2: the advice moves
+    sig = fleet.scaling_signal()
+    assert sig["desired_replicas"] == 4 and sig["pending"] is None
+    round_with(2)                 # a single calm round must NOT flap
+    assert fleet.scaling_signal()["desired_replicas"] == 4
+
+
+def test_burning_replica_bumps_desired_above_healthy():
+    fleet = FleetAggregator(rel_err=REL_ERR, policy=ScalingPolicy(
+        target_queue_per_replica=100.0, hold_polls=1))
+    fleet.watch("r0", "fake://r0")
+    fleet.ingest("r0", parse_snapshot_wire(json.loads(
+        _wire_body(queue=0, verdict="page"))))
+    sig = fleet.scaling_signal()
+    assert sig["page"] is True
+    assert sig["desired_replicas"] == 2  # healthy + 1, not queue-derived
+
+
+def test_scaling_policy_from_yaml(tmp_path):
+    path = tmp_path / "scale.yml"
+    path.write_text(
+        "schema: 1\ntarget_queue_per_replica: 6\nmin_replicas: 2\n"
+        "max_replicas: 5\nhold_polls: 3\n"
+        "class_p99_target_ms:\n  interactive: 250\n"
+    )
+    pol = ScalingPolicy.from_yaml(str(path))
+    assert (pol.target_queue_per_replica, pol.min_replicas,
+            pol.max_replicas, pol.hold_polls) == (6.0, 2, 5, 3)
+    assert pol.class_p99_target_ms == {"interactive": 250.0}
+    bad = tmp_path / "bad.yml"
+    bad.write_text("schema: 2\n")
+    with pytest.raises(ValueError, match="schema"):
+        ScalingPolicy.from_yaml(str(bad))
+    # the shipped policy file must stay loadable and self-consistent
+    shipped = ScalingPolicy.from_yaml(
+        os.path.join(os.path.dirname(SLO_YML), "fleet_scale.yml"))
+    assert 1 <= shipped.min_replicas <= shipped.max_replicas
+    assert shipped.hold_polls >= 1
+
+
+def test_fleet_snapshot_endpoint_composes():
+    """The fleet plane's own ``/snapshot`` serves the MERGED state in
+    the replica wire format — a higher-level aggregator scrapes a fleet
+    exactly like a replica (fleet views compose), bucket-exactly."""
+    fleet = FleetAggregator(rel_err=REL_ERR)
+    for i in range(2):
+        body = _wire_body(seed=40 + i, replica=f"r{i}")
+        fleet.watch(f"r{i}", None)
+        fleet.ingest(f"r{i}", parse_snapshot_wire(json.loads(body)),
+                     wire_bytes=len(body))
+    plane = start_fleet_plane([], port=0, fleet=fleet)
+    try:
+        base = f"http://127.0.0.1:{plane.port}"
+        status, body = _get(base + "/snapshot?window_s=60,300")
+        assert status == 200
+        parsed = parse_snapshot_wire(json.loads(body))
+        assert sorted(parsed["windows"]) == [60.0, 300.0]
+        upper = FleetAggregator(rel_err=REL_ERR)
+        upper.watch("fleet0", None)
+        upper.ingest("fleet0", parsed, wire_bytes=len(body))
+        resnap = upper.snapshot()
+        direct = fleet.snapshot()
+        assert resnap["counters"] == direct["counters"]
+        assert resnap["serving"] == direct["serving"]
+        for fam, dv in direct["spans"].items():
+            assert resnap["spans"][fam] == dv, fam
+        # junk query answers 400, never a torn document
+        status, _ = _get(base + "/snapshot?window_s=sixty")
+        assert status == 400
+        # and the 404 catalog advertises the endpoint
+        status, body = _get(base + "/nope")
+        assert status == 404 and "/snapshot" in body
+    finally:
+        plane.close()
+
+
+# ---------------------------------------------------------------------------
+# THE pin: fleet live vs offline, and /slo agreement
+
+
+def test_fleet_snapshot_matches_offline_multipath_report(tmp_path):
+    """The acceptance criterion: the FleetAggregator snapshot over K
+    replica sinks (scraped over real HTTP) matches the multi-path
+    ``obs report`` on the same JSONL files within the sketch rel_err
+    bound, and the fleet ``/slo`` verdict agrees with
+    ``obs report --slo`` on the same gate file."""
+    planes, sinks, args = [], [], []
+    fleet = FleetAggregator(rel_err=REL_ERR)
+    try:
+        for k in range(3):
+            path = str(tmp_path / f"r{k}.jsonl")
+            sink = TelemetrySink(path)
+            plane = start_live_plane(sink, port=0, slo_path=SLO_YML,
+                                     ns=f"r{k}")
+            _replay_session(sink, seed=20 + k, prefix=f"r{k}")
+            sinks.append(sink)
+            planes.append(plane)
+            args.append(f"r{k}={path}")
+            fleet.watch(f"r{k}",
+                        f"http://127.0.0.1:{plane.port}/snapshot")
+        assert all(fleet.scrape_once().values())
+        live = fleet.snapshot()
+        server = FleetTelemetryServer(fleet, slo_path=SLO_YML)
+        _status, live_slo = server.slo_doc()
+    finally:
+        for plane in planes:
+            plane.close()
+        for sink in sinks:
+            sink.close()
+
+    doc, code = report_files(args, SLO_YML)
+    offline = doc["report"]
+
+    assert live["fleet"]["excluded"] == {}
+    assert sorted(live["fleet"]["merged"]) == ["r0", "r1", "r2"]
+    # exact agreement on counted things
+    assert live["counters"] == offline["counters"]
+    for key in ("requests", "completed", "errors", "windows",
+                "statuses"):
+        assert live["serving"][key] == offline["serving"][key], key
+    assert live["serving"]["requests"] == 9
+    assert live["traces"]["incomplete"] == offline["traces"]["incomplete"]
+    # sketch-backed percentiles within the declared bound
+    for fam, ol in offline["spans"].items():
+        lv = live["spans"][fam]
+        assert lv["count"] == ol["count"], fam
+        for key in ("p50_ms", "p99_ms"):
+            assert lv[key] == pytest.approx(ol[key], rel=REL_ERR), (
+                fam, key, lv[key], ol[key],
+            )
+    for cls, ol in offline["serving"]["classes"].items():
+        lv = live["serving"]["classes"][cls]
+        assert lv["windows"] == ol["windows"]
+        for key in ("window_latency_p50_ms", "window_latency_p99_ms"):
+            assert lv[key] == pytest.approx(ol[key], rel=REL_ERR), (
+                cls, key,
+            )
+    # the verdict agreement: fleet /slo "ok" iff the offline gate exits 0
+    assert (live_slo["verdict"] == "ok") == (code == 0)
+    assert live_slo["verdict"] == "ok" and code == 0
+
+
+def test_fleet_view_over_real_serving_replicas(
+    shared_stream_corpus, warmed_programs, tmp_path
+):
+    """The fleet view over two REAL flagship serving sessions (session
+    fixtures: warm chunk programs, shared corpus — seconds-scale):
+    scrape both live planes over HTTP, merge, and pin the merged /slo
+    verdict against the offline reporter on the same files."""
+    from esr_tpu.serving import RequestClass, ServingEngine
+
+    classes = {
+        "interactive": RequestClass("interactive", chunk_windows=2),
+        "standard": RequestClass("standard", chunk_windows=4),
+    }
+    dataset_cfg = {
+        "scale": 2, "ori_scale": "down8", "time_bins": 1,
+        "mode": "events", "window": 1024, "sliding_window": 512,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {"sequence_length": 4, "seqn": 3, "step_size": None,
+                     "pause": {"enabled": False}},
+    }
+    fleet = FleetAggregator(rel_err=REL_ERR)
+    args = []
+    for k in range(2):
+        path = str(tmp_path / f"replica{k}.jsonl")
+        sink = TelemetrySink(path)
+        plane = start_live_plane(sink, port=0, slo_path=SLO_YML,
+                                 ns=f"replica{k}")
+        prev = set_active_sink(sink)
+        try:
+            engine = ServingEngine(
+                warmed_programs["model"], warmed_programs["params"],
+                dataset_cfg, lanes=2, classes=classes,
+                default_class="standard",
+            )
+            for i, cls in enumerate(("interactive", "standard")):
+                engine.submit(shared_stream_corpus[2 * k + i], cls,
+                              request_id=f"replica{k}-q{i}")
+            engine.run(max_wall_s=120.0)
+            fleet.watch(f"replica{k}",
+                        f"http://127.0.0.1:{plane.port}/snapshot")
+            assert fleet.scrape_once()[f"replica{k}"] is True
+        finally:
+            set_active_sink(prev)
+            plane.close()
+            sink.close()
+        args.append(f"replica{k}={path}")
+
+    live = fleet.snapshot()
+    _status, live_slo = FleetTelemetryServer(fleet,
+                                             slo_path=SLO_YML).slo_doc()
+    doc, code = report_files(args, SLO_YML)
+    offline = doc["report"]
+
+    assert live["fleet"]["excluded"] == {}
+    assert live["serving"]["requests"] == 4
+    assert live["serving"]["requests"] == offline["serving"]["requests"]
+    assert live["serving"]["errors"] == offline["serving"]["errors"] == 0
+    assert live["serving"]["statuses"] == offline["serving"]["statuses"]
+    for cls in ("interactive", "standard"):
+        lv = live["serving"]["classes"][cls]
+        ol = offline["serving"]["classes"][cls]
+        assert lv["windows"] == ol["windows"], cls
+        assert lv["window_latency_p99_ms"] == pytest.approx(
+            ol["window_latency_p99_ms"], rel=REL_ERR), cls
+    assert (live_slo["verdict"] == "ok") == (code == 0)
+    assert live_slo["verdict"] == "ok" and code == 0

@@ -16,7 +16,12 @@ The acceptance contract (ISSUE 15 / docs/SERVING.md "The fleet"):
 - migrated/failed-over streams match the unfaulted single-engine twin's
   per-request metric means within 1e-5 rel;
 - the merged ``obs report --slo configs/slo_fleet.yml`` over every
-  telemetry file exits 0.
+  telemetry file exits 0;
+- (ISSUE 18) the LIVE fleet view scrapes THROUGH the faults: dead
+  replicas flip stale and are excluded with an annotation, survivors +
+  the router's local stream keep merging, and the merged live ``/slo``
+  verdict agrees with the offline reporter over router + survivor
+  files.
 """
 
 import glob
@@ -119,6 +124,36 @@ def test_merged_report_slo_green_with_replica_rows(fleet_run):
     # fleet windows = sum of final terminals only (migrated/replica_lost
     # attempt-terminals must not double-count)
     assert report["serving"]["windows"] == summary["summary"]["windows"]
+
+
+def test_fleet_view_scrapes_through_faults(fleet_run):
+    """ISSUE 18: the live fleet plane ran THROUGH kill/partition — the
+    dead replicas flipped STALE and were excluded with an annotation
+    (never silently merged), the survivor and the router's own ledger
+    stream made it into the final merge, and the merged live /slo
+    verdict agreed with the offline reporter over router + survivor
+    telemetry."""
+    summary, _ = fleet_run
+    checks = summary["checks"]
+    assert checks["fleet_killed_stale"]
+    assert checks["fleet_survivors_merged"]
+    assert checks["fleet_slo_matches_offline"]
+    view = summary["fleet_view"]
+    dead = sorted(rid for rid, st in summary["summary"]["replicas"].items()
+                  if st == "dead")
+    assert dead, summary["summary"]["replicas"]
+    for rid in dead:
+        assert view["replicas"][rid]["stale"] is True, rid
+        assert view["excluded"][rid] == "scrape_budget_exhausted", rid
+    assert "local:router" in view["merged"]
+    # the router's ring topology rides /fleet: ownership sums to one
+    own = view["topology"]["ring_ownership"]
+    assert abs(sum(own.values()) - 1.0) < 1e-5, own
+    # the scaling signal kept ticking across the faults and stayed sane
+    sig = view["scaling"]
+    assert sig["ticks"] >= 1
+    assert sig["desired_replicas"] >= 1
+    assert summary["fleet_slo"]["verdict"] == "ok"
 
 
 def test_scenario_ok(fleet_run):
