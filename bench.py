@@ -350,18 +350,38 @@ def _flops_of(step_fn, state, batch):
 # ---------------------------------------------------------------- stages
 
 
+def _probe_budget():
+    """Backend bring-up budget ``(attempt_timeout_s, attempts)``: the same
+    env knobs the entry points honor (``ESR_BACKEND_PROBE_TIMEOUT_S`` /
+    ``ESR_BACKEND_PROBE_ATTEMPTS``), with a seconds-scale default when
+    ``JAX_PLATFORMS`` pins the run to CPU — a local CPU client cannot
+    legitimately take minutes, and a CPU smoke run must degrade to the
+    capture path in seconds instead of burning the full 600s outer
+    watchdog before exiting 2 (the observed dead-end this replaces)."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    cpu_only = bool(plats) and all(
+        p.strip() == "cpu" for p in plats.split(",") if p.strip()
+    )
+    default_t = 15.0 if cpu_only else 150.0
+    t = float(os.environ.get("ESR_BACKEND_PROBE_TIMEOUT_S", default_t))
+    n = int(os.environ.get("ESR_BACKEND_PROBE_ATTEMPTS", 3))
+    return t, n
+
+
 def stage_backend_up():
     """Backend contact with a BOUNDED bring-up: per-attempt watchdog +
     retry + cached device probe (``utils/artifacts.probe_backend_bounded``)
-    — the 600s stage watchdog becomes the outer belt, not the only line.
+    — the stage watchdog becomes the outer belt, not the only line.
     The observed wedge (``make_c_api_client`` hanging forever) nulled every
-    MULTICHIP artifact since r2; now a hung attempt is abandoned at 150s,
-    retried twice, and a fully failed bring-up still reports the last
-    cached device identity instead of nothing."""
+    MULTICHIP artifact since r2; now a hung attempt is abandoned at the
+    env-tunable per-attempt budget (:func:`_probe_budget`), retried, and a
+    fully failed bring-up still reports the last cached device identity
+    instead of nothing."""
     from esr_tpu.utils.artifacts import probe_backend_bounded
 
+    attempt_timeout_s, attempts = _probe_budget()
     return probe_backend_bounded(
-        attempt_timeout_s=150.0, attempts=3,
+        attempt_timeout_s=attempt_timeout_s, attempts=attempts,
         cache_path=os.path.join(
             os.path.dirname(_REAL_STAGELOG), "DEVICE_PROBE.json"
         ),
@@ -1150,6 +1170,168 @@ def stage_dcn_sparse_ab(ctx):
         seed,
     ), strict=True))
     EXTRA["dcn_sparse_ab"] = dict(res)
+    return res
+
+
+# The precision_ladder stage record schema, pinned by test_bench_registry
+# (ISSUE 19): the bf16 rung's step-time delta against f32, host-vs-device
+# rasterization cost per window with the bitwise-parity verdict, the bf16
+# programs' jaxpr-audit evidence (JX001-clean + the bfloat16->float32
+# share of executed contraction flops) and the drift-harness verdict —
+# every rung claim lands as a bench delta, not prose.
+PRECISION_LADDER_KEYS = (
+    "f32_steps_per_sec", "bf16_steps_per_sec", "bf16_step_speedup",
+    "host_encode_ms_per_window", "device_encode_ms_per_window",
+    "device_encode_speedup", "device_encode_bitwise_ok",
+    "audit_bf16_findings", "audit_bf16_clean", "audit_bf16_flops_frac",
+    "drift_max_rel_err", "drift_first_offender", "drift_ok",
+    "timing", "seed",
+)
+
+
+def stage_precision_ladder(ctx):
+    """The precision ladder (ISSUE 19): f32 vs bf16 on the SAME train
+    step, host vs device rasterization of the SAME seeded event windows.
+
+    Four cells, each with its own evidence discipline:
+
+    - step timing (TPU only — interpreter timings are meaningless): the
+      production ``make_train_step`` at f32 and at the bf16 rung
+      (``compute_dtype=bfloat16``, f32 masters), fresh param copies for
+      each (both rungs donate their TrainState);
+    - rasterization placement: per-window encode cost of the host
+      np/C++ path (always measured — it is host-bound by definition) vs
+      the jitted ``make_device_encoder`` batch program (TPU only), plus
+      the BITWISE count-image parity that makes ``encode:`` a pure
+      placement knob — parity runs in CPU smoke;
+    - the bf16 rungs' jaxpr audits (device-free, runs in smoke):
+      findings must be zero with JX001 enforced, and the
+      ``bfloat16->float32`` share of executed contraction flops is the
+      per-program adoption series;
+    - the drift-harness verdict at a fixed tiny scale: max ladder
+      rel-err, first offender (none expected), tolerance-judged ok.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.analysis.programs import (
+        audit_production_programs,
+        production_programs,
+    )
+    from esr_tpu.data.np_encodings import events_to_channels_np
+    from esr_tpu.obs.numerics import run_drift
+    from esr_tpu.ops.encodings import make_device_encoder
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    on_tpu = jax.default_backend() != "cpu"
+    seed = 0
+    rng = np.random.default_rng(seed)
+
+    # --- step timing: f32 vs bf16, fresh copies (both rungs donate) ----
+    f32_sps = bf16_sps = step_speedup = None
+    if on_tpu:
+        s32 = TrainState.create(
+            jax.tree.map(jnp.array, ctx.params_scan), ctx.opt)
+        s16 = TrainState.create(
+            jax.tree.map(jnp.array, ctx.params_scan), ctx.opt)
+        step16 = jax.jit(
+            make_train_step(ctx.model, ctx.opt, seqn=ctx.seqn,
+                            compute_dtype=jnp.bfloat16),
+            donate_argnums=(0,),
+        )
+        t32, _ = _time_steps(ctx.step, s32, ctx.batch)
+        t16, _ = _time_steps(step16, s16, ctx.batch)
+        f32_sps, bf16_sps = round(t32, 3), round(t16, 3)
+        step_speedup = round(t16 / t32, 3)
+
+    # --- rasterization: seeded raw-event windows, host twin vs device --
+    b, l = 2, 4
+    n = 512 if ctx.smoke else 4096
+    kh, kw = ctx.h, ctx.w
+    xn = rng.random((b, l, n), dtype=np.float32)
+    yn = rng.random((b, l, n), dtype=np.float32)
+    ts = np.sort(rng.random((b, l, n), dtype=np.float32), axis=-1)
+    ps = rng.choice(np.float32([-1.0, 1.0]), size=(b, l, n))
+    n_val = rng.integers(n // 2, n + 1, size=(b, l))
+    valid = (np.arange(n)[None, None, :] < n_val[..., None]).astype(
+        np.float32)
+    gx = rng.random((b, l, n), dtype=np.float32) * kw
+    gy = rng.random((b, l, n), dtype=np.float32) * kh
+    batch_ev = {
+        "inp_events": jnp.asarray(np.stack([xn, yn, ts, ps], axis=-1)),
+        "inp_valid": jnp.asarray(valid),
+        "gt_events": jnp.asarray(np.stack([gx, gy, ts, ps], axis=-1)),
+        "gt_valid": jnp.asarray(valid),
+    }
+    enc = jax.jit(make_device_encoder((kh, kw)))
+    dev = jax.device_get(enc(batch_ev))
+
+    # host twin of the input rung's scale_event_coords (floor onto the
+    # GT grid); the np path takes filtered events instead of a lane mask
+    xi = np.floor(xn * kw).astype(np.float32)
+    yi = np.floor(yn * kh).astype(np.float32)
+
+    def _host_encode():
+        out_inp = np.empty((b, l, kh, kw, 2), np.float32)
+        out_gt = np.empty((b, l, kh, kw, 2), np.float32)
+        for i in range(b):
+            for j in range(l):
+                m = valid[i, j] > 0
+                out_inp[i, j] = events_to_channels_np(
+                    xi[i, j][m], yi[i, j][m], ps[i, j][m], (kh, kw))
+                out_gt[i, j] = events_to_channels_np(
+                    gx[i, j][m], gy[i, j][m], ps[i, j][m], (kh, kw))
+        return out_inp, out_gt
+
+    host_inp, host_gt = _host_encode()
+    bitwise_ok = bool(
+        np.array_equal(dev["inp"], host_inp)
+        and np.array_equal(dev["gt"], host_gt)
+    )
+
+    def _host_run():
+        t0 = time.perf_counter()
+        _host_encode()
+        return (time.perf_counter() - t0) / (b * l)
+
+    host_ms = round(_best_of_reps(_host_run, 3) * 1e3, 4)
+    dev_ms = enc_speedup = None
+    if on_tpu:
+        t_dev = _timed_jit(lambda: enc(batch_ev), iters=20)
+        dev_ms = round(t_dev * 1e3 / (b * l), 4)
+        enc_speedup = round(host_ms / dev_ms, 3) if dev_ms else None
+
+    # --- the bf16 rungs' jaxpr audits (device-free) --------------------
+    specs = [s for s in production_programs() if s.name.endswith("_bf16")]
+    audits = audit_production_programs(specs)
+    findings = {a.name: len(a.findings) for a in audits}
+    fracs = {}
+    for a in audits:
+        by = a.profile.get("flops_by_dtype", {}) or {}
+        tot = sum(by.values())
+        wid = sum(v for k, v in by.items() if k.startswith("bfloat16->"))
+        fracs[a.name] = round(wid / tot, 4) if tot else None
+    audit_clean = bool(audits) and all(v == 0 for v in findings.values())
+
+    # --- drift-harness verdict (fixed tiny scale, device-free) ---------
+    drift = run_drift(dtype="bf16", basech=4, hw=16, seed=seed)
+    max_rel = max((e["rel_err"] for e in drift["ladder"]), default=None)
+    drift_ok = drift["n_exceeding"] == 0
+
+    res = dict(zip(PRECISION_LADDER_KEYS, (
+        f32_sps, bf16_sps, step_speedup,
+        host_ms, dev_ms, enc_speedup, bitwise_ok,
+        findings, audit_clean, fracs,
+        max_rel, drift["first_offender"], drift_ok,
+        "tpu" if on_tpu else "skipped: cpu backend (interpreter timing)",
+        seed,
+    ), strict=True))
+    EXTRA["precision_ladder"] = {
+        "bf16_step_speedup": step_speedup,
+        "device_encode_bitwise_ok": bitwise_ok,
+        "audit_bf16_clean": audit_clean,
+        "drift_ok": drift_ok,
+    }
     return res
 
 
@@ -2574,6 +2756,11 @@ STAGE_REGISTRY = [
     # seeded sparsity levels + per-corpus activity histograms — parity
     # and histograms run in CPU smoke, timings are TPU-only
     ("dcn_sparse_ab", stage_dcn_sparse_ab, 900, True),
+    # the precision ladder (ISSUE 19): f32-vs-bf16 step time, host-vs-
+    # device rasterization cost + bitwise parity, the bf16 rungs' jaxpr
+    # audits and the drift verdict — parity/audit/drift run in CPU
+    # smoke, timings are TPU-only (dcn_sparse_ab idiom)
+    ("precision_ladder", stage_precision_ladder, 900, True),
     # manifest-level roofline record: device-free eval_shape trace, runs
     # (and produces real numbers) in smoke too
     ("mfu_ceiling", lambda ctx: stage_mfu_ceiling(), 600, True),
@@ -2657,8 +2844,15 @@ def main():
     _WD.disarm()
 
     # Backend contact: the covered failure mode is make_c_api_client
-    # hanging forever (wedged tunnel). 10 min is >> a healthy init.
-    up = _stage("backend_up", stage_backend_up, timeout=600)
+    # hanging forever (wedged tunnel). The outer watchdog is derived from
+    # the per-attempt probe budget (env-tunable; seconds on a CPU-pinned
+    # run) instead of a flat 600s, so a CPU smoke host cannot burn ten
+    # minutes before the capture path even starts.
+    probe_t, probe_n = _probe_budget()
+    up = _stage(
+        "backend_up", stage_backend_up,
+        timeout=min(600.0, probe_n * (probe_t + 2.0) + 30.0),
+    )
     if up is None or not up.get("ok", True):
         # bounded bring-up failure: the stage record already carries the
         # attempt log + cached probe; surface them on the headline too so

@@ -250,6 +250,121 @@ def _build_infer_engine_chunk() -> BuiltProgram:
     )
 
 
+# -- the bf16 rung (docs/PERF.md "precision ladder") ------------------------
+#
+# The same three flagship programs, built at compute_dtype=bf16 exactly as
+# the production call sites build them when trainer.precision: bf16 —
+# params/megabatch enter f32 (masters) and are cast in-graph, so the audit
+# sees the REAL mixed program: bf16 operands into f32 accumulators
+# (JX001-clean), f32 loss/metric islands. Registered beside the f32 rungs
+# so the gate pins both widths every run.
+
+
+def _build_train_multi_step_bf16() -> BuiltProgram:
+    """The K-step train step at the bf16 rung (``trainer.precision:
+    bf16``): f32 masters in the donated TrainState, bf16 compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.training.multistep import make_multi_step
+    from esr_tpu.training.optim import make_optimizer
+    from esr_tpu.training.train_step import TrainState, make_train_step
+
+    model, params, seqn, inch = _sds_model()
+    opt = make_optimizer("Adam", lr=1e-3, weight_decay=1e-4, amsgrad=True)
+    step = make_train_step(model, opt, seqn=seqn,
+                           compute_dtype=jnp.bfloat16)
+    multi = make_multi_step(step, AUDIT_K)
+
+    state = jax.eval_shape(lambda p: TrainState.create(p, opt), params)
+    mega = {
+        "inp": jax.ShapeDtypeStruct(
+            (AUDIT_K, AUDIT_B, AUDIT_L, AUDIT_HW, AUDIT_HW, inch), "float32"
+        ),
+        "gt": jax.ShapeDtypeStruct(
+            (AUDIT_K, AUDIT_B, AUDIT_L, AUDIT_HW, AUDIT_HW, inch), "float32"
+        ),
+    }
+    return BuiltProgram(multi, (state, mega), donate_argnums=(0,))
+
+
+def _build_fused_valid_chunk_bf16() -> BuiltProgram:
+    """The fused validation chunk at the bf16 rung: bf16 forward, f32
+    metric sums (the carry's accumulator dict stays f32)."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.training.multistep import make_multi_step
+    from esr_tpu.training.train_step import make_fused_eval_accum
+
+    model, params, seqn, inch = _sds_model()
+    accum = make_fused_eval_accum(model, seqn, compute_dtype=jnp.bfloat16)
+    chunk = make_multi_step(accum, AUDIT_CHUNK)
+
+    zero = jax.ShapeDtypeStruct((), "float32")
+    carry = (
+        params,
+        {"valid_loss": zero, "valid_mse_loss": zero, "count": zero},
+    )
+    mega = {
+        "inp": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_B, AUDIT_L, AUDIT_HW, AUDIT_HW, inch),
+            "float32",
+        ),
+        "gt": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_B, AUDIT_L, AUDIT_HW, AUDIT_HW, inch),
+            "float32",
+        ),
+    }
+    return BuiltProgram(chunk, (carry, mega))
+
+
+def _build_infer_engine_chunk_bf16() -> BuiltProgram:
+    """The streaming/serving chunk at the bf16 rung: lane states
+    materialized bf16 (the donated carry's dtype is part of the program
+    signature — ``StreamingEngine.run_datalist`` / ``ServingEngine``
+    materialize them the same way), f32 metric sums out."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.inference.engine import make_chunk_fn
+
+    model, _, seqn, inch = _sds_model()
+    kh = kw = AUDIT_HW
+
+    def init():
+        x0 = jnp.zeros((AUDIT_LANES, seqn, kh, kw, inch), jnp.float32)
+        states = model.init_states(AUDIT_LANES, kh, kw)
+        params = model.init(jax.random.PRNGKey(0), x0, states)
+        return params, states
+
+    params, states = jax.eval_shape(init)
+    states = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), states
+    )
+    run_chunk = make_chunk_fn(model, AUDIT_LANES, AUDIT_CHUNK, kh, kw,
+                              compute_dtype=jnp.bfloat16)
+    windows = {
+        "inp_scaled": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES, seqn, kh, kw, inch), "float32"
+        ),
+        "inp_mid": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES, kh, kw, inch), "float32"
+        ),
+        "gt": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES, kh, kw, inch), "float32"
+        ),
+        "valid": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES), "float32"
+        ),
+    }
+    reset_keep = jax.ShapeDtypeStruct((AUDIT_LANES,), "float32")
+    return BuiltProgram(
+        run_chunk, (params, states, reset_keep, windows),
+        donate_argnums=(1,),
+    )
+
+
 def _dcn_shapes():
     import jax
 
@@ -321,6 +436,30 @@ PROGRAMS: List[ProgramSpec] = [
         "infer_engine_chunk",
         _build_infer_engine_chunk,
         description="streaming/serving fused chunk, lane states donated",
+    ),
+    # JX003 (cast round-trips) is allowed on the bf16 rungs by design:
+    # mixed precision IS a round trip — every widened contraction emits
+    # f32 and rounds back to bf16 so inter-layer activations stay narrow,
+    # and the loss/upsample islands upcast again. The wash is the rung's
+    # contract (the drift harness bounds it); JX001 (narrow accumulation)
+    # stays enforced.
+    ProgramSpec(
+        "train_multi_step_bf16",
+        _build_train_multi_step_bf16,
+        allow=("JX003",),
+        description="K-step train step at the bf16 rung (f32 masters)",
+    ),
+    ProgramSpec(
+        "fused_valid_chunk_bf16",
+        _build_fused_valid_chunk_bf16,
+        allow=("JX003",),
+        description="fused validation chunk at the bf16 rung",
+    ),
+    ProgramSpec(
+        "infer_engine_chunk_bf16",
+        _build_infer_engine_chunk_bf16,
+        allow=("JX003",),
+        description="streaming/serving chunk at the bf16 rung",
     ),
     ProgramSpec(
         "dcn_train",

@@ -1,0 +1,113 @@
+"""One precision policy for train / valid / infer / serve.
+
+The ladder's rungs are *config-named* (VirtualFlow, arxiv 2009.09523:
+one YAML runs identically from CPU smoke to pod slice), so every
+entrypoint resolves the SAME spelling through the SAME precedence:
+
+    explicit CLI flag  >  checkpoint config (``trainer.precision``)  >
+    built-in default (``f32``)
+
+``trainer.precision`` historically applied only to the train step;
+``inference.engine``/``ServingEngine`` silently ran f32 regardless of
+how the checkpoint was trained. This module is the single seam all four
+planes import, so a checkpoint trained at ``bf16`` serves at ``bf16``
+unless the operator overrides it at the CLI.
+
+Also owns dtype-alias canonicalization: user-facing knobs accept the
+short spellings (``bf16``, ``f32``) that ``jnp.dtype`` does not
+understand, while numerics code wants a numpy-parsable name. jax-free
+at module scope (the obs drift harness imports it before choosing a
+backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+#: the config-level rungs — ``trainer.precision`` / ``--precision`` values
+PRECISIONS = ("f32", "bf16")
+
+# short/long spellings -> canonical rung name
+_PRECISION_ALIASES = {
+    "f32": "f32",
+    "fp32": "f32",
+    "float32": "f32",
+    "bf16": "bf16",
+    "bfloat16": "bf16",
+}
+
+# short/long spellings -> numpy-parsable dtype name (jnp.dtype-safe)
+_DTYPE_ALIASES = {
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+    "f16": "float16",
+    "fp16": "float16",
+    "half": "float16",
+    "float16": "float16",
+    "f32": "float32",
+    "fp32": "float32",
+    "float32": "float32",
+    "f64": "float64",
+    "fp64": "float64",
+    "float64": "float64",
+}
+
+
+def canonical_dtype(name: Any) -> str:
+    """Normalize a user-facing dtype spelling to a numpy-parsable name.
+
+    ``canonical_dtype("bf16") == "bfloat16"`` — the drift harness and
+    every ``--dtype`` knob accept the short config spellings without
+    each call site re-learning that ``jnp.dtype("bf16")`` raises.
+    Unknown names raise ``ValueError`` with the accepted spellings.
+    """
+    key = str(name).strip().lower()
+    if key not in _DTYPE_ALIASES:
+        raise ValueError(
+            f"unknown dtype {name!r}; accepted spellings: "
+            f"{sorted(set(_DTYPE_ALIASES))}"
+        )
+    return _DTYPE_ALIASES[key]
+
+
+def canonical_precision(name: Any) -> str:
+    """Normalize a precision spelling to its config rung (``f32``/``bf16``)."""
+    key = str(name).strip().lower()
+    if key not in _PRECISION_ALIASES:
+        raise ValueError(
+            f"unknown precision {name!r}; supported rungs: {PRECISIONS}"
+        )
+    return _PRECISION_ALIASES[key]
+
+
+def resolve_precision(
+    cli: Optional[str] = None,
+    config: Optional[str] = None,
+    default: str = "f32",
+) -> str:
+    """Resolve one precision rung: CLI > checkpoint config > default.
+
+    Mirrors the tri-state knob idiom (``--engine``/``--compile_cache``):
+    an omitted CLI flag (``None``) defers to the checkpoint config's
+    ``trainer.precision``, which defers to the built-in default. Every
+    spelling is validated — a typo'd rung fails loudly at resolution,
+    not as a silent f32 fallback three layers down.
+    """
+    for source in (cli, config, default):
+        if source is not None:
+            return canonical_precision(source)
+    return canonical_precision(default)
+
+
+def compute_dtype_of(precision: Optional[str]):
+    """Map a precision rung to the ``compute_dtype`` the step factories
+    take: ``None`` for f32 (the unmodified reference program) or
+    ``jnp.bfloat16``. Accepts ``None`` (meaning: unresolved -> f32)."""
+    if precision is None:
+        return None
+    rung = canonical_precision(precision)
+    if rung == "f32":
+        return None
+    import jax.numpy as jnp
+
+    return jnp.bfloat16

@@ -85,7 +85,8 @@ _METRIC_FNS = {
 }
 
 
-def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int):
+def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int,
+                  compute_dtype=None):
     """Build the PURE fused-chunk program: ``(params, states, reset_keep,
     windows) -> (states, sums, stacked)``.
 
@@ -107,6 +108,14 @@ def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int):
     program, so a datalist at a new resolution needs a new program (shape
     changes alone would retrace, but a stale target would silently resize
     to the WRONG grid).
+
+    ``compute_dtype`` is the precision rung (``esr_tpu.config.precision``)
+    the checkpoint trained at: params/inputs/lane states are cast for the
+    apply exactly like the train/eval steps, predictions are upcast to f32
+    BEFORE the resize and metric math, and the per-lane metric sums stay
+    f32 — so a bf16 chunk program reports through the identical metric
+    pipeline. Callers must materialize the entry lane states in the same
+    dtype (the donated carry's signature is part of the program).
     """
     from esr_tpu.training.multistep import make_multi_step
 
@@ -120,10 +129,21 @@ def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int):
         return imgs
 
     def run_chunk(params, states, reset_keep, windows):
+        if compute_dtype is not None:
+            params = jax.tree.map(
+                lambda a: a.astype(compute_dtype), params
+            )
+            states = jax.tree.map(
+                lambda z: z.astype(compute_dtype), states
+            )
+
         def window_step(carry, win):
             states, sums = carry
-            pred, states = model.apply(params, win["inp_scaled"], states)
-            pred = _to_gt_grid(pred)
+            inp = win["inp_scaled"]
+            if compute_dtype is not None:
+                inp = inp.astype(compute_dtype)
+            pred, states = model.apply(params, inp, states)
+            pred = _to_gt_grid(pred.astype(jnp.float32))
             bicubic = _to_gt_grid(win["inp_mid"])
             per = {}
             for name, fn in _METRIC_FNS.items():
@@ -208,7 +228,13 @@ class StreamingEngine:
         lanes: int = 4,
         chunk_windows: int = 8,
         prefetch_depth: int = 2,
+        precision: Optional[str] = None,
     ):
+        from esr_tpu.config.precision import (
+            compute_dtype_of,
+            resolve_precision,
+        )
+
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
         if chunk_windows < 1:
@@ -221,6 +247,11 @@ class StreamingEngine:
         self.lanes = int(lanes)
         self.chunk_windows = int(chunk_windows)
         self.prefetch_depth = int(prefetch_depth)
+        # the rung the caller resolved (CLI > checkpoint config > f32,
+        # esr_tpu.config.precision); `None` means f32 — the engine never
+        # guesses, the harness/serving entrypoints own the resolution
+        self.precision = resolve_precision(cli=precision)
+        self._compute_dtype = compute_dtype_of(self.precision)
         # chunk program cache, keyed by GT resolution: the resize target is
         # baked into the traced program, so a datalist at a new resolution
         # must rebuild (shape changes alone would retrace, but a stale
@@ -237,7 +268,7 @@ class StreamingEngine:
         residency across chunks exactly like the training carry."""
         return checked_jit(
             make_chunk_fn(self.model, self.lanes, self.chunk_windows,
-                          kh, kw),
+                          kh, kw, compute_dtype=self._compute_dtype),
             donate_argnums=(1,), name="infer_engine_chunk",
         )
 
@@ -294,6 +325,14 @@ class StreamingEngine:
         states = jax.tree.map(
             jnp.array, self.model.init_states(self.lanes, kh, kw)
         )
+        if self._compute_dtype is not None:
+            # the donated carry's dtype is part of the program signature:
+            # materialize lane states at the compute width so chunk 0
+            # traces the same program every later chunk reuses (an f32
+            # entry would retrace once and break donation aliasing)
+            states = jax.tree.map(
+                lambda z: z.astype(self._compute_dtype), states
+            )
 
         def _resolve(entry) -> None:
             """Read back one chunk's device outputs and fold them into the

@@ -152,6 +152,7 @@ def export_chunk_program(
     lr_hw: Optional[Tuple[int, int]] = None,
     seqn: int = 3,
     platforms: Tuple[str, ...] = ("tpu", "cpu"),
+    precision: Optional[str] = None,
 ) -> bytes:
     """Lower the ENGINE CHUNK PROGRAM (``inference/engine.make_chunk_fn``)
     and serialize — the AOT artifact the serving tier loads so the serving
@@ -168,6 +169,10 @@ def export_chunk_program(
     DCN kernel to the portable jnp formulation, as in
     :func:`export_forward`.
     """
+    from esr_tpu.config.precision import (
+        compute_dtype_of,
+        resolve_precision,
+    )
     from esr_tpu.inference.engine import make_chunk_fn
 
     model = _portable_dcn(model, platforms)
@@ -182,9 +187,16 @@ def export_chunk_program(
         "inp_mid": jnp.zeros((w_, b, lh, lw, inch), jnp.float32),
         "valid": jnp.zeros((w_, b), jnp.float32),
     }
+    compute_dtype = compute_dtype_of(resolve_precision(cli=precision))
     states = model.init_states(b, kh, kw)
+    if compute_dtype is not None:
+        # the donated carry's dtype is part of the exported signature —
+        # it must match what the serving tier materializes at this rung
+        states = jax.tree.map(
+            lambda z: jnp.asarray(z, compute_dtype), states
+        )
     reset_keep = jnp.zeros((b,), jnp.float32)
-    fn = make_chunk_fn(model, b, w_, kh, kw)
+    fn = make_chunk_fn(model, b, w_, kh, kw, compute_dtype=compute_dtype)
     exported = jax.export.export(jax.jit(fn), platforms=list(platforms))(
         _shape_dtype(params), _shape_dtype(states),
         _shape_dtype(reset_keep), _shape_dtype(windows),
@@ -196,7 +208,8 @@ def export_checkpoint(ckpt_path: str, out_path: str,
                       batch: int = 1, height: int = 64, width: int = 64,
                       program: str = "forward",
                       chunk_windows: int = 8, scale: int = 2,
-                      platforms: Tuple[str, ...] = ("tpu", "cpu")) -> str:
+                      platforms: Tuple[str, ...] = ("tpu", "cpu"),
+                      precision: Optional[str] = None) -> str:
     """Checkpoint directory -> deployable artifact: rebuilds the model from
     the embedded config (the same convention inference uses,
     ``training/checkpoint.py:load_for_inference``) and exports at the given
@@ -222,15 +235,24 @@ def export_checkpoint(ckpt_path: str, out_path: str,
         )
     from esr_tpu.training.checkpoint import load_for_inference
 
+    from esr_tpu.config.precision import resolve_precision
+
     model, params, config = load_for_inference(ckpt_path)
     seqn = int(config.get("model", {}).get("args", {}).get("num_frame", 3))
     inch = int(getattr(model, "inch", 2))
+    # same one-policy resolution as infer/serve: explicit argument >
+    # checkpoint trainer.precision > f32; the sidecar records the rung
+    # and the serving loader refuses a mismatched one
+    precision = resolve_precision(
+        cli=precision,
+        config=(config.get("trainer") or {}).get("precision"),
+    )
     if program == "engine_chunk":
         blob = export_chunk_program(
             model, params, lanes=batch, chunk_windows=chunk_windows,
             gt_hw=(height, width),
             lr_hw=(height // scale, width // scale),
-            seqn=seqn, platforms=platforms,
+            seqn=seqn, platforms=platforms, precision=precision,
         )
         os.makedirs(os.path.dirname(os.path.abspath(out_path)),
                     exist_ok=True)
@@ -246,6 +268,7 @@ def export_checkpoint(ckpt_path: str, out_path: str,
             "gt_hw": [height, width],
             "lr_hw": [height // scale, width // scale],
             "seqn": seqn,
+            "precision": precision,
         }
         with open(out_path + ".json", "w") as f:
             json.dump(sidecar, f, indent=2, default=str)

@@ -76,11 +76,29 @@ class InferenceRunner:
         seqn: int = 3,
         lpips_model=None,
         lpips_params=None,
+        precision: Optional[str] = None,
     ):
+        from esr_tpu.config.precision import (
+            compute_dtype_of,
+            resolve_precision,
+        )
+
         self.model = model
         self.params = params
         self.seqn = seqn
         self.mid_idx = (seqn - 1) // 2
+        # one precision policy (esr_tpu.config.precision): the caller
+        # resolves CLI > checkpoint config > f32 and passes the rung; the
+        # runner casts its params copy once so every recording's forward
+        # runs the width the checkpoint trained at. Metrics stay f32 (the
+        # prediction is upcast before the metric jit).
+        self.precision = resolve_precision(cli=precision)
+        self._compute_dtype = compute_dtype_of(self.precision)
+        if self._compute_dtype is not None:
+            self.params = jax.tree.map(
+                lambda a: jnp.asarray(a).astype(self._compute_dtype),
+                params,
+            )
 
         # checked_jit (docs/ANALYSIS.md): inference retraces now surface as
         # `compile` telemetry events exactly like the training jits'. The
@@ -143,6 +161,10 @@ class InferenceRunner:
 
         # state persists across the WHOLE recording (reference :54)
         states = self.model.init_states(1, kh, kw)
+        if self._compute_dtype is not None:
+            states = jax.tree.map(
+                lambda z: z.astype(self._compute_dtype), states
+            )
 
         # per-window SSIM samples: count maps are sparse enough that the
         # ESR-vs-bicubic SSIM gap can sit inside the sampling noise
@@ -178,9 +200,14 @@ class InferenceRunner:
                 k: v[:, : self.seqn] for k, v in batch.items()
             }  # inputs_seq[0]
             inp_scaled = jnp.asarray(window["inp_scaled_cnt"])
+            if self._compute_dtype is not None:
+                inp_scaled = inp_scaled.astype(self._compute_dtype)
 
             t0 = time.perf_counter()
             pred, states = self._fwd(self.params, inp_scaled, states)
+            if self._compute_dtype is not None:
+                # metrics/PNG dumps consume f32 exactly like the f32 path
+                pred = pred.astype(jnp.float32)
             # intentional per-window latency probe (the one sequential-mode
             # sync the deferred-readback audit keeps): bounding the forward
             # here is what makes `time`/`infer_forward` true dispatch->ready
@@ -358,6 +385,7 @@ def run_inference(
     lanes: Optional[int] = None,
     chunk_windows: Optional[int] = None,
     compile_cache: Optional[bool] = None,
+    precision: Optional[str] = None,
 ) -> Dict[str, float]:
     """Full driver: checkpoint -> model, datalist -> per-recording + mean
     reports under ``output_path`` (reference ``main`` mode 1, ``:295-347``).
@@ -391,6 +419,16 @@ def run_inference(
 
         enable_compile_cache(cc)
     inf_cfg = config.get("inference") or {}
+    # one precision policy (esr_tpu.config.precision, satellite of the
+    # bf16 ladder): CLI > the checkpoint's trainer.precision > f32 — a
+    # checkpoint trained at bf16 infers at bf16 unless overridden, instead
+    # of the engine silently ignoring the rung the model trained at
+    from esr_tpu.config.precision import resolve_precision
+
+    precision = resolve_precision(
+        cli=precision,
+        config=(config.get("trainer") or {}).get("precision"),
+    )
     if engine is None:
         engine = bool(inf_cfg.get("engine", False))
     lanes = int(inf_cfg.get("lanes", 4) if lanes is None else lanes)
@@ -420,7 +458,8 @@ def run_inference(
         from esr_tpu.inference.engine import StreamingEngine
 
         eng = StreamingEngine(
-            model, params, seqn, lanes=lanes, chunk_windows=chunk_windows
+            model, params, seqn, lanes=lanes, chunk_windows=chunk_windows,
+            precision=precision,
         )
         os.makedirs(output_path, exist_ok=True)
         results, names = eng.run_datalist(data_list, dataset_config)
@@ -460,7 +499,8 @@ def run_inference(
         )
 
     runner = InferenceRunner(
-        model, params, seqn, lpips_model=lpips_model, lpips_params=lpips_params
+        model, params, seqn, lpips_model=lpips_model,
+        lpips_params=lpips_params, precision=precision,
     )
 
     os.makedirs(output_path, exist_ok=True)

@@ -41,6 +41,7 @@ from esr_tpu.models.layers import (
     UpsampleConvLayer,
     torch_uniform_init,
     torch_conv_bias_init,
+    wide_accum_conv_general_dilated,
 )
 from esr_tpu.models import model_util
 
@@ -206,6 +207,7 @@ class STFusion(nn.Module):
                 padding=((1, 1), (1, 1)),
                 kernel_init=nn.initializers.zeros,
                 bias_init=nn.initializers.zeros,
+                conv_general_dilated=wide_accum_conv_general_dilated,
             )
             self.dcn_weight = self.param(
                 "dcn_weight", torch_uniform_init(), (3, 3, c, c)

@@ -16,6 +16,7 @@ blocks, conv recurrences with orthogonal GRU init), but:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -61,6 +62,133 @@ def get_activation(name: Optional[str]) -> Optional[Callable]:
     if name not in _ACTIVATIONS:
         raise ValueError(f"unsupported activation: {name}")
     return _ACTIVATIONS[name]
+
+
+def _is_narrow_float(dtype: Any) -> bool:
+    """Sub-4-byte float operands (bf16/f16/f8) — the widths whose MXU
+    contractions must accumulate wide (JX001, docs/ANALYSIS.md)."""
+    dt = jnp.dtype(dtype)
+    return jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4
+
+
+def _conv_from_spec(lhs, rhs, spec):
+    """The widened conv at a resolved primitive-level spec (tuple form so
+    it can ride ``custom_vjp``'s hashable ``nondiff_argnums``)."""
+    ws, pads, ld, rd, dn, fgc, bgc, prec = spec
+    return jax.lax.conv_general_dilated(
+        lhs, rhs, ws, pads, lhs_dilation=ld, rhs_dilation=rd,
+        dimension_numbers=dn, feature_group_count=fgc,
+        batch_group_count=bgc, precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _widened_conv_fwd(lhs, rhs, spec):
+    return _conv_from_spec(lhs, rhs, spec).astype(lhs.dtype), (lhs, rhs)
+
+
+def _widened_conv_bwd(spec, res, g):
+    # Both transpose convolutions run with NARROW operands and an f32
+    # accumulator, then round the cotangents back to the operand widths —
+    # the backward mirror of the forward contract. jax's own conv
+    # transpose rule cannot express this (it feeds the f32 cotangent into
+    # a conv against the narrow weights, which ``lax`` rejects — and a
+    # narrow cotangent without ``preferred_element_type`` would be the
+    # exact narrow-accumulation JX001 exists to forbid), hence the
+    # explicit vjp reusing the transpose-geometry helpers.
+    from jax._src.lax import convolution as _lax_conv
+
+    lhs, rhs = res
+    ws, pads, ld, rd, dn, fgc, bgc, prec = spec
+
+    class _Abstract:
+        """Stand-in for the undefined primal: the transpose helpers read
+        only ``.aval.shape`` of the side being solved for."""
+
+        def __init__(self, a):
+            self.aval = jax.core.ShapedArray(a.shape, a.dtype)
+
+    kwargs = dict(
+        window_strides=ws, padding=pads, lhs_dilation=ld, rhs_dilation=rd,
+        dimension_numbers=dn, feature_group_count=fgc,
+        batch_group_count=bgc, precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+    dlhs = _lax_conv._conv_general_dilated_transpose_lhs(
+        g, _Abstract(lhs), rhs, **kwargs
+    ).astype(lhs.dtype)
+    drhs = _lax_conv._conv_general_dilated_transpose_rhs(
+        g, lhs, _Abstract(rhs), **kwargs
+    ).astype(rhs.dtype)
+    return dlhs, drhs
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _widened_conv(lhs, rhs, spec):
+    return _widened_conv_fwd(lhs, rhs, spec)[0]
+
+
+_widened_conv.defvjp(_widened_conv_fwd, _widened_conv_bwd)
+
+
+def wide_accum_conv_general_dilated(lhs, rhs, window_strides, padding, **kw):
+    """``lax.conv_general_dilated`` with a guaranteed-wide accumulator.
+
+    Injected into every ``nn.Conv`` below via the ``conv_general_dilated``
+    dataclass field (flax calls it with the first four arguments
+    positional and never passes ``preferred_element_type`` itself): when
+    the operands are narrow floats, the contraction accumulates in f32
+    (``preferred_element_type``) and the result is rounded back to the
+    operand width so the inter-layer activations stay narrow — in BOTH
+    directions (a ``custom_vjp`` widens the two transpose convolutions the
+    same way; jax's stock transpose rule cannot grad through a widened
+    conv). Full-width operands take the untouched ``lax`` path, so every
+    existing f32 program traces identically (bitwise pins unaffected).
+    Param names/structure are unchanged — checkpoints are compatible.
+    """
+    if not (_is_narrow_float(lhs.dtype)
+            and kw.get("preferred_element_type") is None):
+        return jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides, padding, **kw
+        )
+    # resolve flax's call-site arguments down to the primitive-level spec
+    # (explicit pads, ConvDimensionNumbers) the transpose helpers need
+    dn = jax.lax.conv_dimension_numbers(
+        lhs.shape, rhs.shape, kw.get("dimension_numbers")
+    )
+    ld = tuple(kw.get("lhs_dilation") or (1,) * (lhs.ndim - 2))
+    rd = tuple(kw.get("rhs_dilation") or (1,) * (rhs.ndim - 2))
+    ws = tuple(window_strides)
+    if isinstance(padding, str):
+        lhs_perm, rhs_perm, _ = dn
+        rhs_sp = np.take(rhs.shape, rhs_perm)[2:]
+        effective = [(k - 1) * r + 1 if k else 0
+                     for k, r in zip(rhs_sp, rd)]
+        pads = jax.lax.padtype_to_pads(
+            np.take(lhs.shape, lhs_perm)[2:], effective, ws, padding
+        )
+    else:
+        pads = padding
+    pads = tuple((int(lo), int(hi)) for lo, hi in pads)
+    spec = (
+        ws, pads, ld, rd, dn,
+        int(kw.get("feature_group_count", 1)),
+        int(kw.get("batch_group_count", 1)),
+        kw.get("precision"),
+    )
+    return _widened_conv(lhs, rhs, spec)
+
+
+def wide_accum_dot_general(lhs, rhs, dimension_numbers, **kw):
+    """``lax.dot_general`` twin of :func:`wide_accum_conv_general_dilated`
+    for the ``nn.Dense`` seams (flax ``dot_general`` injection field)."""
+    if _is_narrow_float(lhs.dtype) and kw.get("preferred_element_type") is None:
+        out = jax.lax.dot_general(
+            lhs, rhs, dimension_numbers,
+            **{**kw, "preferred_element_type": jnp.float32},
+        )
+        return out.astype(lhs.dtype)
+    return jax.lax.dot_general(lhs, rhs, dimension_numbers, **kw)
 
 
 class TorchBatchNorm(nn.Module):
@@ -234,6 +362,7 @@ def _conv_norm_act(mod, x: Array, train: bool, rank: int) -> Array:
         use_bias=use_bias,
         kernel_init=torch_uniform_init(),
         bias_init=torch_conv_bias_init(cin * k**rank),
+        conv_general_dilated=wide_accum_conv_general_dilated,
     )(x)
     x = _NormWrapper(mod.norm, mod.bn_momentum)(x, train)
     act = get_activation(mod.activation)
@@ -296,6 +425,13 @@ class TransposedConvLayer(nn.Module):
         k = self.kernel_size
         p = self.padding
         use_bias = self.norm != "BN"
+        # nn.ConvTranspose has no conv-callable injection seam, so narrow
+        # operands climb to f32 for the whole layer (transpose convs live
+        # only on the upsample tail — negligible FLOPs) and the result is
+        # rounded back to the incoming width below.
+        in_dtype = x.dtype
+        if _is_narrow_float(in_dtype):
+            x = x.astype(jnp.float32)
         # torch: out = (H-1)*2 - 2p + k + output_padding(=1).
         # lax.conv_transpose with explicit padding (k-1-p, k-1-p+1) realizes it.
         # torch ConvTranspose2d weight is (in, out, kh, kw), so its default
@@ -317,7 +453,8 @@ class TransposedConvLayer(nn.Module):
         )(x)
         x = _NormWrapper(self.norm)(x, train)
         act = get_activation(self.activation)
-        return act(x) if act is not None else x
+        x = act(x) if act is not None else x
+        return x.astype(in_dtype)
 
 
 class UpsampleConvLayer(nn.Module):
@@ -377,6 +514,7 @@ class ResidualBlock(nn.Module):
             use_bias=use_bias,
             kernel_init=torch_uniform_init(),
             bias_init=torch_conv_bias_init(cin * 9),
+            conv_general_dilated=wide_accum_conv_general_dilated,
         )(x)
         out = _NormWrapper(self.norm, self.bn_momentum)(out, train)
         out = jax.nn.relu(out)
@@ -387,6 +525,7 @@ class ResidualBlock(nn.Module):
             use_bias=use_bias,
             kernel_init=torch_uniform_init(),
             bias_init=torch_conv_bias_init(self.features * 9),
+            conv_general_dilated=wide_accum_conv_general_dilated,
         )(out)
         out = _NormWrapper(self.norm, self.bn_momentum)(out, train)
         out = out + residual
@@ -420,6 +559,7 @@ class ConvGRUCell(nn.Module):
             padding=((pad, pad), (pad, pad)),
             kernel_init=nn.initializers.orthogonal(),
             bias_init=nn.initializers.zeros,
+            conv_general_dilated=wide_accum_conv_general_dilated,
             name=name,
         )
         stacked = jnp.concatenate([x, state], axis=-1)
@@ -457,6 +597,7 @@ class ConvLSTMCell(nn.Module):
             padding=((pad, pad), (pad, pad)),
             kernel_init=torch_uniform_init(),
             bias_init=torch_conv_bias_init(cin * k * k),
+            conv_general_dilated=wide_accum_conv_general_dilated,
         )(jnp.concatenate([x, prev_hidden], axis=-1))
         in_gate, remember_gate, out_gate, cell_gate = jnp.split(gates, 4, axis=-1)
         in_gate = jax.nn.sigmoid(in_gate)
@@ -520,6 +661,7 @@ class MLP(nn.Module):
                 d,
                 kernel_init=torch_uniform_init("dense"),
                 bias_init=torch_conv_bias_init(x.shape[-1]),
+                dot_general=wide_accum_dot_general,
             )(x)
             if i < self.num_layers - 1:
                 x = jax.nn.relu(x)

@@ -95,7 +95,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dr.add_argument(
         "--dtype", default="bfloat16",
-        help="candidate dtype for the twin (default bfloat16)",
+        help="candidate dtype for the twin (default bfloat16; the "
+             "config spellings bf16/f16/f32 are accepted too)",
     )
     dr.add_argument("--basech", type=int, default=8,
                     help="model base channel count (default 8)")

@@ -342,10 +342,13 @@ def run_drift(
     import jax
     import jax.numpy as jnp
 
+    from esr_tpu.config.precision import canonical_dtype
     from esr_tpu.models.esr import DeepRecurrNet
     from esr_tpu.ops.numerics import flatten_probes
 
-    cand_dtype = jnp.dtype(dtype)
+    # accept the config spellings ("bf16") next to the numpy names
+    # ("bfloat16") — jnp.dtype alone rejects the former with exit 2
+    cand_dtype = jnp.dtype(canonical_dtype(dtype))
     model = DeepRecurrNet(
         inch=inch, basech=basech, num_frame=frames,
         numerics=True, numerics_mode="raw", numerics_break=break_tag,

@@ -119,9 +119,16 @@ def deform_conv2d(
     cols = sample(xg, ys_g, xs_g)  # [B, dg, Ho, Wo, K, Cg]
     cols = cols * jnp.moveaxis(mask, 3, 1)[..., None]
 
-    # Contract with weight: [kh*kw, dg, Cg, Cout]
+    # Contract with weight: [kh*kw, dg, Cg, Cout]. The contraction is the
+    # one MXU-bound op in this composite — at narrow operand widths it must
+    # accumulate in f32 (JX001, docs/ANALYSIS.md "low-precision
+    # accumulation"), then round back to the operand width so the layer's
+    # output dtype matches its input dtype either way.
     wk = weight.reshape(kh * kw, dg, cg, cout)
-    out = jnp.einsum("bgijkc,kgco->bijo", cols, wk)
+    out = jnp.einsum(
+        "bgijkc,kgco->bijo", cols, wk,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
     if bias is not None:
         out = out + bias
     return out

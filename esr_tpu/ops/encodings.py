@@ -611,3 +611,47 @@ def scale_event_coords(
         jnp.floor(xs_norm * w).astype(jnp.int32),
         jnp.floor(ys_norm * h).astype(jnp.int32),
     )
+
+
+def make_device_encoder(gt_resolution: Tuple[int, int]):
+    """Build the jitted on-device batch encoder: raw event windows in,
+    dense count images out — host rasterization moved off the critical
+    path (``dataset.encode: device``, docs/CONFIG.md).
+
+    The host ships fixed-capacity padded event windows (~4 floats/event
+    vs a dense ``[H, W, 2]`` image per frame) and the device scatter-adds
+    them inside the consuming jit. Consumes the raw-event batch contract
+    ``{"inp_events" [B, L, N, 4] (coords normalized to [0,1)),
+    "inp_valid" [B, L, N], "gt_events" [B, L, Ng, 4] (raw GT-grid
+    coords), "gt_valid"}`` and produces the dense ``{"inp", "gt"}``
+    streams the train/eval steps expect.
+
+    Per-event math is the PR-12 jnp twin of the host path
+    (``np_encodings``): ``scale_event_coords`` + ``events_to_channels``
+    for the input rung, plain ``events_to_channels`` for GT — so the
+    integer count images are BITWISE equal to host encoding (pinned in
+    tier-1), and ``encode: device|host`` is a pure placement knob, never
+    a numerics knob. Counts accumulate in f32 regardless of
+    ``trainer.precision``; the mixed-precision cast happens inside the
+    train step like every other input stream.
+    """
+    kh, kw = gt_resolution
+
+    def _inp_one(ev, valid):
+        xs, ys = scale_event_coords(ev[:, 0], ev[:, 1], (kh, kw))
+        return events_to_channels(xs, ys, ev[:, 3], (kh, kw), valid=valid)
+
+    def _gt_one(ev, valid):
+        return events_to_channels(
+            ev[:, 0], ev[:, 1], ev[:, 3], (kh, kw), valid=valid
+        )
+
+    vmap2 = lambda f: jax.vmap(jax.vmap(f))  # over B, L
+
+    def encode(batch: Dict[str, Array]) -> Dict[str, Array]:
+        return {
+            "inp": vmap2(_inp_one)(batch["inp_events"], batch["inp_valid"]),
+            "gt": vmap2(_gt_one)(batch["gt_events"], batch["gt_valid"]),
+        }
+
+    return encode

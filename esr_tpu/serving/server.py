@@ -188,7 +188,19 @@ class ServingEngine:
         profile_steps: int = 0,
         profile_dir: Optional[str] = None,
         health_ns: Optional[str] = None,
+        precision: Optional[str] = None,
     ):
+        # precision rung (docs/PERF.md "precision ladder"): serving runs at
+        # the width the caller resolved (serve.py: CLI > checkpoint
+        # trainer.precision > f32) — same one-policy seam as the offline
+        # StreamingEngine, so a bf16-trained model serves bf16 by default
+        from esr_tpu.config.precision import (
+            compute_dtype_of,
+            resolve_precision,
+        )
+
+        self.precision = resolve_precision(cli=precision)
+        self._compute_dtype = compute_dtype_of(self.precision)
         self.model = model
         self.params = params
         self.dataset_config = dict(dataset_config)
@@ -339,6 +351,15 @@ class ServingEngine:
             from esr_tpu.inference.export import load_exported_model
 
             fn, sidecar = load_exported_model(self._aot_paths[w])
+            # an exported program's precision is baked in at export time;
+            # a mismatched rung would silently serve the wrong numerics
+            aot_precision = sidecar.get("precision") or "f32"
+            if aot_precision != self.precision:
+                raise ValueError(
+                    f"AOT artifact {self._aot_paths[w]} was exported at "
+                    f"precision={aot_precision!r}, serving was asked for "
+                    f"{self.precision!r}"
+                )
             got = (sidecar.get("lanes"), sidecar.get("chunk_windows"))
             if got != (self.lanes, w):
                 raise ValueError(
@@ -362,14 +383,17 @@ class ServingEngine:
                 )
             prog = fn
         else:
-            key = (self.model, self.lanes, w, kh, kw)
+            key = (self.model, self.lanes, w, kh, kw, self.precision)
             prog = _PROGRAM_CACHE.get(key)
             if prog is None:
                 # donation is traced-path-only: a deserialized exported
                 # call owns no donation metadata, and the states buffers
                 # there are small relative to serving batch arrays
                 prog = checked_jit(
-                    make_chunk_fn(self.model, self.lanes, w, kh, kw),
+                    make_chunk_fn(
+                        self.model, self.lanes, w, kh, kw,
+                        compute_dtype=self._compute_dtype,
+                    ),
                     donate_argnums=(1,), name=f"serve_chunk_w{w}",
                 )
                 _PROGRAM_CACHE[key] = prog
@@ -389,9 +413,17 @@ class ServingEngine:
             # on the GT grid (LR events rasterized onto it), exactly like
             # the offline engine's init_states(lanes, kh, kw)
             kh, kw = self._resolutions[1]
-            self._states = jax.tree.map(
-                jnp.array, self.model.init_states(self.lanes, kh, kw)
-            )
+            # materialize in the compute dtype so chunk 0 traces the same
+            # program every later chunk reuses (the donated carry's dtype
+            # is part of the program signature)
+            states = self.model.init_states(self.lanes, kh, kw)
+            if self._compute_dtype is not None:
+                cd = self._compute_dtype
+                self._states = jax.tree.map(
+                    lambda z: jnp.asarray(z, cd), states
+                )
+            else:
+                self._states = jax.tree.map(jnp.array, states)
 
     # -- session API ---------------------------------------------------------
 

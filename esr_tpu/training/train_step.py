@@ -104,29 +104,15 @@ def make_device_rasterizer(gt_resolution: Tuple[int, int]) -> Callable:
     ``{"inp_events" [B, L, N, 4] (normalized coords), "inp_valid" [B, L, N],
     "gt_events" [B, L, Ng, 4] (raw GT-grid coords), "gt_valid"}`` and
     produces the ``{"inp", "gt"}`` dense batch the loss expects.
+
+    The encoder itself lives with its jnp twins in ``ops/encodings``
+    (:func:`esr_tpu.ops.encodings.make_device_encoder`) so inference and
+    serving can stage the same raw-event contract; this name remains the
+    training-side seam.
     """
-    from esr_tpu.ops.encodings import events_to_channels, scale_event_coords
+    from esr_tpu.ops.encodings import make_device_encoder
 
-    kh, kw = gt_resolution
-
-    def _inp_one(ev, valid):
-        xs, ys = scale_event_coords(ev[:, 0], ev[:, 1], (kh, kw))
-        return events_to_channels(xs, ys, ev[:, 3], (kh, kw), valid=valid)
-
-    def _gt_one(ev, valid):
-        return events_to_channels(
-            ev[:, 0], ev[:, 1], ev[:, 3], (kh, kw), valid=valid
-        )
-
-    vmap2 = lambda f: jax.vmap(jax.vmap(f))
-
-    def rasterize(batch):
-        return {
-            "inp": vmap2(_inp_one)(batch["inp_events"], batch["inp_valid"]),
-            "gt": vmap2(_gt_one)(batch["gt_events"], batch["gt_valid"]),
-        }
-
-    return rasterize
+    return make_device_encoder(gt_resolution)
 
 
 def make_train_step(
@@ -375,25 +361,42 @@ def make_train_step(
 
 
 def make_eval_step(
-    model, seqn: int = 3, rasterize: Optional[Callable] = None
+    model, seqn: int = 3, rasterize: Optional[Callable] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> Callable:
     """Validation step: same scan, no grad (reference ``_valid``,
-    ``train_ours_cnt_seq.py:541-633``)."""
+    ``train_ours_cnt_seq.py:541-633``).
+
+    ``compute_dtype`` mirrors :func:`make_train_step`: params/inputs/
+    states are cast for the apply so a ``trainer.precision: bf16`` run
+    validates the program it actually trains, while the per-window MSE is
+    reduced from an f32-cast prediction so the monitored scalars keep f32
+    accumulation (the drift harness, not the metric sums, judges the
+    rung). ``None`` traces the unmodified f32 reference program.
+    """
     mid_idx = (seqn - 1) // 2
 
     def eval_step(params, batch) -> dict:
         if rasterize is not None:
             batch = rasterize(batch)
         inp, gt = batch["inp"], batch["gt"]
+        if compute_dtype is not None:
+            params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+            inp = inp.astype(compute_dtype)
         b, L = inp.shape[0], inp.shape[1]
         slice_window = _window_slicer(inp, gt, seqn, mid_idx)
         idxs = jnp.arange(L - seqn + 1)
         states0 = model.init_states(b, inp.shape[2], inp.shape[3])
+        if compute_dtype is not None:
+            states0 = jax.tree.map(
+                lambda a: a.astype(compute_dtype), states0
+            )
 
         def body(states, i):
             window, gtw = slice_window(i)
             pred, states = model.apply(params, window, states)
-            return states, ((pred - gtw) ** 2).mean()
+            predf = pred.astype(jnp.float32)
+            return states, ((predf - gtw) ** 2).mean()
 
         _, losses = jax.lax.scan(body, states0, idxs)
         # valid_loss = window-summed MSE, valid_mse_loss = last window's MSE —
@@ -405,7 +408,8 @@ def make_eval_step(
 
 
 def make_fused_eval_accum(
-    model, seqn: int = 3, rasterize: Optional[Callable] = None
+    model, seqn: int = 3, rasterize: Optional[Callable] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> Callable:
     """The scanned accumulator behind fused validation: ``((params, sums),
     batch) -> ((params, sums), {})`` where ``sums`` carries the
@@ -416,7 +420,9 @@ def make_fused_eval_accum(
     ``_build_fused_eval``) and audit it through
     ``esr_tpu.analysis.programs`` (the jaxpr auditor registers exactly
     this composition as the production validation program)."""
-    eval_fn = make_eval_step(model, seqn, rasterize=rasterize)
+    eval_fn = make_eval_step(
+        model, seqn, rasterize=rasterize, compute_dtype=compute_dtype
+    )
 
     def accum(carry, batch):
         params, sums = carry
@@ -437,6 +443,7 @@ def jit_eval_step(
     model,
     seqn: int = 3,
     rasterize: Optional[Callable] = None,
+    compute_dtype: Optional[Any] = None,
     max_traces: int = 8,
     **jit_kwargs,
 ) -> Callable:
@@ -451,7 +458,9 @@ def jit_eval_step(
     from esr_tpu.analysis.retrace_guard import checked_jit
 
     return checked_jit(
-        make_eval_step(model, seqn, rasterize=rasterize),
+        make_eval_step(
+            model, seqn, rasterize=rasterize, compute_dtype=compute_dtype
+        ),
         name="eval_step",
         max_traces=max_traces,
         **jit_kwargs,

@@ -225,6 +225,41 @@ class Trainer:
         # scatter-add kernels feeding the HBM-resident event tensor") —
         # minimal host work + ~50x smaller host->device transfers.
         self.device_rasterize = bool(trainer_cfg.get("device_rasterize", False))
+        # dataset-level `encode: device|host` (docs/CONFIG.md): the
+        # VirtualFlow-style config-named spelling of the same placement
+        # decision — one YAML runs host-encoded on CPU smoke and
+        # device-encoded on chip by flipping one dataset row. When set it
+        # is authoritative; a contradicting trainer.device_rasterize is a
+        # config error, not a silent override.
+        encode = (
+            config["train_dataloader"].get("dataset") or {}
+        ).get("encode")
+        if encode not in (None, "host", "device"):
+            raise ValueError(
+                f"unknown dataset encode {encode!r} ('host' or 'device')"
+            )
+        if encode is not None:
+            want = encode == "device"
+            explicit = trainer_cfg.get("device_rasterize")
+            if explicit is not None and bool(explicit) != want:
+                raise ValueError(
+                    f"dataset encode: {encode!r} contradicts "
+                    f"trainer.device_rasterize: {explicit!r}"
+                )
+            self.device_rasterize = want
+        # one precision policy (esr_tpu.config.precision): the trainer is
+        # the config-block source the CLI-less planes defer to. Resolved
+        # here, BEFORE the transfer knob, so transfer_dtype: auto can
+        # follow the rung.
+        from esr_tpu.config.precision import (
+            compute_dtype_of,
+            resolve_precision,
+        )
+
+        self.precision = resolve_precision(
+            config=trainer_cfg.get("precision")
+        )
+        compute_dtype = compute_dtype_of(self.precision)
         # opt-in bf16 host->device batch transfer: halves the bytes the
         # count-map streams push over PCIe/ICI each TRAIN step (the e2e
         # bottleneck on transfer-bound hosts). Inputs are bf16-rounded
@@ -235,8 +270,14 @@ class Trainer:
         # best-checkpoint selection, and early stop are bit-identical to a
         # non-optioned run.
         transfer = trainer_cfg.get("transfer_dtype", None)
-        if transfer not in (None, "f32", "bf16"):
+        if transfer not in (None, "f32", "bf16", "auto"):
             raise ValueError(f"unknown transfer_dtype {transfer!r}")
+        if transfer == "auto":
+            # compose with the precision rung instead of being a separate
+            # train-only knob: at bf16 the step casts inputs to bf16
+            # in-graph anyway, so rounding them on the host first is free
+            # precision-wise and halves the wire bytes; at f32 it stays off.
+            transfer = "bf16" if self.precision == "bf16" else "f32"
         self.transfer_dtype = (
             jnp.bfloat16 if transfer == "bf16" else None
         )
@@ -262,6 +303,9 @@ class Trainer:
 
             cfg = copy.deepcopy(block)
             cfg["dataset"].setdefault("item_keys", keys)
+            # `encode:` is a trainer-resolved placement knob, not a
+            # dataset-construction parameter
+            cfg["dataset"].pop("encode", None)
             return cfg
 
         self.train_loader = build_train_loader(
@@ -316,10 +360,7 @@ class Trainer:
         # mesh + compiled steps
         self.mesh = mesh if mesh is not None else make_mesh()
         remat = bool(trainer_cfg.get("remat", False))
-        precision = trainer_cfg.get("precision", "f32")
-        if precision not in ("f32", "bf16"):
-            raise ValueError(f"unknown precision {precision!r}")
-        compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+        # precision/compute_dtype resolved above (one policy, CONFIG.md)
         rasterize = None
         if self.device_rasterize:
             from esr_tpu.training.train_step import make_device_rasterizer
@@ -353,8 +394,10 @@ class Trainer:
         data = NamedSharding(self.mesh, P("data"))
         # retrace-guarded jit (analysis.retrace_guard): a validation-loader
         # shape leak would otherwise recompile every stamp, silently
+        self._compute_dtype = compute_dtype
         self.eval_step = jit_eval_step(
             self.model, self.seqn, rasterize=rasterize,
+            compute_dtype=compute_dtype,
             in_shardings=(repl, data),
             out_shardings=repl,
         )
@@ -764,7 +807,8 @@ class Trainer:
         # the accumulator is the registered production program the jaxpr
         # auditor traces (esr_tpu.analysis.programs) — one definition
         accum = make_fused_eval_accum(
-            self.model, self.seqn, rasterize=self._rasterize
+            self.model, self.seqn, rasterize=self._rasterize,
+            compute_dtype=self._compute_dtype,
         )
 
         repl = NamedSharding(self.mesh, P())

@@ -68,6 +68,14 @@ def get_flags():
                    help="disable the cache even when the checkpoint "
                         "config enables it")
 
+    # precision rung (docs/PERF.md "precision ladder"): tri-state like
+    # --engine — omitted defers to the checkpoint's trainer.precision, so
+    # a bf16-trained model infers at the width it trained at by default
+    p.add_argument("--precision", type=str, default=None,
+                   choices=["f32", "bf16"],
+                   help="compute precision (default: checkpoint config's "
+                        "trainer.precision, else f32)")
+
     # dataset overrides (reference get_flags, infer_ours_cnt.py:135-157)
     p.add_argument("--scale", type=int, default=4)
     p.add_argument("--seqn", type=int, default=3)
@@ -145,6 +153,7 @@ def main():
         lanes=flags.lanes,
         chunk_windows=flags.chunk_windows,
         compile_cache=flags.compile_cache,
+        precision=flags.precision,
     )
     # One machine-readable JSON line (ADVICE r4: consumers must not eval()
     # a repr). json.dumps emits bare NaN/Infinity tokens for non-finite

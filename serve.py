@@ -108,6 +108,13 @@ def get_flags():
                         "quorum, /slo over merged windows, /fleet "
                         "topology + desired_replicas) on this port "
                         "(0 = ephemeral; fleet mode only; default off)")
+    # precision rung (docs/PERF.md "precision ladder"): tri-state like
+    # infer.py's — omitted defers to the checkpoint's trainer.precision,
+    # so a bf16-trained model serves at the width it trained at
+    p.add_argument("--precision", type=str, default=None,
+                   choices=["f32", "bf16"],
+                   help="compute precision (default: checkpoint config's "
+                        "trainer.precision, else f32)")
     p.add_argument("--profile-steps", type=int, default=0, metavar="N",
                    help="capture a jax.profiler device trace over the "
                         "first N dispatched chunks and stamp a "
@@ -191,8 +198,16 @@ def main():
     from esr_tpu.utils.logging import setup_logging
 
     setup_logging(flags.output_path)
-    model, params, _config = load_for_inference(flags.model_path)
+    model, params, ckpt_config = load_for_inference(flags.model_path)
     classes = parse_classes(flags.classes)
+    # one precision policy across train/infer/serve (docs/PERF.md
+    # "precision ladder"): CLI > checkpoint trainer.precision > f32
+    from esr_tpu.config.precision import resolve_precision
+
+    precision = resolve_precision(
+        cli=flags.precision,
+        config=((ckpt_config or {}).get("trainer") or {}).get("precision"),
+    )
 
     if flags.loadgen is not None:
         paths = make_stream_corpus(
@@ -223,6 +238,7 @@ def main():
                 flags.model_path, path, batch=flags.lanes,
                 height=kh, width=kw, program="engine_chunk",
                 chunk_windows=w, scale=flags.scale,
+                precision=precision,
             )
             aot_programs[w] = path
 
@@ -233,7 +249,7 @@ def main():
 
     if flags.replicas > 1:
         run_fleet(flags, model, params, dataset_config, classes,
-                  schedule, aot_programs)
+                  schedule, aot_programs, precision)
         return
 
     sink = TelemetrySink(os.path.join(flags.output_path, "telemetry.jsonl"))
@@ -254,6 +270,7 @@ def main():
                       else None),
             profile_steps=flags.profile_steps,
             profile_dir=os.path.join(flags.output_path, "profile"),
+            precision=precision,
         )
         if server.live is not None:
             print(
@@ -288,7 +305,7 @@ def main():
 
 
 def run_fleet(flags, model, params, dataset_config, classes, schedule,
-              aot_programs):
+              aot_programs, precision=None):
     """The fleet path (``--replicas N``, docs/SERVING.md "The fleet"):
     N replicas — each its own ``ServingEngine``, telemetry file, and
     live ``/healthz`` + ``/slo`` plane — behind a consistent-hash router
@@ -318,6 +335,7 @@ def run_fleet(flags, model, params, dataset_config, classes, schedule,
             preempt_quantum=flags.preempt_quantum,
             lane_quarantine_k=flags.lane_quarantine_k,
             request_retries=flags.request_retries,
+            precision=precision,
         ).start())
     for rep in replicas:
         print(

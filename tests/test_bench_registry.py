@@ -35,7 +35,7 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
-        "dcn_sparse_ab", "mfu_ceiling", "program_audit",
+        "dcn_sparse_ab", "precision_ladder", "mfu_ceiling", "program_audit",
         "concurrency_audit", "tier1_budget", "obs_live", "fleet_obs",
         "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
@@ -346,6 +346,31 @@ def test_dcn_sparse_ab_stage_registered_schema_pinned_and_smoke_runs():
     assert sum(rec["hist_synthetic"][:3]) > 0  # bursty tails counted
 
 
+def test_precision_ladder_stage_registered_and_schema_pinned():
+    """The precision-ladder series (ISSUE 19): f32-vs-bf16 step time,
+    host-vs-device rasterization cost with the bitwise-parity verdict,
+    the bf16 rungs' jaxpr-audit evidence and the drift verdict keep a
+    pinned schema, machine-comparable across rounds. The stage runs in
+    smoke (timings skip on CPU, parity/audit/drift are real); the full
+    smoke execution lives in the precision smoke gate
+    (tests/test_precision_ladder.py, scripts/precision_smoke.sh) — too
+    heavy for tier-1."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "precision_ladder"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_precision_ladder
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.PRECISION_LADDER_KEYS == (
+        "f32_steps_per_sec", "bf16_steps_per_sec", "bf16_step_speedup",
+        "host_encode_ms_per_window", "device_encode_ms_per_window",
+        "device_encode_speedup", "device_encode_bitwise_ok",
+        "audit_bf16_findings", "audit_bf16_clean", "audit_bf16_flops_frac",
+        "drift_max_rel_err", "drift_first_offender", "drift_ok",
+        "timing", "seed",
+    )
+
+
 def test_mfu_ceiling_stage_registered_schema_pinned_and_runs_offline():
     """The manifest-level roofline record (ISSUE 7 satellite — ROADMAP
     named scripts/mfu_ceiling.py as unwired): schema pinned, and the
@@ -399,8 +424,12 @@ def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
         assert prog["peak_bytes"] > 0, pname
         assert prog["findings"] == 0, pname
         # per-dtype breakdown (ISSUE 13): keyed "input->accumulator",
-        # sums back to the total, and the not-yet-climbed ladder keeps
-        # every production contraction in the f32 bucket
+        # sums back to the total. The f32 flagships keep every
+        # contraction in the f32 bucket; the bf16 rungs (ISSUE 19) must
+        # show bfloat16->float32 in the clear majority with NO narrow
+        # accumulator anywhere (JX001 — also enforced by findings == 0),
+        # and their residual f32 islands (loss/upsample) keep the
+        # float32->float32 entry present on every program.
         by_dtype = prog["flops_by_dtype"]
         assert by_dtype, pname
         assert all("->" in k for k in by_dtype), pname
@@ -408,6 +437,13 @@ def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
             prog["flops"], rel=1e-6
         ), pname
         assert "float32->float32" in by_dtype, pname
+        assert "bfloat16->bfloat16" not in by_dtype, pname
+        if pname.endswith("_bf16"):
+            wide = sum(v for k, v in by_dtype.items()
+                       if k.startswith("bfloat16->"))
+            assert wide / sum(by_dtype.values()) > 0.9, pname
+        else:
+            assert not any(k.startswith("bfloat16") for k in by_dtype), pname
     assert rec["clean"] is True and rec["total_findings"] == 0
     assert rec["rules_version"].startswith("jx:")
 

@@ -193,8 +193,10 @@ def test_checkpoint_config_inference_block_resolves_knobs(
     calls = []
 
     class _StubEngine:
-        def __init__(self, model, p, seqn, lanes, chunk_windows):
-            calls.append({"lanes": lanes, "chunk_windows": chunk_windows})
+        def __init__(self, model, p, seqn, lanes, chunk_windows,
+                     precision=None):
+            calls.append({"lanes": lanes, "chunk_windows": chunk_windows,
+                          "precision": precision})
 
         def run_datalist(self, data_list, dataset_config):
             return (
@@ -207,14 +209,18 @@ def test_checkpoint_config_inference_block_resolves_knobs(
     mean = run_inference(
         ckpt, ["/fake/rec0.h5"], out, DATASET_CFG, save_images=False
     )
-    assert calls == [{"lanes": 2, "chunk_windows": 3}]  # config block won
+    # config block won; precision resolves to the rung default (no CLI
+    # flag, no trainer.precision in this checkpoint)
+    assert calls == [{"lanes": 2, "chunk_windows": 3, "precision": "f32"}]
     assert mean["esr_mse"] == 1.0
     # explicit arguments override the config block
     run_inference(
         ckpt, ["/fake/rec0.h5"], out, DATASET_CFG, save_images=False,
         lanes=5, chunk_windows=7,
     )
-    assert calls[-1] == {"lanes": 5, "chunk_windows": 7}
+    assert calls[-1] == {
+        "lanes": 5, "chunk_windows": 7, "precision": "f32"
+    }
     # and engine=False overrides engine: true — the sequential path would
     # open the (nonexistent) recording, which is exactly the proof the
     # stub engine was bypassed
