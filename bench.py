@@ -1185,8 +1185,24 @@ PRECISION_LADDER_KEYS = (
     "device_encode_speedup", "device_encode_bitwise_ok",
     "audit_bf16_findings", "audit_bf16_clean", "audit_bf16_flops_frac",
     "drift_max_rel_err", "drift_first_offender", "drift_ok",
+    # the int8 serving rung (ISSUE 20): PSNR/SSIM per rung on the SAME
+    # seeded synthetic corpus with the acceptance bound pinned (the int8
+    # PSNR drop vs f32 must stay under INT8_PSNR_DROP_BOUND_DB), the
+    # int8 flagship's jaxpr-audit evidence (JX001-clean + the
+    # int8->int32 share of executed contraction flops), and the
+    # quantization-drift attribution (worst-quantized seam by name).
+    "f32_psnr", "bf16_psnr", "int8_psnr",
+    "f32_ssim", "bf16_ssim", "int8_ssim",
+    "int8_psnr_drop_db", "int8_psnr_bound_db", "int8_quality_ok",
+    "audit_int8_findings", "audit_int8_clean", "audit_int8_flops_frac",
+    "int8_drift_max_rel_err", "int8_drift_worst_tag", "int8_drift_ok",
     "timing", "seed",
 )
+
+# the int8 quality acceptance bound (ISSUE 20): post-training w8a8
+# quantization may cost at most this much PSNR against the f32 twin on
+# the seeded synthetic corpus — above it the rung is not servable
+INT8_PSNR_DROP_BOUND_DB = 1.0
 
 
 def stage_precision_ladder(ctx):
@@ -1209,7 +1225,12 @@ def stage_precision_ladder(ctx):
       ``bfloat16->float32`` share of executed contraction flops is the
       per-program adoption series;
     - the drift-harness verdict at a fixed tiny scale: max ladder
-      rel-err, first offender (none expected), tolerance-judged ok.
+      rel-err, first offender (none expected), tolerance-judged ok;
+    - the int8 serving rung (ISSUE 20, device-free, runs in smoke):
+      per-rung PSNR/SSIM on one seeded synthetic corpus with the pinned
+      acceptance bound (``INT8_PSNR_DROP_BOUND_DB``), the int8
+      flagship's clean audit + ``int8->int32`` flops share, and the
+      quantization-drift ladder naming the worst-quantized seam.
     """
     import jax
     import jax.numpy as jnp
@@ -1318,11 +1339,70 @@ def stage_precision_ladder(ctx):
     max_rel = max((e["rel_err"] for e in drift["ladder"]), default=None)
     drift_ok = drift["n_exceeding"] == 0
 
+    # --- int8 rung quality cell (ISSUE 20, device-free) ----------------
+    # SAME seeded synthetic corpus, SAME seeded init, all three rungs:
+    # PSNR/SSIM against one seeded GT — the cross-rung DROP is the rung
+    # cost, with the shared-content variance cancelling by construction.
+    from esr_tpu.config.quantize import int8_scope
+    from esr_tpu.losses.restore import psnr_metric, ssim_metric
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    qmodel = DeepRecurrNet(inch=2, basech=4, num_frame=3)
+    qb, qhw = 2, 16
+    qx = jnp.asarray(
+        rng.poisson(0.3, size=(qb, 3, qhw, qhw, 2)).astype(np.float32))
+    qstates = qmodel.init_states(qb, qhw, qhw)
+    qparams = qmodel.init(jax.random.PRNGKey(seed), qx, qstates)
+
+    pred32, _ = qmodel.apply(qparams, qx, qstates)
+    gt = jnp.asarray(
+        rng.poisson(0.5, size=pred32.shape).astype(np.float32))
+
+    def _quality(pred):
+        pred = pred.astype(jnp.float32)
+        ps = float(np.mean([
+            float(psnr_metric(pred[i], gt[i])) for i in range(qb)]))
+        ss = float(np.mean([
+            float(ssim_metric(pred[i], gt[i])) for i in range(qb)]))
+        return round(ps, 4), round(ss, 5)
+
+    f32_psnr, f32_ssim = _quality(pred32)
+    cast16 = lambda t: jax.tree.map(  # noqa: E731
+        lambda a: a.astype(jnp.bfloat16), t)
+    pred16, _ = qmodel.apply(cast16(qparams), cast16(qx), cast16(qstates))
+    bf16_psnr, bf16_ssim = _quality(pred16)
+    with int8_scope():
+        pred8, _ = qmodel.apply(qparams, qx, qstates)
+    int8_psnr, int8_ssim = _quality(pred8)
+    psnr_drop = round(f32_psnr - int8_psnr, 4)
+    quality_ok = psnr_drop <= INT8_PSNR_DROP_BOUND_DB
+
+    # --- int8 flagship audit + quantization-drift attribution ----------
+    specs8 = [s for s in production_programs() if s.name.endswith("_int8")]
+    audits8 = audit_production_programs(specs8)
+    findings8 = {a.name: len(a.findings) for a in audits8}
+    fracs8 = {}
+    for a in audits8:
+        by = a.profile.get("flops_by_dtype", {}) or {}
+        tot = sum(by.values())
+        q = sum(v for k, v in by.items() if k.startswith("int8->"))
+        fracs8[a.name] = round(q / tot, 4) if tot else None
+    audit8_clean = bool(audits8) and all(
+        v == 0 for v in findings8.values())
+    drift8 = run_drift(dtype="int8", basech=4, hw=16, seed=seed)
+    max_rel8 = max((e["rel_err"] for e in drift8["ladder"]), default=None)
+    drift8_ok = drift8["n_exceeding"] == 0
+
     res = dict(zip(PRECISION_LADDER_KEYS, (
         f32_sps, bf16_sps, step_speedup,
         host_ms, dev_ms, enc_speedup, bitwise_ok,
         findings, audit_clean, fracs,
         max_rel, drift["first_offender"], drift_ok,
+        f32_psnr, bf16_psnr, int8_psnr,
+        f32_ssim, bf16_ssim, int8_ssim,
+        psnr_drop, INT8_PSNR_DROP_BOUND_DB, quality_ok,
+        findings8, audit8_clean, fracs8,
+        max_rel8, drift8["worst_tag"], drift8_ok,
         "tpu" if on_tpu else "skipped: cpu backend (interpreter timing)",
         seed,
     ), strict=True))
@@ -1331,6 +1411,10 @@ def stage_precision_ladder(ctx):
         "device_encode_bitwise_ok": bitwise_ok,
         "audit_bf16_clean": audit_clean,
         "drift_ok": drift_ok,
+        "int8_psnr_drop_db": psnr_drop,
+        "int8_quality_ok": quality_ok,
+        "audit_int8_clean": audit8_clean,
+        "int8_drift_ok": drift8_ok,
     }
     return res
 
@@ -1369,6 +1453,201 @@ def stage_mfu_ceiling():
         jax.devices()[0].device_kind,
     ), strict=True))
     EXTRA["mfu_ceiling"] = dict(res)
+    return res
+
+
+# The batch_scaling stage record schema, pinned by test_bench_registry
+# (ISSUE 20): the roofline-anchored batch sweep. Every cell carries
+# device-free shape/flops/peak-bytes evidence (the jaxpr profile of the
+# PRODUCTION program at that geometry) next to the model-imposed MXU
+# ceiling from utils/roofline, and — on TPU only — measured steps/s,
+# MFU, and the compute-bound verdict. Off-TPU the timings are honestly
+# skipped but the evidence series still accumulates, and the sweep names
+# the largest memory-feasible trainer batch the flagship configs adopt.
+BATCH_SCALING_KEYS = (
+    "geometry", "train_batches", "train_cells",
+    "largest_feasible_batch", "serving_cells",
+    "hbm_budget_bytes", "hbm_budget_source", "peak_flops_chip",
+    "timing", "seed",
+)
+
+# per-chip HBM capacity, keyed like _PEAK_FLOPS (device_kind prefix);
+# the memory-feasibility verdicts below are judged against this budget
+_HBM_BYTES = {
+    "TPU v5 lite": 16e9,  # v5e
+    "TPU v5": 95e9,       # v5p
+    "TPU v4": 32e9,
+    "TPU v6 lite": 32e9,  # v6e
+}
+
+# a measured MFU within this factor of the model-imposed MXU ceiling
+# reads as compute-bound: the cell is spending its time in contractions,
+# not in dispatch/memory stalls (the ceiling itself already prices the
+# model's tile-packing losses)
+_COMPUTE_BOUND_FRAC = 0.5
+
+
+def _hbm_budget():
+    """(bytes, source) for the current chip; off-TPU falls back to the
+    flagship serving target so feasibility verdicts still record."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix, cap in _HBM_BYTES.items():
+        if kind.startswith(prefix):
+            return cap, kind
+    return 16e9, "assumed: TPU v5 lite (flagship serving target)"
+
+
+def stage_batch_scaling(ctx):
+    """Batch scaling to the roofline (ISSUE 20): sweep the trainer batch
+    (2 -> 64, geometric) and the serving lanes x chunk_windows grid
+    against ``utils/roofline``'s model-imposed MXU ceiling.
+
+    Evidence discipline per cell:
+
+    - ALWAYS (device-free, runs in smoke): the jaxpr profile of the
+      PRODUCTION program at that geometry — static contraction flops and
+      peak buffer residency (``analysis.jaxpr_audit``) — plus the
+      flops-weighted MXU occupancy ceiling at that batch and the
+      HBM-feasibility verdict against the chip budget;
+    - TPU ONLY: measured steps/s (windows/s for serving cells), MFU
+      against the chip peak, and the compute-bound verdict (measured MFU
+      within ``_COMPUTE_BOUND_FRAC`` of the ceiling). Off-TPU the timing
+      keys are honestly null with ``timing`` naming why.
+
+    The sweep's ``largest_feasible_batch`` is what the flagship recipes
+    adopt (configs/train_esr_2x.yml documents the adoption).
+    """
+    import jax
+
+    from esr_tpu.analysis.jaxpr_audit import audit_callable
+    from esr_tpu.inference.engine import make_chunk_fn
+    from esr_tpu.training.train_step import TrainState
+    from esr_tpu.utils.roofline import ceiling_for
+
+    on_tpu = jax.default_backend() != "cpu"
+    seed = 0
+    budget, budget_src = _hbm_budget()
+    peak = _peak_flops()
+
+    batches = (2, 4) if ctx.smoke else (2, 4, 8, 16, 32, 64)
+    state_sds = jax.eval_shape(
+        lambda p: TrainState.create(p, ctx.opt), ctx.params_scan)
+
+    train_cells = {}
+    feasible = []
+    for b in batches:
+        ceil = ceiling_for(8, b=b, h=ctx.h, w=ctx.w, seqn=ctx.seqn)
+        batch_sds = {
+            "inp": jax.ShapeDtypeStruct(
+                (b, ctx.L, ctx.h, ctx.w, 2), "float32"),
+            "gt": jax.ShapeDtypeStruct(
+                (b, ctx.L, ctx.h, ctx.w, 2), "float32"),
+        }
+        prof = audit_callable(
+            f"train_step_b{b}", ctx.step_fn, (state_sds, batch_sds),
+            donate_argnums=(0,),
+        ).profile
+        peak_bytes = prof.get("peak_bytes", 0)
+        fits = bool(peak_bytes and peak_bytes <= budget)
+        if fits:
+            feasible.append(b)
+        cell = {
+            "mxu_occupancy_ceiling": ceil["mxu_occupancy_ceiling"],
+            "total_gflops_fwd": ceil["total_gflops_fwd"],
+            "flops_per_step": prof.get("flops", 0.0),
+            "peak_bytes": peak_bytes,
+            "fits_hbm": fits,
+            "steps_per_sec": None,
+            "mfu": None,
+            "mfu_vs_ceiling": None,
+            "compute_bound": None,
+        }
+        if on_tpu and fits:
+            batch = _recipe_batch(b, ctx.L, ctx.h, ctx.w, seed=seed)
+            st = TrainState.create(
+                jax.tree.map(jax.numpy.array, ctx.params_scan), ctx.opt)
+            step = jax.jit(ctx.step_fn, donate_argnums=(0,))
+            sps, _ = _time_steps(step, st, batch, iters=10, reps=2)
+            mfu = cell["flops_per_step"] * sps / peak
+            cell["steps_per_sec"] = round(sps, 3)
+            cell["mfu"] = round(mfu, 4)
+            cell["mfu_vs_ceiling"] = round(
+                mfu / ceil["mxu_occupancy_ceiling"], 4)
+            cell["compute_bound"] = bool(
+                cell["mfu_vs_ceiling"] >= _COMPUTE_BOUND_FRAC)
+        train_cells[f"b{b}"] = cell
+
+    # serving grid: lanes x chunk_windows on the GT grid (the engine's
+    # fused chunk at the f32 rung — rung deltas live in precision_ladder)
+    grid = ((2, 2),) if ctx.smoke else ((2, 4), (4, 8), (8, 8), (8, 16))
+    params_sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), ctx.params_scan)
+    serving_cells = {}
+    for lanes, w in grid:
+        run_chunk = make_chunk_fn(ctx.model, lanes, w, ctx.h, ctx.w)
+        states_sds = jax.eval_shape(
+            lambda lanes=lanes: ctx.model.init_states(lanes, ctx.h, ctx.w))
+        windows_sds = {
+            "inp_scaled": jax.ShapeDtypeStruct(
+                (w, lanes, ctx.seqn, ctx.h, ctx.w, 2), "float32"),
+            "inp_mid": jax.ShapeDtypeStruct(
+                (w, lanes, ctx.h, ctx.w, 2), "float32"),
+            "gt": jax.ShapeDtypeStruct(
+                (w, lanes, ctx.h, ctx.w, 2), "float32"),
+            "valid": jax.ShapeDtypeStruct((w, lanes), "float32"),
+        }
+        reset_sds = jax.ShapeDtypeStruct((lanes,), "float32")
+        prof = audit_callable(
+            f"serve_chunk_l{lanes}w{w}", run_chunk,
+            (params_sds, states_sds, reset_sds, windows_sds),
+            donate_argnums=(1,),
+        ).profile
+        peak_bytes = prof.get("peak_bytes", 0)
+        cell = {
+            "flops_per_chunk": prof.get("flops", 0.0),
+            "peak_bytes": peak_bytes,
+            "fits_hbm": bool(peak_bytes and peak_bytes <= budget),
+            "windows_per_sec": None,
+            "mfu": None,
+            "compute_bound": None,
+        }
+        if on_tpu and cell["fits_hbm"]:
+            import jax.numpy as jnp
+
+            zeros = lambda s: jax.tree.map(  # noqa: E731
+                lambda d: jnp.zeros(d.shape, d.dtype), s)
+            args = (ctx.params_scan, zeros(states_sds),
+                    zeros(reset_sds), zeros(windows_sds))
+            jfn = jax.jit(run_chunk)  # no donation: timing reuses args
+            t = _timed_jit(lambda: jfn(*args), iters=10)
+            mfu = cell["flops_per_chunk"] / t / peak
+            ceil = ceiling_for(
+                8, b=lanes, h=ctx.h, w=ctx.w, seqn=ctx.seqn)
+            cell["windows_per_sec"] = round(w * lanes / t, 2)
+            cell["mfu"] = round(mfu, 4)
+            cell["compute_bound"] = bool(
+                mfu / ceil["mxu_occupancy_ceiling"]
+                >= _COMPUTE_BOUND_FRAC)
+        serving_cells[f"l{lanes}w{w}"] = cell
+
+    res = dict(zip(BATCH_SCALING_KEYS, (
+        {"L": ctx.L, "h": ctx.h, "w": ctx.w, "seqn": ctx.seqn},
+        list(batches),
+        train_cells,
+        max(feasible) if feasible else None,
+        serving_cells,
+        budget,
+        budget_src,
+        peak,
+        "tpu" if on_tpu else "skipped: cpu backend (interpreter timing)",
+        seed,
+    ), strict=True))
+    EXTRA["batch_scaling"] = {
+        "largest_feasible_batch": res["largest_feasible_batch"],
+        "train_batches": res["train_batches"],
+    }
     return res
 
 
@@ -2764,6 +3043,10 @@ STAGE_REGISTRY = [
     # manifest-level roofline record: device-free eval_shape trace, runs
     # (and produces real numbers) in smoke too
     ("mfu_ceiling", lambda ctx: stage_mfu_ceiling(), 600, True),
+    # the roofline-anchored batch sweep (ISSUE 20): device-free
+    # shape/flops/peak-bytes evidence always; steps/s + MFU + the
+    # compute-bound verdicts only on a chip
+    ("batch_scaling", stage_batch_scaling, 900, True),
     # jaxpr-level program contracts + per-program growth profile
     # (device-free make_jaxpr/lower over the production registry — runs
     # in smoke; the same audit `python -m esr_tpu.analysis --jaxpr` gates)

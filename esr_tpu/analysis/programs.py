@@ -365,6 +365,53 @@ def _build_infer_engine_chunk_bf16() -> BuiltProgram:
     )
 
 
+def _build_infer_engine_chunk_int8() -> BuiltProgram:
+    """The streaming/serving chunk at the int8 PTQ rung: params/states
+    STAY f32 (quantization happens inside the contraction seams,
+    ``esr_tpu.config.quantize``), every dot/conv runs int8 x int8 with an
+    i32 ``preferred_element_type`` accumulator, and the dequantized result
+    returns to f32 before the next layer. The audit's ``flops_by_dtype``
+    must show the contraction flops in the ``int8->int32`` bucket — a
+    narrow int8 accumulator is exactly the JX001 hazard this flagship
+    exists to pin against."""
+    import jax
+    import jax.numpy as jnp
+
+    from esr_tpu.inference.engine import make_chunk_fn
+
+    model, _, seqn, inch = _sds_model()
+    kh = kw = AUDIT_HW
+
+    def init():
+        x0 = jnp.zeros((AUDIT_LANES, seqn, kh, kw, inch), jnp.float32)
+        states = model.init_states(AUDIT_LANES, kh, kw)
+        params = model.init(jax.random.PRNGKey(0), x0, states)
+        return params, states
+
+    params, states = jax.eval_shape(init)
+    run_chunk = make_chunk_fn(model, AUDIT_LANES, AUDIT_CHUNK, kh, kw,
+                              precision="int8")
+    windows = {
+        "inp_scaled": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES, seqn, kh, kw, inch), "float32"
+        ),
+        "inp_mid": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES, kh, kw, inch), "float32"
+        ),
+        "gt": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES, kh, kw, inch), "float32"
+        ),
+        "valid": jax.ShapeDtypeStruct(
+            (AUDIT_CHUNK, AUDIT_LANES), "float32"
+        ),
+    }
+    reset_keep = jax.ShapeDtypeStruct((AUDIT_LANES,), "float32")
+    return BuiltProgram(
+        run_chunk, (params, states, reset_keep, windows),
+        donate_argnums=(1,),
+    )
+
+
 def _dcn_shapes():
     import jax
 
@@ -460,6 +507,16 @@ PROGRAMS: List[ProgramSpec] = [
         _build_infer_engine_chunk_bf16,
         allow=("JX003",),
         description="streaming/serving chunk at the bf16 rung",
+    ),
+    # the int8 rung needs NO JX003 waiver: the quantize path's converts
+    # (f32 clip -> int8, i32 accumulator -> f32) are one-way — nothing
+    # rounds back through its own origin dtype, so no cast round-trip
+    # exists for JX003 to flag. An empty allow keeps the rung honest.
+    ProgramSpec(
+        "infer_engine_chunk_int8",
+        _build_infer_engine_chunk_int8,
+        description="streaming/serving chunk at the int8 PTQ rung "
+                    "(w8a8, i32 accumulation)",
     ),
     ProgramSpec(
         "dcn_train",

@@ -77,7 +77,11 @@ Deliberate scope limits (quiet enough to gate CI, like the CX pass):
   module) resolve one hop through the import, not transitively;
 - "fresh shapes/dtypes" in TX005 is approximated by call-site counting —
   distinct test-body trace sites are what churns the program cache,
-  whatever their shapes;
+  whatever their shapes; a factory call lexically inside a ``with
+  pytest.raises(...)`` body is exempt (the call is the REFUSAL under
+  test — it raises at validation and never produces a traced program,
+  so it cannot churn the cache or push innocent sites over the
+  threshold);
 - dynamically-built fixtures (``request.getfixturevalue``) and
   ``usefixtures`` marks are invisible; the suite does not use them.
 """
@@ -462,6 +466,23 @@ def _iter_defs(tree: ast.Module):
                     yield item, cls_slow
 
 
+def _expected_raise_nodes(fn: ast.AST) -> Set[ast.AST]:
+    """All AST nodes lexically inside a ``with pytest.raises(...)`` body
+    in ``fn`` — calls there are refusals under test, not paid costs."""
+    nodes: Set[ast.AST] = set()
+    for w in ast.walk(fn):
+        if not isinstance(w, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(
+                isinstance(i.context_expr, ast.Call)
+                and _dotted(i.context_expr.func) == "pytest.raises"
+                for i in w.items):
+            continue
+        for stmt in w.body:
+            nodes.update(ast.walk(stmt))
+    return nodes
+
+
 def extract_test_module(ctx: ModuleContext) -> TestModule:
     """The cost model of one test file: fixture defs (scope + params),
     tests (slow flags), helper call graph, expensive/subprocess/wait
@@ -499,12 +520,17 @@ def extract_test_module(ctx: ModuleContext) -> TestModule:
         direct: List[ExpensiveCall] = []
         direct_sub: List[SubprocessSite] = []
         calls: List[Tuple[ast.AST, str]] = []
+        expected_raise = _expected_raise_nodes(fn)
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             exp = _classify_expensive(node, m.consts)
             if exp is not None:
-                direct.append(exp)
+                # a traced-factory call under `with pytest.raises(...)`
+                # is the refusal under test: it raises at validation and
+                # never traces, so it is no TX005 churn site
+                if not (exp.kind == "traced" and node in expected_raise):
+                    direct.append(exp)
             sub = _classify_subprocess(node, m.consts)
             if sub is not None:
                 timeout = _literal_timeout(node, m.consts)

@@ -24,16 +24,23 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-#: the config-level rungs — ``trainer.precision`` / ``--precision`` values
-PRECISIONS = ("f32", "bf16")
+#: the config-level rungs — ``trainer.precision`` / ``--precision`` values.
+#: ``int8`` is the SERVING rung (post-training quantization,
+#: ``esr_tpu.config.quantize``): inference/serving only — the trainer
+#: rejects it loudly (training updates need float params).
+PRECISIONS = ("f32", "bf16", "int8")
 
-# short/long spellings -> canonical rung name
+# short/long spellings -> canonical rung name. "w8a8" is the literature
+# spelling (8-bit weights, 8-bit activations) of the same PTQ rung.
 _PRECISION_ALIASES = {
     "f32": "f32",
     "fp32": "f32",
     "float32": "f32",
     "bf16": "bf16",
     "bfloat16": "bf16",
+    "int8": "int8",
+    "i8": "int8",
+    "w8a8": "int8",
 }
 
 # short/long spellings -> numpy-parsable dtype name (jnp.dtype-safe)
@@ -50,6 +57,9 @@ _DTYPE_ALIASES = {
     "f64": "float64",
     "fp64": "float64",
     "float64": "float64",
+    "int8": "int8",
+    "i8": "int8",
+    "w8a8": "int8",
 }
 
 
@@ -102,11 +112,19 @@ def resolve_precision(
 def compute_dtype_of(precision: Optional[str]):
     """Map a precision rung to the ``compute_dtype`` the step factories
     take: ``None`` for f32 (the unmodified reference program) or
-    ``jnp.bfloat16``. Accepts ``None`` (meaning: unresolved -> f32)."""
+    ``jnp.bfloat16``. Accepts ``None`` (meaning: unresolved -> f32).
+
+    ``int8`` also maps to ``None`` — deliberately. The PTQ rung never
+    casts params/states/inputs (quantization happens INSIDE the
+    contraction seams, ``esr_tpu.config.quantize``; everything between
+    seams stays f32), so any caller that would cast to a compute dtype
+    must not cast at all. The rung itself is threaded separately
+    (``make_chunk_fn(..., precision=...)``).
+    """
     if precision is None:
         return None
     rung = canonical_precision(precision)
-    if rung == "f32":
+    if rung in ("f32", "int8"):
         return None
     import jax.numpy as jnp
 

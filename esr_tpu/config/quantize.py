@@ -1,0 +1,234 @@
+"""Post-training int8 quantization — the ladder's serving rung.
+
+The bf16 rung (docs/PERF.md "precision ladder") casts operands and
+widens accumulators; this module is the next rung down: **w8a8 PTQ** at
+the contraction seams only. Nothing outside a conv/dot changes width —
+params, recurrent lane states, the rasterized wire, and every
+inter-layer activation stay f32 (so ``transfer_dtype: auto`` composes
+trivially: the wire carries the rasterized input dtype, quantization
+happens at the seams, not on the wire). At each seam:
+
+- **weights**: per-output-channel symmetric int8 — ``scale_c =
+  max|w[..., c]| / 127``, one scale per output feature, so a channel
+  with small weights does not burn its 8 bits on another channel's
+  range (the standard PTQ choice, e.g. arxiv 2107.02547's fixed-point
+  DCN datapath);
+- **activations**: dynamic per-tensor symmetric int8 — the scale comes
+  from the live ``max|x|`` in-graph, so no baked range can be stale;
+- **contraction**: int8 x int8 with an **i32 accumulator**
+  (``preferred_element_type=jnp.int32``) — the JX001 contract; the
+  jaxpr auditor's ``flops_by_dtype`` shows these as an
+  ``int8->int32`` bucket, and a narrow (int8) accumulator anywhere
+  fails ``python -m esr_tpu.analysis --jaxpr``;
+- **dequantize at the seam**: ``i32 * (scale_x * scale_w[c])`` back to
+  the incoming float dtype, so downstream code is byte-identical to
+  the f32 program.
+
+The trigger is a TRACE-TIME scope (:func:`int8_scope`), queried by the
+existing ``wide_accum_conv_general_dilated`` /
+``wide_accum_dot_general`` injection seams in ``models.layers`` — the
+same seam set the bf16 rung rides, so coverage is identical by
+construction. The scope must be entered INSIDE the traced function
+body (``make_chunk_fn`` does this when built with ``precision="int8"``)
+so shape-driven retraces re-apply it.
+
+**Calibration** (:func:`calibrate_ranges`) runs a seeded synthetic
+corpus through the EXISTING ``obs/numerics`` tensor-stats taps
+(``numerics_mode="stats"``, ``max_abs`` per tag) — no new
+instrumentation plane. Dynamic per-tensor quantization needs no baked
+ranges to run; the calibration pass records, deterministically from
+its seed, the per-layer ranges the dynamic scales will encounter — the
+range evidence the drift harness (``python -m esr_tpu.obs drift
+--dtype int8``) and the bench quality cell attribute error against.
+
+jax is imported lazily (module scope stays jax-free, like
+``config.precision`` — the drift CLI imports before backend choice).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Dict, Optional
+
+# floor for the symmetric scale so an all-zero tensor quantizes to
+# zeros instead of dividing by zero
+_SCALE_EPS = 1e-12
+
+# trace-time switch the models.layers seams query; a ContextVar (not a
+# bare global) so concurrent traces on different threads — the serving
+# pump vs a background export — cannot leak the rung into each other
+_INT8_SCOPE: contextvars.ContextVar = contextvars.ContextVar(
+    "esr_int8_scope", default=False
+)
+
+
+@contextlib.contextmanager
+def int8_scope(enabled: bool = True):
+    """While active, every ``models.layers`` contraction seam traced on
+    this thread runs the PTQ path. Enter it INSIDE the traced function
+    body (not around a ``jit`` call site) so retraces re-apply it."""
+    token = _INT8_SCOPE.set(bool(enabled))
+    try:
+        yield
+    finally:
+        _INT8_SCOPE.reset(token)
+
+
+def int8_enabled() -> bool:
+    """Is the PTQ scope active on this thread (trace-time query)?"""
+    return bool(_INT8_SCOPE.get())
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize primitives
+
+
+def quantize_symmetric(x, axis: Optional[int] = None):
+    """Symmetric int8 quantization: ``(q, scale)`` with ``q = clip(
+    round(x / scale), -127, 127)`` as int8 and ``scale`` f32.
+
+    ``axis=None`` is per-tensor (one scalar scale — the dynamic
+    activation path); ``axis=k`` is per-channel along axis ``k`` (the
+    weight path: ``scale`` keeps a keepdims shape so it broadcasts
+    against ``x``). Values exactly on the ``scale * [-127, 127]`` grid
+    round-trip exactly (pinned by tests/test_quantize.py)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    if axis is None:
+        amax = jnp.max(jnp.abs(x))
+    else:
+        red = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+        amax = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    scale = (jnp.maximum(amax, _SCALE_EPS) / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize(q, scale):
+    """Inverse of :func:`quantize_symmetric` (f32 out): ``q * scale``."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale
+
+
+# ---------------------------------------------------------------------------
+# the quantized contractions (called by the models.layers seams)
+
+
+def quantized_conv_general_dilated(lhs, rhs, window_strides, padding, **kw):
+    """The PTQ conv seam: dynamic per-tensor activation quant,
+    per-output-channel weight quant, int8 contraction with an i32
+    ``preferred_element_type`` accumulator, dequantized back to the
+    incoming float dtype. Signature mirrors flax's
+    ``conv_general_dilated`` injection callable."""
+    import jax
+    import jax.numpy as jnp
+
+    dn = jax.lax.conv_dimension_numbers(
+        lhs.shape, rhs.shape, kw.get("dimension_numbers")
+    )
+    q_lhs, s_lhs = quantize_symmetric(lhs)
+    # rhs_spec[0] is the output-feature dim of the kernel (HWIO -> O)
+    q_rhs, s_rhs = quantize_symmetric(rhs, axis=dn.rhs_spec[0])
+    acc = jax.lax.conv_general_dilated(
+        q_lhs, q_rhs, window_strides, padding,
+        **{**kw, "preferred_element_type": jnp.int32},
+    )
+    # broadcast the per-channel weight scale over the conv OUTPUT's
+    # feature dim (out_spec[1] — NHWC -> C)
+    shape = [1] * acc.ndim
+    shape[dn.out_spec[1]] = acc.shape[dn.out_spec[1]]
+    ch_scale = jnp.reshape(s_rhs, shape)
+    return (acc.astype(jnp.float32) * (s_lhs * ch_scale)).astype(lhs.dtype)
+
+
+def quantized_dot_general(lhs, rhs, dimension_numbers, **kw):
+    """The PTQ dot seam (``nn.Dense``: rhs is ``(in, out)``, contraction
+    over axis 0, output feature last) — int8 operands, i32 accumulator,
+    per-output-channel dequant."""
+    import jax
+    import jax.numpy as jnp
+
+    (lc, rc), (lb, rb) = dimension_numbers
+    out_axes = [
+        a for a in range(rhs.ndim) if a not in tuple(rc) + tuple(rb)
+    ]
+    q_lhs, s_lhs = quantize_symmetric(lhs)
+    q_rhs, s_rhs = quantize_symmetric(rhs, axis=out_axes[-1])
+    acc = jax.lax.dot_general(
+        q_lhs, q_rhs, dimension_numbers,
+        **{**kw, "preferred_element_type": jnp.int32},
+    )
+    # dot_general output layout: batch dims, lhs free dims, rhs free
+    # dims — the rhs output feature lands last
+    shape = [1] * acc.ndim
+    shape[-1] = acc.shape[-1]
+    ch_scale = jnp.reshape(s_rhs, shape)
+    return (acc.astype(jnp.float32) * (s_lhs * ch_scale)).astype(lhs.dtype)
+
+
+# ---------------------------------------------------------------------------
+# calibration: seeded corpus -> per-layer ranges via the EXISTING taps
+
+
+def calibrate_ranges(
+    model=None,
+    *,
+    inch: int = 2,
+    basech: int = 8,
+    hw: int = 32,
+    frames: int = 3,
+    batch: int = 1,
+    seed: int = 0,
+    n_batches: int = 2,
+) -> Dict[str, float]:
+    """Per-layer activation ranges ``{tag: max_abs}`` from a seeded
+    synthetic corpus pass through the numerics plane's tensor-stats
+    probes (``ops.numerics.probe`` in ``mode="stats"``) — the existing
+    instrumentation, no new taps. Deterministic from ``seed`` (pinned):
+    params init and every corpus batch derive from it.
+
+    ``model`` (when given) must be probe-enabled
+    (``numerics=True, numerics_mode="stats"``); by default a
+    ``DeepRecurrNet`` at the drift harness's geometry is built."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esr_tpu.ops.numerics import STAT_FIELDS, flatten_probes
+
+    if model is None:
+        from esr_tpu.models.esr import DeepRecurrNet
+
+        model = DeepRecurrNet(
+            inch=inch, basech=basech, num_frame=frames,
+            numerics=True, numerics_mode="stats",
+        )
+    states = model.init_states(batch, hw, hw)
+    x0 = jax.random.normal(
+        jax.random.PRNGKey(seed), (batch, frames, hw, hw, inch),
+        jnp.float32,
+    )
+    variables = model.init(jax.random.PRNGKey(seed + 1), x0, states)
+    params = {"params": variables["params"]}
+    idx = STAT_FIELDS.index("max_abs")
+    probes = []
+    for i in range(int(n_batches)):
+        x = jax.random.normal(
+            jax.random.PRNGKey(seed + 2 + i),
+            (batch, frames, hw, hw, inch), jnp.float32,
+        )
+        (_out, _st), mut = model.apply(
+            params, x, states, train=False, mutable=["numerics"]
+        )
+        probes.append(mut["numerics"])
+    ranges: Dict[str, float] = {}
+    # one host transfer for the whole corpus, after the device loop
+    host_probes = jax.device_get(probes)
+    for taps in (flatten_probes(t) for t in host_probes):
+        for tag, vec in taps.items():
+            v = float(np.asarray(vec, np.float64).reshape(-1)[idx])
+            ranges[tag] = max(ranges.get(tag, 0.0), v)
+    return {tag: round(v, 6) for tag, v in sorted(ranges.items())}

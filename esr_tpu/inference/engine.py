@@ -86,7 +86,7 @@ _METRIC_FNS = {
 
 
 def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int,
-                  compute_dtype=None):
+                  compute_dtype=None, precision=None):
     """Build the PURE fused-chunk program: ``(params, states, reset_keep,
     windows) -> (states, sums, stacked)``.
 
@@ -116,8 +116,24 @@ def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int,
     f32 — so a bf16 chunk program reports through the identical metric
     pipeline. Callers must materialize the entry lane states in the same
     dtype (the donated carry's signature is part of the program).
+
+    ``precision`` threads the RUNG itself for the paths a cast dtype
+    cannot express: at ``"int8"`` (the PTQ serving rung,
+    ``esr_tpu.config.quantize``) params/states/inputs stay f32
+    (``compute_dtype`` must be ``None``) and the apply runs inside the
+    int8 trace scope, so every contraction seam quantizes in-graph.
+    The scope is entered INSIDE the traced body — retraces re-apply it.
     """
+    from esr_tpu.config.precision import canonical_precision
     from esr_tpu.training.multistep import make_multi_step
+
+    int8 = (precision is not None
+            and canonical_precision(precision) == "int8")
+    if int8 and compute_dtype is not None:
+        raise ValueError(
+            "precision='int8' quantizes at the seams — params/states stay "
+            "f32, so compute_dtype must be None"
+        )
 
     sum_keys = METRIC_KEYS + ("count",)
 
@@ -142,7 +158,13 @@ def make_chunk_fn(model, lanes: int, chunk_windows: int, kh: int, kw: int,
             inp = win["inp_scaled"]
             if compute_dtype is not None:
                 inp = inp.astype(compute_dtype)
-            pred, states = model.apply(params, inp, states)
+            if int8:
+                from esr_tpu.config.quantize import int8_scope
+
+                with int8_scope():
+                    pred, states = model.apply(params, inp, states)
+            else:
+                pred, states = model.apply(params, inp, states)
             pred = _to_gt_grid(pred.astype(jnp.float32))
             bicubic = _to_gt_grid(win["inp_mid"])
             per = {}
@@ -268,7 +290,8 @@ class StreamingEngine:
         residency across chunks exactly like the training carry."""
         return checked_jit(
             make_chunk_fn(self.model, self.lanes, self.chunk_windows,
-                          kh, kw, compute_dtype=self._compute_dtype),
+                          kh, kw, compute_dtype=self._compute_dtype,
+                          precision=self.precision),
             donate_argnums=(1,), name="infer_engine_chunk",
         )
 

@@ -187,7 +187,8 @@ def export_chunk_program(
         "inp_mid": jnp.zeros((w_, b, lh, lw, inch), jnp.float32),
         "valid": jnp.zeros((w_, b), jnp.float32),
     }
-    compute_dtype = compute_dtype_of(resolve_precision(cli=precision))
+    rung = resolve_precision(cli=precision)
+    compute_dtype = compute_dtype_of(rung)
     states = model.init_states(b, kh, kw)
     if compute_dtype is not None:
         # the donated carry's dtype is part of the exported signature —
@@ -196,7 +197,10 @@ def export_chunk_program(
             lambda z: jnp.asarray(z, compute_dtype), states
         )
     reset_keep = jnp.zeros((b,), jnp.float32)
-    fn = make_chunk_fn(model, b, w_, kh, kw, compute_dtype=compute_dtype)
+    # int8 bakes the QUANTIZED program (seams quantize in-graph; states
+    # stay f32) — the sidecar's rung + bind-time refusal cover it like bf16
+    fn = make_chunk_fn(model, b, w_, kh, kw, compute_dtype=compute_dtype,
+                       precision=rung)
     exported = jax.export.export(jax.jit(fn), platforms=list(platforms))(
         _shape_dtype(params), _shape_dtype(states),
         _shape_dtype(reset_keep), _shape_dtype(windows),

@@ -104,7 +104,23 @@ class InferenceRunner:
         # `compile` telemetry events exactly like the training jits'. The
         # budget is above the default because one runner legitimately spans
         # a multi-resolution datalist (one retrace per distinct shape).
-        self._fwd = checked_jit(model.apply, name="infer_fwd", max_traces=16)
+        # int8 rung: params/states/inputs stay f32 (compute_dtype is None);
+        # the scope is entered INSIDE the traced body so every retrace
+        # re-applies the seam quantization (esr_tpu.config.quantize).
+        if self.precision == "int8":
+            from esr_tpu.config.quantize import int8_scope
+
+            def _fwd_int8(params, x, states):
+                with int8_scope():
+                    return model.apply(params, x, states)
+
+            self._fwd = checked_jit(
+                _fwd_int8, name="infer_fwd", max_traces=16
+            )
+        else:
+            self._fwd = checked_jit(
+                model.apply, name="infer_fwd", max_traces=16
+            )
 
         self.lpips = None
         if lpips_model is not None and lpips_params is not None:

@@ -145,7 +145,27 @@ def wide_accum_conv_general_dilated(lhs, rhs, window_strides, padding, **kw):
     conv). Full-width operands take the untouched ``lax`` path, so every
     existing f32 program traces identically (bitwise pins unaffected).
     Param names/structure are unchanged — checkpoints are compatible.
+
+    The int8 PTQ rung (``esr_tpu.config.quantize``, serving only) rides
+    the SAME seam: when its trace-time scope is active and the operands
+    are floats, the contraction is re-expressed as int8 x int8 -> i32
+    (dynamic per-tensor activation quant, per-output-channel weight
+    quant, dequant back at the seam) — coverage is identical to the
+    bf16 rung by construction. Scope off (every training/f32/bf16
+    trace): zero change, not even an import.
     """
+    if kw.get("preferred_element_type") is None and jnp.issubdtype(
+        jnp.dtype(lhs.dtype), jnp.floating
+    ):
+        from esr_tpu.config.quantize import (
+            int8_enabled,
+            quantized_conv_general_dilated,
+        )
+
+        if int8_enabled():
+            return quantized_conv_general_dilated(
+                lhs, rhs, window_strides, padding, **kw
+            )
     if not (_is_narrow_float(lhs.dtype)
             and kw.get("preferred_element_type") is None):
         return jax.lax.conv_general_dilated(
@@ -181,7 +201,18 @@ def wide_accum_conv_general_dilated(lhs, rhs, window_strides, padding, **kw):
 
 def wide_accum_dot_general(lhs, rhs, dimension_numbers, **kw):
     """``lax.dot_general`` twin of :func:`wide_accum_conv_general_dilated`
-    for the ``nn.Dense`` seams (flax ``dot_general`` injection field)."""
+    for the ``nn.Dense`` seams (flax ``dot_general`` injection field) —
+    including the int8 PTQ scope hook."""
+    if kw.get("preferred_element_type") is None and jnp.issubdtype(
+        jnp.dtype(lhs.dtype), jnp.floating
+    ):
+        from esr_tpu.config.quantize import (
+            int8_enabled,
+            quantized_dot_general,
+        )
+
+        if int8_enabled():
+            return quantized_dot_general(lhs, rhs, dimension_numbers, **kw)
     if _is_narrow_float(lhs.dtype) and kw.get("preferred_element_type") is None:
         out = jax.lax.dot_general(
             lhs, rhs, dimension_numbers,

@@ -96,7 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument(
         "--dtype", default="bfloat16",
         help="candidate dtype for the twin (default bfloat16; the "
-             "config spellings bf16/f16/f32 are accepted too)",
+             "config spellings bf16/f16/f32 are accepted too; int8 "
+             "reruns the SAME f32 feed under the PTQ seam quantization "
+             "and attributes per-layer quantization error)",
     )
     dr.add_argument("--basech", type=int, default=8,
                     help="model base channel count (default 8)")

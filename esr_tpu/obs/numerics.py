@@ -338,6 +338,12 @@ def run_drift(
     candidate twin casts params, inputs, and recurrent states to
     ``dtype`` so every conv/matmul executes at the candidate width,
     mirroring how ``trainer.precision: bf16`` casts for the apply.
+
+    ``dtype="int8"`` selects the PTQ serving rung instead: nothing is
+    cast — the same f32 feed reruns under ``config.quantize.int8_scope``
+    so each contraction quantizes w8a8 with an i32 accumulator exactly as
+    serving does, and the ladder attributes per-layer QUANTIZATION error
+    (``worst_tag`` names the worst-quantized seam).
     """
     import jax
     import jax.numpy as jnp
@@ -369,15 +375,28 @@ def run_drift(
 
     ref = flatten_probes(jax.device_get(taps(params, x, states)))
 
-    def cast(tree):
-        return jax.tree.map(lambda a: a.astype(cand_dtype), tree)
+    if cand_dtype == jnp.dtype(jnp.int8):
+        # the int8 PTQ rung does NOT cast anything — params/inputs/states
+        # stay f32 and the contraction seams quantize in-graph
+        # (esr_tpu.config.quantize). The candidate twin is therefore the
+        # SAME f32 feed run under the int8 scope, so the ladder attributes
+        # pure quantization error per layer.
+        from esr_tpu.config.quantize import int8_scope
 
-    cand = flatten_probes(jax.device_get(
-        taps(cast(params), x.astype(cand_dtype), cast(states))
-    ))
+        with int8_scope():
+            cand = flatten_probes(jax.device_get(taps(params, x, states)))
+    else:
+        def cast(tree):
+            return jax.tree.map(lambda a: a.astype(cand_dtype), tree)
+
+        cand = flatten_probes(jax.device_get(
+            taps(cast(params), x.astype(cand_dtype), cast(states))
+        ))
 
     ladder = []
     first = None
+    worst_tag = None
+    worst_rel = -1.0
     for tag in order_tags(ref):
         rel = _rel_error(ref[tag], cand[tag])
         exceeds = rel > tolerance
@@ -388,6 +407,9 @@ def run_drift(
         })
         if exceeds and first is None:
             first = tag
+        if rel > worst_rel:
+            worst_rel = rel
+            worst_tag = tag
     return {
         "dtype": str(cand_dtype),
         "reference": "float32",
@@ -399,6 +421,9 @@ def run_drift(
         },
         "break_tag": break_tag,
         "first_offender": first,
+        # the max-rel-err seam even when nothing exceeds tolerance — the
+        # int8 rung's "which layer quantizes worst" attribution reads this
+        "worst_tag": worst_tag,
         "n_exceeding": sum(1 for e in ladder if e["exceeds"]),
         "ladder": ladder,
     }

@@ -393,6 +393,7 @@ class ServingEngine:
                     make_chunk_fn(
                         self.model, self.lanes, w, kh, kw,
                         compute_dtype=self._compute_dtype,
+                        precision=self.precision,
                     ),
                     donate_argnums=(1,), name=f"serve_chunk_w{w}",
                 )

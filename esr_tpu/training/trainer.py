@@ -259,6 +259,16 @@ class Trainer:
         self.precision = resolve_precision(
             config=trainer_cfg.get("precision")
         )
+        if self.precision == "int8":
+            # the PTQ rung is serving-side only: training needs float
+            # params/grads, and "train at int8" would silently mean
+            # "quantize nothing" — refuse loudly instead
+            raise ValueError(
+                "trainer.precision: int8 is not a training rung — int8 is "
+                "post-training quantization for the inference/serving "
+                "path (infer.py/serve.py --precision int8, docs/PERF.md "
+                "'precision ladder'); train at f32 or bf16"
+            )
         compute_dtype = compute_dtype_of(self.precision)
         # opt-in bf16 host->device batch transfer: halves the bytes the
         # count-map streams push over PCIe/ICI each TRAIN step (the e2e

@@ -70,11 +70,14 @@ def get_flags():
 
     # precision rung (docs/PERF.md "precision ladder"): tri-state like
     # --engine — omitted defers to the checkpoint's trainer.precision, so
-    # a bf16-trained model infers at the width it trained at by default
+    # a bf16-trained model infers at the width it trained at by default.
+    # int8 = the PTQ serving rung (esr_tpu.config.quantize): inference-
+    # only, never a checkpoint default — it must be asked for here.
     p.add_argument("--precision", type=str, default=None,
-                   choices=["f32", "bf16"],
+                   choices=["f32", "bf16", "int8"],
                    help="compute precision (default: checkpoint config's "
-                        "trainer.precision, else f32)")
+                        "trainer.precision, else f32; int8 = post-"
+                        "training quantization at the contraction seams)")
 
     # dataset overrides (reference get_flags, infer_ours_cnt.py:135-157)
     p.add_argument("--scale", type=int, default=4)

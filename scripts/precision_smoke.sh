@@ -1,23 +1,31 @@
 #!/usr/bin/env bash
-# Precision-ladder smoke (ISSUE 19, docs/PERF.md "the precision ladder"):
-# the full gate set for `trainer.precision: bf16` as a standalone CPU run —
+# Precision-ladder smoke (ISSUE 19 + 20, docs/PERF.md "the precision
+# ladder"): the full gate set for `trainer.precision: bf16` AND the int8
+# PTQ serving rung as a standalone CPU run —
 #   - the wide-accumulation conv/dot seams grad correctly at bf16 and the
 #     f32 rung stays the bitwise-unmodified reference program,
 #   - the on-device encoder is BITWISE equal to the host np/C++ twin
 #     (`encode: device|host` is placement, never numerics),
 #   - the bf16 production programs audit CLEAN (JX001 enforced, JX003
 #     waived by design) with bfloat16->float32 flops in the majority,
-#   - `python -m esr_tpu.obs drift --dtype bf16 --fail-on-drift` exits 0,
-#   - a real AOT export bakes the rung into its sidecar and serving
-#     refuses a mismatched one,
-#   - the bench `precision_ladder` stage emits its pinned record with
-#     timings honestly skipped on CPU.
+#   - the int8 seams quantize per-out-channel symmetric, accumulate in
+#     i32 (int8->int32 flops in the majority, never int8->int8), the
+#     trainer/chunk-fn/AOT-bind refusals hold, calibration is
+#     deterministic from its seed, and drift names the worst-quantized
+#     seam (`python -m esr_tpu.obs drift --dtype int8`),
+#   - a real AOT export bakes the rung (bf16 OR int8) into its sidecar
+#     and serving refuses a mismatched one,
+#   - the bench `precision_ladder` stage emits its pinned record — now
+#     with the int8 PSNR/SSIM quality cell inside its 1.0 dB bound — and
+#     the `batch_scaling` stage sweeps to the roofline, timings honestly
+#     skipped on CPU.
 #
-# Runs the exact assertions tier-1 enforces (tests/test_precision_ladder.py)
-# PLUS the slow-marked heavyweight cells tier-1 excludes.
+# Runs the exact assertions tier-1 enforces (tests/test_precision_ladder.py,
+# tests/test_quantize.py) PLUS the slow-marked heavyweight cells tier-1
+# excludes.
 #
 # Usage: scripts/precision_smoke.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu ESR_SMOKE_FULL=1 python -m pytest \
-    tests/test_precision_ladder.py -q "$@"
+    tests/test_precision_ladder.py tests/test_quantize.py -q "$@"

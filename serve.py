@@ -110,11 +110,14 @@ def get_flags():
                         "(0 = ephemeral; fleet mode only; default off)")
     # precision rung (docs/PERF.md "precision ladder"): tri-state like
     # infer.py's — omitted defers to the checkpoint's trainer.precision,
-    # so a bf16-trained model serves at the width it trained at
+    # so a bf16-trained model serves at the width it trained at. int8 is
+    # the PTQ serving rung (esr_tpu.config.quantize) — serving-side only,
+    # never a trained default, so it must be asked for here.
     p.add_argument("--precision", type=str, default=None,
-                   choices=["f32", "bf16"],
+                   choices=["f32", "bf16", "int8"],
                    help="compute precision (default: checkpoint config's "
-                        "trainer.precision, else f32)")
+                        "trainer.precision, else f32; int8 = post-"
+                        "training quantization at the contraction seams)")
     p.add_argument("--profile-steps", type=int, default=0, metavar="N",
                    help="capture a jax.profiler device trace over the "
                         "first N dispatched chunks and stamp a "

@@ -24,6 +24,19 @@ def _build_bf16_dot_narrow_accum() -> BuiltProgram:
     return BuiltProgram(lambda x, y: x @ y, (a, b))
 
 
+def _build_int8_dot_narrow_accum() -> BuiltProgram:
+    """JX001 seed, int8 edition (ISSUE 20): an int8 x int8 contraction
+    with no i32 ``preferred_element_type`` — the quantized serving rung's
+    exact hazard (an int8 accumulator overflows at the third MAC). The
+    production seams (``config.quantize``) always widen; this fixture
+    pins that the gate would catch one that did not."""
+    import jax
+
+    a = jax.ShapeDtypeStruct((32, 64), "int8")
+    b = jax.ShapeDtypeStruct((64, 32), "int8")
+    return BuiltProgram(lambda x, y: x @ y, (a, b))
+
+
 def _build_dropped_donation() -> BuiltProgram:
     """JX004 seed: the donated arg's buffer shapes match no output, so
     the lowering aliases nothing and residency doubles."""
@@ -103,6 +116,10 @@ PROGRAMS = [
     ProgramSpec(
         "hazard_bf16_dot", _build_bf16_dot_narrow_accum,
         description="JX001: bf16 matmul, narrow accumulator",
+    ),
+    ProgramSpec(
+        "hazard_int8_dot", _build_int8_dot_narrow_accum,
+        description="JX001: int8 matmul, narrow int8 accumulator",
     ),
     ProgramSpec(
         "hazard_dropped_donation", _build_dropped_donation,

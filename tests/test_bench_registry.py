@@ -35,7 +35,8 @@ def test_stage_registry_names_order_and_timeouts():
     assert names == [
         "scan_compute", "scan_matmul", "wide_model", "mosaic_dcn",
         "conv_anchor", "compute", "bf16", "dcn_ab", "dcn_fwd_ab",
-        "dcn_sparse_ab", "precision_ladder", "mfu_ceiling", "program_audit",
+        "dcn_sparse_ab", "precision_ladder", "mfu_ceiling",
+        "batch_scaling", "program_audit",
         "concurrency_audit", "tier1_budget", "obs_live", "fleet_obs",
         "numerics_overhead",
         "e2e", "e2e_device_raster", "scaling", "breakdown",
@@ -367,8 +368,16 @@ def test_precision_ladder_stage_registered_and_schema_pinned():
         "device_encode_speedup", "device_encode_bitwise_ok",
         "audit_bf16_findings", "audit_bf16_clean", "audit_bf16_flops_frac",
         "drift_max_rel_err", "drift_first_offender", "drift_ok",
+        "f32_psnr", "bf16_psnr", "int8_psnr",
+        "f32_ssim", "bf16_ssim", "int8_ssim",
+        "int8_psnr_drop_db", "int8_psnr_bound_db", "int8_quality_ok",
+        "audit_int8_findings", "audit_int8_clean", "audit_int8_flops_frac",
+        "int8_drift_max_rel_err", "int8_drift_worst_tag", "int8_drift_ok",
         "timing", "seed",
     )
+    # the int8 quality acceptance bound (ISSUE 20) is pinned: loosening
+    # it is a reviewed diff, not a drift
+    assert bench.INT8_PSNR_DROP_BOUND_DB == 1.0
 
 
 def test_mfu_ceiling_stage_registered_schema_pinned_and_runs_offline():
@@ -394,6 +403,32 @@ def test_mfu_ceiling_stage_registered_schema_pinned_and_runs_offline():
     assert rec["total_gflops_fwd"] > 0
     assert rec["n_contractions"] > 10
     assert rec["peak_flops_chip"] > 0
+
+
+def test_batch_scaling_stage_registered_and_schema_pinned():
+    """The roofline-anchored batch sweep (ISSUE 20): trainer batch
+    (2 -> 64, geometric) and serving lanes x chunk_windows against the
+    model-imposed MXU ceiling. Schema pinned; the stage runs in smoke —
+    device-free shape/flops/peak-bytes evidence always records, timings
+    honestly skip off-TPU. The full smoke execution lives in the
+    precision smoke gate (too heavy for tier-1: it traces the production
+    train step at several batches)."""
+    entry = [e for e in bench.STAGE_REGISTRY if e[0] == "batch_scaling"]
+    assert len(entry) == 1
+    name, runner, timeout, in_smoke = entry[0]
+    assert runner is bench.stage_batch_scaling
+    assert timeout >= 600
+    assert in_smoke is True
+    assert bench.BATCH_SCALING_KEYS == (
+        "geometry", "train_batches", "train_cells",
+        "largest_feasible_batch", "serving_cells",
+        "hbm_budget_bytes", "hbm_budget_source", "peak_flops_chip",
+        "timing", "seed",
+    )
+    # the full (non-smoke) sweep is the geometric ladder the flagship
+    # configs adopt from; the HBM table drives its feasibility verdicts
+    assert set(bench._HBM_BYTES) == set(bench._PEAK_FLOPS)
+    assert 0.0 < bench._COMPUTE_BOUND_FRAC <= 1.0
 
 
 def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
@@ -438,12 +473,23 @@ def test_program_audit_stage_registered_schema_pinned_and_runs_offline():
         ), pname
         assert "float32->float32" in by_dtype, pname
         assert "bfloat16->bfloat16" not in by_dtype, pname
+        # the int8 rung's JX001 contract (ISSUE 20): a narrow int8
+        # accumulator must never appear — on ANY program
+        assert "int8->int8" not in by_dtype, pname
         if pname.endswith("_bf16"):
             wide = sum(v for k, v in by_dtype.items()
                        if k.startswith("bfloat16->"))
             assert wide / sum(by_dtype.values()) > 0.9, pname
+        elif pname.endswith("_int8"):
+            # the quantized flagship: int8->int32 contraction flops in
+            # the clear majority, no bf16 anywhere
+            quant = sum(v for k, v in by_dtype.items()
+                        if k == "int8->int32")
+            assert quant / sum(by_dtype.values()) > 0.9, pname
+            assert not any(k.startswith("bfloat16") for k in by_dtype), pname
         else:
             assert not any(k.startswith("bfloat16") for k in by_dtype), pname
+            assert not any(k.startswith("int8") for k in by_dtype), pname
     assert rec["clean"] is True and rec["total_findings"] == 0
     assert rec["rules_version"].startswith("jx:")
 

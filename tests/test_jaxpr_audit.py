@@ -398,6 +398,7 @@ def test_hazard_fixture_programs_each_fire_their_rule():
 
     expected = {
         "hazard_bf16_dot": "JX001",
+        "hazard_int8_dot": "JX001",
         "hazard_dropped_donation": "JX004",
         "hazard_f64_leak": "JX002",
         "hazard_dead_output": "JX006",

@@ -56,7 +56,7 @@ DN = ("NHWC", "HWIO", "NHWC")
 
 
 def test_resolve_precision_precedence():
-    assert PRECISIONS == ("f32", "bf16")
+    assert PRECISIONS == ("f32", "bf16", "int8")
     # CLI > checkpoint config > default
     assert resolve_precision(cli="bf16", config="f32") == "bf16"
     assert resolve_precision(cli=None, config="bf16") == "bf16"
@@ -65,11 +65,15 @@ def test_resolve_precision_precedence():
     # long spellings normalize to the config rung
     assert resolve_precision(cli="bfloat16") == "bf16"
     assert resolve_precision(config="float32") == "f32"
-    with pytest.raises(ValueError, match="unknown precision"):
-        resolve_precision(cli="int8")
+    # the int8 serving rung (ISSUE 20) and its alias spellings
+    assert resolve_precision(cli="int8") == "int8"
+    assert resolve_precision(cli="i8") == "int8"
+    assert resolve_precision(cli="w8a8") == "int8"
     # a typo'd CONFIG rung fails loudly too, never a silent f32 fallback
     with pytest.raises(ValueError, match="unknown precision"):
         resolve_precision(config="bf-16")
+    with pytest.raises(ValueError, match="unknown precision"):
+        resolve_precision(cli="int4")
 
 
 def test_canonical_dtype_and_precision_aliases():
@@ -77,9 +81,12 @@ def test_canonical_dtype_and_precision_aliases():
     assert canonical_dtype("bfloat16") == "bfloat16"
     assert canonical_dtype("f16") == "float16"
     assert canonical_dtype("F32") == "float32"
+    assert canonical_dtype("int8") == "int8"
+    assert canonical_dtype("w8a8") == "int8"
     with pytest.raises(ValueError, match="unknown dtype"):
-        canonical_dtype("int8")
+        canonical_dtype("int4")
     assert canonical_precision("BF16") == "bf16"
+    assert canonical_precision("I8") == "int8"
 
 
 def test_compute_dtype_of_maps_rungs():
@@ -88,6 +95,12 @@ def test_compute_dtype_of_maps_rungs():
     assert compute_dtype_of("float32") is None
     assert compute_dtype_of("bf16") is jnp.bfloat16
     assert compute_dtype_of("bfloat16") is jnp.bfloat16
+    # int8 deliberately maps to None: nothing is cast — the rung
+    # quantizes INSIDE the contraction seams (esr_tpu.config.quantize),
+    # so params/states/wire all stay f32 and every compute_dtype-driven
+    # cast site is automatically a no-op at this rung
+    assert compute_dtype_of("int8") is None
+    assert compute_dtype_of("w8a8") is None
 
 
 # ---------------------------------------------------------------------------
@@ -337,8 +350,12 @@ def test_serving_engine_resolves_precision_rung():
     srv16 = _tiny_engine(precision="bf16")
     assert srv16.precision == "bf16"
     assert srv16._compute_dtype is jnp.bfloat16
+    # the int8 rung resolves; compute dtype stays None (seam-quantized —
+    # lane states and the wire stay f32)
+    srv8 = _tiny_engine(precision="int8")
+    assert srv8.precision == "int8" and srv8._compute_dtype is None
     with pytest.raises(ValueError, match="unknown precision"):
-        _tiny_engine(precision="int8")
+        _tiny_engine(precision="int4")
 
 
 def test_serving_refuses_aot_artifact_at_wrong_rung(monkeypatch):
@@ -473,6 +490,50 @@ def test_bench_precision_ladder_stage_smoke_record(monkeypatch):
     assert all(f is not None and f > 0.9
                for f in rec["audit_bf16_flops_frac"].values())
     assert rec["drift_ok"] is True and rec["drift_max_rel_err"] is not None
+    # the int8 serving rung (ISSUE 20): quality within the pinned bound,
+    # the flagship audits clean with int8->int32 flops in the majority,
+    # and the drift ladder names a worst-quantized seam
+    assert rec["int8_quality_ok"] is True
+    assert rec["int8_psnr_drop_db"] <= rec["int8_psnr_bound_db"]
+    assert rec["audit_int8_clean"] is True
+    assert sorted(rec["audit_int8_findings"]) == ["infer_engine_chunk_int8"]
+    assert all(f is not None and f > 0.9
+               for f in rec["audit_int8_flops_frac"].values())
+    assert rec["int8_drift_ok"] is True
+    assert rec["int8_drift_worst_tag"] is not None
+
+
+@pytest.mark.slow
+def test_bench_batch_scaling_stage_smoke_record(monkeypatch):
+    """The roofline-anchored batch sweep (ISSUE 20) on this (CPU) host:
+    pinned key tuple, timings honestly skipped, and the device-free
+    evidence — per-cell static flops, peak buffer residency, MXU
+    occupancy ceiling, HBM feasibility — REAL for every train and
+    serving cell."""
+    import bench
+
+    monkeypatch.setenv("ESR_BENCH_SMOKE", "1")
+    rec = bench.stage_batch_scaling(bench._Ctx())
+    assert tuple(rec.keys()) == bench.BATCH_SCALING_KEYS
+    assert rec["timing"].startswith("skipped")
+    assert rec["train_batches"] == [2, 4]
+    for bname, cell in rec["train_cells"].items():
+        assert cell["flops_per_step"] > 0, bname
+        assert cell["peak_bytes"] > 0, bname
+        assert 0.0 < cell["mxu_occupancy_ceiling"] <= 1.0, bname
+        assert cell["steps_per_sec"] is None, bname  # CPU: no fake timings
+        assert cell["compute_bound"] is None, bname
+    # evidence must scale with batch: flops exactly, bytes monotonically
+    b2, b4 = rec["train_cells"]["b2"], rec["train_cells"]["b4"]
+    assert b4["flops_per_step"] > 1.5 * b2["flops_per_step"]
+    assert b4["peak_bytes"] > b2["peak_bytes"]
+    assert rec["largest_feasible_batch"] in (2, 4)
+    for sname, cell in rec["serving_cells"].items():
+        assert cell["flops_per_chunk"] > 0, sname
+        assert cell["peak_bytes"] > 0, sname
+        assert cell["windows_per_sec"] is None, sname
+    assert rec["hbm_budget_bytes"] > 0
+    assert rec["peak_flops_chip"] > 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,440 @@
+"""The int8 PTQ serving rung's tier-1 pins (ISSUE 20, CPU).
+
+``--precision int8`` is a real serving rung only while four gates hold,
+each pinned here off-TPU:
+
+- **quantize/dequantize round-trips exactly** where it must: symmetric
+  per-output-channel weight scales reconstruct representable values
+  bitwise (power-of-two scales), and the all-zero channel never divides
+  by zero;
+- **i32 accumulation end-to-end**: the seam-injected quantized conv/dot
+  (``config.quantize`` riding the ``models/layers.wide_accum_*`` seams)
+  emit int8 operands with an int32 ``preferred_element_type`` — JX001's
+  contract — and the scope is a trace-time switch: OFF leaves the f32
+  program bitwise unmodified, ON routes every seam;
+- **deterministic calibration**: the seeded corpus pass through the
+  EXISTING obs/numerics tensor-stats taps returns the same per-tag
+  ranges for the same seed;
+- **one precision policy**: the trainer REFUSES ``precision: int8``
+  (PTQ is serving-side only), ``make_chunk_fn`` refuses the
+  contradictory int8+compute_dtype combination, serving refuses an AOT
+  artifact baked at a different rung, and the drift harness names the
+  worst-quantized seam.
+
+The heavyweight cells — the probed calibration passes, the drift
+attribution, a real int8 AOT export/refusal round-trip and the
+engine-chunk int8-vs-f32 metric parity — are ``slow``-marked;
+``scripts/precision_smoke.sh`` runs them standalone.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esr_tpu.config.quantize import (
+    calibrate_ranges,
+    dequantize,
+    int8_enabled,
+    int8_scope,
+    quantize_symmetric,
+    quantized_conv_general_dilated,
+    quantized_dot_general,
+)
+from esr_tpu.models.layers import (
+    wide_accum_conv_general_dilated,
+    wide_accum_dot_general,
+)
+
+DN = ("NHWC", "HWIO", "NHWC")
+DOT_DN = (((1,), (0,)), ((), ()))
+
+
+# ---------------------------------------------------------------------------
+# quantize/dequantize primitives
+
+
+def test_per_channel_roundtrip_exact_for_representable_values():
+    """Per-out-channel symmetric scales: values that ARE representable on
+    the int8 grid (integer multiples of a power-of-two scale, |q|<=127)
+    must round-trip BITWISE — the quantizer adds no error of its own."""
+    rng = np.random.default_rng(0)
+    q_int = rng.integers(-127, 128, size=(3, 3, 4, 6)).astype(np.float32)
+    # force each channel's absmax to exactly 127 so the recovered scale
+    # is exactly the power of two we built the grid from
+    q_int[0, 0, 0, :] = 127.0
+    scales = 2.0 ** rng.integers(-8, 4, size=(6,)).astype(np.float32)
+    x = jnp.asarray(q_int * scales)
+
+    q, s = quantize_symmetric(x, axis=3)
+    assert q.dtype == jnp.int8
+    assert s.shape == (1, 1, 1, 6)
+    np.testing.assert_array_equal(
+        np.asarray(s).ravel(), scales.astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(dequantize(q, s)),
+                                  np.asarray(x))
+
+
+def test_per_tensor_quantization_bounds_error_and_handles_zeros():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((16, 16)).astype(np.float32))
+    q, s = quantize_symmetric(x)
+    assert q.dtype == jnp.int8 and np.ndim(s) == 0  # per-tensor scale
+    # symmetric int8: error bounded by half a quantization step
+    err = np.abs(np.asarray(dequantize(q, s)) - np.asarray(x))
+    assert err.max() <= float(np.asarray(s).max()) / 2 + 1e-7
+    assert int(np.abs(np.asarray(q)).max()) <= 127
+    # the all-zero tensor must not divide by zero and must stay zero
+    q0, s0 = quantize_symmetric(jnp.zeros((4, 4)))
+    assert np.asarray(q0).sum() == 0
+    assert np.isfinite(np.asarray(s0)).all()
+    assert np.asarray(dequantize(q0, s0)).sum() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# i32 accumulation: the JX001 contract, pinned in the jaxpr
+
+
+def _dot_operands(seed=0):
+    rng = np.random.default_rng(seed)
+    a = jnp.asarray(rng.standard_normal((8, 32)).astype(np.float32))
+    b = jnp.asarray((rng.standard_normal((32, 6)) * 0.2).astype(np.float32))
+    return a, b
+
+
+def test_quantized_dot_emits_int8_operands_with_i32_accumulator():
+    a, b = _dot_operands()
+    jx = str(jax.make_jaxpr(
+        lambda x, y: quantized_dot_general(x, y, DOT_DN))(a, b))
+    assert "i8" in jx
+    assert "preferred_element_type=int32" in jx
+    # no narrow int8 accumulation anywhere (the JX001 hazard)
+    assert "preferred_element_type=int8" not in jx
+    out = quantized_dot_general(a, b, DOT_DN)
+    assert out.dtype == jnp.float32
+    ref = jax.lax.dot_general(a, b, DOT_DN)
+    rel = np.abs(np.asarray(out) - np.asarray(ref)) / (
+        np.abs(np.asarray(ref)) + 1.0)
+    assert rel.max() < 0.05, rel.max()
+
+
+def test_quantized_conv_emits_i32_accumulator_and_tracks_reference():
+    rng = np.random.default_rng(2)
+    lhs = jnp.asarray(rng.standard_normal((2, 8, 8, 4)).astype(np.float32))
+    rhs = jnp.asarray(
+        (rng.standard_normal((3, 3, 4, 6)) * 0.2).astype(np.float32))
+    jx = str(jax.make_jaxpr(
+        lambda l, r: quantized_conv_general_dilated(
+            l, r, (1, 1), "SAME", dimension_numbers=DN))(lhs, rhs))
+    assert "i8" in jx and "preferred_element_type=int32" in jx
+    out = quantized_conv_general_dilated(
+        lhs, rhs, (1, 1), "SAME", dimension_numbers=DN)
+    assert out.dtype == jnp.float32
+    ref = jax.lax.conv_general_dilated(
+        lhs, rhs, (1, 1), "SAME", dimension_numbers=DN)
+    rel = np.abs(np.asarray(out) - np.asarray(ref)) / (
+        np.abs(np.asarray(ref)) + 1.0)
+    assert rel.max() < 0.05, rel.max()
+
+
+def test_int8_scope_routes_the_seams_and_off_is_bitwise_reference():
+    """The seams are a trace-time switch: scope OFF must leave the f32
+    program BITWISE the unmodified reference (the f32 rung's contract),
+    scope ON must quantize, and the scope must not leak."""
+    a, b = _dot_operands(3)
+    assert not int8_enabled()
+    off = wide_accum_dot_general(a, b, DOT_DN)
+    ref = jax.lax.dot_general(a, b, DOT_DN)
+    assert (np.asarray(off) == np.asarray(ref)).all()
+    with int8_scope():
+        assert int8_enabled()
+        jx = str(jax.make_jaxpr(
+            lambda x, y: wide_accum_dot_general(x, y, DOT_DN))(a, b))
+        assert "i8" in jx and "preferred_element_type=int32" in jx
+    # the scope is confined: back to the bitwise f32 reference
+    assert not int8_enabled()
+    assert (np.asarray(wide_accum_dot_general(a, b, DOT_DN))
+            == np.asarray(ref)).all()
+    # a jit traced INSIDE the scope bakes the quantized program; the
+    # engine enters the scope inside the traced body for exactly this
+    with int8_scope():
+        out8 = jax.jit(
+            lambda x, y: wide_accum_dot_general(x, y, DOT_DN))(a, b)
+    rel = np.abs(np.asarray(out8) - np.asarray(ref)) / (
+        np.abs(np.asarray(ref)) + 1.0)
+    assert 0.0 < rel.max() < 0.05  # quantized, but close
+
+
+def test_bf16_seam_unchanged_under_no_scope():
+    """The bf16 rung keeps its wide-accum f32 path: int8 riding the same
+    seam must not have disturbed the existing dispatch."""
+    a, b = _dot_operands(4)
+    a16, b16 = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
+    out = wide_accum_dot_general(a16, b16, DOT_DN)
+    assert out.dtype == jnp.bfloat16
+    wide = jax.lax.dot_general(
+        a16.astype(jnp.float32), b16.astype(jnp.float32), DOT_DN)
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32),
+        np.asarray(wide.astype(jnp.bfloat16), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# calibration: seeded corpus pass through the EXISTING numerics taps
+
+
+@pytest.mark.slow  # three probed corpus passes; precision_smoke.sh runs it
+def test_calibration_ranges_deterministic_from_seed():
+    r1 = calibrate_ranges(basech=2, hw=8, seed=7, n_batches=2)
+    r2 = calibrate_ranges(basech=2, hw=8, seed=7, n_batches=2)
+    assert r1 == r2
+    assert len(r1) > 5  # the probe plane's per-layer tags
+    assert all(np.isfinite(v) and v >= 0 for v in r1.values())
+    # a different corpus seed moves at least one activation range
+    r3 = calibrate_ranges(basech=2, hw=8, seed=8, n_batches=2)
+    assert r3 != r1
+
+
+# ---------------------------------------------------------------------------
+# one precision policy: refusals and registration
+
+
+def test_make_chunk_fn_refuses_int8_with_compute_dtype():
+    from esr_tpu.inference.engine import make_chunk_fn
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    with pytest.raises(ValueError, match="compute_dtype must be None"):
+        # raises at argument validation, BEFORE any trace happens — the
+        # testplane gate exempts pytest.raises bodies from TX005 churn
+        make_chunk_fn(model, 2, 2, 8, 8,
+                      compute_dtype=jnp.bfloat16, precision="int8")
+
+
+def test_trainer_refuses_int8_precision(tmp_path):
+    """PTQ is serving-side only: ``trainer.precision: int8`` must fail
+    loudly at construction, before any dataloader IO."""
+    from esr_tpu.config.parser import RunConfig
+    from esr_tpu.training.trainer import Trainer
+
+    config = {
+        "experiment": "int8_refusal",
+        "model": {"name": "DeepRecurrNet",
+                  "args": {"inch": 2, "basech": 2, "num_frame": 3}},
+        "optimizer": {"name": "Adam",
+                      "args": {"lr": 1e-3, "weight_decay": 1e-4,
+                               "amsgrad": True}},
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {
+            "output_path": str(tmp_path / "out"),
+            "precision": "int8",
+            "iteration_based_train": {
+                "enabled": True, "iterations": 1, "save_period": 10**6,
+                "train_log_step": 1, "valid_step": 10**6,
+                "lr_change_rate": 4000,
+            },
+            "monitor": "off", "tensorboard": False,
+            "vis": {"enabled": False},
+        },
+        "train_dataloader": {
+            "path_to_datalist_txt": str(tmp_path / "absent.txt"),
+            "batch_size": 2, "shuffle": False, "drop_last": True,
+            "prefetch": 0,
+            "dataset": {"sequence": {"seqn": 3}},
+        },
+    }
+    with pytest.raises(ValueError, match="not a training rung"):
+        Trainer(RunConfig(config, runid="int8ref", seed=0))
+
+
+def test_int8_flagship_registered_after_bf16_trio_with_empty_allow():
+    from esr_tpu.analysis.programs import production_programs
+
+    names = [s.name for s in production_programs()]
+    assert "infer_engine_chunk_int8" in names
+    assert names.index("infer_engine_chunk_int8") > names.index(
+        "infer_engine_chunk_bf16")
+    spec = next(s for s in production_programs()
+                if s.name == "infer_engine_chunk_int8")
+    # no JX003 waiver: the quantize path's converts are one-way
+    assert not spec.allow
+
+
+def test_serving_refuses_aot_artifact_at_wrong_rung_int8(monkeypatch):
+    """An artifact baked at the int8 rung must be refused by an f32
+    engine and accepted by an int8 one — same bind-time gate as bf16."""
+    import esr_tpu.inference.export as export_mod
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.serving import RequestClass, ServingEngine
+
+    cfg = {
+        "scale": 2, "ori_scale": "down8", "time_bins": 1,
+        "mode": "events", "window": 1024, "sliding_window": 512,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {"sequence_length": 4, "seqn": 3, "step_size": None,
+                     "pause": {"enabled": False}},
+    }
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+
+    def _engine(**kw):
+        # empty params, nothing traced: host-side bookkeeping only
+        return ServingEngine(
+            model, {}, cfg, lanes=2,
+            classes={"only": RequestClass("only", chunk_windows=4)},
+            default_class="only", aot_programs={4: "/fake.stablehlo"},
+            **kw,
+        )
+
+    sidecar = {"precision": "int8", "lanes": 2, "chunk_windows": 4}
+    monkeypatch.setattr(
+        export_mod, "load_exported_model",
+        lambda path: ((lambda *a: None), dict(sidecar)),
+    )
+    srv = _engine()  # f32 rung
+    srv._resolutions = ((8, 8), (16, 16))
+    with pytest.raises(ValueError, match="precision='int8'"):
+        srv._program(4)
+    srv8 = _engine(precision="int8")
+    srv8._resolutions = ((8, 8), (16, 16))
+    assert callable(srv8._program(4))
+
+
+# ---------------------------------------------------------------------------
+# drift attribution: the worst-quantized seam, by name
+
+
+@pytest.mark.slow  # two full tapped forwards; precision_smoke.sh runs it
+def test_drift_int8_attributes_quantization_error_per_layer():
+    from esr_tpu.obs.numerics import run_drift
+
+    rec = run_drift(dtype="int8", basech=2, hw=8)
+    assert rec["dtype"] == "int8"
+    assert rec["reference"] == "float32"
+    assert rec["ladder"]  # non-vacuous: probes actually compared
+    # dynamic w8a8 on a tiny twin stays inside the bf16-grade tolerance
+    assert rec["n_exceeding"] == 0
+    assert rec["first_offender"] is None
+    # the attribution the rung exists for: the worst-quantized seam is
+    # NAMED, and it is a real probe tag with a real nonzero error
+    tags = {e["tag"]: e["rel_err"] for e in rec["ladder"]}
+    assert rec["worst_tag"] in tags
+    assert tags[rec["worst_tag"]] == max(tags.values())
+    assert tags[rec["worst_tag"]] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# heavyweight cells — scripts/precision_smoke.sh (ESR_SMOKE_FULL profile)
+
+
+@pytest.mark.slow
+def test_int8_chunk_fn_metrics_track_f32_twin():
+    """The engine chunk at the int8 rung on REAL arrays: same windows,
+    same states, PSNR metric sums within a bounded delta of the f32
+    twin — the chunk-level version of the quality cell."""
+    from esr_tpu.inference.engine import make_chunk_fn
+    from esr_tpu.models.esr import DeepRecurrNet
+
+    rng = np.random.default_rng(0)
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    lanes, w, hw = 2, 2, 8
+    states = model.init_states(lanes, hw, hw)
+    x0 = jnp.zeros((lanes, 3, hw, hw, 2), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x0, states)
+    windows = {
+        "inp_scaled": jnp.asarray(rng.poisson(
+            0.3, (w, lanes, 3, hw, hw, 2)).astype(np.float32)),
+        "inp_mid": jnp.asarray(rng.poisson(
+            0.3, (w, lanes, hw, hw, 2)).astype(np.float32)),
+        "gt": jnp.asarray(rng.poisson(
+            0.5, (w, lanes, hw, hw, 2)).astype(np.float32)),
+        "valid": jnp.ones((w, lanes), jnp.float32),
+    }
+    reset = jnp.ones((lanes,), jnp.float32)
+
+    run32 = make_chunk_fn(model, lanes, w, hw, hw)
+    run8 = make_chunk_fn(model, lanes, w, hw, hw, precision="int8")
+    _, sums32, _ = run32(params, states, reset, windows)
+    _, sums8, _ = run8(params, model.init_states(lanes, hw, hw),
+                       reset, windows)
+    # the esr PSNR sums track; bicubic cells are rung-independent
+    for k in ("bicubic_psnr", "bicubic_ssim"):
+        np.testing.assert_allclose(
+            np.asarray(sums8[k]), np.asarray(sums32[k]), rtol=1e-5)
+    # sums are per-lane accumulators over the chunk's w windows
+    d_psnr = np.abs(np.asarray(sums8["esr_psnr"])
+                    - np.asarray(sums32["esr_psnr"]))
+    assert (d_psnr / w).max() <= 1.0  # per-window drop under the bound
+
+
+@pytest.mark.slow
+def test_export_bakes_int8_and_serving_round_trip_refuses(tmp_path):
+    """A REAL int8 artifact round-trip: export with --precision int8
+    bakes the QUANTIZED chunk program (int8 seams in-graph, f32 states),
+    the sidecar records the rung, f32 serving refuses it, int8 serving
+    loads it."""
+    import json
+
+    from esr_tpu.config.build import build_optimizer
+    from esr_tpu.inference.export import export_checkpoint
+    from esr_tpu.models.esr import DeepRecurrNet
+    from esr_tpu.serving import RequestClass, ServingEngine
+    from esr_tpu.training import checkpoint as ckpt_lib
+    from esr_tpu.training.train_step import TrainState
+
+    model = DeepRecurrNet(inch=2, basech=2, num_frame=3)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 3, 16, 16, 2), np.float32),
+        model.init_states(1, 16, 16),
+    )
+    config = {
+        "experiment": "int8_aot",
+        "model": {"name": "DeepRecurrNet",
+                  "args": {"inch": 2, "basech": 2, "num_frame": 3}},
+        "optimizer": {"name": "Adam",
+                      "args": {"lr": 1e-3, "weight_decay": 1e-4,
+                               "amsgrad": True}},
+        "lr_scheduler": {"name": "ExponentialLR", "args": {"gamma": 0.95}},
+        "trainer": {"output_path": str(tmp_path / "ck"),
+                    "iteration_based_train": {"enabled": True,
+                                              "iterations": 1}},
+    }
+    opt, _ = build_optimizer(
+        config["optimizer"], config["lr_scheduler"], 4000)
+    ckpt = ckpt_lib.save_checkpoint(
+        str(tmp_path / "ck"), TrainState.create(params, opt), config, 0, 0.0)
+    art = str(tmp_path / "chunk_int8.w4.stablehlo")
+    # explicit rung: int8 is never a checkpoint default
+    export_checkpoint(
+        ckpt, art, batch=2, height=16, width=16,
+        program="engine_chunk", chunk_windows=4, scale=2,
+        platforms=("cpu",), precision="int8",
+    )
+    sidecar = json.load(open(art + ".json"))
+    assert sidecar["precision"] == "int8"
+
+    cfg = {
+        "scale": 2, "ori_scale": "down8", "time_bins": 1,
+        "mode": "events", "window": 1024, "sliding_window": 512,
+        "need_gt_events": True, "need_gt_frame": False,
+        "data_augment": {"enabled": False, "augment": [],
+                         "augment_prob": []},
+        "sequence": {"sequence_length": 4, "seqn": 3, "step_size": None,
+                     "pause": {"enabled": False}},
+    }
+
+    def _engine(**kw):
+        return ServingEngine(  # esr: noqa(TX001) - binds AOT, no trace
+            model, {}, cfg, lanes=2,
+            classes={"only": RequestClass("only", chunk_windows=4)},
+            default_class="only", aot_programs={4: art}, **kw,
+        )
+
+    srv = _engine()  # f32 engine must refuse the int8 artifact
+    srv._resolutions = ((8, 8), (16, 16))
+    with pytest.raises(ValueError, match="precision='int8'"):
+        srv._program(4)
+    srv8 = _engine(precision="int8")
+    srv8._resolutions = ((8, 8), (16, 16))
+    assert callable(srv8._program(4))

@@ -340,6 +340,35 @@ def test_tx005_fires_at_three_suite_wide_trace_sites(tmp_path):
     assert all("3 test-body trace sites" in f.message for f in tx5)
 
 
+def test_tx005_exempts_refusals_under_pytest_raises(tmp_path):
+    """A factory call inside `with pytest.raises(...)` is the refusal
+    under test — it never traces, so it neither fires nor counts toward
+    the suite-wide threshold (ISSUE 20's int8+compute_dtype refusal)."""
+    one_site = (
+        "from esr_tpu.analysis import checked_jit\n\n"
+        "def test_{n}():\n"
+        "    checked_jit(lambda x: x)\n"
+    )
+    refusal = (
+        "import pytest\n"
+        "from esr_tpu.analysis import checked_jit\n\n"
+        "def test_refused():\n"
+        "    with pytest.raises(ValueError):\n"
+        "        checked_jit(lambda x: x)\n"
+    )
+    files = {f"test_{n}.py": one_site.format(n=n) for n in "ab"}
+    files["test_c.py"] = refusal
+    # 2 real sites + 1 refusal: the refusal does not tip the threshold
+    assert _rules_fired(_audit(tmp_path, **files)) == []
+    files["test_d.py"] = one_site.format(n="d")
+    # 3 real sites: those fire, the refusal still does not
+    tx5 = [f for f in _audit(tmp_path, **files).findings
+           if f.rule == "TX005"]
+    assert len(tx5) == 3
+    assert not any(f.path.endswith("test_c.py") for f in tx5)
+    assert all("3 test-body trace sites" in f.message for f in tx5)
+
+
 def test_tx006_groups_by_resolved_signature(tmp_path):
     site = (
         "from esr_tpu.data.synthetic import write_synthetic_h5\n\n"
